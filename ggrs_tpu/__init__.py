@@ -1,0 +1,33 @@
+"""ggrs_tpu — a TPU-native rollback-networking framework.
+
+A brand-new implementation of GGPO-style peer-to-peer rollback netcode with
+the capabilities of the reference library GGRS (caspark/ggrs), re-designed
+for JAX/XLA on TPU: game state lives on HBM as a pytree ring buffer, the
+rollback replay runs as a jit-compiled ``lax.scan``, speculative input
+predictions fan out as a vmap'd branch batch, and many independent sessions
+batch across chips via ``shard_map`` — while peer-to-peer UDP networking
+stays on the host behind the same ordered Save/Load/Advance command-list
+boundary as the reference.
+"""
+
+from .core import *  # noqa: F401,F403
+from .core import __all__ as _core_all
+from .net import (
+    FakeSocket,
+    InMemoryNetwork,
+    Message,
+    NetworkStats,
+    NonBlockingSocket,
+    UdpNonBlockingSocket,
+)
+
+__version__ = "0.1.0"
+
+__all__ = list(_core_all) + [
+    "FakeSocket",
+    "InMemoryNetwork",
+    "Message",
+    "NetworkStats",
+    "NonBlockingSocket",
+    "UdpNonBlockingSocket",
+]

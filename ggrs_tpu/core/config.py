@@ -1,0 +1,149 @@
+"""Session parameterization: the analog of the reference's ``Config`` trait.
+
+The reference bundles four generics — Input, InputPredictor, State, Address —
+into one compile-time trait (/root/reference/src/lib.rs:244-262).  Python has
+no compile-time generics, so ``Config`` is a frozen dataclass carrying the
+*behavioral* pieces: how to construct the default ("blank") input, how to
+(de)serialize inputs for the wire, how to compare them, and how to predict the
+next input (the fork's pluggable ``InputPredictor``, lib.rs:374-406).
+
+For the TPU device path, jit-static knobs (num_players, max_prediction, the
+state treedef) must be hashable/frozen — which a frozen dataclass gives us.
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass, field
+from typing import Any, Callable, Generic, Optional, TypeVar
+
+I = TypeVar("I")
+
+
+class InputPredictor(Generic[I]):
+    """Strategy for predicting the next input from the previous one
+    (reference fork delta #1: src/lib.rs:374-406).
+
+    When no previous input exists the session uses the default input without
+    consulting the predictor (reference: src/input_queue.rs:144-148)."""
+
+    def predict(self, previous: I) -> I:
+        raise NotImplementedError
+
+
+class PredictRepeatLast(InputPredictor[I]):
+    """Predicts the next input is identical to the last received input
+    (reference: src/lib.rs:388-393).  Good default for held-button inputs."""
+
+    def predict(self, previous: I) -> I:
+        return previous
+
+
+class PredictDefault(InputPredictor[I]):
+    """Always predicts the default input (reference: src/lib.rs:401-406).
+    Suited to transition-style (edge-triggered) inputs."""
+
+    def __init__(self, default_factory: Optional[Callable[[], I]] = None) -> None:
+        self._default_factory = default_factory
+
+    def predict(self, previous: I) -> I:
+        if self._default_factory is None:
+            raise ValueError(
+                "PredictDefault has no default factory; Config binds one at "
+                "construction — construct the predictor via Config(...) or pass "
+                "default_factory explicitly"
+            )
+        return self._default_factory()
+
+
+class PredictCustom(InputPredictor[I]):
+    """Wraps a user callable ``previous -> next`` as a predictor."""
+
+    def __init__(self, fn: Callable[[I], I]) -> None:
+        self._fn = fn
+
+    def predict(self, previous: I) -> I:
+        return self._fn(previous)
+
+
+@dataclass(frozen=True)
+class Config:
+    """Bundles the session's type behavior (reference: src/lib.rs:244-262).
+
+    input_default  — zero-arg factory for the "no input" value (used for blank
+                     inputs and for disconnected players).
+    input_encode   — input -> bytes, the only game data that crosses the wire.
+    input_decode   — bytes -> input; must tolerate any input that encode can
+                     produce.  Variable-length encodings are fully supported
+                     (fork delta #2: serde-based inputs, CHANGELOG.md:7-11).
+    input_eq       — equality used for misprediction detection; defaults to ==.
+    predictor      — InputPredictor strategy, default repeat-last.
+    """
+
+    input_default: Callable[[], Any]
+    input_encode: Callable[[Any], bytes]
+    input_decode: Callable[[bytes], Any]
+    input_eq: Callable[[Any, Any], bool] = field(default=lambda a, b: a == b)
+    predictor: InputPredictor = field(default_factory=PredictRepeatLast)
+
+    def __post_init__(self) -> None:
+        # A bare PredictDefault() needs the config's own notion of "default
+        # input" — bind it here so predictions have the right shape for any
+        # input type (tuple, bytes, int, ...).
+        if (
+            isinstance(self.predictor, PredictDefault)
+            and self.predictor._default_factory is None
+        ):
+            object.__setattr__(
+                self, "predictor", PredictDefault(self.input_default)
+            )
+
+    # ---------------------------------------------------------------
+    # Convenience constructors for common input shapes
+    # ---------------------------------------------------------------
+
+    @staticmethod
+    def for_uint(bits: int = 32, predictor: Optional[InputPredictor] = None) -> "Config":
+        """Input is a non-negative int packed little-endian into bits//8 bytes."""
+        if bits not in (8, 16, 32, 64):
+            raise ValueError("bits must be one of 8, 16, 32, 64")
+        fmt = {8: "<B", 16: "<H", 32: "<I", 64: "<Q"}[bits]
+        return Config(
+            input_default=lambda: 0,
+            input_encode=lambda v: struct.pack(fmt, v),
+            input_decode=lambda b: struct.unpack(fmt, b)[0],
+            predictor=predictor if predictor is not None else PredictRepeatLast(),
+        )
+
+    @staticmethod
+    def for_bytes(predictor: Optional[InputPredictor] = None) -> "Config":
+        """Input is a raw ``bytes`` object (variable length allowed)."""
+        return Config(
+            input_default=lambda: b"",
+            input_encode=lambda v: bytes(v),
+            input_decode=lambda b: bytes(b),
+            predictor=predictor if predictor is not None else PredictRepeatLast(),
+        )
+
+    @staticmethod
+    def for_struct(fmt: str, predictor: Optional[InputPredictor] = None) -> "Config":
+        """Input is a tuple packed with ``struct`` format ``fmt``."""
+        size = struct.calcsize(fmt)
+        nfields = len(struct.unpack(fmt, b"\x00" * size))
+
+        def _default() -> tuple:
+            return struct.unpack(fmt, b"\x00" * size)
+
+        def _encode(v: tuple) -> bytes:
+            return struct.pack(fmt, *v)
+
+        def _decode(b: bytes) -> tuple:
+            return struct.unpack(fmt, b)
+
+        del nfields
+        return Config(
+            input_default=_default,
+            input_encode=_encode,
+            input_decode=_decode,
+            predictor=predictor if predictor is not None else PredictRepeatLast(),
+        )

@@ -1,0 +1,39 @@
+"""Frame primitives: a saved game state and a single-player single-frame input
+(reference: /root/reference/src/frame_info.rs)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Generic, Optional, TypeVar
+
+from .types import Frame, NULL_FRAME
+
+I = TypeVar("I")
+S = TypeVar("S")
+
+
+@dataclass
+class GameState(Generic[S]):
+    """A user game state for a single frame plus an optional checksum
+    (reference: frame_info.rs:6-23).  ``data`` may be None — users may keep the
+    real state elsewhere and only use the frame/checksum bookkeeping."""
+
+    frame: Frame = NULL_FRAME
+    data: Optional[S] = None
+    checksum: Optional[int] = None
+
+
+@dataclass
+class PlayerInput(Generic[I]):
+    """An input for one player at one frame (reference: frame_info.rs:27-52)."""
+
+    frame: Frame
+    input: I
+
+    @staticmethod
+    def blank(frame: Frame, default_factory: Callable[[], I]) -> "PlayerInput[I]":
+        return PlayerInput(frame, default_factory())
+
+    def equal(self, other: "PlayerInput[I]", input_only: bool,
+              eq: Callable[[Any, Any], bool] = lambda a, b: a == b) -> bool:
+        return (input_only or self.frame == other.frame) and eq(self.input, other.input)

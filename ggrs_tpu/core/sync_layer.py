@@ -1,0 +1,246 @@
+"""The rollback core: state ring, per-player input queues, confirmed-frame
+bookkeeping (reference: /root/reference/src/sync_layer.rs).
+
+``GameStateCell`` is the host-side handle handed to the user inside
+Save/Load requests.  On the TPU path (ggrs_tpu.ops / ggrs_tpu.parallel) the
+cell's ``data`` is a device-array pytree and never leaves HBM during replay —
+save/load degenerate to ring-index bookkeeping; only checksums (scalars) cross
+to the host.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Generic, List, Optional, Sequence, Tuple, TypeVar
+
+from .config import Config
+from .frame_info import GameState, PlayerInput
+from .input_queue import InputQueue
+from .types import (
+    Frame,
+    InputStatus,
+    LoadGameState,
+    NULL_FRAME,
+    PlayerHandle,
+    SaveGameState,
+)
+
+I = TypeVar("I")
+S = TypeVar("S")
+
+
+class GameStateCell(Generic[S]):
+    """A shared, lock-protected slot holding one saved game state
+    (reference: sync_layer.rs:14-111).
+
+    Unlike the reference's clone-on-load, ``load()`` returns the stored object
+    directly; ``data()`` makes the no-clone access explicit for parity with the
+    fork's ``GameStateAccessor`` (fork delta #5, sync_layer.rs:62-70).  Users
+    who mutate their state in place should save copies (or device arrays,
+    which are immutable by construction)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._state: GameState[S] = GameState()
+
+    def save(self, frame: Frame, data: Optional[S], checksum: Optional[int]) -> None:
+        assert frame != NULL_FRAME
+        with self._lock:
+            self._state.frame = frame
+            self._state.data = data
+            self._state.checksum = checksum
+
+    def load(self) -> Optional[S]:
+        with self._lock:
+            return self._state.data
+
+    # Direct access without copying; do not mutate the result in any way that
+    # affects game logic (reference: sync_layer.rs:130-142).  Same body as
+    # load() here since Python never clones — kept as a distinct name for
+    # parity with the reference's no-clone accessor.
+    data = load
+
+    @property
+    def frame(self) -> Frame:
+        with self._lock:
+            return self._state.frame
+
+    @property
+    def checksum(self) -> Optional[int]:
+        with self._lock:
+            return self._state.checksum
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"GameStateCell(frame={self.frame}, checksum={self.checksum})"
+
+
+class SavedStates(Generic[S]):
+    """Ring of ``max_prediction + 1`` cells indexed by ``frame % len`` —
+    enough to roll back to the oldest frame even at full prediction depth
+    (reference: sync_layer.rs:144-166)."""
+
+    def __init__(self, max_prediction: int) -> None:
+        self.cells: List[GameStateCell[S]] = [
+            GameStateCell() for _ in range(max_prediction + 1)
+        ]
+
+    def get_cell(self, frame: Frame) -> GameStateCell[S]:
+        assert frame >= 0
+        return self.cells[frame % len(self.cells)]
+
+
+class SyncLayer(Generic[I, S]):
+    """Owns the state ring and input queues; emits Save/Load requests and
+    merges per-player inputs (reference: sync_layer.rs:168-375)."""
+
+    def __init__(self, config: Config, num_players: int, max_prediction: int) -> None:
+        self._config = config
+        self.num_players = num_players
+        self.max_prediction = max_prediction
+        self.saved_states: SavedStates[S] = SavedStates(max_prediction)
+        self._last_confirmed_frame: Frame = NULL_FRAME
+        self._last_saved_frame: Frame = NULL_FRAME
+        self._current_frame: Frame = 0
+        self.input_queues: List[InputQueue[I]] = [
+            InputQueue(config) for _ in range(num_players)
+        ]
+
+    # ------------------------------------------------------------------
+    # frame counters
+    # ------------------------------------------------------------------
+
+    @property
+    def current_frame(self) -> Frame:
+        return self._current_frame
+
+    @property
+    def last_saved_frame(self) -> Frame:
+        return self._last_saved_frame
+
+    @property
+    def last_confirmed_frame(self) -> Frame:
+        return self._last_confirmed_frame
+
+    def advance_frame(self) -> None:
+        self._current_frame += 1
+
+    # ------------------------------------------------------------------
+    # save / load
+    # ------------------------------------------------------------------
+
+    def save_current_state(self) -> SaveGameState:
+        self._last_saved_frame = self._current_frame
+        cell = self.saved_states.get_cell(self._current_frame)
+        return SaveGameState(cell=cell, frame=self._current_frame)
+
+    def load_frame(self, frame_to_load: Frame) -> LoadGameState:
+        """Rewind to a past frame within the prediction window
+        (reference: sync_layer.rs:229-255)."""
+        assert frame_to_load != NULL_FRAME, "cannot load null frame"
+        assert frame_to_load < self._current_frame, (
+            f"must load frame in the past (frame to load is {frame_to_load}, "
+            f"current frame is {self._current_frame})"
+        )
+        assert frame_to_load >= self._current_frame - self.max_prediction, (
+            "cannot load frame outside of prediction window; "
+            f"(frame to load is {frame_to_load}, current frame is "
+            f"{self._current_frame}, max prediction is {self.max_prediction})"
+        )
+
+        cell = self.saved_states.get_cell(frame_to_load)
+        assert cell.frame == frame_to_load
+        self._current_frame = frame_to_load
+        return LoadGameState(cell=cell, frame=frame_to_load)
+
+    def saved_state_by_frame(self, frame: Frame) -> Optional[GameStateCell[S]]:
+        cell = self.saved_states.get_cell(frame)
+        return cell if cell.frame == frame else None
+
+    # ------------------------------------------------------------------
+    # inputs
+    # ------------------------------------------------------------------
+
+    def set_frame_delay(self, player_handle: PlayerHandle, delay: int) -> None:
+        assert player_handle < self.num_players
+        self.input_queues[player_handle].set_frame_delay(delay)
+
+    def reset_prediction(self) -> None:
+        for q in self.input_queues:
+            q.reset_prediction()
+
+    def add_local_input(
+        self, player_handle: PlayerHandle, input: PlayerInput[I]
+    ) -> Frame:
+        assert input.frame == self._current_frame
+        return self.input_queues[player_handle].add_input(input)
+
+    def add_remote_input(
+        self, player_handle: PlayerHandle, input: PlayerInput[I]
+    ) -> None:
+        self.input_queues[player_handle].add_input(input)
+
+    def synchronized_inputs(
+        self, connect_status: Sequence
+    ) -> List[Tuple[I, InputStatus]]:
+        """Inputs for all players at the current frame; predictions where
+        confirmed input hasn't arrived; dummies for disconnected players
+        (reference: sync_layer.rs:280-293)."""
+        inputs: List[Tuple[I, InputStatus]] = []
+        for i, status in enumerate(connect_status):
+            if status.disconnected and status.last_frame < self._current_frame:
+                inputs.append((self._config.input_default(), InputStatus.DISCONNECTED))
+            else:
+                inputs.append(self.input_queues[i].input(self._current_frame))
+        return inputs
+
+    def confirmed_inputs(
+        self, frame: Frame, connect_status: Sequence
+    ) -> List[PlayerInput[I]]:
+        """Confirmed inputs for all players at ``frame``; blanks for
+        disconnected players (reference: sync_layer.rs:296-310)."""
+        inputs: List[PlayerInput[I]] = []
+        for i, status in enumerate(connect_status):
+            if status.disconnected and status.last_frame < frame:
+                inputs.append(PlayerInput.blank(NULL_FRAME, self._config.input_default))
+            else:
+                inputs.append(self.input_queues[i].confirmed_input(frame))
+        return inputs
+
+    # ------------------------------------------------------------------
+    # confirmation / consistency
+    # ------------------------------------------------------------------
+
+    def set_last_confirmed_frame(self, frame: Frame, sparse_saving: bool) -> None:
+        """Raise the confirmed-frame watermark and discard older inputs
+        (reference: sync_layer.rs:313-340)."""
+        first_incorrect: Frame = NULL_FRAME
+        for q in self.input_queues:
+            first_incorrect = max(first_incorrect, q.first_incorrect_frame)
+
+        # With sparse saving, never confirm past the last save — otherwise the
+        # rollback target would have been discarded.
+        if sparse_saving:
+            frame = min(frame, self._last_saved_frame)
+
+        # never delete anything ahead of the current frame
+        frame = min(frame, self._current_frame)
+
+        # Confirming past the first incorrect frame would discard inputs still
+        # needed for the pending rollback.
+        assert first_incorrect == NULL_FRAME or first_incorrect >= frame
+
+        self._last_confirmed_frame = frame
+        if self._last_confirmed_frame > 0:
+            for q in self.input_queues:
+                q.discard_confirmed_frames(frame - 1)
+
+    def check_simulation_consistency(self, first_incorrect: Frame) -> Frame:
+        """Earliest incorrect frame across all input queues
+        (reference: sync_layer.rs:343-353)."""
+        for q in self.input_queues:
+            incorrect = q.first_incorrect_frame
+            if incorrect != NULL_FRAME and (
+                first_incorrect == NULL_FRAME or incorrect < first_incorrect
+            ):
+                first_incorrect = incorrect
+        return first_incorrect

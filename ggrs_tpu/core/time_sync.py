@@ -1,0 +1,26 @@
+"""Frame-advantage averaging for time synchronization between peers
+(reference: /root/reference/src/time_sync.rs)."""
+
+from __future__ import annotations
+
+from .types import Frame
+
+# Sliding window length in frames (reference: time_sync.rs:3).
+FRAME_WINDOW_SIZE = 30
+
+
+class TimeSync:
+    def __init__(self) -> None:
+        self._local = [0] * FRAME_WINDOW_SIZE
+        self._remote = [0] * FRAME_WINDOW_SIZE
+
+    def advance_frame(self, frame: Frame, local_adv: int, remote_adv: int) -> None:
+        self._local[frame % FRAME_WINDOW_SIZE] = local_adv
+        self._remote[frame % FRAME_WINDOW_SIZE] = remote_adv
+
+    def average_frame_advantage(self) -> int:
+        """Average both windows and meet in the middle
+        (reference: time_sync.rs:30-39)."""
+        local_avg = sum(self._local) / FRAME_WINDOW_SIZE
+        remote_avg = sum(self._remote) / FRAME_WINDOW_SIZE
+        return int((remote_avg - local_avg) / 2.0)

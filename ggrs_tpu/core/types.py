@@ -1,0 +1,179 @@
+"""Core vocabulary of the framework: frames, players, statuses, requests, events.
+
+Reproduces the public type surface of the reference library (see
+/root/reference/src/lib.rs:44-195) as idiomatic Python dataclasses/enums.  The
+command-list contract is identical: sessions hand back an ordered list of
+requests (save / load / advance) which the user fulfills verbatim.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Generic, Hashable, List, Optional, Tuple, TypeVar
+
+# A frame is a single step of execution (reference: src/lib.rs:47-51).
+Frame = int
+NULL_FRAME: Frame = -1
+PlayerHandle = int
+
+I = TypeVar("I")  # input type
+S = TypeVar("S")  # state type
+A = TypeVar("A", bound=Hashable)  # address type
+
+
+class InputStatus(enum.Enum):
+    """Given together with each player input when asked to advance a frame
+    (reference: src/lib.rs:104-113)."""
+
+    CONFIRMED = "confirmed"
+    PREDICTED = "predicted"
+    DISCONNECTED = "disconnected"
+
+
+class SessionState(enum.Enum):
+    """Session lifecycle state (reference: src/lib.rs:93-102).  This fork of the
+    reference never actually produces SYNCHRONIZING (handshake removed); the
+    variant is kept for API parity."""
+
+    SYNCHRONIZING = "synchronizing"
+    RUNNING = "running"
+
+
+@dataclass(frozen=True)
+class DesyncDetection:
+    """Desync detection by comparing checksums between peers
+    (reference: src/lib.rs:57-67)."""
+
+    enabled: bool = False
+    interval: int = 0
+
+    @staticmethod
+    def off() -> "DesyncDetection":
+        return DesyncDetection(False, 0)
+
+    @staticmethod
+    def on(interval: int) -> "DesyncDetection":
+        if interval <= 0:
+            raise ValueError("desync detection interval must be positive")
+        return DesyncDetection(True, interval)
+
+
+# ---------------------------------------------------------------------------
+# Player taxonomy (reference: src/lib.rs:69-91)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Local:
+    """This player plays on the local device."""
+
+
+@dataclass(frozen=True)
+class Remote(Generic[A]):
+    """This player plays on a remote device identified by the address."""
+
+    addr: A
+
+
+@dataclass(frozen=True)
+class Spectator(Generic[A]):
+    """A remote device that observes but does not contribute input."""
+
+    addr: A
+
+
+PlayerType = Local | Remote | Spectator
+
+
+# ---------------------------------------------------------------------------
+# Requests (reference: src/lib.rs:170-195)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class SaveGameState:
+    """Save the current gamestate into ``cell``; ``frame`` is a sanity check."""
+
+    cell: Any  # GameStateCell; typed loosely to avoid an import cycle
+    frame: Frame
+
+
+@dataclass
+class LoadGameState:
+    """Load the gamestate in ``cell``; ``frame`` is a sanity check."""
+
+    cell: Any
+    frame: Frame
+
+
+@dataclass
+class AdvanceFrame(Generic[I]):
+    """Advance the gamestate with the given per-player ``(input, status)`` pairs.
+
+    Disconnected players get default inputs with DISCONNECTED status."""
+
+    inputs: List[Tuple[I, InputStatus]]
+
+
+GgrsRequest = SaveGameState | LoadGameState | AdvanceFrame
+
+
+# ---------------------------------------------------------------------------
+# Events (reference: src/lib.rs:115-168)
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Synchronizing(Generic[A]):
+    """Kept for API parity: this fork's protocol starts Running and never emits
+    synchronization progress (reference fork delta: protocol.rs:117-121)."""
+
+    addr: A
+    total: int
+    count: int
+
+
+@dataclass(frozen=True)
+class Synchronized(Generic[A]):
+    addr: A
+
+
+@dataclass(frozen=True)
+class Disconnected(Generic[A]):
+    addr: A
+
+
+@dataclass(frozen=True)
+class NetworkInterrupted(Generic[A]):
+    addr: A
+    disconnect_timeout: int  # ms until the remote is disconnected
+
+
+@dataclass(frozen=True)
+class NetworkResumed(Generic[A]):
+    addr: A
+
+
+@dataclass(frozen=True)
+class WaitRecommendation:
+    skip_frames: int
+
+
+@dataclass(frozen=True)
+class DesyncDetected(Generic[A]):
+    frame: Frame
+    local_checksum: int
+    remote_checksum: int
+    addr: A
+
+
+GgrsEvent = (
+    Synchronizing
+    | Synchronized
+    | Disconnected
+    | NetworkInterrupted
+    | NetworkResumed
+    | WaitRecommendation
+    | DesyncDetected
+)

@@ -1,0 +1,174 @@
+"""Wire message vocabulary (reference: /root/reference/src/network/messages.rs).
+
+Message = header {magic: u16} + body, where body is one of Input / InputAck /
+QualityReport / QualityReply / ChecksumReport / KeepAlive.  As in the
+reference fork, the magic is carried but not verified on receive — routing is
+purely by source address (reference: p2p_session.rs:433-440); it is kept for
+wire-format parity and debugging.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Union
+
+from ..core.types import Frame, NULL_FRAME
+from .wire import Reader, WireError, Writer
+
+
+@dataclass
+class ConnectionStatus:
+    """Per-player connection knowledge piggybacked on every Input message
+    (reference: messages.rs:5-18)."""
+
+    disconnected: bool = False
+    last_frame: Frame = NULL_FRAME
+
+
+@dataclass
+class InputMessage:
+    """Redundant batch of all unacked inputs, delta+RLE compressed
+    (reference: messages.rs:20-39)."""
+
+    peer_connect_status: List[ConnectionStatus] = field(default_factory=list)
+    disconnect_requested: bool = False
+    start_frame: Frame = NULL_FRAME
+    ack_frame: Frame = NULL_FRAME
+    bytes: bytes = b""
+
+
+@dataclass
+class InputAck:
+    ack_frame: Frame = NULL_FRAME
+
+
+@dataclass
+class QualityReport:
+    """frame_advantage is i16, not i8: long pauses (debugger, background tab)
+    can push it past +/-127 at common FPS (reference rationale:
+    messages.rs:77-93).  ``ping`` is a millisecond timestamp echoed back."""
+
+    frame_advantage: int = 0
+    ping: int = 0
+
+
+@dataclass
+class QualityReply:
+    pong: int = 0
+
+
+@dataclass
+class ChecksumReport:
+    checksum: int = 0
+    frame: Frame = NULL_FRAME
+
+
+@dataclass
+class KeepAlive:
+    pass
+
+
+MessageBody = Union[
+    InputMessage, InputAck, QualityReport, QualityReply, ChecksumReport, KeepAlive
+]
+
+_TAG_INPUT = 0
+_TAG_INPUT_ACK = 1
+_TAG_QUALITY_REPORT = 2
+_TAG_QUALITY_REPLY = 3
+_TAG_CHECKSUM_REPORT = 4
+_TAG_KEEP_ALIVE = 5
+
+# Bound player count on decode so a malicious length prefix can't allocate
+# unbounded memory.
+_MAX_PLAYERS_ON_WIRE = 64
+
+
+@dataclass
+class Message:
+    """The unit a NonBlockingSocket sends/receives."""
+
+    magic: int
+    body: MessageBody
+
+    def encode(self) -> bytes:
+        # Memoized: the protocol encodes once for byte accounting and the
+        # socket encodes again on send.  Messages must not be mutated after
+        # the first encode.
+        cached = self.__dict__.get("_encoded")
+        if cached is not None:
+            return cached
+        w = Writer()
+        w.u16(self.magic)
+        b = self.body
+        if isinstance(b, InputMessage):
+            w.u8(_TAG_INPUT)
+            w.uvarint(len(b.peer_connect_status))
+            for cs in b.peer_connect_status:
+                w.bool(cs.disconnected)
+                w.svarint(cs.last_frame)
+            w.bool(b.disconnect_requested)
+            w.svarint(b.start_frame)
+            w.svarint(b.ack_frame)
+            w.bytes(b.bytes)
+        elif isinstance(b, InputAck):
+            w.u8(_TAG_INPUT_ACK)
+            w.svarint(b.ack_frame)
+        elif isinstance(b, QualityReport):
+            w.u8(_TAG_QUALITY_REPORT)
+            w.i16(b.frame_advantage)
+            w.u64(b.ping)
+        elif isinstance(b, QualityReply):
+            w.u8(_TAG_QUALITY_REPLY)
+            w.u64(b.pong)
+        elif isinstance(b, ChecksumReport):
+            w.u8(_TAG_CHECKSUM_REPORT)
+            w.svarint(b.frame)
+            w.u128(b.checksum)
+        elif isinstance(b, KeepAlive):
+            w.u8(_TAG_KEEP_ALIVE)
+        else:  # pragma: no cover
+            raise TypeError(f"unknown message body {type(b)}")
+        out = w.finish()
+        self.__dict__["_encoded"] = out
+        return out
+
+    @staticmethod
+    def decode(data: bytes) -> "Message":
+        """Decode a datagram; raises WireError on malformed data (callers drop
+        undecodable packets, reference: udp_socket.rs:70-72)."""
+        r = Reader(data)
+        magic = r.u16()
+        tag = r.u8()
+        body: MessageBody
+        if tag == _TAG_INPUT:
+            n = r.uvarint()
+            if n > _MAX_PLAYERS_ON_WIRE:
+                raise WireError("too many connect statuses")
+            statuses = [
+                ConnectionStatus(disconnected=r.bool(), last_frame=r.svarint())
+                for _ in range(n)
+            ]
+            body = InputMessage(
+                peer_connect_status=statuses,
+                disconnect_requested=r.bool(),
+                start_frame=r.svarint(),
+                ack_frame=r.svarint(),
+                bytes=r.bytes(),
+            )
+        elif tag == _TAG_INPUT_ACK:
+            body = InputAck(ack_frame=r.svarint())
+        elif tag == _TAG_QUALITY_REPORT:
+            body = QualityReport(frame_advantage=r.i16(), ping=r.u64())
+        elif tag == _TAG_QUALITY_REPLY:
+            body = QualityReply(pong=r.u64())
+        elif tag == _TAG_CHECKSUM_REPORT:
+            frame = r.svarint()
+            checksum = r.u128()
+            body = ChecksumReport(checksum=checksum, frame=frame)
+        elif tag == _TAG_KEEP_ALIVE:
+            body = KeepAlive()
+        else:
+            raise WireError(f"unknown message tag {tag}")
+        r.expect_end()
+        return Message(magic=magic, body=body)

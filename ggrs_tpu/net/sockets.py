@@ -1,0 +1,165 @@
+"""Transport layer: the pluggable non-blocking socket boundary.
+
+The trait boundary is identical to the reference (`NonBlockingSocket`,
+/root/reference/src/lib.rs:264-279): unreliable, unordered, UDP-like
+datagrams; the endpoint protocol above it provides redundancy and acks.
+Besides the real UDP socket we ship an in-memory fault-injecting network —
+deterministic loss/duplication/reordering/latency — which the reference
+lacks but its trait design makes trivial.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import socket as _socket
+from collections import deque
+from typing import Deque, Dict, Hashable, List, Protocol, Tuple, TypeVar
+
+from .messages import Message
+from .wire import WireError
+
+logger = logging.getLogger(__name__)
+
+A = TypeVar("A", bound=Hashable)
+
+RECV_BUFFER_SIZE = 4096
+# Packets larger than this risk IP fragmentation (reference: udp_socket.rs:14).
+IDEAL_MAX_UDP_PACKET_SIZE = 508
+
+
+class NonBlockingSocket(Protocol[A]):
+    """Send one message; receive everything that arrived since last poll."""
+
+    def send_to(self, msg: Message, addr: A) -> None: ...
+
+    def receive_all_messages(self) -> List[Tuple[A, Message]]: ...
+
+
+class UdpNonBlockingSocket:
+    """Non-blocking UDP socket bound to 0.0.0.0:port
+    (reference: udp_socket.rs:16-83).  Addresses are ``(host, port)`` tuples."""
+
+    def __init__(self, port: int) -> None:
+        self._sock = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+        self._sock.bind(("0.0.0.0", port))
+        self._sock.setblocking(False)
+
+    @staticmethod
+    def bind_to_port(port: int) -> "UdpNonBlockingSocket":
+        return UdpNonBlockingSocket(port)
+
+    def send_to(self, msg: Message, addr: Tuple[str, int]) -> None:
+        buf = msg.encode()
+        if len(buf) > IDEAL_MAX_UDP_PACKET_SIZE:
+            # Occasional large packets usually get through; persistent ones
+            # mean the input struct is too big.  Warn, don't fail.
+            logger.warning(
+                "Sending UDP packet of size %d bytes, larger than ideal (%d)",
+                len(buf),
+                IDEAL_MAX_UDP_PACKET_SIZE,
+            )
+        self._sock.sendto(buf, addr)
+
+    def receive_all_messages(self) -> List[Tuple[Tuple[str, int], Message]]:
+        received: List[Tuple[Tuple[str, int], Message]] = []
+        while True:
+            try:
+                data, src = self._sock.recvfrom(RECV_BUFFER_SIZE)
+            except BlockingIOError:
+                return received
+            except ConnectionResetError:
+                # datagram sockets surface this after send_to on some OSes
+                continue
+            try:
+                received.append((src, Message.decode(data)))
+            except WireError:
+                # drop undecodable packets (reference: udp_socket.rs:70-72)
+                continue
+
+    def close(self) -> None:
+        self._sock.close()
+
+    def __del__(self) -> None:  # pragma: no cover
+        try:
+            self._sock.close()
+        except Exception:
+            pass
+
+
+class InMemoryNetwork:
+    """A hub connecting FakeSockets by address, with deterministic fault
+    injection: drop probability, duplication, reordering, and fixed latency in
+    delivery ticks.  Improvement over the reference's test setup (real
+    loopback UDP only)."""
+
+    def __init__(
+        self,
+        seed: int = 0,
+        loss: float = 0.0,
+        duplicate: float = 0.0,
+        reorder: float = 0.0,
+        latency_ticks: int = 0,
+    ) -> None:
+        self._rng = random.Random(seed)
+        self.loss = loss
+        self.duplicate = duplicate
+        self.reorder = reorder
+        self.latency_ticks = latency_ticks
+        # address -> deque of (deliver_at_tick, from_addr, encoded_bytes)
+        self._queues: Dict[Hashable, Deque[Tuple[int, Hashable, bytes]]] = {}
+        self._tick = 0
+
+    def socket(self, addr: Hashable) -> "FakeSocket":
+        self._queues.setdefault(addr, deque())
+        return FakeSocket(self, addr)
+
+    def tick(self) -> None:
+        """Advance simulated time by one delivery tick."""
+        self._tick += 1
+
+    def _send(self, from_addr: Hashable, to_addr: Hashable, msg: Message) -> None:
+        if to_addr not in self._queues:
+            return  # unroutable: dropped silently, like real UDP
+        if self._rng.random() < self.loss:
+            return
+        payload = msg.encode()  # serialize: real sockets don't share references
+        deliver_at = self._tick + self.latency_ticks
+        q = self._queues[to_addr]
+        q.append((deliver_at, from_addr, payload))
+        if self._rng.random() < self.duplicate:
+            q.append((deliver_at, from_addr, payload))
+        if len(q) >= 2 and self._rng.random() < self.reorder:
+            q[-1], q[-2] = q[-2], q[-1]
+
+    def _receive(self, addr: Hashable) -> List[Tuple[Hashable, Message]]:
+        q = self._queues.get(addr)
+        out: List[Tuple[Hashable, Message]] = []
+        if not q:
+            return out
+        remaining: Deque[Tuple[int, Hashable, bytes]] = deque()
+        while q:
+            deliver_at, from_addr, payload = q.popleft()
+            if deliver_at > self._tick:
+                remaining.append((deliver_at, from_addr, payload))
+                continue
+            try:
+                out.append((from_addr, Message.decode(payload)))
+            except WireError:
+                continue
+        self._queues[addr] = remaining
+        return out
+
+
+class FakeSocket:
+    """A NonBlockingSocket attached to an InMemoryNetwork."""
+
+    def __init__(self, network: InMemoryNetwork, addr: Hashable) -> None:
+        self._network = network
+        self.addr = addr
+
+    def send_to(self, msg: Message, addr: Hashable) -> None:
+        self._network._send(self.addr, addr, msg)
+
+    def receive_all_messages(self) -> List[Tuple[Hashable, Message]]:
+        return self._network._receive(self.addr)

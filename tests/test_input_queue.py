@@ -1,0 +1,102 @@
+"""InputQueue unit tests, parity oracles from the reference
+(/root/reference/src/input_queue.rs:272-354)."""
+
+from ggrs_tpu.core import Config, InputQueue, InputStatus, NULL_FRAME, PlayerInput
+
+
+def make_queue() -> InputQueue:
+    return InputQueue(Config.for_uint(8))
+
+
+def test_add_input_wrong_frame():
+    q = make_queue()
+    assert q.add_input(PlayerInput(0, 0)) == 0
+    assert q.add_input(PlayerInput(3, 0)) == NULL_FRAME  # non-sequential: dropped
+
+
+def test_add_input_twice():
+    q = make_queue()
+    assert q.add_input(PlayerInput(0, 0)) == 0
+    assert q.add_input(PlayerInput(0, 0)) == NULL_FRAME  # duplicate: dropped
+
+
+def test_add_input_sequentially():
+    q = make_queue()
+    for i in range(10):
+        q.add_input(PlayerInput(i, 0))
+        assert q.last_added_frame == i
+        assert q.length == i + 1
+
+
+def test_input_sequentially():
+    q = make_queue()
+    for i in range(10):
+        q.add_input(PlayerInput(i, i))
+        assert q.last_added_frame == i
+        assert q.length == i + 1
+        value, status = q.input(i)
+        assert value == i
+        assert status == InputStatus.CONFIRMED
+
+
+def test_delayed_inputs():
+    q = make_queue()
+    delay = 2
+    q.set_frame_delay(delay)
+    for i in range(10):
+        q.add_input(PlayerInput(i, i))
+        assert q.last_added_frame == i + delay
+        assert q.length == i + delay + 1
+        value, _status = q.input(i)
+        assert value == max(0, i - delay)
+
+
+def test_prediction_repeat_last():
+    q = make_queue()
+    q.add_input(PlayerInput(0, 7))
+    # frame 1 not confirmed yet: predict repeat-last
+    value, status = q.input(1)
+    assert value == 7
+    assert status == InputStatus.PREDICTED
+    # confirm with a matching input: no misprediction recorded
+    q.add_input(PlayerInput(1, 7))
+    assert q.first_incorrect_frame == NULL_FRAME
+
+
+def test_prediction_mismatch_recorded():
+    q = make_queue()
+    q.add_input(PlayerInput(0, 7))
+    value, status = q.input(1)
+    assert (value, status) == (7, InputStatus.PREDICTED)
+    q.add_input(PlayerInput(1, 9))  # reality disagrees
+    assert q.first_incorrect_frame == 1
+    q.reset_prediction()
+    assert q.first_incorrect_frame == NULL_FRAME
+
+
+def test_prediction_without_previous_input_uses_default():
+    q = make_queue()
+    value, status = q.input(0)
+    assert value == 0  # default input
+    assert status == InputStatus.PREDICTED
+
+
+def test_discard_confirmed_frames():
+    q = make_queue()
+    for i in range(10):
+        q.add_input(PlayerInput(i, i))
+    q.input(9)
+    q.discard_confirmed_frames(5)
+    assert q.length == 5  # frames 5..9 remain
+    assert q.confirmed_input(5).input == 5
+
+
+def test_confirmed_input_missing_raises():
+    q = make_queue()
+    q.add_input(PlayerInput(0, 0))
+    try:
+        q.confirmed_input(5)
+    except AssertionError:
+        pass
+    else:
+        raise AssertionError("expected missing confirmed input to raise")

@@ -1,0 +1,75 @@
+"""Wire message round-trip and hardening tests."""
+
+import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from ggrs_tpu.net.messages import (
+    ChecksumReport,
+    ConnectionStatus,
+    InputAck,
+    InputMessage,
+    KeepAlive,
+    Message,
+    QualityReply,
+    QualityReport,
+)
+from ggrs_tpu.net.wire import WireError
+
+
+def roundtrip(msg: Message) -> Message:
+    return Message.decode(msg.encode())
+
+
+def test_keep_alive_roundtrip():
+    m = roundtrip(Message(magic=7, body=KeepAlive()))
+    assert m.magic == 7
+    assert isinstance(m.body, KeepAlive)
+
+
+def test_input_roundtrip():
+    body = InputMessage(
+        peer_connect_status=[
+            ConnectionStatus(False, 10),
+            ConnectionStatus(True, -1),
+        ],
+        disconnect_requested=False,
+        start_frame=5,
+        ack_frame=-1,
+        bytes=b"\x01\x02\x03",
+    )
+    m = roundtrip(Message(magic=0xABCD, body=body))
+    assert m.body == body
+
+
+def test_quality_roundtrip():
+    m = roundtrip(Message(magic=1, body=QualityReport(frame_advantage=-3, ping=123456)))
+    assert m.body == QualityReport(frame_advantage=-3, ping=123456)
+    m = roundtrip(Message(magic=1, body=QualityReply(pong=42)))
+    assert m.body == QualityReply(pong=42)
+
+
+def test_input_ack_roundtrip():
+    m = roundtrip(Message(magic=1, body=InputAck(ack_frame=99)))
+    assert m.body == InputAck(ack_frame=99)
+
+
+def test_checksum_report_roundtrip_u128():
+    checksum = (1 << 127) | 12345
+    m = roundtrip(Message(magic=1, body=ChecksumReport(checksum=checksum, frame=200)))
+    assert m.body == ChecksumReport(checksum=checksum, frame=200)
+
+
+@settings(max_examples=300)
+@given(data=st.binary(max_size=256))
+def test_decode_arbitrary_bytes_never_crashes(data):
+    try:
+        Message.decode(data)
+    except WireError:
+        pass
+
+
+def test_trailing_garbage_rejected():
+    buf = Message(magic=1, body=KeepAlive()).encode() + b"\x00"
+    with pytest.raises(WireError):
+        Message.decode(buf)

@@ -1,0 +1,89 @@
+"""SyncLayer unit tests, parity oracle from the reference
+(/root/reference/src/sync_layer.rs:381-436) plus save/load ring behavior."""
+
+import pytest
+
+from ggrs_tpu.core import Config, NULL_FRAME, PlayerInput, SyncLayer
+from ggrs_tpu.net.messages import ConnectionStatus
+
+
+def test_different_delays():
+    sl = SyncLayer(Config.for_uint(8), num_players=2, max_prediction=8)
+    p1_delay, p2_delay = 2, 0
+    sl.set_frame_delay(0, p1_delay)
+    sl.set_frame_delay(1, p2_delay)
+
+    status = [ConnectionStatus(), ConnectionStatus()]
+
+    for i in range(20):
+        gi = PlayerInput(i, i)
+        # add as remote to avoid prediction threshold checks
+        sl.add_remote_input(0, gi)
+        sl.add_remote_input(1, gi)
+        status[0].last_frame = i
+        status[1].last_frame = i
+
+        if i >= 3:
+            sync_inputs = sl.synchronized_inputs(status)
+            assert sync_inputs[0][0] == i - p1_delay
+            assert sync_inputs[1][0] == i - p2_delay
+
+        sl.advance_frame()
+
+
+def test_save_load_round_trip():
+    sl = SyncLayer(Config.for_uint(8), num_players=1, max_prediction=4)
+    req = sl.save_current_state()
+    assert req.frame == 0
+    req.cell.save(0, {"hp": 100}, checksum=42)
+    assert sl.last_saved_frame == 0
+
+    for _ in range(3):
+        sl.advance_frame()
+        sl.save_current_state().cell.save(sl.current_frame, {"hp": 90}, None)
+
+    load = sl.load_frame(0)
+    assert load.frame == 0
+    assert load.cell.load() == {"hp": 100}
+    assert sl.current_frame == 0
+
+
+def test_load_frame_window_asserts():
+    sl = SyncLayer(Config.for_uint(8), num_players=1, max_prediction=2)
+    for _ in range(5):
+        req = sl.save_current_state()
+        req.cell.save(req.frame, None, None)
+        sl.advance_frame()
+    with pytest.raises(AssertionError):
+        sl.load_frame(1)  # outside prediction window (current=5, max_pred=2)
+    with pytest.raises(AssertionError):
+        sl.load_frame(5)  # not in the past
+    with pytest.raises(AssertionError):
+        sl.load_frame(NULL_FRAME)
+
+
+def test_set_last_confirmed_discards_inputs():
+    sl = SyncLayer(Config.for_uint(8), num_players=1, max_prediction=8)
+    status = [ConnectionStatus()]
+    for i in range(10):
+        sl.add_remote_input(0, PlayerInput(i, i))
+        status[0].last_frame = i
+        sl.synchronized_inputs(status)
+        sl.advance_frame()
+    sl.set_last_confirmed_frame(8, sparse_saving=False)
+    assert sl.last_confirmed_frame == 8
+    # frame 7 (= 8-1) and beyond must still be fetchable
+    assert sl.input_queues[0].confirmed_input(8).input == 8
+
+
+def test_disconnected_player_gets_default_input():
+    sl = SyncLayer(Config.for_uint(8), num_players=2, max_prediction=8)
+    status = [ConnectionStatus(), ConnectionStatus(disconnected=True, last_frame=NULL_FRAME)]
+    sl.add_remote_input(0, PlayerInput(0, 5))
+    status[0].last_frame = 0
+    inputs = sl.synchronized_inputs(status)
+    assert inputs[0][0] == 5
+    assert inputs[1][0] == 0  # default
+    from ggrs_tpu.core import InputStatus
+
+    assert inputs[1][1] == InputStatus.DISCONNECTED
