@@ -20,6 +20,12 @@ from .net import (
     NonBlockingSocket,
     UdpNonBlockingSocket,
 )
+from .sessions import (
+    P2PSession,
+    SessionBuilder,
+    SpectatorSession,
+    SyncTestSession,
+)
 
 __version__ = "0.1.0"
 
@@ -29,5 +35,9 @@ __all__ = list(_core_all) + [
     "Message",
     "NetworkStats",
     "NonBlockingSocket",
+    "P2PSession",
+    "SessionBuilder",
+    "SpectatorSession",
+    "SyncTestSession",
     "UdpNonBlockingSocket",
 ]

@@ -129,7 +129,6 @@ class Config:
     def for_struct(fmt: str, predictor: Optional[InputPredictor] = None) -> "Config":
         """Input is a tuple packed with ``struct`` format ``fmt``."""
         size = struct.calcsize(fmt)
-        nfields = len(struct.unpack(fmt, b"\x00" * size))
 
         def _default() -> tuple:
             return struct.unpack(fmt, b"\x00" * size)
@@ -140,7 +139,6 @@ class Config:
         def _decode(b: bytes) -> tuple:
             return struct.unpack(fmt, b)
 
-        del nfields
         return Config(
             input_default=_default,
             input_encode=_encode,

@@ -45,6 +45,11 @@ class GameStateCell(Generic[S]):
 
     def save(self, frame: Frame, data: Optional[S], checksum: Optional[int]) -> None:
         assert frame != NULL_FRAME
+        if checksum is not None and not 0 <= checksum < (1 << 128):
+            # the wire carries checksums as u128; reject out-of-range values
+            # here rather than silently truncating on send, which would make
+            # synchronized peers report false desyncs
+            raise ValueError("checksum must fit in an unsigned 128-bit integer")
         with self._lock:
             self._state.frame = frame
             self._state.data = data

@@ -1,0 +1,13 @@
+from .builder import SessionBuilder
+from .p2p import P2PSession, PlayerRegistry
+from .spectator import SPECTATOR_BUFFER_SIZE, SpectatorSession
+from .synctest import SyncTestSession
+
+__all__ = [
+    "P2PSession",
+    "PlayerRegistry",
+    "SPECTATOR_BUFFER_SIZE",
+    "SessionBuilder",
+    "SpectatorSession",
+    "SyncTestSession",
+]

@@ -1,0 +1,605 @@
+"""The peer-to-peer session: the main driver of rollback netcode.
+
+Behavior-parity reimplementation of the reference's P2PSession
+(/root/reference/src/sessions/p2p_session.rs): per tick it drains the
+network, detects desyncs, rolls back and resimulates on mispredictions,
+forwards confirmed inputs to spectators, recommends waits when running ahead,
+registers and broadcasts local inputs, and advances — returning the ordered
+request list the game must fulfill.  Includes lockstep mode
+(max_prediction == 0), sparse saving, and rollback-on-disconnect.
+"""
+
+from __future__ import annotations
+
+import logging
+from collections import deque
+from typing import Callable, Deque, Dict, Generic, Hashable, List, Optional, TypeVar
+
+from ..core.config import Config
+from ..core.errors import BadPlayerHandle, GgrsError, InvalidRequest
+from ..core.frame_info import PlayerInput
+from ..core.sync_layer import SyncLayer
+from ..core.types import (
+    AdvanceFrame,
+    DesyncDetected,
+    DesyncDetection,
+    Disconnected,
+    Frame,
+    GgrsEvent,
+    GgrsRequest,
+    Local,
+    NetworkInterrupted,
+    NetworkResumed,
+    NULL_FRAME,
+    PlayerHandle,
+    PlayerType,
+    Remote,
+    SessionState,
+    Spectator,
+    WaitRecommendation,
+)
+from ..net.messages import ConnectionStatus
+from ..net.protocol import (
+    EvDisconnected,
+    EvInput,
+    EvNetworkInterrupted,
+    EvNetworkResumed,
+    MAX_CHECKSUM_HISTORY_SIZE,
+    PeerProtocol,
+    ProtocolEvent,
+)
+from ..net.sockets import NonBlockingSocket
+from ..net.stats import NetworkStats
+
+logger = logging.getLogger(__name__)
+
+I = TypeVar("I")
+S = TypeVar("S")
+A = TypeVar("A", bound=Hashable)
+
+RECOMMENDATION_INTERVAL = 60  # frames between WaitRecommendation events
+MIN_RECOMMENDATION = 3  # minimum frames-ahead before recommending a wait
+MAX_EVENT_QUEUE_SIZE = 100
+
+
+class PlayerRegistry(Generic[I, A]):
+    """Maps player handles to types and addresses to shared endpoints
+    (reference: p2p_session.rs:24-115).  Multiple players can share one
+    endpoint (several players behind one address)."""
+
+    def __init__(self) -> None:
+        self.handles: Dict[PlayerHandle, PlayerType] = {}
+        self.remotes: Dict[A, PeerProtocol[I, A]] = {}
+        self.spectators: Dict[A, PeerProtocol[I, A]] = {}
+
+    def local_player_handles(self) -> List[PlayerHandle]:
+        return sorted(h for h, t in self.handles.items() if isinstance(t, Local))
+
+    def remote_player_handles(self) -> List[PlayerHandle]:
+        return sorted(h for h, t in self.handles.items() if isinstance(t, Remote))
+
+    def spectator_handles(self) -> List[PlayerHandle]:
+        return sorted(h for h, t in self.handles.items() if isinstance(t, Spectator))
+
+    def num_players(self) -> int:
+        return sum(1 for t in self.handles.values() if isinstance(t, (Local, Remote)))
+
+    def num_spectators(self) -> int:
+        return sum(1 for t in self.handles.values() if isinstance(t, Spectator))
+
+    def handles_by_address(self, addr: A) -> List[PlayerHandle]:
+        return sorted(
+            h
+            for h, t in self.handles.items()
+            if isinstance(t, (Remote, Spectator)) and t.addr == addr
+        )
+
+
+class P2PSession(Generic[I, S, A]):
+    def __init__(
+        self,
+        config: Config,
+        num_players: int,
+        max_prediction: int,
+        socket: NonBlockingSocket,
+        players: PlayerRegistry[I, A],
+        sparse_saving: bool,
+        desync_detection: DesyncDetection,
+        input_delay: int,
+    ) -> None:
+        self._config = config
+        self._num_players = num_players
+        self._max_prediction = max_prediction
+        self._socket = socket
+        self._player_reg = players
+
+        self.local_connect_status = [ConnectionStatus() for _ in range(num_players)]
+
+        self._sync_layer: SyncLayer[I, S] = SyncLayer(config, num_players, max_prediction)
+        for handle, player_type in players.handles.items():
+            if isinstance(player_type, Local):
+                self._sync_layer.set_frame_delay(handle, input_delay)
+
+        if max_prediction == 0 and sparse_saving:
+            # In lockstep mode no saving happens, but the last-saved frame
+            # gates frame confirmation under sparse saving — so frames would
+            # never confirm and the game would never advance.
+            logger.warning(
+                "Sparse saving setting is ignored because lockstep mode is on "
+                "(max_prediction set to 0), so no saving will take place"
+            )
+            sparse_saving = False
+        self._sparse_saving = sparse_saving
+
+        self._disconnect_frame: Frame = NULL_FRAME
+        self._next_spectator_frame: Frame = 0
+        self._next_recommended_sleep: Frame = 0
+        self._frames_ahead = 0
+
+        self._event_queue: Deque[GgrsEvent] = deque()
+        self._local_inputs: Dict[PlayerHandle, PlayerInput[I]] = {}
+
+        self._desync_detection = desync_detection
+        self._local_checksum_history: Dict[Frame, int] = {}
+        self._last_sent_checksum_frame: Frame = NULL_FRAME
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+
+    def add_local_input(self, player_handle: PlayerHandle, input: I) -> None:
+        """Register local input for the current frame; must be called for
+        every local player before advance_frame()."""
+        if player_handle not in self._player_reg.local_player_handles():
+            raise InvalidRequest(
+                "The player handle you provided is not referring to a local player."
+            )
+        self._local_inputs[player_handle] = PlayerInput(
+            self._sync_layer.current_frame, input
+        )
+
+    def current_state(self) -> SessionState:
+        return SessionState.RUNNING
+
+    def advance_frame(self) -> List[GgrsRequest]:
+        """The main entry point; see the reference call stack
+        (p2p_session.rs:265-426).  Returns the ordered request list."""
+        self.poll_remote_clients()
+
+        for handle in self._player_reg.local_player_handles():
+            if handle not in self._local_inputs:
+                raise InvalidRequest(
+                    f"Missing local input for handle {handle} while calling "
+                    "advance_frame()."
+                )
+
+        # DESYNC DETECTION — must run before any frame can be newly marked
+        # confirmed this tick: the comparison looks at the current confirmed
+        # frame, and a frame re-confirmed after a rollback wouldn't have its
+        # fresh checksum stored yet (reference comment: p2p_session.rs:280-288).
+        if self._desync_detection.enabled:
+            self._check_checksum_send_interval()
+            self._compare_local_checksums_against_peers()
+
+        requests: List[GgrsRequest] = []
+
+        # In lockstep mode we only advance on fully-confirmed frames; no
+        # rollback, hence no saving at all.
+        lockstep = self.in_lockstep_mode()
+
+        if self._sync_layer.current_frame == 0 and not lockstep:
+            requests.append(self._sync_layer.save_current_state())
+
+        self._update_player_disconnects()
+
+        confirmed_frame = self.confirmed_frame()
+
+        if not lockstep:
+            # the disconnect frame forces a rollback to erase predictions made
+            # for a player we now know disconnected earlier
+            first_incorrect = self._sync_layer.check_simulation_consistency(
+                self._disconnect_frame
+            )
+            if first_incorrect != NULL_FRAME:
+                self._adjust_gamestate(first_incorrect, confirmed_frame, requests)
+                self._disconnect_frame = NULL_FRAME
+
+            last_saved = self._sync_layer.last_saved_frame
+            if self._sparse_saving:
+                self._check_last_saved_state(last_saved, confirmed_frame, requests)
+            else:
+                requests.append(self._sync_layer.save_current_state())
+
+        # send confirmed inputs to spectators before discarding them
+        self._send_confirmed_inputs_to_spectators(confirmed_frame)
+        self._sync_layer.set_last_confirmed_frame(confirmed_frame, self._sparse_saving)
+
+        self._check_wait_recommendation()
+
+        # register local inputs and send them
+        for handle in self._player_reg.local_player_handles():
+            player_input = self._local_inputs[handle]
+            actual_frame = self._sync_layer.add_local_input(handle, player_input)
+            player_input.frame = actual_frame
+            if actual_frame != NULL_FRAME:
+                self.local_connect_status[handle].last_frame = actual_frame
+
+        if not any(pi.frame == NULL_FRAME for pi in self._local_inputs.values()):
+            for endpoint in self._player_reg.remotes.values():
+                endpoint.send_input(self._local_inputs, self.local_connect_status)
+                endpoint.send_all_messages(self._socket)
+
+        # advance decision
+        if lockstep:
+            can_advance = (
+                self._sync_layer.last_confirmed_frame == self._sync_layer.current_frame
+            )
+        else:
+            if self._sync_layer.last_confirmed_frame == NULL_FRAME:
+                frames_ahead = self._sync_layer.current_frame
+            else:
+                frames_ahead = (
+                    self._sync_layer.current_frame - self._sync_layer.last_confirmed_frame
+                )
+            can_advance = frames_ahead < self._max_prediction
+
+        if can_advance:
+            inputs = self._sync_layer.synchronized_inputs(self.local_connect_status)
+            self._sync_layer.advance_frame()
+            self._local_inputs.clear()
+            requests.append(AdvanceFrame(inputs=inputs))
+        else:
+            logger.debug(
+                "Prediction threshold reached, skipping on frame %d",
+                self._sync_layer.current_frame,
+            )
+
+        return requests
+
+    def poll_remote_clients(self) -> None:
+        """Drain the socket, route messages to endpoints, run timers, handle
+        events, and flush outgoing packets (reference: p2p_session.rs:430-478)."""
+        for from_addr, msg in self._socket.receive_all_messages():
+            if from_addr in self._player_reg.remotes:
+                self._player_reg.remotes[from_addr].handle_message(msg)
+            if from_addr in self._player_reg.spectators:
+                self._player_reg.spectators[from_addr].handle_message(msg)
+
+        for endpoint in self._player_reg.remotes.values():
+            if endpoint.is_running():
+                endpoint.update_local_frame_advantage(self._sync_layer.current_frame)
+
+        events: List = []
+        for endpoint in list(self._player_reg.remotes.values()) + list(
+            self._player_reg.spectators.values()
+        ):
+            handles = list(endpoint.handles)
+            addr = endpoint.peer_addr
+            for event in endpoint.poll(self.local_connect_status):
+                events.append((event, handles, addr))
+
+        for event, handles, addr in events:
+            self._handle_event(event, handles, addr)
+
+        for endpoint in list(self._player_reg.remotes.values()) + list(
+            self._player_reg.spectators.values()
+        ):
+            endpoint.send_all_messages(self._socket)
+
+    def disconnect_player(self, player_handle: PlayerHandle) -> None:
+        """Disconnect a remote player (and everyone sharing their address)
+        (reference: p2p_session.rs:485-511)."""
+        player_type = self._player_reg.handles.get(player_handle)
+        if player_type is None:
+            raise InvalidRequest("Invalid Player Handle.")
+        if isinstance(player_type, Local):
+            raise InvalidRequest("Local Player cannot be disconnected.")
+        if isinstance(player_type, Remote):
+            if not self.local_connect_status[player_handle].disconnected:
+                last_frame = self.local_connect_status[player_handle].last_frame
+                self._disconnect_player_at_frame(player_handle, last_frame)
+                return
+            raise InvalidRequest("Player already disconnected.")
+        # spectators are simpler
+        self._disconnect_player_at_frame(player_handle, NULL_FRAME)
+
+    def network_stats(self, player_handle: PlayerHandle) -> NetworkStats:
+        player_type = self._player_reg.handles.get(player_handle)
+        if isinstance(player_type, Remote):
+            return self._player_reg.remotes[player_type.addr].network_stats()
+        if isinstance(player_type, Spectator):
+            return self._player_reg.spectators[player_type.addr].network_stats()
+        raise BadPlayerHandle()
+
+    def confirmed_frame(self) -> Frame:
+        """Minimum last-received frame over all connected players
+        (reference: p2p_session.rs:542-553)."""
+        confirmed = 2**31 - 1
+        for status in self.local_connect_status:
+            if not status.disconnected:
+                confirmed = min(confirmed, status.last_frame)
+        assert confirmed < 2**31 - 1
+        return confirmed
+
+    @property
+    def current_frame(self) -> Frame:
+        return self._sync_layer.current_frame
+
+    @property
+    def max_prediction(self) -> int:
+        return self._max_prediction
+
+    def in_lockstep_mode(self) -> bool:
+        return self._max_prediction == 0
+
+    def events(self) -> List[GgrsEvent]:
+        out = list(self._event_queue)
+        self._event_queue.clear()
+        return out
+
+    @property
+    def num_players(self) -> int:
+        return self._player_reg.num_players()
+
+    @property
+    def num_spectators(self) -> int:
+        return self._player_reg.num_spectators()
+
+    def local_player_handles(self) -> List[PlayerHandle]:
+        return self._player_reg.local_player_handles()
+
+    def remote_player_handles(self) -> List[PlayerHandle]:
+        return self._player_reg.remote_player_handles()
+
+    def spectator_handles(self) -> List[PlayerHandle]:
+        return self._player_reg.spectator_handles()
+
+    def handles_by_address(self, addr: A) -> List[PlayerHandle]:
+        return self._player_reg.handles_by_address(addr)
+
+    def frames_ahead(self) -> int:
+        return self._frames_ahead
+
+    def desync_detection(self) -> DesyncDetection:
+        return self._desync_detection
+
+    # ------------------------------------------------------------------
+    # internals
+    # ------------------------------------------------------------------
+
+    def _disconnect_player_at_frame(
+        self, player_handle: PlayerHandle, last_frame: Frame
+    ) -> None:
+        """Mark everyone at the player's endpoint disconnected; schedule a
+        rollback to the disconnect frame so wrong predictions are erased
+        (reference: p2p_session.rs:618-655)."""
+        player_type = self._player_reg.handles[player_handle]
+        if isinstance(player_type, Remote):
+            endpoint = self._player_reg.remotes[player_type.addr]
+            for handle in endpoint.handles:
+                self.local_connect_status[handle].disconnected = True
+            endpoint.disconnect()
+            if self._sync_layer.current_frame > last_frame:
+                # resimulate from the disconnect with correct disconnect flags
+                self._disconnect_frame = last_frame + 1
+        elif isinstance(player_type, Spectator):
+            self._player_reg.spectators[player_type.addr].disconnect()
+
+    def _adjust_gamestate(
+        self,
+        first_incorrect: Frame,
+        min_confirmed: Frame,
+        requests: List[GgrsRequest],
+    ) -> None:
+        """Roll back and resimulate with up-to-date inputs
+        (reference: p2p_session.rs:658-714)."""
+        current_frame = self._sync_layer.current_frame
+        if self._sparse_saving:
+            # only the last saved state survives under sparse saving
+            frame_to_load = self._sync_layer.last_saved_frame
+        else:
+            frame_to_load = first_incorrect
+
+        assert frame_to_load <= first_incorrect
+        count = current_frame - frame_to_load
+
+        requests.append(self._sync_layer.load_frame(frame_to_load))
+        assert self._sync_layer.current_frame == frame_to_load
+        self._sync_layer.reset_prediction()
+
+        for i in range(count):
+            inputs = self._sync_layer.synchronized_inputs(self.local_connect_status)
+            if self._sparse_saving:
+                # save exactly the min_confirmed frame on the way forward
+                if self._sync_layer.current_frame == min_confirmed:
+                    requests.append(self._sync_layer.save_current_state())
+            else:
+                # save every state except the one just loaded
+                if i > 0:
+                    requests.append(self._sync_layer.save_current_state())
+            self._sync_layer.advance_frame()
+            requests.append(AdvanceFrame(inputs=inputs))
+
+        assert self._sync_layer.current_frame == current_frame
+
+    def _send_confirmed_inputs_to_spectators(self, confirmed_frame: Frame) -> None:
+        """Forward every newly-confirmed frame's inputs (for all players) to
+        each spectator endpoint (reference: p2p_session.rs:717-744)."""
+        if self._player_reg.num_spectators() == 0:
+            return
+
+        while self._next_spectator_frame <= confirmed_frame:
+            inputs = self._sync_layer.confirmed_inputs(
+                self._next_spectator_frame, self.local_connect_status
+            )
+            assert len(inputs) == self._num_players
+            input_map: Dict[PlayerHandle, PlayerInput[I]] = {}
+            for handle, player_input in enumerate(inputs):
+                assert (
+                    player_input.frame == NULL_FRAME
+                    or player_input.frame == self._next_spectator_frame
+                )
+                input_map[handle] = player_input
+
+            for endpoint in self._player_reg.spectators.values():
+                if endpoint.is_running():
+                    endpoint.send_input(input_map, self.local_connect_status)
+
+            self._next_spectator_frame += 1
+
+    def _update_player_disconnects(self) -> None:
+        """Cross-peer disconnect consensus: adopt any peer's knowledge of an
+        earlier disconnect (reference: p2p_session.rs:748-783)."""
+        for handle in range(self._num_players):
+            queue_connected = True
+            queue_min_confirmed = 2**31 - 1
+
+            for endpoint in self._player_reg.remotes.values():
+                if not endpoint.is_running():
+                    continue
+                status = endpoint.peer_connect_status[handle]
+                queue_connected = queue_connected and not status.disconnected
+                queue_min_confirmed = min(queue_min_confirmed, status.last_frame)
+
+            local_connected = not self.local_connect_status[handle].disconnected
+            local_min_confirmed = self.local_connect_status[handle].last_frame
+            if local_connected:
+                queue_min_confirmed = min(queue_min_confirmed, local_min_confirmed)
+
+            if not queue_connected:
+                # A peer saw the disconnect earlier than we did: re-adjust.
+                if local_connected or local_min_confirmed > queue_min_confirmed:
+                    self._disconnect_player_at_frame(handle, queue_min_confirmed)
+
+    def _max_frame_advantage(self) -> int:
+        interval = None
+        for endpoint in self._player_reg.remotes.values():
+            for handle in endpoint.handles:
+                if not self.local_connect_status[handle].disconnected:
+                    adv = endpoint.average_frame_advantage()
+                    interval = adv if interval is None else max(interval, adv)
+        return 0 if interval is None else interval
+
+    def _check_wait_recommendation(self) -> None:
+        """Emit WaitRecommendation when well ahead of the slowest remote, at
+        most every RECOMMENDATION_INTERVAL frames
+        (reference: p2p_session.rs:804-817)."""
+        self._frames_ahead = self._max_frame_advantage()
+        if (
+            self._sync_layer.current_frame > self._next_recommended_sleep
+            and self._frames_ahead >= MIN_RECOMMENDATION
+        ):
+            self._next_recommended_sleep = (
+                self._sync_layer.current_frame + RECOMMENDATION_INTERVAL
+            )
+            self._push_event(WaitRecommendation(skip_frames=self._frames_ahead))
+
+    def _check_last_saved_state(
+        self, last_saved: Frame, confirmed_frame: Frame, requests: List[GgrsRequest]
+    ) -> None:
+        """Sparse saving: before the save slides out of the prediction window,
+        either save the (confirmed) current frame or roll back to resave
+        (reference: p2p_session.rs:819-843)."""
+        if self._sync_layer.current_frame - last_saved >= self._max_prediction:
+            if confirmed_frame >= self._sync_layer.current_frame:
+                requests.append(self._sync_layer.save_current_state())
+            else:
+                self._adjust_gamestate(last_saved, confirmed_frame, requests)
+
+            assert confirmed_frame == NULL_FRAME or self._sync_layer.last_saved_frame == min(
+                confirmed_frame, self._sync_layer.current_frame
+            )
+
+    def _handle_event(
+        self, event: ProtocolEvent, player_handles: List[PlayerHandle], addr: A
+    ) -> None:
+        """Translate protocol events into user events / session actions
+        (reference: p2p_session.rs:846-902)."""
+        if isinstance(event, EvNetworkInterrupted):
+            self._push_event(
+                NetworkInterrupted(addr=addr, disconnect_timeout=event.disconnect_timeout)
+            )
+        elif isinstance(event, EvNetworkResumed):
+            self._push_event(NetworkResumed(addr=addr))
+        elif isinstance(event, EvDisconnected):
+            for handle in player_handles:
+                last_frame = (
+                    self.local_connect_status[handle].last_frame
+                    if handle < self._num_players
+                    else NULL_FRAME  # spectator
+                )
+                self._disconnect_player_at_frame(handle, last_frame)
+            self._push_event(Disconnected(addr=addr))
+        elif isinstance(event, EvInput):
+            player = event.player
+            assert player < self._num_players
+            if not self.local_connect_status[player].disconnected:
+                current_remote_frame = self.local_connect_status[player].last_frame
+                assert (
+                    current_remote_frame == NULL_FRAME
+                    or current_remote_frame + 1 == event.input.frame
+                )
+                self.local_connect_status[player].last_frame = event.input.frame
+                self._sync_layer.add_remote_input(player, event.input)
+
+    def _push_event(self, event: GgrsEvent) -> None:
+        self._event_queue.append(event)
+        while len(self._event_queue) > MAX_EVENT_QUEUE_SIZE:
+            self._event_queue.popleft()
+
+    # ------------------------------------------------------------------
+    # desync detection (reference: p2p_session.rs:904-975)
+    # ------------------------------------------------------------------
+
+    def _compare_local_checksums_against_peers(self) -> None:
+        for remote in self._player_reg.remotes.values():
+            checked = []
+            for remote_frame, remote_checksum in remote.pending_checksums.items():
+                if remote_frame >= self._sync_layer.last_confirmed_frame:
+                    continue  # still waiting for inputs for this frame
+                local_checksum = self._local_checksum_history.get(remote_frame)
+                if local_checksum is None:
+                    continue
+                if local_checksum != remote_checksum:
+                    self._push_event(
+                        DesyncDetected(
+                            frame=remote_frame,
+                            local_checksum=local_checksum,
+                            remote_checksum=remote_checksum,
+                            addr=remote.peer_addr,
+                        )
+                    )
+                checked.append(remote_frame)
+            for frame in checked:
+                del remote.pending_checksums[frame]
+
+    def _check_checksum_send_interval(self) -> None:
+        interval = self._desync_detection.interval
+        if self._last_sent_checksum_frame == NULL_FRAME:
+            frame_to_send = interval
+        else:
+            frame_to_send = self._last_sent_checksum_frame + interval
+
+        if (
+            frame_to_send <= self._sync_layer.last_confirmed_frame
+            and frame_to_send <= self._sync_layer.last_saved_frame
+        ):
+            cell = self._sync_layer.saved_state_by_frame(frame_to_send)
+            assert cell is not None, f"cell not found!: frame {frame_to_send}"
+
+            checksum = cell.checksum
+            if checksum is not None:
+                for remote in self._player_reg.remotes.values():
+                    remote.send_checksum_report(frame_to_send, checksum)
+                self._last_sent_checksum_frame = frame_to_send
+                self._local_checksum_history[frame_to_send] = checksum
+
+            if len(self._local_checksum_history) > MAX_CHECKSUM_HISTORY_SIZE:
+                oldest_to_keep = (
+                    frame_to_send - (MAX_CHECKSUM_HISTORY_SIZE - 1) * interval
+                )
+                self._local_checksum_history = {
+                    f: c
+                    for f, c in self._local_checksum_history.items()
+                    if f >= oldest_to_keep
+                }

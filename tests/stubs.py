@@ -1,0 +1,81 @@
+"""Deterministic fake game fixtures (parity with /root/reference/tests/stubs.rs):
+GameStub advances a tiny arithmetic state; RandomChecksumGameStub deliberately
+breaks checksums to exercise desync machinery."""
+
+from __future__ import annotations
+
+import random
+import struct
+from dataclasses import dataclass
+from typing import List, Tuple
+
+from ggrs_tpu.core import (
+    AdvanceFrame,
+    Config,
+    InputStatus,
+    LoadGameState,
+    SaveGameState,
+)
+
+
+def stub_config() -> Config:
+    return Config.for_uint(32)
+
+
+@dataclass
+class StateStub:
+    frame: int = 0
+    state: int = 0
+
+    def advance(self, inputs: List[Tuple[int, InputStatus]]) -> None:
+        p0 = inputs[0][0]
+        p1 = inputs[1][0] if len(inputs) > 1 else 0
+        if (p0 + p1) % 2 == 0:
+            self.state += 2
+        else:
+            self.state -= 1
+        self.frame += 1
+
+
+def stub_checksum(gs: StateStub) -> int:
+    # deterministic across processes (unlike Python's salted hash())
+    data = struct.pack("<qq", gs.frame, gs.state)
+    acc = 0xCBF29CE484222325
+    for b in data:
+        acc = ((acc ^ b) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return acc
+
+
+class GameStub:
+    def __init__(self) -> None:
+        self.gs = StateStub()
+
+    def handle_requests(self, requests) -> None:
+        for request in requests:
+            if isinstance(request, LoadGameState):
+                self.gs = StateStub(**vars(request.cell.load()))
+            elif isinstance(request, SaveGameState):
+                assert self.gs.frame == request.frame
+                snapshot = StateStub(**vars(self.gs))
+                request.cell.save(request.frame, snapshot, stub_checksum(snapshot))
+            elif isinstance(request, AdvanceFrame):
+                self.gs.advance(request.inputs)
+
+
+class RandomChecksumGameStub:
+    """Saves random checksums: the SyncTest session must flag the mismatch."""
+
+    def __init__(self) -> None:
+        self.gs = StateStub()
+        self._rng = random.Random()
+
+    def handle_requests(self, requests) -> None:
+        for request in requests:
+            if isinstance(request, LoadGameState):
+                self.gs = StateStub(**vars(request.cell.load()))
+            elif isinstance(request, SaveGameState):
+                assert self.gs.frame == request.frame
+                snapshot = StateStub(**vars(self.gs))
+                request.cell.save(request.frame, snapshot, self._rng.getrandbits(128))
+            elif isinstance(request, AdvanceFrame):
+                self.gs.advance(request.inputs)

@@ -1,0 +1,283 @@
+"""P2P session integration tests over both the in-memory network and real
+loopback UDP (parity with /root/reference/tests/test_p2p_session.rs)."""
+
+import random
+
+import pytest
+
+from ggrs_tpu.core import (
+    DesyncDetected,
+    DesyncDetection,
+    InvalidRequest,
+    Local,
+    Remote,
+    Spectator,
+)
+from ggrs_tpu.net import InMemoryNetwork, UdpNonBlockingSocket
+from ggrs_tpu.sessions import SessionBuilder
+
+from stubs import GameStub, stub_config
+
+
+def make_pair(net, desync=None, input_delay=0, clock=None):
+    """Two P2P sessions connected through an in-memory network."""
+    clock = clock if clock is not None else (lambda: 0)
+    builders = []
+    for me, other, local_handle in (("A", "B", 0), ("B", "A", 1)):
+        b = (
+            SessionBuilder(stub_config())
+            .with_clock(clock)
+            .with_rng(random.Random(hash(me) & 0xFFFF | 1))
+        )
+        if input_delay:
+            b = b.with_input_delay(input_delay)
+        if desync is not None:
+            b = b.with_desync_detection_mode(desync)
+        b = b.add_player(Local(), local_handle).add_player(Remote(other), 1 - local_handle)
+        builders.append(b.start_p2p_session(net.socket(me)))
+    return builders
+
+
+def test_add_more_players():
+    net = InMemoryNetwork()
+    sess = (
+        SessionBuilder(stub_config())
+        .with_num_players(4)
+        .add_player(Local(), 0)
+        .add_player(Remote("R1"), 1)
+        .add_player(Remote("R2"), 2)
+        .add_player(Remote("R3"), 3)
+        .add_player(Spectator("SPEC"), 4)
+        .start_p2p_session(net.socket("me"))
+    )
+    assert sess.num_players == 4
+    assert sess.num_spectators == 1
+
+
+def test_builder_validation():
+    with pytest.raises(InvalidRequest):
+        SessionBuilder(stub_config()).add_player(Local(), 5)  # local handle too big
+    with pytest.raises(InvalidRequest):
+        SessionBuilder(stub_config()).add_player(Spectator("S"), 0)  # spec too small
+    with pytest.raises(InvalidRequest):
+        b = SessionBuilder(stub_config()).add_player(Local(), 0)
+        b.add_player(Local(), 0)  # duplicate
+    with pytest.raises(InvalidRequest):
+        net = InMemoryNetwork()
+        SessionBuilder(stub_config()).add_player(Local(), 0).start_p2p_session(
+            net.socket("me")
+        )  # not enough players
+
+
+def test_disconnect_player():
+    net = InMemoryNetwork()
+    sess = (
+        SessionBuilder(stub_config())
+        .add_player(Local(), 0)
+        .add_player(Remote("R"), 1)
+        .add_player(Spectator("S"), 2)
+        .start_p2p_session(net.socket("me"))
+    )
+    with pytest.raises(InvalidRequest):
+        sess.disconnect_player(5)  # invalid handle
+    with pytest.raises(InvalidRequest):
+        sess.disconnect_player(0)  # local players cannot be disconnected
+    sess.disconnect_player(1)
+    with pytest.raises(InvalidRequest):
+        sess.disconnect_player(1)  # already disconnected
+    sess.disconnect_player(2)  # spectators are fine
+
+
+def test_advance_frame_p2p_sessions():
+    net = InMemoryNetwork()
+    sess1, sess2 = make_pair(net)
+
+    for _ in range(50):
+        sess1.poll_remote_clients()
+        sess2.poll_remote_clients()
+
+    stub1, stub2 = GameStub(), GameStub()
+    for i in range(10):
+        sess1.poll_remote_clients()
+        sess2.poll_remote_clients()
+
+        sess1.add_local_input(0, i)
+        stub1.handle_requests(sess1.advance_frame())
+        sess2.add_local_input(1, i)
+        stub2.handle_requests(sess2.advance_frame())
+
+        assert stub1.gs.frame == i + 1
+        assert stub2.gs.frame == i + 1
+
+
+def test_p2p_sessions_state_converges():
+    """Both peers end at identical state after mixed inputs."""
+    net = InMemoryNetwork(seed=3, loss=0.1)
+    sess1, sess2 = make_pair(net)
+    stub1, stub2 = GameStub(), GameStub()
+
+    for i in range(120):
+        sess1.poll_remote_clients()
+        sess2.poll_remote_clients()
+        sess1.add_local_input(0, i % 3)
+        stub1.handle_requests(sess1.advance_frame())
+        sess2.add_local_input(1, (i * 7) % 5)
+        stub2.handle_requests(sess2.advance_frame())
+
+    # drain: let both finish pending rollbacks with all inputs confirmed
+    for i in range(120, 130):
+        sess1.poll_remote_clients()
+        sess2.poll_remote_clients()
+        sess1.add_local_input(0, 0)
+        stub1.handle_requests(sess1.advance_frame())
+        sess2.add_local_input(1, 0)
+        stub2.handle_requests(sess2.advance_frame())
+
+    assert stub1.gs.frame == stub2.gs.frame
+    assert stub1.gs.state == stub2.gs.state
+
+
+def test_desyncs_detected():
+    """Deliberately corrupt one peer's state; both sides must report symmetric
+    DesyncDetected at frame 200 with crossed checksums (reference:
+    test_p2p_session.rs:114-213)."""
+    net = InMemoryNetwork()
+    desync_mode = DesyncDetection.on(100)
+    sess1, sess2 = make_pair(net, desync=desync_mode)
+
+    assert sess1.events() == []
+    assert sess2.events() == []
+
+    stub1, stub2 = GameStub(), GameStub()
+
+    for i in range(110):
+        sess1.poll_remote_clients()
+        sess2.poll_remote_clients()
+        sess1.add_local_input(0, i)
+        sess2.add_local_input(1, i)
+        stub1.handle_requests(sess1.advance_frame())
+        stub2.handle_requests(sess2.advance_frame())
+
+    assert sess1.events() == []
+    assert sess2.events() == []
+
+    for _ in range(100):
+        sess1.poll_remote_clients()
+        sess2.poll_remote_clients()
+
+        # mess up state for peer 1
+        stub1.gs.state = 1234
+
+        # keep inputs steady to avoid rollbacks restoring valid state
+        sess1.add_local_input(0, 0)
+        sess2.add_local_input(1, 1)
+        stub1.handle_requests(sess1.advance_frame())
+        stub2.handle_requests(sess2.advance_frame())
+
+    ev1 = [e for e in sess1.events() if isinstance(e, DesyncDetected)]
+    ev2 = [e for e in sess2.events() if isinstance(e, DesyncDetected)]
+    assert len(ev1) == 1
+    assert len(ev2) == 1
+
+    assert ev1[0].frame == 200
+    assert ev1[0].addr == "B"
+    assert ev1[0].local_checksum != ev1[0].remote_checksum
+    assert ev2[0].frame == 200
+    assert ev2[0].addr == "A"
+    assert ev2[0].local_checksum != ev2[0].remote_checksum
+    # crossed checksums match
+    assert ev1[0].remote_checksum == ev2[0].local_checksum
+    assert ev2[0].remote_checksum == ev1[0].local_checksum
+
+
+def test_desyncs_and_input_delay_no_panic():
+    net = InMemoryNetwork()
+    sess1, sess2 = make_pair(net, desync=DesyncDetection.on(100), input_delay=5)
+    stub1, stub2 = GameStub(), GameStub()
+
+    for i in range(150):
+        sess1.poll_remote_clients()
+        sess2.poll_remote_clients()
+        sess1.add_local_input(0, i)
+        sess2.add_local_input(1, i)
+        stub1.handle_requests(sess1.advance_frame())
+        stub2.handle_requests(sess2.advance_frame())
+
+
+def test_lockstep_mode_never_saves_or_loads():
+    """max_prediction=0: only AdvanceFrame requests, only on confirmed frames
+    (fork delta #3, reference: p2p_session.rs:301-307,393-397)."""
+    from ggrs_tpu.core import AdvanceFrame
+
+    net = InMemoryNetwork()
+    clock = lambda: 0
+    sessions = []
+    for me, other, local_handle in (("A", "B", 0), ("B", "A", 1)):
+        sessions.append(
+            SessionBuilder(stub_config())
+            .with_clock(clock)
+            .with_max_prediction_window(0)
+            .add_player(Local(), local_handle)
+            .add_player(Remote(other), 1 - local_handle)
+            .start_p2p_session(net.socket(me))
+        )
+    sess1, sess2 = sessions
+    stub1, stub2 = GameStub(), GameStub()
+
+    advanced1 = advanced2 = 0
+    for i in range(30):
+        sess1.poll_remote_clients()
+        sess2.poll_remote_clients()
+        sess1.add_local_input(0, i)
+        r1 = sess1.advance_frame()
+        sess2.add_local_input(1, i)
+        r2 = sess2.advance_frame()
+        assert all(isinstance(r, AdvanceFrame) for r in r1)
+        assert all(isinstance(r, AdvanceFrame) for r in r2)
+        advanced1 += len(r1)
+        advanced2 += len(r2)
+        stub1.handle_requests(r1)
+        stub2.handle_requests(r2)
+
+    # lockstep advances at most one frame behind the slowest confirmation
+    assert advanced1 > 0 and advanced2 > 0
+    assert stub1.gs.state == stub2.gs.state or abs(stub1.gs.frame - stub2.gs.frame) <= 1
+
+
+def test_advance_frame_p2p_sessions_real_udp():
+    """Same as the in-memory test but over real loopback UDP sockets
+    (reference: test_p2p_session.rs:69-110)."""
+    addr1, addr2 = ("127.0.0.1", 7777), ("127.0.0.1", 8888)
+    socket1 = UdpNonBlockingSocket.bind_to_port(7777)
+    socket2 = UdpNonBlockingSocket.bind_to_port(8888)
+    try:
+        sess1 = (
+            SessionBuilder(stub_config())
+            .add_player(Local(), 0)
+            .add_player(Remote(addr2), 1)
+            .start_p2p_session(socket1)
+        )
+        sess2 = (
+            SessionBuilder(stub_config())
+            .add_player(Remote(addr1), 0)
+            .add_player(Local(), 1)
+            .start_p2p_session(socket2)
+        )
+
+        for _ in range(50):
+            sess1.poll_remote_clients()
+            sess2.poll_remote_clients()
+
+        stub1, stub2 = GameStub(), GameStub()
+        for i in range(10):
+            sess1.poll_remote_clients()
+            sess2.poll_remote_clients()
+            sess1.add_local_input(0, i)
+            stub1.handle_requests(sess1.advance_frame())
+            sess2.add_local_input(1, i)
+            stub2.handle_requests(sess2.advance_frame())
+            assert stub1.gs.frame == i + 1
+            assert stub2.gs.frame == i + 1
+    finally:
+        socket1.close()
+        socket2.close()

