@@ -1,0 +1,122 @@
+"""Benchmark: resimulated frames/sec at 8-frame rollback (BASELINE config 2).
+
+Measures the flagship path — BoxGame under ``DeviceSyncTestSession`` with
+check_distance=8, the fused load→(advance, save)^8 replay as one XLA program —
+against a host-side baseline that executes the same session semantics the way
+the reference does: one Python-level request at a time over NumPy state
+(save = copy + checksum, advance = vectorized NumPy step).  The reference
+itself publishes no numbers (BASELINE.md), so ``vs_baseline`` is the ratio of
+the device path to that host request-loop on this machine.
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import zlib
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ggrs_tpu.games import BoxGame
+from ggrs_tpu.sessions import DeviceSyncTestSession
+
+CHECK_DISTANCE = 8
+PLAYERS = 2
+
+
+def _inputs(n: int, seed: int) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    return rng.integers(0, 16, size=(n, PLAYERS)).astype(np.uint8)
+
+
+def bench_device(total_ticks: int, chunk: int) -> float:
+    """Resim frames/sec through the fused device session."""
+    game = BoxGame(PLAYERS)
+    sess = DeviceSyncTestSession(
+        game.advance,
+        game.init_state(),
+        jnp.zeros((PLAYERS,), jnp.uint8),
+        check_distance=CHECK_DISTANCE,
+        max_prediction=CHECK_DISTANCE,
+    )
+    warm = _inputs(chunk, seed=100)
+    sess.run_ticks(warm)  # covers warmup ticks + compiles both programs
+    sess.run_ticks(warm)  # steady-state program now cached
+    sess.block_until_ready()
+
+    t0 = time.perf_counter()
+    done = 0
+    while done < total_ticks:
+        sess.run_ticks(_inputs(chunk, seed=done))
+        done += chunk
+    sess.block_until_ready()
+    dt = time.perf_counter() - t0
+    return done * CHECK_DISTANCE / dt
+
+
+def bench_host_baseline(ticks: int) -> float:
+    """The same synctest semantics executed the reference's way: a Python
+    request loop, one save/load/advance at a time, NumPy state."""
+    game = BoxGame(PLAYERS)
+    state = game.init_state_np()
+    saved = {}  # frame -> (state copy, checksum)
+    history = {}
+    inputs_by_frame = {}
+    d = CHECK_DISTANCE
+    ins = _inputs(ticks, seed=7)
+
+    def checksum(s):
+        return zlib.crc32(s["pos"].tobytes() + s["vel"].tobytes() + s["rot"].tobytes())
+
+    t0 = time.perf_counter()
+    resim_frames = 0
+    for frame in range(ticks):
+        inputs_by_frame[frame] = ins[frame]
+        if frame > d:
+            # verify window, then forced rollback: load + d×(save, advance)
+            for f in range(frame - d, frame):
+                if f in history and f in saved and saved[f][1] != history[f]:
+                    raise AssertionError("desync in baseline")
+            state = {k: v.copy() for k, v in saved[frame - d][0].items()}
+            for f in range(frame - d, frame):
+                if f > frame - d:
+                    saved[f] = ({k: v.copy() for k, v in state.items()}, checksum(state))
+                state = game.advance_np(state, inputs_by_frame[f])
+                resim_frames += 1
+        cs = checksum(state)
+        saved[frame] = ({k: v.copy() for k, v in state.items()}, cs)
+        history.setdefault(frame, cs)
+        state = game.advance_np(state, ins[frame])
+        # drop data outside the ring, like the real session
+        saved.pop(frame - d - 1, None)
+        inputs_by_frame.pop(frame - d - 1, None)
+    dt = time.perf_counter() - t0
+    return max(resim_frames, 1) / dt
+
+
+def main() -> None:
+    backend = jax.default_backend()
+    # enough work to dwarf dispatch overhead; chunked so inputs stream H2D
+    total_ticks, chunk = (16384, 1024) if backend == "tpu" else (4096, 512)
+    device_fps = bench_device(total_ticks, chunk)
+    host_fps = bench_host_baseline(600)
+    print(
+        json.dumps(
+            {
+                "metric": f"boxgame_synctest_resim_frames_per_sec_cd{CHECK_DISTANCE}",
+                "value": round(device_fps, 1),
+                "unit": "resim_frames/sec",
+                "vs_baseline": round(device_fps / host_fps, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -21,6 +21,7 @@ from .net import (
     UdpNonBlockingSocket,
 )
 from .sessions import (
+    DeviceSyncTestSession,
     P2PSession,
     SessionBuilder,
     SpectatorSession,
@@ -30,6 +31,7 @@ from .sessions import (
 __version__ = "0.1.0"
 
 __all__ = list(_core_all) + [
+    "DeviceSyncTestSession",
     "FakeSocket",
     "InMemoryNetwork",
     "Message",

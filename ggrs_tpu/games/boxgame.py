@@ -1,0 +1,181 @@
+"""BoxGame: the flagship deterministic workload.
+
+Capability parity with the reference example (2-4 ships, "ice physics":
+rotate / thrust / drift / wrap-around playfield,
+/root/reference/examples/ex_game/ex_game.rs:236-333), redesigned for TPU:
+
+- state is a pytree of arrays **vectorized over players** (no per-player
+  structs): ``{"pos": (P, 2), "vel": (P, 2), "rot": (P,)}``;
+- the canonical variant is **16.16 fixed-point int32** with a sine LUT, so the
+  simulation is bitwise identical on TPU, CPU, and the NumPy mirror — the
+  property the desync gate needs.  (The reference's float example famously
+  desyncs across architectures; its README says to use integers for
+  cross-platform determinism, /root/reference/examples/README.md:16-21.)
+- a float32 variant exists for physics-feel parity; it is only
+  deterministic *within* one backend.
+
+Inputs are one ``uint8`` bitmask per player (up/down/left/right), the same
+encoding the reference example uses for its wire input.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.config import Config
+
+BOX_INPUT_UP = 1 << 0
+BOX_INPUT_DOWN = 1 << 1
+BOX_INPUT_LEFT = 1 << 2
+BOX_INPUT_RIGHT = 1 << 3
+
+# playfield and physics constants, 16.16 fixed point
+_FP = 16
+_ONE = 1 << _FP
+WINDOW_W = 800 * _ONE
+WINDOW_H = 600 * _ONE
+_ACCEL = int(0.12 * _ONE)  # thrust per frame
+_MAX_SPEED = 6 * _ONE  # per-axis speed clamp
+_FRICTION_NUM = 252  # vel *= 252/256 per frame ("ice")
+_ROT_STEP = 3  # LUT steps per frame of turning
+_ROT_PERIOD = 256  # sine LUT length (full circle)
+
+# int32 sine LUT in 16.16: sin_fp[i] = round(sin(2*pi*i/256) * 65536).
+# Module-level constant => identical on every host; lookups are gathers.
+_SIN_FP = np.round(
+    np.sin(2.0 * np.pi * np.arange(_ROT_PERIOD) / _ROT_PERIOD) * _ONE
+).astype(np.int32)
+
+
+def _decode_buttons(inputs: Any, xp: Any) -> Tuple[Any, Any]:
+    """bitmask (P,) -> (turn, thrust) in {-1, 0, 1} as int32."""
+    inp = inputs.astype(xp.int32)
+    up = (inp >> 0) & 1
+    down = (inp >> 1) & 1
+    left = (inp >> 2) & 1
+    right = (inp >> 3) & 1
+    return right - left, up - down
+
+
+class BoxGame:
+    """Factory for init state / advance functions at a given player count.
+
+    ``advance`` / ``init_state`` are pure and jittable; ``advance_np`` is the
+    NumPy mirror used as the independent CPU reference in the desync gate.
+    """
+
+    def __init__(self, num_players: int, variant: str = "fixed") -> None:
+        assert 2 <= num_players <= 4, "BoxGame supports 2-4 players"
+        assert variant in ("fixed", "float")
+        self.num_players = num_players
+        self.variant = variant
+
+    # -- state ---------------------------------------------------------
+
+    def init_state(self) -> Dict[str, jax.Array]:
+        """Ships spaced around the playfield center, facing outward."""
+        p = self.num_players
+        angles = (np.arange(p) * (_ROT_PERIOD // p)) % _ROT_PERIOD
+        cx, cy = WINDOW_W // 2, WINDOW_H // 2
+        r = 150 * _ONE
+        cos = _SIN_FP[(angles + _ROT_PERIOD // 4) % _ROT_PERIOD].astype(np.int64)
+        sin = _SIN_FP[angles].astype(np.int64)
+        pos = np.stack(
+            [cx + ((r * cos) >> _FP), cy + ((r * sin) >> _FP)], axis=1
+        ).astype(np.int32)
+        state = {
+            "pos": pos,
+            "vel": np.zeros((p, 2), np.int32),
+            "rot": angles.astype(np.int32),
+        }
+        if self.variant == "float":
+            state = {
+                "pos": (state["pos"] / _ONE).astype(np.float32),
+                "vel": np.zeros((p, 2), np.float32),
+                "rot": (angles * (2 * np.pi / _ROT_PERIOD)).astype(np.float32),
+            }
+        return jax.tree_util.tree_map(jnp.asarray, state)
+
+    # -- advance: jax --------------------------------------------------
+
+    def advance(self, state: Any, inputs: Any) -> Any:
+        """One simulation step. ``inputs``: (P,) uint8 button bitmasks."""
+        if self.variant == "float":
+            return self._advance_float(state, inputs)
+        turn, thrust = _decode_buttons(inputs, jnp)
+        rot = jnp.remainder(state["rot"] + turn * _ROT_STEP, _ROT_PERIOD)
+        sin_lut = jnp.asarray(_SIN_FP)
+        cos = sin_lut[jnp.remainder(rot + _ROT_PERIOD // 4, _ROT_PERIOD)]
+        sin = sin_lut[rot]
+        # thrust is ±1; _ACCEL * cos fits int32 (≤ 0.12 * 2^32 / 2 range)
+        acc = jnp.stack(
+            [
+                (thrust * ((_ACCEL * cos) >> _FP)),
+                (thrust * ((_ACCEL * sin) >> _FP)),
+            ],
+            axis=1,
+        )
+        vel = state["vel"] + acc
+        vel = jnp.clip(vel, -_MAX_SPEED, _MAX_SPEED)
+        vel = (vel * _FRICTION_NUM) >> 8
+        window = jnp.asarray([WINDOW_W, WINDOW_H], jnp.int32)
+        pos = jnp.remainder(state["pos"] + vel, window)
+        return {"pos": pos.astype(jnp.int32), "vel": vel.astype(jnp.int32), "rot": rot}
+
+    def _advance_float(self, state: Any, inputs: Any) -> Any:
+        turn, thrust = _decode_buttons(inputs, jnp)
+        rot = jnp.remainder(
+            state["rot"] + turn.astype(jnp.float32) * np.float32(0.05),
+            np.float32(2 * np.pi),
+        )
+        acc = thrust.astype(jnp.float32)[:, None] * jnp.stack(
+            [jnp.cos(rot), jnp.sin(rot)], axis=1
+        ) * np.float32(0.12)
+        vel = jnp.clip(state["vel"] + acc, -6.0, 6.0) * np.float32(
+            _FRICTION_NUM / 256.0
+        )
+        window = jnp.asarray([800.0, 600.0], jnp.float32)
+        pos = jnp.remainder(state["pos"] + vel, window)
+        return {"pos": pos, "vel": vel, "rot": rot}
+
+    # -- advance: numpy mirror (the independent CPU oracle) ------------
+
+    def advance_np(self, state: Dict[str, np.ndarray], inputs: np.ndarray) -> Dict[str, np.ndarray]:
+        """Bitwise mirror of ``advance`` in plain NumPy (fixed variant only).
+
+        Used as the desync gate's CPU reference: TPU-resident simulation must
+        produce checksums identical to this."""
+        assert self.variant == "fixed"
+        turn, thrust = _decode_buttons(inputs, np)
+        rot = np.remainder(state["rot"] + turn * _ROT_STEP, _ROT_PERIOD).astype(
+            np.int32
+        )
+        cos = _SIN_FP[np.remainder(rot + _ROT_PERIOD // 4, _ROT_PERIOD)]
+        sin = _SIN_FP[rot]
+        acc = np.stack(
+            [
+                thrust * ((_ACCEL * cos.astype(np.int64)) >> _FP).astype(np.int32),
+                thrust * ((_ACCEL * sin.astype(np.int64)) >> _FP).astype(np.int32),
+            ],
+            axis=1,
+        ).astype(np.int32)
+        vel = state["vel"] + acc
+        vel = np.clip(vel, -_MAX_SPEED, _MAX_SPEED)
+        vel = ((vel * np.int64(_FRICTION_NUM)) >> 8).astype(np.int32)
+        window = np.asarray([WINDOW_W, WINDOW_H], np.int32)
+        pos = np.remainder(state["pos"] + vel, window).astype(np.int32)
+        return {"pos": pos, "vel": vel, "rot": rot}
+
+    def init_state_np(self) -> Dict[str, np.ndarray]:
+        assert self.variant == "fixed"
+        return jax.tree_util.tree_map(np.asarray, self.init_state())
+
+
+def boxgame_config() -> Config:
+    """Host-session Config for BoxGame inputs (one u8 bitmask per player)."""
+    return Config.for_uint(bits=8)

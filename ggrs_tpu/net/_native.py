@@ -1,0 +1,191 @@
+"""ctypes loader for the native input codec (native/codec.cpp).
+
+The codec is the per-packet hot path on the UDP side, the one place hand
+written C++ is warranted (SURVEY §2 native note).  This module compiles the
+shared library on first use (g++, no pybind11 needed), caches it next to the
+package, and exposes ``encode``/``decode`` with the exact signatures of
+``ggrs_tpu.net.compression`` — the pure-Python implementations remain the
+fallback whenever a toolchain is unavailable.
+
+Set GGRS_TPU_NO_NATIVE=1 to force the Python codec.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sys
+import threading
+from pathlib import Path
+from typing import List, Optional, Sequence
+
+_LIB_NAME = "_ggrs_codec.so"
+_MAX_DECODED_BYTES = 1 << 22
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+_decode_out = None
+_decode_sizes = None
+
+_ERROR_NAMES = {
+    -1: "truncated data",
+    -2: "uvarint too long",
+    -3: "decoded data exceeds maximum size",
+    -4: "literal run exceeds remaining data",
+    -5: "invalid size-mode byte",
+    -6: "input size is negative or too large",
+    -7: "decoded byte count does not match expected sizes",
+    -8: "reference must be non-empty to decode inputs of unknown size",
+    -9: "encoded bytes not a multiple of the reference size",
+    -10: "trailing bytes after message",
+    -11: "output buffer too small",
+    -12: "too many inputs",
+}
+
+
+def _source_path() -> Path:
+    return Path(__file__).resolve().parents[2] / "native" / "codec.cpp"
+
+
+def _build(lib_path: Path) -> bool:
+    src = _source_path()
+    if not src.exists():
+        return False
+    cmd = [
+        "g++",
+        "-O2",
+        "-shared",
+        "-fPIC",
+        "-std=c++17",
+        "-o",
+        str(lib_path),
+        str(src),
+    ]
+    try:
+        subprocess.run(
+            cmd, check=True, capture_output=True, timeout=120
+        )
+        return True
+    except (subprocess.SubprocessError, OSError):
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_failed
+    if _lib is not None:
+        return _lib
+    if _load_failed or os.environ.get("GGRS_TPU_NO_NATIVE"):
+        return None
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        lib_path = Path(__file__).resolve().parent / _LIB_NAME
+        src = _source_path()
+        try:
+            stale = not lib_path.exists() or (
+                src.exists() and src.stat().st_mtime > lib_path.stat().st_mtime
+            )
+            if stale and not _build(lib_path):
+                _load_failed = True
+                return None
+            lib = ctypes.CDLL(str(lib_path))
+        except OSError:
+            _load_failed = True
+            return None
+
+        lib.ggrs_codec_encode_bound.restype = ctypes.c_size_t
+        lib.ggrs_codec_encode_bound.argtypes = [ctypes.c_size_t, ctypes.c_size_t]
+        lib.ggrs_codec_encode.restype = ctypes.c_int
+        lib.ggrs_codec_encode.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_size_t),
+            ctypes.c_size_t,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
+        lib.ggrs_codec_decode.restype = ctypes.c_int
+        lib.ggrs_codec_decode.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_size_t),
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def encode(reference: bytes, inputs: Sequence[bytes]) -> Optional[bytes]:
+    """Native encode; returns None if the library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    blob = b"".join(inputs)
+    n = len(inputs)
+    lens = (ctypes.c_size_t * max(n, 1))(*[len(i) for i in inputs])
+    cap = lib.ggrs_codec_encode_bound(len(blob), n)
+    out = ctypes.create_string_buffer(cap)
+    out_len = ctypes.c_size_t(0)
+    rc = lib.ggrs_codec_encode(
+        reference,
+        len(reference),
+        blob,
+        lens,
+        n,
+        out,
+        cap,
+        ctypes.byref(out_len),
+    )
+    if rc != 0:  # pragma: no cover - encode can only fail on a bad bound
+        raise RuntimeError(f"native encode failed: {_ERROR_NAMES.get(rc, rc)}")
+    return out.raw[: out_len.value]
+
+
+def decode(reference: bytes, data: bytes) -> Optional[List[bytes]]:
+    """Native decode; returns None if unavailable.  Raises ``CodecError`` (the
+    same type the Python codec raises) on malformed data."""
+    lib = _load()
+    if lib is None:
+        return None
+    from .compression import CodecError
+
+    global _decode_out, _decode_sizes
+    with _lock:  # buffers are reused across calls; protocol use is 1-thread
+        if _decode_out is None:
+            _decode_out = ctypes.create_string_buffer(_MAX_DECODED_BYTES)
+            _decode_sizes = (ctypes.c_size_t * _MAX_DECODED_BYTES)()
+        out, out_sizes = _decode_out, _decode_sizes
+        out_count = ctypes.c_size_t(0)
+        rc = lib.ggrs_codec_decode(
+            reference,
+            len(reference),
+            data,
+            len(data),
+            out,
+            _MAX_DECODED_BYTES,
+            out_sizes,
+            _MAX_DECODED_BYTES,
+            ctypes.byref(out_count),
+        )
+        if rc != 0:
+            raise CodecError(_ERROR_NAMES.get(rc, f"native error {rc}"))
+        result: List[bytes] = []
+        pos = 0
+        for i in range(out_count.value):
+            size = out_sizes[i]
+            result.append(out.raw[pos : pos + size])
+            pos += size
+        return result

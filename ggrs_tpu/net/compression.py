@@ -99,7 +99,35 @@ def _rle_decode(data: bytes, max_bytes: int = MAX_DECODED_BYTES) -> bytearray:
 
 
 def encode(reference: bytes, inputs: Sequence[bytes]) -> bytes:
-    """Compress ``inputs`` (oldest first) against ``reference``."""
+    """Compress ``inputs`` (oldest first) against ``reference``.
+
+    Dispatches to the C++ codec (net/_native.py) when available; the Python
+    implementation below is the always-present fallback and the semantic
+    reference for both."""
+    from . import _native
+
+    native = _native.encode(reference, inputs)
+    if native is not None:
+        return native
+    return encode_py(reference, inputs)
+
+
+def decode(reference: bytes, data: bytes) -> List[bytes]:
+    """Decompress into the original input byte strings.  Raises CodecError on
+    any malformed input.  Dispatches to the C++ codec when available."""
+    from . import _native
+
+    try:
+        native = _native.decode(reference, data)
+    except CodecError:
+        raise
+    if native is not None:
+        return native
+    return decode_py(reference, data)
+
+
+def encode_py(reference: bytes, inputs: Sequence[bytes]) -> bytes:
+    """Pure-Python encode (the semantic reference)."""
     same_size = len(reference) > 0 and all(len(i) == len(reference) for i in inputs)
 
     delta = _delta_bytes(reference, inputs)
@@ -122,9 +150,8 @@ def encode(reference: bytes, inputs: Sequence[bytes]) -> bytes:
     return w.finish()
 
 
-def decode(reference: bytes, data: bytes) -> List[bytes]:
-    """Decompress into the original input byte strings.  Raises CodecError on
-    any malformed input."""
+def decode_py(reference: bytes, data: bytes) -> List[bytes]:
+    """Pure-Python decode (the semantic reference; hardened)."""
     try:
         r = Reader(data)
         has_sizes = r.u8()

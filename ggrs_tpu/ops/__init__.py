@@ -1,0 +1,30 @@
+"""Device-side primitives: on-device checksums, the HBM-resident state ring,
+and the fused rollback replay.
+
+These are the TPU-native equivalents of the reference's hot path — the
+load→(save, advance)^N resimulation loop that the Rust reference executes as
+user-side request fulfillment (/root/reference/src/sessions/sync_test_session.rs,
+/root/reference/src/sync_layer.rs).  Here the whole loop is one compiled XLA
+program and game state never leaves HBM; only scalar checksums cross to host.
+"""
+
+from .checksum import (
+    CHECKSUM_LANES,
+    checksum_device,
+    checksum_to_u128,
+    pytree_checksum,
+)
+from .executor import DeviceRequestExecutor
+from .ring import DeviceStateRing
+from .replay import ReplayPrograms, build_replay_programs
+
+__all__ = [
+    "CHECKSUM_LANES",
+    "checksum_device",
+    "checksum_to_u128",
+    "pytree_checksum",
+    "DeviceRequestExecutor",
+    "DeviceStateRing",
+    "ReplayPrograms",
+    "build_replay_programs",
+]

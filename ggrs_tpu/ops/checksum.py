@@ -1,0 +1,110 @@
+"""On-device state checksums.
+
+The reference leaves checksumming to the user (fletcher16 over bincode bytes in
+the example game, /root/reference/examples/ex_game/ex_game.rs:45-55) and carries
+checksums as u128 on the wire (/root/reference/src/network/messages.rs:95-104).
+A TPU-native framework cannot serialize a pytree to bytes per frame — that
+would drag every state through host memory.  Instead we compute a
+position-sensitive 4-lane u32 digest directly on device with pure integer ops
+(bitwise identical on every XLA backend, which is what the desync gate needs),
+and compose the lanes into a single u128 host-side for wire/API parity.
+
+Design notes:
+- all arithmetic is uint32 with natural mod-2^32 wraparound — deterministic on
+  TPU (which has no native u64) and identical on CPU;
+- lanes: (sum of words, index-weighted sum, odd-stride weighted sum, xor-rotate
+  mix) per leaf, folded across leaves with a Knuth-multiplicative mix so leaf
+  order matters;
+- float leaves are bitcast, not converted: checksum equality means bitwise
+  state equality, exactly the guarantee desync detection is built on.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+# Number of u32 lanes in the device digest; composed into one u128 on host.
+CHECKSUM_LANES = 4
+
+_GOLDEN = np.uint32(2654435761)  # Knuth multiplicative constant
+_PRIME_A = np.uint32(40503)
+_PRIME_B = np.uint32(2246822519)
+
+
+def _as_u32_words(x: jax.Array) -> jax.Array:
+    """Flatten any array to a 1-D uint32 word vector via bitcast (zero-pad to a
+    4-byte multiple for sub-word dtypes)."""
+    flat = jnp.ravel(x)
+    if flat.dtype == jnp.bool_:
+        # bitcast rejects bool; uint8 widening is bitwise-stable for bools
+        flat = flat.astype(jnp.uint8)
+    nbytes = flat.dtype.itemsize
+    if nbytes == 4:
+        return jax.lax.bitcast_convert_type(flat, jnp.uint32)
+    if nbytes == 8:
+        # split 8-byte elements into two u32 words (works on TPU where u64 is
+        # unavailable: bitcast to (n, 2) u32)
+        return jnp.ravel(jax.lax.bitcast_convert_type(flat, jnp.uint32))
+    # 1- or 2-byte dtypes: widen through uint32 after bitcasting to same-size
+    # unsigned int so float16/bfloat16 stay bitwise-exact
+    uint_t = {1: jnp.uint8, 2: jnp.uint16}[nbytes]
+    words_small = jax.lax.bitcast_convert_type(flat, uint_t).astype(jnp.uint32)
+    per = 4 // nbytes
+    pad = (-words_small.shape[0]) % per
+    if pad:
+        words_small = jnp.concatenate(
+            [words_small, jnp.zeros((pad,), jnp.uint32)]
+        )
+    packed = words_small.reshape(-1, per)
+    shifts = (jnp.arange(per, dtype=jnp.uint32) * np.uint32(8 * nbytes))
+    return jnp.sum(packed << shifts[None, :], axis=1, dtype=jnp.uint32)
+
+
+def _leaf_digest(x: jax.Array) -> jax.Array:
+    """4-lane u32 digest of one array leaf; position-sensitive."""
+    w = _as_u32_words(x)
+    n = w.shape[0]
+    idx = jnp.arange(1, n + 1, dtype=jnp.uint32)
+    lane0 = jnp.sum(w, dtype=jnp.uint32)
+    lane1 = jnp.sum(w * idx, dtype=jnp.uint32)
+    lane2 = jnp.sum(w * (idx * _PRIME_A + jnp.uint32(1)), dtype=jnp.uint32)
+    rot = (w << jnp.uint32(13)) | (w >> jnp.uint32(19))
+    lane3 = jnp.sum(rot ^ (idx * _PRIME_B), dtype=jnp.uint32)
+    return jnp.stack([lane0, lane1, lane2, lane3])
+
+
+def checksum_device(state: Any) -> jax.Array:
+    """Digest a whole pytree into a ``(4,)`` uint32 array, on device.
+
+    Pure and jittable; safe inside ``lax.scan`` bodies.  Leaf traversal order
+    is the deterministic ``jax.tree_util`` order, so two peers running the same
+    program on the same state get the same digest bit-for-bit.
+    """
+    leaves = jax.tree_util.tree_leaves(state)
+    acc = jnp.array([0x9E3779B9, 0x85EBCA6B, 0xC2B2AE35, 0x27D4EB2F], jnp.uint32)
+    for leaf in leaves:
+        d = _leaf_digest(jnp.asarray(leaf))
+        acc = acc * _GOLDEN + d
+        acc = acc ^ (acc >> jnp.uint32(15))
+    return acc
+
+
+def checksum_to_u128(lanes: Any) -> int:
+    """Compose a 4-lane digest into the u128 integer the wire/API carries
+    (reference wire type: /root/reference/src/network/messages.rs:95-104)."""
+    arr = np.asarray(lanes, dtype=np.uint32)
+    assert arr.shape == (CHECKSUM_LANES,)
+    out = 0
+    for i, lane in enumerate(arr):
+        out |= int(lane) << (32 * i)
+    return out
+
+
+def pytree_checksum(state: Any) -> int:
+    """One-call convenience: device digest + host composition → u128 int."""
+    return checksum_to_u128(jax.device_get(checksum_device(state)))

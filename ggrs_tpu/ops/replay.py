@@ -1,0 +1,227 @@
+"""The fused rollback replay: load → (advance, save)^d → advance, as one XLA
+program.
+
+This is the TPU-native form of the reference's hot loop — the request list a
+SyncTest/P2P session emits per tick (Load, then ``check_distance`` resimulated
+Save/Advance pairs, then the live Save/Advance;
+/root/reference/src/sessions/sync_test_session.rs:85-150 and
+/root/reference/src/sessions/p2p_session.rs:658-714).  The reference executes
+those 2d+2 requests one by one through user callbacks; here a whole *tick* is
+one jitted function and ``run_*`` scans hundreds of ticks per dispatch, so
+state and inputs stay in HBM and only scalar desync counters ever reach the
+host.
+
+Determinism checking is also device-side: a first-seen checksum history ring is
+compared against every resimulated frame's digest, reproducing the SyncTest
+contract (first-seen vs. later resimulations,
+/root/reference/src/sessions/sync_test_session.rs:173-190) without a per-frame
+device→host sync.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .checksum import CHECKSUM_LANES, checksum_device
+from .ring import DeviceStateRing
+
+_I32_MAX = jnp.iinfo(jnp.int32).max
+
+AdvanceFn = Callable[[Any, Any], Any]  # (state_pytree, inputs_for_frame) -> state
+ChecksumFn = Callable[[Any], jax.Array]  # state_pytree -> (4,) uint32
+
+
+@dataclass(frozen=True)
+class ReplayPrograms:
+    """Compiled tick programs over a fixed (advance, ring, check_distance).
+
+    ``carry`` layout (a plain pytree, lives on device between calls):
+      ring       — DeviceStateRing buffers (states / checksums / frames)
+      inputs     — input ring, same slotting as the state ring
+      hist       — (R, 4) u32 first-seen checksum per frame slot
+      live       — the current (unsaved) game state
+      frame      — i32 scalar, the session's current frame
+      mismatches — i32 count of resimulated frames whose digest diverged
+      first_bad  — i32 earliest mismatched frame (INT32_MAX if none)
+    """
+
+    ring: DeviceStateRing
+    check_distance: int
+    run_warmup: Callable[[Any, Any], Any]
+    run_steady: Callable[[Any, Any], Any]
+    init_carry: Callable[[Any, Any], Any]
+    # un-jitted pure forms of run_warmup/run_steady, for composition with
+    # vmap / shard_map (session batching) before the final jit
+    scan_warmup: Callable[[Any, Any], Any] = None
+    scan_steady: Callable[[Any, Any], Any] = None
+
+    @property
+    def warmup_ticks(self) -> int:
+        """Ticks before rollback starts: frames 0..d inclusive (the reference
+        only rolls back once current_frame > check_distance)."""
+        return self.check_distance + 1
+
+    def split_at_warmup(self, ticks_run: int, n: int) -> int:
+        """How many of the next ``n`` ticks must go through the warmup program
+        given ``ticks_run`` ticks already executed."""
+        return min(max(0, self.warmup_ticks - ticks_run), n)
+
+
+def _store_input(ring: DeviceStateRing, inputs: Any, frame: jax.Array, inp: Any) -> Any:
+    i = ring.slot(frame)
+    return jax.tree_util.tree_map(
+        lambda buf, leaf: jax.lax.dynamic_update_index_in_dim(
+            buf, jnp.asarray(leaf, buf.dtype), i, axis=0
+        ),
+        inputs,
+        inp,
+    )
+
+
+def _read_input(ring: DeviceStateRing, inputs: Any, frame: jax.Array) -> Any:
+    i = ring.slot(frame)
+    return jax.tree_util.tree_map(
+        lambda buf: jax.lax.dynamic_index_in_dim(buf, i, axis=0, keepdims=False),
+        inputs,
+    )
+
+
+def build_replay_programs(
+    advance: AdvanceFn,
+    ring_length: int,
+    check_distance: int,
+    checksum: ChecksumFn = checksum_device,
+    donate: Optional[bool] = None,
+) -> ReplayPrograms:
+    """Compile the warmup/steady tick programs.
+
+    ``advance`` must be a pure JAX function ``(state, inputs) -> state`` with
+    static shapes — the user-supplied simulation, the analog of fulfilling an
+    AdvanceFrame request (/root/reference/src/lib.rs:183-189).
+    ``ring_length`` must exceed ``check_distance`` so the rollback target is
+    still in the ring, mirroring ``max_prediction + 1`` cells in the reference.
+    ``donate``: donate the carry buffers to each dispatch (in-place HBM update);
+    defaults to on for TPU, off elsewhere (CPU/interpret donation is a no-op
+    that only produces warnings).
+    """
+    assert check_distance >= 1, "device replay needs check_distance >= 1"
+    assert ring_length > check_distance, "ring must cover the rollback window"
+    ring = DeviceStateRing(ring_length)
+    d = check_distance
+    if donate is None:
+        donate = jax.default_backend() == "tpu"
+
+    def warmup_tick(carry: Any, inp: Any) -> Any:
+        # [Save, Advance] — the pre-rollback request pattern
+        frame = carry["frame"]
+        cs = checksum(carry["live"])
+        new_ring = ring.save(carry["ring"], frame, carry["live"], cs)
+        hist = jax.lax.dynamic_update_index_in_dim(
+            carry["hist"], cs, ring.slot(frame), axis=0
+        )
+        inputs = _store_input(ring, carry["inputs"], frame, inp)
+        live = advance(carry["live"], inp)
+        return {
+            **carry,
+            "ring": new_ring,
+            "inputs": inputs,
+            "hist": hist,
+            "live": live,
+            "frame": frame + 1,
+        }
+
+    def steady_tick(carry: Any, inp: Any) -> Any:
+        # [Load, (Save, Advance)×d resim, Save, Advance] — 2d+2 requests fused
+        frame = carry["frame"]  # F
+        inputs = _store_input(ring, carry["inputs"], frame, inp)
+
+        loaded = ring.load(carry["ring"], frame - d)
+
+        def resim_step(scan_carry: Any, j: jax.Array) -> Tuple[Any, jax.Array]:
+            st, rng = scan_carry
+            f_j = frame - d + j  # frame whose input we consume
+            st = advance(st, _read_input(ring, inputs, f_j))
+            cs = checksum(st)
+            rng = ring.save(rng, f_j + 1, st, cs)
+            return (st, rng), cs
+
+        (st, new_ring), resim_cs = jax.lax.scan(
+            resim_step, (loaded, carry["ring"]), jnp.arange(d, dtype=jnp.int32)
+        )
+        # resim_cs[j] digests frame F-d+1+j; the first d-1 entries are
+        # re-simulations of frames already in the history — compare; the last
+        # (frame F) is first-seen — record.
+        resim_frames = frame - d + 1 + jnp.arange(d, dtype=jnp.int32)
+        seen = jax.vmap(
+            lambda f: jax.lax.dynamic_index_in_dim(
+                carry["hist"], ring.slot(f), axis=0, keepdims=False
+            )
+        )(resim_frames)
+        is_resim = jnp.arange(d) < (d - 1)
+        bad = jnp.any(resim_cs != seen, axis=1) & is_resim
+        mismatches = carry["mismatches"] + jnp.sum(bad, dtype=jnp.int32)
+        first_bad = jnp.minimum(
+            carry["first_bad"],
+            jnp.min(jnp.where(bad, resim_frames, _I32_MAX)),
+        )
+        hist = jax.lax.dynamic_update_index_in_dim(
+            carry["hist"], resim_cs[-1], ring.slot(frame), axis=0
+        )
+        live = advance(st, inp)  # st is the resimulated state at F
+        return {
+            "ring": new_ring,
+            "inputs": inputs,
+            "hist": hist,
+            "live": live,
+            "frame": frame + 1,
+            "mismatches": mismatches,
+            "first_bad": first_bad,
+        }
+
+    def _scan_ticks(tick: Callable, carry: Any, tick_inputs: Any) -> Any:
+        def body(c: Any, inp: Any) -> Tuple[Any, None]:
+            return tick(c, inp), None
+
+        out, _ = jax.lax.scan(body, carry, tick_inputs)
+        return out
+
+    donate_argnums = (0,) if donate else ()
+    scan_warmup = partial(_scan_ticks, warmup_tick)
+    scan_steady = partial(_scan_ticks, steady_tick)
+    run_warmup = jax.jit(scan_warmup, donate_argnums=donate_argnums)
+    run_steady = jax.jit(scan_steady, donate_argnums=donate_argnums)
+
+    def init_carry(init_state: Any, input_template: Any) -> Any:
+        """Device carry for a session starting at frame 0 with ``init_state``.
+        ``input_template`` is one frame's worth of inputs (e.g. a (P,) array)
+        used to shape the input ring."""
+        inputs = jax.tree_util.tree_map(
+            lambda leaf: jnp.zeros(
+                (ring_length,) + jnp.asarray(leaf).shape, jnp.asarray(leaf).dtype
+            ),
+            input_template,
+        )
+        return {
+            "ring": ring.init(init_state),
+            "inputs": inputs,
+            "hist": jnp.zeros((ring_length, CHECKSUM_LANES), jnp.uint32),
+            "live": jax.tree_util.tree_map(jnp.asarray, init_state),
+            "frame": jnp.int32(0),
+            "mismatches": jnp.int32(0),
+            "first_bad": jnp.int32(_I32_MAX),
+        }
+
+    return ReplayPrograms(
+        ring=ring,
+        check_distance=d,
+        run_warmup=run_warmup,
+        run_steady=run_steady,
+        init_carry=init_carry,
+        scan_warmup=scan_warmup,
+        scan_steady=scan_steady,
+    )

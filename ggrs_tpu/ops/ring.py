@@ -1,0 +1,97 @@
+"""HBM-resident state ring.
+
+The reference keeps ``max_prediction + 1`` saved states in a ring of
+host-memory cells indexed ``frame % len`` (/root/reference/src/sync_layer.rs:144-166).
+The TPU equivalent stacks every saved state into one pytree with a leading ring
+axis that lives in HBM for the whole session: *save* is a
+``dynamic_update_index_in_dim`` write, *load* is a gather, and neither moves a
+byte to the host.  Checksums for each slot are kept in a parallel ``(R, 4)``
+uint32 array so desync/synctest comparisons are device-side too.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .checksum import CHECKSUM_LANES
+
+
+class DeviceStateRing:
+    """Functional ring buffer over a pytree-of-arrays.
+
+    All methods are pure (return new buffers) and jittable; a ring is just a
+    pytree ``{"states": stacked pytree, "checksums": (R, 4) u32,
+    "frames": (R,) i32}`` and can live inside ``lax.scan`` carries.  The class
+    only holds the static ring length and offers the index math; this mirrors
+    how ``SavedStates`` owns cells while the session owns frame bookkeeping.
+    """
+
+    def __init__(self, length: int) -> None:
+        assert length >= 1
+        self.length = length
+
+    # -- construction --------------------------------------------------
+
+    def init(self, template_state: Any) -> Any:
+        """Build ring buffers by broadcasting ``template_state`` into every
+        slot (slot frames start as NULL_FRAME = -1)."""
+        stacked = jax.tree_util.tree_map(
+            lambda leaf: jnp.broadcast_to(
+                jnp.asarray(leaf)[None, ...],
+                (self.length,) + jnp.asarray(leaf).shape,
+            ).copy(),
+            template_state,
+        )
+        return {
+            "states": stacked,
+            "checksums": jnp.zeros((self.length, CHECKSUM_LANES), jnp.uint32),
+            "frames": jnp.full((self.length,), -1, jnp.int32),
+        }
+
+    # -- index math ----------------------------------------------------
+
+    def slot(self, frame: jax.Array) -> jax.Array:
+        """``frame % R`` with traced frames (frame >= 0)."""
+        return jax.lax.rem(jnp.asarray(frame, jnp.int32), jnp.int32(self.length))
+
+    # -- save / load ---------------------------------------------------
+
+    def save(
+        self, ring: Any, frame: jax.Array, state: Any, checksum: jax.Array
+    ) -> Any:
+        """Write ``state`` (+ checksum) into the slot for ``frame``."""
+        i = self.slot(frame)
+        return {
+            "states": jax.tree_util.tree_map(
+                lambda buf, leaf: jax.lax.dynamic_update_index_in_dim(
+                    buf, jnp.asarray(leaf, buf.dtype), i, axis=0
+                ),
+                ring["states"],
+                state,
+            ),
+            "checksums": jax.lax.dynamic_update_index_in_dim(
+                ring["checksums"], checksum, i, axis=0
+            ),
+            "frames": ring["frames"].at[i].set(jnp.asarray(frame, jnp.int32)),
+        }
+
+    def load(self, ring: Any, frame: jax.Array) -> Any:
+        """Read the state stored in the slot for ``frame``."""
+        i = self.slot(frame)
+        return jax.tree_util.tree_map(
+            lambda buf: jax.lax.dynamic_index_in_dim(buf, i, axis=0, keepdims=False),
+            ring["states"],
+        )
+
+    def load_checksum(self, ring: Any, frame: jax.Array) -> jax.Array:
+        return jax.lax.dynamic_index_in_dim(
+            ring["checksums"], self.slot(frame), axis=0, keepdims=False
+        )
+
+    def frame_at(self, ring: Any, frame: jax.Array) -> jax.Array:
+        """The frame number actually stored in ``frame``'s slot (NULL_FRAME if
+        never written) — the device analog of ``GameStateCell.frame``."""
+        return ring["frames"][self.slot(frame)]

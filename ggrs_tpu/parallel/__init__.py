@@ -1,0 +1,24 @@
+"""TPU parallelism strategies.
+
+The reference is a single-threaded Rust library with no parallelism at all
+(SURVEY §2, parallelism note).  The TPU build's first-class axes are new
+design, not ports:
+
+- **temporal** — the rollback replay as ``lax.scan`` (ggrs_tpu.ops.replay);
+- **speculative** — ``vmap`` over K predicted-input branches with post-hoc
+  selection on confirmed inputs (``speculation``);
+- **session** — ``shard_map`` batching of many independent sessions across a
+  device mesh with ICI collectives for global health counters (``batch``);
+- **player/entity** — vectorization inside one state pytree (the games do
+  this by construction, e.g. BoxGame's (P, ...) arrays).
+"""
+
+from .speculation import SpeculativeBranches, build_speculation_programs
+from .batch import BatchedSessions, make_mesh
+
+__all__ = [
+    "BatchedSessions",
+    "SpeculativeBranches",
+    "build_speculation_programs",
+    "make_mesh",
+]

@@ -1,0 +1,155 @@
+"""Session parallelism: many independent sessions batched across a device mesh.
+
+The reference runs exactly one session per process; scaling to hundreds of
+matches means hundreds of processes.  On TPU the same hundreds of sessions are
+one program: session state gets a leading batch axis (``vmap``), the batch is
+sharded across chips over a 1-D ``Mesh`` with ``shard_map`` so each chip owns
+``B / n_devices`` sessions, and the only cross-chip traffic is the scalar
+health reduction (``psum`` over ICI — desync and frame counters), exactly the
+collective-over-ICI design SURVEY §2's backend note calls for.  This is
+BASELINE config 5's shape (256 concurrent SyncTest sessions on v5e-8).
+
+Works identically on a virtual ``--xla_force_host_platform_device_count=N``
+CPU mesh, which is how tests and the driver's multi-chip dry-run exercise it.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.8
+    from jax import shard_map
+except ImportError:  # pragma: no cover - older jax
+    from jax.experimental.shard_map import shard_map
+
+from ..ops.replay import ReplayPrograms, build_replay_programs
+
+SESSION_AXIS = "sessions"
+
+
+def make_mesh(n_devices: Optional[int] = None, axis: str = SESSION_AXIS) -> Mesh:
+    """1-D mesh over the first ``n_devices`` (default: all) devices."""
+    devs = jax.devices()
+    if n_devices is not None:
+        assert n_devices <= len(devs), (
+            f"asked for {n_devices} devices, have {len(devs)}"
+        )
+        devs = devs[:n_devices]
+    return Mesh(np.asarray(devs), (axis,))
+
+
+class BatchedSessions:
+    """B independent device-synctest sessions as one sharded program.
+
+    All sessions share the same (advance, check_distance) program but have
+    independent states, inputs, and desync counters.  ``run_ticks`` dispatches
+    one program for the whole batch; mismatch totals come back via an on-mesh
+    ``psum`` so the host reads two scalars per call, regardless of B.
+    """
+
+    def __init__(
+        self,
+        advance: Callable[[Any, Any], Any],
+        init_state: Any,
+        input_template: Any,
+        batch_size: int,
+        mesh: Optional[Mesh] = None,
+        check_distance: int = 2,
+        max_prediction: int = 8,
+    ) -> None:
+        self.mesh = mesh if mesh is not None else make_mesh()
+        n_dev = self.mesh.devices.size
+        assert batch_size % n_dev == 0, (
+            f"batch_size {batch_size} must divide evenly over {n_dev} devices"
+        )
+        self.batch_size = batch_size
+        ring_length = max(max_prediction, check_distance) + 1
+        self._programs: ReplayPrograms = build_replay_programs(
+            advance, ring_length, check_distance, donate=False
+        )
+        self.check_distance = check_distance
+        self._ticks_run = 0
+
+        spec_b = P(SESSION_AXIS)  # shard leading (session) axis
+        sharding = NamedSharding(self.mesh, spec_b)
+
+        # one carry per session, stacked on a leading B axis and sharded
+        carry0 = self._programs.init_carry(init_state, input_template)
+        batched = jax.tree_util.tree_map(
+            lambda leaf: jnp.broadcast_to(
+                leaf[None, ...], (batch_size,) + leaf.shape
+            ),
+            carry0,
+        )
+        self._carry = jax.tree_util.tree_map(
+            lambda leaf: jax.device_put(leaf, sharding), batched
+        )
+
+        def _sharded(scan_fn, carry: Any, inputs: Any) -> Tuple[Any, Dict[str, Any]]:
+            def local(carry_l: Any, inputs_l: Any):
+                out = jax.vmap(scan_fn)(carry_l, inputs_l)
+                stats = {
+                    "mismatches": jax.lax.psum(
+                        jnp.sum(out["mismatches"]), SESSION_AXIS
+                    ),
+                    "first_bad": jax.lax.pmin(
+                        jnp.min(out["first_bad"]), SESSION_AXIS
+                    ),
+                }
+                return out, stats
+
+            return shard_map(
+                local,
+                mesh=self.mesh,
+                in_specs=(spec_b, spec_b),
+                out_specs=(spec_b, P()),
+                check_vma=False,
+            )(carry, inputs)
+
+        self._run_warmup = jax.jit(partial(_sharded, self._programs.scan_warmup))
+        self._run_steady = jax.jit(partial(_sharded, self._programs.scan_steady))
+
+    # ------------------------------------------------------------------
+
+    @property
+    def current_frame(self) -> int:
+        return self._ticks_run
+
+    def run_ticks(self, inputs: Any) -> Dict[str, int]:
+        """Advance all sessions ``n`` frames.  ``inputs`` leading axes are
+        ``(B, n, ...per-frame...)``.  Returns global stats from the on-mesh
+        reduction: total mismatches and earliest bad frame across all
+        sessions."""
+        inputs = jax.tree_util.tree_map(jnp.asarray, inputs)
+        leaf0 = jax.tree_util.tree_leaves(inputs)[0]
+        assert leaf0.shape[0] == self.batch_size
+        n = leaf0.shape[1]
+        if n == 0:
+            return {"mismatches": 0, "first_bad": np.iinfo(np.int32).max}
+        n_warm = self._programs.split_at_warmup(self._ticks_run, n)
+        stats = None
+        if n_warm:
+            head = jax.tree_util.tree_map(lambda a: a[:, :n_warm], inputs)
+            self._carry, stats = self._run_warmup(self._carry, head)
+        if n > n_warm:
+            tail = jax.tree_util.tree_map(lambda a: a[:, n_warm:], inputs)
+            self._carry, stats = self._run_steady(self._carry, tail)
+        self._ticks_run += n
+        return {
+            "mismatches": int(jax.device_get(stats["mismatches"])),
+            "first_bad": int(jax.device_get(stats["first_bad"])),
+        }
+
+    def live_states(self) -> Any:
+        """All B live states, gathered to host (leading axis B)."""
+        return jax.device_get(self._carry["live"])
+
+    def block_until_ready(self) -> None:
+        jax.block_until_ready(self._carry)

@@ -1,0 +1,115 @@
+"""Speculative branch execution: vmap over predicted-input futures.
+
+The reference predicts remote inputs with a single strategy (repeat-last by
+default) and pays a full rollback+resimulation whenever the prediction was
+wrong (/root/reference/src/input_queue.rs:104-167,
+/root/reference/src/sessions/p2p_session.rs:658-714).  On TPU, advancing one
+small state is MXU-starved anyway — so instead of one predicted future we
+advance **K parallel branches** under K different predicted input sequences
+with ``vmap`` (one batched program, same wall-clock as one branch), and when
+confirmed inputs arrive we *select* the branch whose predictions matched
+(a device-side argmax — no replay at all).  Only when no branch guessed right
+do we fall back to the fused scan replay.  This is BASELINE config 3's
+speculative parallelism; it has no analog in the reference.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+
+AdvanceFn = Callable[[Any, Any], Any]
+
+
+@dataclass(frozen=True)
+class SpeculativeBranches:
+    """Compiled speculative-execution programs for a fixed (advance, K).
+
+    Shapes: branch states carry a leading K axis on every leaf; windowed
+    inputs are ``[K, W, ...per-frame-input...]`` (per branch, per frame).
+    """
+
+    num_branches: int
+    init: Callable[[Any], Any]  # state -> K-branch states
+    speculate_window: Callable[[Any, Any], Any]  # (state, inputs_KW) -> (branches, per-branch traj checksums)
+    resolve: Callable[[Any, Any, Any], Tuple[Any, jax.Array, jax.Array]]
+    replay_window: Callable[[Any, Any], Any]  # (state, inputs_W) -> state
+    collapse: Callable[[Any, jax.Array], Any]  # (branches, idx) -> state
+
+
+def build_speculation_programs(
+    advance: AdvanceFn, num_branches: int
+) -> SpeculativeBranches:
+    """Compile the branch programs.
+
+    ``advance`` is the same pure ``(state, inputs) -> state`` the replay path
+    uses; speculation composes with it rather than requiring a special game.
+    """
+    assert num_branches >= 1
+    K = num_branches
+
+    def _init(state: Any) -> Any:
+        return jax.tree_util.tree_map(
+            lambda leaf: jnp.broadcast_to(
+                jnp.asarray(leaf)[None, ...], (K,) + jnp.asarray(leaf).shape
+            ).copy(),
+            state,
+        )
+
+    def _window_one(state: Any, inputs_w: Any) -> Any:
+        def body(st: Any, inp: Any) -> Tuple[Any, None]:
+            return advance(st, inp), None
+
+        out, _ = jax.lax.scan(body, state, inputs_w)
+        return out
+
+    def _speculate_window(state: Any, inputs_kw: Any) -> Any:
+        """Advance K branches from one shared base state through a W-frame
+        window; returns the K final states (one vmap'd scan — a single XLA
+        program, not K programs)."""
+        branches = _init(state)
+        return jax.vmap(_window_one)(branches, inputs_kw)
+
+    def _resolve(
+        branches: Any, inputs_kw: Any, confirmed_w: Any
+    ) -> Tuple[Any, jax.Array, jax.Array]:
+        """Select the branch whose input window matches the confirmed inputs.
+
+        Returns ``(state, branch_idx, found)``; when ``found`` is False the
+        returned state is branch 0 and the caller must replay from the base
+        state with the confirmed inputs instead."""
+        def leaf_match(pred: jax.Array, conf: jax.Array) -> jax.Array:
+            # pred: [K, W, ...], conf: [W, ...] -> [K] all-equal
+            eq = pred == conf[None, ...]
+            return jnp.all(eq.reshape(K, -1), axis=1)
+
+        matches_per_leaf = jax.tree_util.tree_map(
+            leaf_match, inputs_kw, confirmed_w
+        )
+        match = jax.tree_util.tree_reduce(
+            jnp.logical_and, matches_per_leaf, jnp.ones((K,), bool)
+        )
+        idx = jnp.argmax(match)  # first matching branch
+        found = jnp.any(match)
+        return _collapse(branches, idx), idx, found
+
+    def _collapse(branches: Any, idx: jax.Array) -> Any:
+        return jax.tree_util.tree_map(
+            lambda leaf: jax.lax.dynamic_index_in_dim(
+                leaf, idx, axis=0, keepdims=False
+            ),
+            branches,
+        )
+
+    return SpeculativeBranches(
+        num_branches=K,
+        init=jax.jit(_init),
+        speculate_window=jax.jit(_speculate_window),
+        resolve=jax.jit(_resolve),
+        replay_window=jax.jit(_window_one),
+        collapse=jax.jit(_collapse),
+    )

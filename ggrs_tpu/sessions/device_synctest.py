@@ -1,0 +1,116 @@
+"""DeviceSyncTestSession: the determinism harness with HBM-resident state.
+
+Semantics mirror ``SyncTestSession`` (forced rollback of ``check_distance``
+frames every tick with first-seen checksum comparison,
+/root/reference/src/sessions/sync_test_session.rs:85-150) — but the whole tick
+is a fused XLA program (`ggrs_tpu.ops.replay`) and ``run_ticks`` dispatches
+hundreds of ticks per device call.  The observable contract differs in one
+documented way: checksum mismatches surface at the end of a ``run_ticks``
+batch (as ``MismatchedChecksum`` with the earliest offending frame), not at
+the exact tick — the price of never syncing the device per frame, and the
+reason this session is the benchmark harness (BASELINE configs 1-2).
+
+Use the host ``SyncTestSession`` when you need per-tick request lists or
+arbitrary Python state; use this one when the game is a JAX pytree.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.errors import InvalidRequest, MismatchedChecksum
+from ..ops.checksum import checksum_device
+from ..ops.replay import ReplayPrograms, build_replay_programs
+
+_I32_MAX = np.iinfo(np.int32).max
+
+
+class DeviceSyncTestSession:
+    """Determinism harness over a pure JAX ``advance``; states live on device.
+
+    Arguments mirror the builder's synctest knobs
+    (/root/reference/src/sessions/builder.rs:346-358): ``check_distance`` is
+    the forced-rollback depth; ``max_prediction`` only sizes the state ring
+    (``max(max_prediction, check_distance) + 1`` slots).
+    """
+
+    def __init__(
+        self,
+        advance: Callable[[Any, Any], Any],
+        init_state: Any,
+        input_template: Any,
+        check_distance: int = 2,
+        max_prediction: int = 8,
+        checksum: Callable[[Any], jax.Array] = checksum_device,
+    ) -> None:
+        if check_distance < 1:
+            raise InvalidRequest(
+                "DeviceSyncTestSession requires check_distance >= 1; with 0 "
+                "there is no rollback to fuse — use the host SyncTestSession."
+            )
+        ring_length = max(max_prediction, check_distance) + 1
+        self._programs: ReplayPrograms = build_replay_programs(
+            advance, ring_length, check_distance, checksum=checksum
+        )
+        self._carry = self._programs.init_carry(init_state, input_template)
+        self._ticks_run = 0
+        self.check_distance = check_distance
+
+    # ------------------------------------------------------------------
+
+    @property
+    def current_frame(self) -> int:
+        return self._ticks_run
+
+    @property
+    def resim_frames_per_tick(self) -> int:
+        """Resimulated (rolled-back) frames per steady tick."""
+        return self.check_distance
+
+    @property
+    def requests_per_tick(self) -> int:
+        """Request-list equivalents fused per steady tick (2d+2, the
+        reference's per-tick workload — SURVEY §3.3)."""
+        return 2 * self.check_distance + 2
+
+    def run_ticks(self, inputs: Any) -> None:
+        """Advance ``n`` frames with ``inputs`` (leading axis = ticks, then the
+        per-frame input shape, e.g. ``(n, P)`` u8 for BoxGame).
+
+        Splits the batch across the warmup boundary automatically, then raises
+        ``MismatchedChecksum`` if any resimulated frame diverged from its
+        first-seen checksum."""
+        inputs = jax.tree_util.tree_map(jnp.asarray, inputs)
+        n = jax.tree_util.tree_leaves(inputs)[0].shape[0]
+        if n == 0:
+            return
+        n_warm = self._programs.split_at_warmup(self._ticks_run, n)
+        if n_warm:
+            head = jax.tree_util.tree_map(lambda a: a[:n_warm], inputs)
+            self._carry = self._programs.run_warmup(self._carry, head)
+        if n > n_warm:
+            tail = jax.tree_util.tree_map(lambda a: a[n_warm:], inputs)
+            self._carry = self._programs.run_steady(self._carry, tail)
+        self._ticks_run += n
+        self._raise_on_mismatch()
+
+    def live_state(self) -> Any:
+        """The current (frame ``current_frame``) game state, fetched to host."""
+        return jax.device_get(self._carry["live"])
+
+    def block_until_ready(self) -> None:
+        jax.block_until_ready(self._carry)
+
+    # ------------------------------------------------------------------
+
+    def _raise_on_mismatch(self) -> None:
+        mismatches = int(jax.device_get(self._carry["mismatches"]))
+        if mismatches:
+            first_bad = int(jax.device_get(self._carry["first_bad"]))
+            frames = [first_bad] if first_bad != _I32_MAX else []
+            raise MismatchedChecksum(self._ticks_run, frames)

@@ -1,12 +1,24 @@
 """Test configuration: force JAX onto a virtual 8-device CPU platform so
 multi-chip sharding paths can be exercised without TPU hardware.  Must run
-before jax is imported anywhere."""
+before any test imports jax.
+
+The container's sitecustomize registers the tunneled TPU platform and
+overrides JAX_PLATFORMS at interpreter start, so an env-var default is not
+enough — we override the jax config directly (safe: backends initialize
+lazily, and no jax computation has run yet at conftest import time).
+Set GGRS_TPU_TEST_PLATFORM to opt out (e.g. =axon to run the suite on TPU).
+"""
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+platform = os.environ.get("GGRS_TPU_TEST_PLATFORM", "cpu")
+if platform == "cpu":
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+
+import jax  # noqa: E402  (import after XLA_FLAGS is set)
+
+jax.config.update("jax_platforms", platform)

@@ -1,0 +1,165 @@
+"""Unit tests for the device primitives: checksum, state ring, fused replay."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from ggrs_tpu.ops import (
+    CHECKSUM_LANES,
+    DeviceStateRing,
+    build_replay_programs,
+    checksum_device,
+    checksum_to_u128,
+    pytree_checksum,
+)
+
+
+class TestChecksum:
+    def test_shape_and_dtype(self):
+        cs = checksum_device({"a": jnp.arange(7), "b": jnp.ones((2, 3))})
+        assert cs.shape == (CHECKSUM_LANES,)
+        assert cs.dtype == jnp.uint32
+
+    def test_deterministic(self):
+        state = {"x": jnp.arange(100, dtype=jnp.int32), "y": jnp.float32(3.5)}
+        assert pytree_checksum(state) == pytree_checksum(state)
+
+    def test_sensitive_to_values(self):
+        a = jnp.arange(16, dtype=jnp.int32)
+        assert pytree_checksum(a) != pytree_checksum(a.at[3].add(1))
+
+    def test_sensitive_to_position(self):
+        # same multiset of words, different order
+        a = jnp.asarray([1, 2, 3, 4], jnp.uint32)
+        b = jnp.asarray([4, 3, 2, 1], jnp.uint32)
+        assert pytree_checksum(a) != pytree_checksum(b)
+
+    def test_float_bitcast_not_rounded(self):
+        # two floats equal under fp-tolerance but not bitwise must differ
+        a = jnp.float32(1.0)
+        b = jnp.float32(1.0 + 1.2e-7)
+        assert pytree_checksum(a) != pytree_checksum(b)
+
+    @pytest.mark.parametrize("dtype", [jnp.uint8, jnp.int16, jnp.int32, jnp.float32])
+    def test_small_dtypes_supported(self, dtype):
+        x = jnp.arange(5).astype(dtype)
+        assert isinstance(pytree_checksum(x), int)
+
+    def test_u128_composition(self):
+        lanes = np.asarray([1, 2, 3, 4], np.uint32)
+        v = checksum_to_u128(lanes)
+        assert v == 1 | (2 << 32) | (3 << 64) | (4 << 96)
+        assert 0 <= v < (1 << 128)
+
+    def test_jittable_inside_scan(self):
+        def body(c, _):
+            return c + 1, checksum_device({"s": c})
+
+        _, css = jax.lax.scan(body, jnp.int32(0), None, length=4)
+        assert css.shape == (4, CHECKSUM_LANES)
+        # different states digest differently
+        assert not np.array_equal(np.asarray(css[0]), np.asarray(css[1]))
+
+
+class TestDeviceStateRing:
+    def _mk(self, length=4):
+        ring = DeviceStateRing(length)
+        template = {"a": jnp.zeros((3,), jnp.int32), "b": jnp.zeros((), jnp.float32)}
+        return ring, ring.init(template)
+
+    def test_init_frames_null(self):
+        ring, buf = self._mk()
+        assert np.all(np.asarray(buf["frames"]) == -1)
+
+    def test_save_load_roundtrip(self):
+        ring, buf = self._mk()
+        state = {"a": jnp.asarray([1, 2, 3], jnp.int32), "b": jnp.float32(7.5)}
+        cs = checksum_device(state)
+        buf = ring.save(buf, jnp.int32(5), state, cs)
+        got = ring.load(buf, jnp.int32(5))
+        assert np.array_equal(np.asarray(got["a"]), [1, 2, 3])
+        assert float(got["b"]) == 7.5
+        assert int(ring.frame_at(buf, jnp.int32(5))) == 5
+        assert np.array_equal(
+            np.asarray(ring.load_checksum(buf, jnp.int32(5))), np.asarray(cs)
+        )
+
+    def test_ring_wraparound_overwrites(self):
+        ring, buf = self._mk(length=4)
+        s = lambda v: {"a": jnp.full((3,), v, jnp.int32), "b": jnp.float32(v)}
+        for f in range(6):  # frames 4,5 overwrite slots 0,1
+            buf = ring.save(buf, jnp.int32(f), s(f), checksum_device(s(f)))
+        assert int(ring.frame_at(buf, jnp.int32(4))) == 4
+        got = ring.load(buf, jnp.int32(4))
+        assert np.all(np.asarray(got["a"]) == 4)
+        # frame 0's slot now holds frame 4 — frame_at exposes the overwrite
+        assert int(ring.frame_at(buf, jnp.int32(0))) == 4
+
+
+class _CounterGame:
+    """Trivial deterministic game: state {count, acc}; input (1,) int32."""
+
+    @staticmethod
+    def advance(state, inp):
+        return {
+            "count": state["count"] + 1,
+            "acc": state["acc"] * 3 + inp[0],
+        }
+
+    @staticmethod
+    def init():
+        return {"count": jnp.int32(0), "acc": jnp.int32(0)}
+
+
+class TestReplayPrograms:
+    def _run(self, n_ticks, d=2, ring_len=9):
+        progs = build_replay_programs(_CounterGame.advance, ring_len, d)
+        carry = progs.init_carry(_CounterGame.init(), jnp.zeros((1,), jnp.int32))
+        inputs = jnp.arange(n_ticks, dtype=jnp.int32).reshape(n_ticks, 1)
+        w = min(progs.warmup_ticks, n_ticks)
+        carry = progs.run_warmup(carry, inputs[:w])
+        if n_ticks > w:
+            carry = progs.run_steady(carry, inputs[w:])
+        return progs, carry
+
+    def test_warmup_advances_frames(self):
+        progs, carry = self._run(3, d=2)
+        assert int(carry["frame"]) == 3
+        assert int(carry["mismatches"]) == 0
+
+    def test_steady_matches_plain_simulation(self):
+        n = 40
+        progs, carry = self._run(n, d=3)
+        # plain forward simulation of the same inputs
+        state = _CounterGame.init()
+        for i in range(n):
+            state = _CounterGame.advance(state, jnp.asarray([i], jnp.int32))
+        live = jax.device_get(carry["live"])
+        assert int(live["count"]) == int(state["count"]) == n
+        assert int(live["acc"]) == int(state["acc"])
+        assert int(carry["mismatches"]) == 0
+
+    def test_nondeterminism_detected(self):
+        # a game whose advance depends on how many times it has been called
+        # (hidden Python-side state) is exactly what synctest must catch —
+        # emulate via a frame-independent RNG-free trick: advance uses
+        # state["count"] *squared* only when count is the live pass; instead
+        # we corrupt determinism by making advance read the ring slot parity
+        # through its own input history — simplest honest case: flip a value
+        # in the saved ring between ticks and watch the compare fire.
+        progs = build_replay_programs(_CounterGame.advance, 5, 2)
+        carry = progs.init_carry(_CounterGame.init(), jnp.zeros((1,), jnp.int32))
+        inputs = jnp.ones((3, 1), jnp.int32)
+        carry = progs.run_warmup(carry, inputs)
+        # corrupt the first-seen history for frame 2 → next steady tick's
+        # resimulation of frame 2 must mismatch
+        carry["hist"] = carry["hist"].at[2].set(jnp.uint32(0xDEAD))
+        carry = progs.run_steady(carry, jnp.ones((1, 1), jnp.int32))
+        assert int(carry["mismatches"]) >= 1
+        assert int(carry["first_bad"]) == 2
+
+    def test_requests_per_tick_accounting(self):
+        progs, _ = self._run(2, d=2)
+        assert progs.warmup_ticks == 3
