@@ -1,0 +1,120 @@
+"""P2P example: one local player vs remote peers over UDP, state on device.
+
+The reference's ex_game_p2p (/root/reference/examples/ex_game/ex_game_p2p.rs)
+runs one window per process; here one process drives ONE session and you
+start the peers separately (or use --both to spawn both sides in-process,
+handy for a quick look):
+
+  python examples/ex_game_p2p.py --local-port 7777 --players local 127.0.0.1:8888 &
+  python examples/ex_game_p2p.py --local-port 8888 --players 127.0.0.1:7777 local
+
+Honors WaitRecommendation by skipping frames (the reference's slow-down),
+prints network stats periodically, reports desync/disconnect events.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def parse_addr(s: str):
+    host, _, port = s.rpartition(":")
+    return (host or "127.0.0.1", int(port))
+
+
+def run_session(local_port: int, players, spectators, frames: int, render: bool):
+    from ex_game import FPS, FrameClock, Game, box_config
+    from ggrs_tpu.core import DesyncDetection, Local, Remote, Spectator
+    from ggrs_tpu.core.errors import PredictionThreshold
+    from ggrs_tpu.net import UdpNonBlockingSocket
+    from ggrs_tpu.sessions import SessionBuilder
+
+    builder = (
+        SessionBuilder(box_config())
+        .with_num_players(len(players))
+        .with_desync_detection_mode(DesyncDetection.on(60))
+        .with_fps(FPS)
+    )
+    local_handles = []
+    for handle, spec in enumerate(players):
+        if spec == "local":
+            builder = builder.add_player(Local(), handle)
+            local_handles.append(handle)
+        else:
+            builder = builder.add_player(Remote(parse_addr(spec)), handle)
+    for i, spec in enumerate(spectators):
+        builder = builder.add_player(Spectator(parse_addr(spec)), len(players) + i)
+
+    sess = builder.start_p2p_session(UdpNonBlockingSocket.bind_to_port(local_port))
+    game = Game(len(players), render=render)
+    clock = FrameClock(FPS)
+
+    frame = 0
+    while frame < frames:
+        sess.poll_remote_clients()
+        for ev in sess.events():
+            name = type(ev).__name__
+            if name == "WaitRecommendation":
+                clock.skip(ev.skip_frames)
+            print(f"[:{local_port}] event: {ev}")
+        for _ in range(clock.ready_frames()):
+            for h in local_handles:
+                sess.add_local_input(h, game.bot_input(h, frame))
+            try:
+                requests = sess.advance_frame()
+            except PredictionThreshold:
+                continue  # waiting on remote inputs
+            game.handle_requests(requests)
+            game.draw()
+            frame += 1
+            if frame % 300 == 0:
+                for h in sess.remote_player_handles():
+                    try:
+                        print(f"[:{local_port}] stats p{h}: {sess.network_stats(h)}")
+                    except Exception:
+                        pass
+        time.sleep(0.0005)
+    print(f"[:{local_port}] done: {frame} frames")
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--local-port", type=int, default=7777)
+    ap.add_argument(
+        "--players",
+        nargs="+",
+        default=["local", "127.0.0.1:8888"],
+        help="per-handle: 'local' or host:port of the remote peer",
+    )
+    ap.add_argument("--spectators", nargs="*", default=[])
+    ap.add_argument("--frames", type=int, default=600)
+    ap.add_argument("--render", action="store_true")
+    ap.add_argument("--both", action="store_true", help="run both peers in-process")
+    args = ap.parse_args()
+
+    if args.both:
+        import threading
+
+        a = threading.Thread(
+            target=run_session,
+            args=(7777, ["local", "127.0.0.1:8888"], [], args.frames, args.render),
+        )
+        b = threading.Thread(
+            target=run_session,
+            args=(8888, ["127.0.0.1:7777", "local"], [], args.frames, False),
+        )
+        a.start(), b.start()
+        a.join(), b.join()
+        return
+
+    run_session(args.local_port, args.players, args.spectators, args.frames, args.render)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,70 @@
+"""Spectator example: follow a host's confirmed inputs, never rolling back.
+
+Counterpart of the reference's ex_game_spectator
+(/root/reference/examples/ex_game/ex_game_spectator.rs).  The host must list
+this process as a spectator:
+
+  python examples/ex_game_p2p.py --local-port 7777 --players local 127.0.0.1:8888 \
+      --spectators 127.0.0.1:9999
+  python examples/ex_game_spectator.py --local-port 9999 --host 127.0.0.1:7777
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--local-port", type=int, default=9999)
+    ap.add_argument("--host", default="127.0.0.1:7777")
+    ap.add_argument("--num-players", type=int, default=2)
+    ap.add_argument("--frames", type=int, default=600)
+    ap.add_argument("--render", action="store_true")
+    args = ap.parse_args()
+
+    from ex_game import FPS, FrameClock, Game, box_config
+    from ggrs_tpu.core.errors import PredictionThreshold, SpectatorTooFarBehind
+    from ggrs_tpu.net import UdpNonBlockingSocket
+    from ggrs_tpu.sessions import SessionBuilder
+
+    host, _, port = args.host.rpartition(":")
+    sess = (
+        SessionBuilder(box_config())
+        .with_num_players(args.num_players)
+        .with_fps(FPS)
+        .start_spectator_session(
+            (host or "127.0.0.1", int(port)),
+            UdpNonBlockingSocket.bind_to_port(args.local_port),
+        )
+    )
+    game = Game(args.num_players, render=args.render)
+    clock = FrameClock(FPS)
+
+    frame = 0
+    while frame < args.frames:
+        sess.poll_remote_clients()
+        for ev in sess.events():
+            print(f"[spectator] event: {ev}")
+        for _ in range(clock.ready_frames()):
+            try:
+                game.handle_requests(sess.advance_frame())
+                frame = sess.current_frame
+                game.draw()
+            except PredictionThreshold:
+                pass  # host inputs not here yet
+            except SpectatorTooFarBehind:
+                print("[spectator] lapped by host; exiting")
+                return
+        time.sleep(0.0005)
+    print(f"[spectator] done: {frame} frames")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,78 @@
+"""SyncTest example: forced rollback + checksum verification every frame.
+
+Host-session flavor of the reference's ex_game_synctest
+(/root/reference/examples/ex_game/ex_game_synctest.rs): builds a
+SyncTestSession, feeds bot inputs for all players, executes requests on
+device.  Use --device-session to run the same thing through the fused
+DeviceSyncTestSession instead (states never leave HBM).
+
+  python examples/ex_game_synctest.py --num-players 2 --check-distance 7 --frames 600
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import numpy as np
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--num-players", type=int, default=2)
+    ap.add_argument("--check-distance", type=int, default=7)
+    ap.add_argument("--input-delay", type=int, default=0)
+    ap.add_argument("--frames", type=int, default=600)
+    ap.add_argument("--render", action="store_true")
+    ap.add_argument("--device-session", action="store_true")
+    args = ap.parse_args()
+
+    from ex_game import FPS, Game, box_config
+    from ggrs_tpu.sessions import SessionBuilder
+
+    game = Game(args.num_players, render=args.render)
+
+    if args.device_session:
+        import jax.numpy as jnp
+        from ggrs_tpu.sessions import DeviceSyncTestSession
+
+        sess = DeviceSyncTestSession(
+            game.box.advance,
+            game.box.init_state(),
+            jnp.zeros((args.num_players,), jnp.uint8),
+            check_distance=max(args.check_distance, 1),
+        )
+        inputs = np.asarray(
+            [
+                [game.bot_input(p, f) for p in range(args.num_players)]
+                for f in range(args.frames)
+            ],
+            np.uint8,
+        )
+        sess.run_ticks(inputs)
+        print(f"device synctest: {args.frames} frames, no desyncs")
+        return
+
+    builder = (
+        SessionBuilder(box_config())
+        .with_num_players(args.num_players)
+        .with_check_distance(args.check_distance)
+        .with_input_delay(args.input_delay)
+        .with_fps(FPS)
+    )
+    sess = builder.start_synctest_session()
+
+    for frame in range(args.frames):
+        for p in range(args.num_players):
+            sess.add_local_input(p, game.bot_input(p, frame))
+        game.handle_requests(sess.advance_frame())
+        game.draw()
+    print(f"synctest: {args.frames} frames, no desyncs (state on device)")
+
+
+if __name__ == "__main__":
+    main()

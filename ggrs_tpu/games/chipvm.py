@@ -1,0 +1,166 @@
+"""ChipVM: a tiny deterministic 8-bit virtual machine as a game state.
+
+BASELINE config 5 calls for an emulator-style workload ("NES-bundler-style
+6502 emu state") for massed batched sessions.  Rather than porting a 6502,
+ChipVM is a TPU-honest equivalent: a branchless interpreter where every
+opcode's effect is computed and the result selected with ``jnp.where`` — the
+idiomatic way to run *thousands of divergent machines in lockstep* under
+vmap/shard_map (a scalar 6502 with Python branches would be untraceable; a
+lax.switch per instruction would serialize).  State is 256 bytes of memory +
+4 registers + pc, all uint8; inputs are injected into fixed memory cells each
+frame; everything is integer, so simulation is bitwise identical on every
+backend and mirror (the desync-gate requirement).
+
+Opcode format (2 bytes: op byte at pc, operand at pc+1):
+  op = (kind << 4) | (a << 2) | b     kinds:
+  0 NOP        1 LDI  r[a] = imm      2 ADD r[a] += r[b]
+  3 XOR  r[a] ^= r[b]                 4 LD  r[a] = mem[imm]
+  5 ST   mem[imm] = r[a]              6 JNZ pc = imm if r[a] != 0
+  7 INP  r[a] = input[b mod P]        8+ treated as NOP
+pc advances by 2 (wrapping) unless a JNZ takes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+MEM_SIZE = 256
+NUM_REGS = 4
+STEPS_PER_FRAME = 16
+# inputs land here each frame, one byte per player (read with INP or LD)
+INPUT_BASE = 0xF0
+
+
+def _decode(op):
+    kind = op >> 4
+    a = (op >> 2) & 0b11
+    b = op & 0b11
+    return kind, a, b
+
+
+class ChipVM:
+    """Factory mirroring the BoxGame interface: ``init_state`` / ``advance``
+    (pure JAX) and ``advance_np`` (independent NumPy oracle)."""
+
+    def __init__(self, num_players: int = 2, steps_per_frame: int = STEPS_PER_FRAME) -> None:
+        assert 1 <= num_players <= 4
+        self.num_players = num_players
+        self.steps = steps_per_frame
+
+    # -- state ---------------------------------------------------------
+
+    def _program(self) -> np.ndarray:
+        """A fixed demo ROM: mixes inputs into a rolling hash across memory.
+        Deterministic constant — part of the game definition."""
+        rom = np.zeros(MEM_SIZE, np.uint8)
+        code = [
+            (7, 0, 0), (7, 1, 1),          # r0 = in[0], r1 = in[1]
+            (2, 0, 1),                     # r0 += r1
+            (4, 2, 0), (0x40,),            # r2 = mem[0x40]
+            (3, 2, 0),                     # r2 ^= r0
+            (2, 2, 2),                     # r2 += r2
+            (5, 2, 0), (0x40,),            # mem[0x40] = r2
+            (4, 3, 0), (0x41,),            # r3 = mem[0x41]
+            (2, 3, 2),                     # r3 += r2
+            (5, 3, 0), (0x41,),            # mem[0x41] = r3
+            (6, 3, 0), (0x00,),            # jnz r3 -> 0
+        ]
+        pc = 0
+        for entry in code:
+            if len(entry) == 3:
+                kind, a, b = entry
+                rom[pc] = (kind << 4) | (a << 2) | b
+                pc += 1
+                if kind in (1, 4, 5, 6):
+                    continue  # operand byte appended by next entry
+                rom[pc] = 0
+                pc += 1
+            else:
+                rom[pc] = entry[0]
+                pc += 1
+        return rom
+
+    def init_state(self) -> Dict[str, jax.Array]:
+        return jax.tree_util.tree_map(jnp.asarray, self.init_state_np())
+
+    def init_state_np(self) -> Dict[str, np.ndarray]:
+        return {
+            "mem": self._program(),
+            "regs": np.zeros(NUM_REGS, np.uint8),
+            "pc": np.uint8(0),
+        }
+
+    # -- advance: jax (branchless) --------------------------------------
+
+    def advance(self, state: Any, inputs: Any) -> Any:
+        mem0 = state["mem"]
+        # write this frame's inputs into the input cells
+        idx = INPUT_BASE + jnp.arange(self.num_players)
+        mem0 = mem0.at[idx].set(jnp.asarray(inputs, jnp.uint8))
+
+        def step(carry, _):
+            mem, regs, pc = carry
+            op = mem[pc]
+            imm = mem[(pc + 1).astype(jnp.uint8)]
+            kind = op >> 4
+            a = (op >> 2) & 0b11
+            b = op & 0b11
+            ra, rb = regs[a], regs[b]
+            inp = mem[(INPUT_BASE + (b % self.num_players)).astype(jnp.uint8)]
+
+            new_ra = jnp.where(
+                kind == 1, imm,
+                jnp.where(kind == 2, ra + rb,
+                jnp.where(kind == 3, ra ^ rb,
+                jnp.where(kind == 4, mem[imm],
+                jnp.where(kind == 7, inp, ra)))),
+            ).astype(jnp.uint8)
+            regs = regs.at[a].set(new_ra)
+
+            st_val = jnp.where(kind == 5, new_ra, mem[imm]).astype(jnp.uint8)
+            mem = mem.at[imm].set(st_val)
+
+            seq = (pc + jnp.uint8(2)).astype(jnp.uint8)  # fixed 2-byte slots
+            take = (kind == 6) & (new_ra != 0)
+            pc = jnp.where(take, imm, seq).astype(jnp.uint8)
+            return (mem, regs, pc), None
+
+        (mem, regs, pc), _ = jax.lax.scan(
+            step, (mem0, state["regs"], state["pc"]), None, length=self.steps
+        )
+        return {"mem": mem, "regs": regs, "pc": pc}
+
+    # -- advance: numpy oracle ------------------------------------------
+
+    def advance_np(self, state: Dict[str, np.ndarray], inputs: np.ndarray) -> Dict[str, np.ndarray]:
+        mem = state["mem"].copy()
+        regs = state["regs"].copy()
+        pc = int(state["pc"])
+        for p in range(self.num_players):
+            mem[INPUT_BASE + p] = np.uint8(inputs[p])
+        for _ in range(self.steps):
+            op = int(mem[pc])
+            imm = int(mem[(pc + 1) % 256])
+            kind, a, b = _decode(op)
+            if kind == 1:
+                regs[a] = imm
+            elif kind == 2:
+                regs[a] = np.uint8((int(regs[a]) + int(regs[b])) & 0xFF)
+            elif kind == 3:
+                regs[a] = regs[a] ^ regs[b]
+            elif kind == 4:
+                regs[a] = mem[imm]
+            elif kind == 5:
+                mem[imm] = regs[a]
+            elif kind == 7:
+                regs[a] = mem[INPUT_BASE + (b % self.num_players)]
+            if kind == 6 and regs[a] != 0:
+                pc = imm
+            else:
+                pc = (pc + 2) % 256
+        return {"mem": mem, "regs": regs, "pc": np.uint8(pc)}

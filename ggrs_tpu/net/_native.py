@@ -21,7 +21,15 @@ from pathlib import Path
 from typing import List, Optional, Sequence
 
 _LIB_NAME = "_ggrs_codec.so"
-_MAX_DECODED_BYTES = 1 << 22
+# Resource caps for the fast path.  Real packets sit under the ~508-byte UDP
+# budget with at most the 128-input pending window; anything bigger (but
+# still legal for the Python codec, whose hard cap is 1<<22 bytes) falls back
+# to the Python implementation rather than holding megabytes of scratch.
+_DECODE_CAP_BYTES = 1 << 20
+_DECODE_CAP_INPUTS = 4096
+# error codes that mean "packet exceeded the fast path's resources", not
+# "packet is malformed" — mirror codec.cpp's kErrBufferTooSmall / TooMany
+_RESOURCE_ERRORS = (-11, -12)
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
@@ -150,13 +158,15 @@ def encode(reference: bytes, inputs: Sequence[bytes]) -> Optional[bytes]:
         ctypes.byref(out_len),
     )
     if rc != 0:  # pragma: no cover - encode can only fail on a bad bound
-        raise RuntimeError(f"native encode failed: {_ERROR_NAMES.get(rc, rc)}")
+        return None  # fall back to the Python encoder rather than fail
     return out.raw[: out_len.value]
 
 
 def decode(reference: bytes, data: bytes) -> Optional[List[bytes]]:
-    """Native decode; returns None if unavailable.  Raises ``CodecError`` (the
-    same type the Python codec raises) on malformed data."""
+    """Native decode; returns None when unavailable OR when the packet
+    exceeds the fast path's resource caps (caller falls back to Python).
+    Raises ``CodecError`` (the same type the Python codec raises) on
+    malformed data."""
     lib = _load()
     if lib is None:
         return None
@@ -165,8 +175,8 @@ def decode(reference: bytes, data: bytes) -> Optional[List[bytes]]:
     global _decode_out, _decode_sizes
     with _lock:  # buffers are reused across calls; protocol use is 1-thread
         if _decode_out is None:
-            _decode_out = ctypes.create_string_buffer(_MAX_DECODED_BYTES)
-            _decode_sizes = (ctypes.c_size_t * _MAX_DECODED_BYTES)()
+            _decode_out = ctypes.create_string_buffer(_DECODE_CAP_BYTES)
+            _decode_sizes = (ctypes.c_size_t * _DECODE_CAP_INPUTS)()
         out, out_sizes = _decode_out, _decode_sizes
         out_count = ctypes.c_size_t(0)
         rc = lib.ggrs_codec_decode(
@@ -175,11 +185,13 @@ def decode(reference: bytes, data: bytes) -> Optional[List[bytes]]:
             data,
             len(data),
             out,
-            _MAX_DECODED_BYTES,
+            _DECODE_CAP_BYTES,
             out_sizes,
-            _MAX_DECODED_BYTES,
+            _DECODE_CAP_INPUTS,
             ctypes.byref(out_count),
         )
+        if rc in _RESOURCE_ERRORS:
+            return None  # legal-but-huge packet: Python path handles it
         if rc != 0:
             raise CodecError(_ERROR_NAMES.get(rc, f"native error {rc}"))
         result: List[bytes] = []

@@ -114,13 +114,11 @@ def encode(reference: bytes, inputs: Sequence[bytes]) -> bytes:
 
 def decode(reference: bytes, data: bytes) -> List[bytes]:
     """Decompress into the original input byte strings.  Raises CodecError on
-    any malformed input.  Dispatches to the C++ codec when available."""
+    any malformed input.  Dispatches to the C++ codec when available; packets
+    beyond the native resource caps (None return) take the Python path."""
     from . import _native
 
-    try:
-        native = _native.decode(reference, data)
-    except CodecError:
-        raise
+    native = _native.decode(reference, data)
     if native is not None:
         return native
     return decode_py(reference, data)

@@ -97,6 +97,8 @@ def build_replay_programs(
     check_distance: int,
     checksum: ChecksumFn = checksum_device,
     donate: Optional[bool] = None,
+    unroll_resim: bool = True,
+    unroll_ticks: int = 4,
 ) -> ReplayPrograms:
     """Compile the warmup/steady tick programs.
 
@@ -108,6 +110,10 @@ def build_replay_programs(
     ``donate``: donate the carry buffers to each dispatch (in-place HBM update);
     defaults to on for TPU, off elsewhere (CPU/interpret donation is a no-op
     that only produces warnings).
+    ``unroll_resim``/``unroll_ticks``: loop unrolling for the inner (resim)
+    and outer (tick) scans — scan iterations carry fixed launch overhead on
+    TPU that dwarfs this workload's tiny per-step compute, so the inner
+    d-step loop is fully unrolled by default and ticks unroll moderately.
     """
     assert check_distance >= 1, "device replay needs check_distance >= 1"
     assert ring_length > check_distance, "ring must cover the rollback window"
@@ -126,6 +132,14 @@ def build_replay_programs(
         )
         inputs = _store_input(ring, carry["inputs"], frame, inp)
         live = advance(carry["live"], inp)
+        # first-seen digest for frame+1 comes from this live advance; later
+        # resimulations of that frame are compared against it (this makes
+        # every resim frame checkable — stronger than the reference, which
+        # never digests the live advance and so cannot compare the newest
+        # window frame)
+        hist = jax.lax.dynamic_update_index_in_dim(
+            hist, checksum(live), ring.slot(frame + 1), axis=0
+        )
         return {
             **carry,
             "ring": new_ring,
@@ -151,28 +165,32 @@ def build_replay_programs(
             return (st, rng), cs
 
         (st, new_ring), resim_cs = jax.lax.scan(
-            resim_step, (loaded, carry["ring"]), jnp.arange(d, dtype=jnp.int32)
+            resim_step,
+            (loaded, carry["ring"]),
+            jnp.arange(d, dtype=jnp.int32),
+            unroll=d if unroll_resim else 1,
         )
-        # resim_cs[j] digests frame F-d+1+j; the first d-1 entries are
-        # re-simulations of frames already in the history — compare; the last
-        # (frame F) is first-seen — record.
+        # resim_cs[j] digests frame F-d+1+j.  Every entry has a first-seen
+        # digest in the history (frame F's was recorded by the previous
+        # tick's live advance), so the whole window is compared — including
+        # at check_distance=1, where the reference's scheme has nothing to
+        # compare against.
         resim_frames = frame - d + 1 + jnp.arange(d, dtype=jnp.int32)
         seen = jax.vmap(
             lambda f: jax.lax.dynamic_index_in_dim(
                 carry["hist"], ring.slot(f), axis=0, keepdims=False
             )
         )(resim_frames)
-        is_resim = jnp.arange(d) < (d - 1)
-        bad = jnp.any(resim_cs != seen, axis=1) & is_resim
+        bad = jnp.any(resim_cs != seen, axis=1)
         mismatches = carry["mismatches"] + jnp.sum(bad, dtype=jnp.int32)
         first_bad = jnp.minimum(
             carry["first_bad"],
             jnp.min(jnp.where(bad, resim_frames, _I32_MAX)),
         )
-        hist = jax.lax.dynamic_update_index_in_dim(
-            carry["hist"], resim_cs[-1], ring.slot(frame), axis=0
-        )
         live = advance(st, inp)  # st is the resimulated state at F
+        hist = jax.lax.dynamic_update_index_in_dim(
+            carry["hist"], checksum(live), ring.slot(frame + 1), axis=0
+        )
         return {
             "ring": new_ring,
             "inputs": inputs,
@@ -187,7 +205,7 @@ def build_replay_programs(
         def body(c: Any, inp: Any) -> Tuple[Any, None]:
             return tick(c, inp), None
 
-        out, _ = jax.lax.scan(body, carry, tick_inputs)
+        out, _ = jax.lax.scan(body, carry, tick_inputs, unroll=unroll_ticks)
         return out
 
     donate_argnums = (0,) if donate else ()

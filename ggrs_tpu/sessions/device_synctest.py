@@ -78,13 +78,19 @@ class DeviceSyncTestSession:
         reference's per-tick workload — SURVEY §3.3)."""
         return 2 * self.check_distance + 2
 
-    def run_ticks(self, inputs: Any) -> None:
+    def run_ticks(self, inputs: Any, check: bool = True) -> None:
         """Advance ``n`` frames with ``inputs`` (leading axis = ticks, then the
         per-frame input shape, e.g. ``(n, P)`` u8 for BoxGame).
 
         Splits the batch across the warmup boundary automatically, then raises
         ``MismatchedChecksum`` if any resimulated frame diverged from its
-        first-seen checksum."""
+        first-seen checksum.
+
+        ``check=False`` defers the desync check: the call stays fully async
+        (no device→host read — which costs a full round-trip on tunneled
+        TPUs), accumulating mismatch counters on device until ``verify()``.
+        Pre-stage inputs with ``jnp.asarray`` to keep the submit path free of
+        host→device transfers too."""
         inputs = jax.tree_util.tree_map(jnp.asarray, inputs)
         n = jax.tree_util.tree_leaves(inputs)[0].shape[0]
         if n == 0:
@@ -94,9 +100,20 @@ class DeviceSyncTestSession:
             head = jax.tree_util.tree_map(lambda a: a[:n_warm], inputs)
             self._carry = self._programs.run_warmup(self._carry, head)
         if n > n_warm:
-            tail = jax.tree_util.tree_map(lambda a: a[n_warm:], inputs)
+            # avoid a per-call device slice when the whole batch is steady
+            tail = (
+                inputs
+                if n_warm == 0
+                else jax.tree_util.tree_map(lambda a: a[n_warm:], inputs)
+            )
             self._carry = self._programs.run_steady(self._carry, tail)
         self._ticks_run += n
+        if check:
+            self._raise_on_mismatch()
+
+    def verify(self) -> None:
+        """Raise ``MismatchedChecksum`` if any deferred ``run_ticks`` batch
+        saw a resimulation diverge."""
         self._raise_on_mismatch()
 
     def live_state(self) -> Any:
@@ -109,8 +126,10 @@ class DeviceSyncTestSession:
     # ------------------------------------------------------------------
 
     def _raise_on_mismatch(self) -> None:
-        mismatches = int(jax.device_get(self._carry["mismatches"]))
-        if mismatches:
-            first_bad = int(jax.device_get(self._carry["first_bad"]))
-            frames = [first_bad] if first_bad != _I32_MAX else []
+        # one fetch for both scalars: each device_get is a full round-trip
+        mismatches, first_bad = jax.device_get(
+            (self._carry["mismatches"], self._carry["first_bad"])
+        )
+        if int(mismatches):
+            frames = [int(first_bad)] if int(first_bad) != _I32_MAX else []
             raise MismatchedChecksum(self._ticks_run, frames)

@@ -79,6 +79,10 @@ struct Reader {
       uint8_t b;
       int rc = u8(&b);
       if (rc != kOk) return rc;
+      // at shift 63 only bit 0 fits in u64; Python's unbounded ints keep the
+      // high bits and reject the huge value downstream — reject here so both
+      // implementations refuse the same packets instead of truncating
+      if (shift == 63 && (b & 0x7E)) return kErrTooLarge;
       result |= static_cast<uint64_t>(b & 0x7F) << shift;
       if (!(b & 0x80)) {
         *out = result;

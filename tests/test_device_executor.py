@@ -9,14 +9,11 @@ import pytest
 
 import jax.numpy as jnp
 
-from ggrs_tpu.core import Config
-from ggrs_tpu.games import BoxGame
+from ggrs_tpu.games import BoxGame, boxgame_config
 from ggrs_tpu.ops import DeviceRequestExecutor
 from ggrs_tpu.sessions import SessionBuilder
 
-
-def _box_config():
-    return Config.for_uint(bits=8)
+_box_config = boxgame_config
 
 
 def _inputs_to_array(pairs):

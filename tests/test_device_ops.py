@@ -163,3 +163,23 @@ class TestReplayPrograms:
     def test_requests_per_tick_accounting(self):
         progs, _ = self._run(2, d=2)
         assert progs.warmup_ticks == 3
+
+    def test_check_distance_one_still_detects(self):
+        # at d=1 the reference's scheme has nothing to compare (each frame is
+        # resimulated exactly once); our live-advance digest makes even d=1
+        # meaningful — corrupting the saved state a rollback reloads must be
+        # caught on the next tick
+        progs = build_replay_programs(_CounterGame.advance, 4, 1)
+        carry = progs.init_carry(_CounterGame.init(), jnp.zeros((1,), jnp.int32))
+        inputs = jnp.ones((6, 1), jnp.int32)
+        carry = progs.run_warmup(carry, inputs[: progs.warmup_ticks])
+        carry = progs.run_steady(carry, inputs[progs.warmup_ticks :])
+        assert int(carry["mismatches"]) == 0
+        frame = int(carry["frame"])  # next steady tick reloads frame-1
+        slot = (frame - 1) % 4
+        carry["ring"]["states"]["acc"] = (
+            carry["ring"]["states"]["acc"].at[slot].add(1)
+        )
+        carry = progs.run_steady(carry, jnp.ones((1, 1), jnp.int32))
+        assert int(carry["mismatches"]) >= 1
+        assert int(carry["first_bad"]) == frame

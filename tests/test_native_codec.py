@@ -75,6 +75,10 @@ class TestHardening:
                 err_cc = None
             except CodecError as e:
                 out_cc, err_cc = None, e
+            if err_cc is None and out_cc is None:
+                # packet exceeded the native resource caps; the dispatcher
+                # would fall back to Python, so there is nothing to compare
+                continue
             try:
                 out_py = decode_py(reference, data)
                 err_py = None
