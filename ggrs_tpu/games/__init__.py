@@ -18,6 +18,8 @@ from .boxgame import (
     BoxGame,
     boxgame_config,
 )
+from .chipvm import ChipVM
+from .ecs_world import EcsWorld
 
 __all__ = [
     "BOX_INPUT_UP",
@@ -25,5 +27,7 @@ __all__ = [
     "BOX_INPUT_LEFT",
     "BOX_INPUT_RIGHT",
     "BoxGame",
+    "ChipVM",
+    "EcsWorld",
     "boxgame_config",
 ]

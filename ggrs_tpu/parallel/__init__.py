@@ -14,11 +14,13 @@ design, not ports:
 """
 
 from .speculation import SpeculativeBranches, build_speculation_programs
+from .spec_rollback import SpeculativeRollback
 from .batch import BatchedSessions, make_mesh
 
 __all__ = [
     "BatchedSessions",
     "SpeculativeBranches",
+    "SpeculativeRollback",
     "build_speculation_programs",
     "make_mesh",
 ]

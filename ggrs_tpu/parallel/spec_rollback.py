@@ -1,0 +1,139 @@
+"""Speculative rollback: skip the replay entirely when a branch guessed right.
+
+The reference rolls back and resimulates every time a prediction was wrong
+(/root/reference/src/sessions/p2p_session.rs:658-714) — and its single
+repeat-last predictor is wrong whenever a remote player changes input.  On
+TPU we can afford K predictions at once (`parallel.speculation`): this module
+keeps K branch trajectories *incrementally extended each tick* under K
+different remote-input hypotheses, so when confirmed inputs arrive and a
+rollback is requested, a matching branch turns the whole
+load→(advance, save)^N replay into a device-side select.  Misses fall back
+to the fused replay — correctness never depends on a hit.
+
+``SpeculativeRollback`` is session-agnostic: it works on input *arrays* (the
+same ones the user's ``advance`` consumes).  ``DeviceRequestExecutor`` uses
+it through the ``speculation`` constructor argument, keying branches to the
+frames of Save/Load requests.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+AdvanceFn = Callable[[Any, Any], Any]
+# branch_inputs(branch_k, tick_local_inputs_array) -> full inputs array for
+# branch k this frame (local players' real inputs merged with hypothesis k's
+# remote inputs)
+BranchInputsFn = Callable[[int, Any], Any]
+
+
+class SpeculativeRollback:
+    """K incrementally-extended branch trajectories rooted at a saved frame.
+
+    Usage per tick:
+      - ``root(frame, state)`` whenever the rollback anchor moves (a Save of
+        the confirmed frame);
+      - ``extend(local_inputs)`` once per advanced frame: every branch steps
+        under its own hypothesis (ONE vmap dispatch for all K);
+      - on rollback to ``frame``: ``resolve(frame, confirmed)`` with the
+        confirmed full-input arrays for the window — returns the matched
+        branch's trajectory or None (miss → caller replays).
+    """
+
+    def __init__(
+        self,
+        advance: AdvanceFn,
+        num_branches: int,
+        branch_inputs: BranchInputsFn,
+        max_window: int = 16,
+    ) -> None:
+        assert num_branches >= 1
+        self.K = num_branches
+        self.max_window = max_window
+        self._branch_inputs = branch_inputs
+        self._root_frame: Optional[int] = None
+        self._states: Any = None  # [K, ...] current branch states
+        self._traj: List[Any] = []  # per-step [K, ...] states (post-advance)
+        self._inputs: List[Any] = []  # per-step [K, ...] hypothesized inputs
+
+        self._step_all = jax.jit(
+            lambda states, inputs_k: jax.vmap(advance)(states, inputs_k)
+        )
+
+    # ------------------------------------------------------------------
+
+    @property
+    def window(self) -> int:
+        return len(self._traj)
+
+    @property
+    def root_frame(self) -> Optional[int]:
+        return self._root_frame
+
+    def root(self, frame: int, state: Any) -> None:
+        """Re-anchor all branches at ``state`` (the save of ``frame``)."""
+        self._root_frame = frame
+        self._states = jax.tree_util.tree_map(
+            lambda leaf: jnp.broadcast_to(
+                jnp.asarray(leaf)[None, ...], (self.K,) + jnp.asarray(leaf).shape
+            ),
+            state,
+        )
+        self._traj = []
+        self._inputs = []
+
+    def extend(self, local_inputs: Any) -> None:
+        """Advance every branch one frame under its hypothesis."""
+        if self._root_frame is None or len(self._traj) >= self.max_window:
+            return
+        per_branch = [self._branch_inputs(k, local_inputs) for k in range(self.K)]
+        inputs_k = jax.tree_util.tree_map(
+            lambda *leaves: jnp.stack([jnp.asarray(l) for l in leaves]), *per_branch
+        )
+        self._states = self._step_all(self._states, inputs_k)
+        self._traj.append(self._states)
+        self._inputs.append(inputs_k)
+
+    def resolve(
+        self, frame: int, confirmed: Sequence[Any]
+    ) -> Optional[List[Any]]:
+        """Match hypotheses against the ``confirmed`` input arrays for the
+        frames after ``frame``.  On a hit, returns the per-step states of the
+        matching branch (``len(confirmed)`` entries, post-advance each step);
+        on any miss condition, returns None."""
+        n = len(confirmed)
+        if (
+            self._root_frame is None
+            or frame != self._root_frame
+            or n == 0
+            or n > len(self._traj)
+        ):
+            return None
+
+        match = jnp.ones((self.K,), bool)
+        for step, conf in enumerate(confirmed):
+            hyp = self._inputs[step]
+
+            def leaf_eq(h: jax.Array, c: Any) -> jax.Array:
+                c = jnp.asarray(c)
+                return jnp.all(
+                    (h == c[None, ...]).reshape(self.K, -1), axis=1
+                )
+
+            eqs = jax.tree_util.tree_map(leaf_eq, hyp, conf)
+            match = match & jax.tree_util.tree_reduce(
+                jnp.logical_and, eqs, jnp.ones((self.K,), bool)
+            )
+        idx = jnp.argmax(match)
+        if not bool(jnp.any(match)):  # one scalar read per rollback
+            return None
+        take = lambda tree: jax.tree_util.tree_map(
+            lambda leaf: jax.lax.dynamic_index_in_dim(
+                leaf, idx, axis=0, keepdims=False
+            ),
+            tree,
+        )
+        return [take(self._traj[step]) for step in range(n)]

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from ..core.errors import InvalidRequest, MismatchedChecksum
 from ..ops.checksum import checksum_device
 from ..ops.replay import ReplayPrograms, build_replay_programs
+from ..utils.tracing import trace_span
 
 _I32_MAX = np.iinfo(np.int32).max
 
@@ -98,7 +99,8 @@ class DeviceSyncTestSession:
         n_warm = self._programs.split_at_warmup(self._ticks_run, n)
         if n_warm:
             head = jax.tree_util.tree_map(lambda a: a[:n_warm], inputs)
-            self._carry = self._programs.run_warmup(self._carry, head)
+            with trace_span("ggrs:synctest_warmup"):
+                self._carry = self._programs.run_warmup(self._carry, head)
         if n > n_warm:
             # avoid a per-call device slice when the whole batch is steady
             tail = (
@@ -106,7 +108,8 @@ class DeviceSyncTestSession:
                 if n_warm == 0
                 else jax.tree_util.tree_map(lambda a: a[n_warm:], inputs)
             )
-            self._carry = self._programs.run_steady(self._carry, tail)
+            with trace_span("ggrs:synctest_steady"):
+                self._carry = self._programs.run_steady(self._carry, tail)
         self._ticks_run += n
         if check:
             self._raise_on_mismatch()

@@ -1,0 +1,12 @@
+"""Cross-cutting utilities: tracing/profiling and logging.
+
+The reference uses the ``tracing`` crate for protocol/session debug output
+(SURVEY §5; /root/reference/src/network/protocol.rs, tracing calls
+throughout).  The TPU equivalents here are Python ``logging`` for the host
+path plus ``jax.profiler`` trace annotations around device dispatches so the
+fused replay shows up as named spans in TensorBoard/Perfetto profiles.
+"""
+
+from .tracing import enable_tracing, get_logger, trace_span
+
+__all__ = ["enable_tracing", "get_logger", "trace_span"]

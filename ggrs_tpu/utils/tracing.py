@@ -1,0 +1,48 @@
+"""Tracing and logging.
+
+Host-side events (protocol state changes, rollback decisions, oversized
+packets) log through the ``ggrs_tpu`` logger hierarchy — the analog of the
+reference's ``tracing`` crate spans (e.g. rollback decisions at
+/root/reference/src/sessions/p2p_session.rs:679-682, packet warnings at
+/root/reference/src/network/udp_socket.rs:54-59).  Device dispatches can be
+wrapped in ``trace_span`` so they appear as named ranges in ``jax.profiler``
+traces (TensorBoard / Perfetto) without any cost when profiling is off.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+from typing import Iterator
+
+_ROOT = "ggrs_tpu"
+
+
+def get_logger(name: str = "") -> logging.Logger:
+    """Logger under the ``ggrs_tpu`` hierarchy (e.g. ``get_logger("net")``)."""
+    return logging.getLogger(f"{_ROOT}.{name}" if name else _ROOT)
+
+
+def enable_tracing(level: int = logging.DEBUG) -> None:
+    """Opt-in console tracing, the analog of installing the reference
+    examples' FmtSubscriber (/root/reference/examples/ex_game/ex_game_p2p.rs:37-44)."""
+    logger = get_logger()
+    if not logger.handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(name)s %(levelname)s %(message)s")
+        )
+        logger.addHandler(handler)
+    logger.setLevel(level)
+
+
+@contextlib.contextmanager
+def trace_span(name: str) -> Iterator[None]:
+    """Named range in jax profiler traces; no-op overhead when not profiling."""
+    try:
+        from jax.profiler import TraceAnnotation
+    except ImportError:  # pragma: no cover - ancient jax
+        yield
+        return
+    with TraceAnnotation(name):
+        yield
