@@ -12,6 +12,16 @@ desync exchange needs it as a u128 wire value).
 Rollback bursts — a Load followed by a run of Save/Advance pairs — are
 executed as one fused scan dispatch instead of 2N python-level dispatches,
 recovering the ``ops.replay`` fast path inside the generic request protocol.
+
+With a ``speculation`` strategy (``parallel.SpeculativeRollback``) attached,
+the executor additionally keeps K branch trajectories alive between ticks and
+lets a rollback be fulfilled by *branch selection* instead of replay: when the
+Load's target frame matches the branch anchor and one branch's hypothesized
+inputs equal the inputs of the following resimulation burst, the burst's
+Save cells are filled straight from the matching branch's stored states and
+no replay scan is dispatched at all (the TPU answer to the reference's
+rollback hot loop, /root/reference/src/sessions/p2p_session.rs:658-714).
+Misses fall back to the fused replay — correctness never depends on a hit.
 """
 
 from __future__ import annotations
@@ -28,6 +38,7 @@ from ..core.types import (
     LoadGameState,
     SaveGameState,
 )
+from ..parallel.spec_rollback import SpeculativeRollback
 from .checksum import checksum_device, checksum_to_u128
 
 InputsToArray = Callable[[Sequence[Tuple[Any, InputStatus]]], Any]
@@ -42,6 +53,13 @@ class DeviceRequestExecutor:
                        the array ``advance`` consumes (e.g. u8 bitmask vector
                        for BoxGame).  Disconnected players already arrive as
                        default inputs, matching the reference's dummy inputs.
+    ``speculation``    optional ``SpeculativeRollback``: K vmap'd branch
+                       trajectories that turn a matching rollback into a
+                       device-side select (see module docstring).  The
+                       executor re-anchors the branches at the first save of
+                       every rollback burst (frame ``load+1`` — the next
+                       rollback's steady-state target) and extends them by one
+                       hypothesized frame per executed advance.
     """
 
     def __init__(
@@ -50,12 +68,16 @@ class DeviceRequestExecutor:
         init_state: Any,
         inputs_to_array: InputsToArray,
         with_checksums: bool = True,
+        speculation: Optional[SpeculativeRollback] = None,
     ) -> None:
         self._advance = jax.jit(advance)
         self._state = jax.tree_util.tree_map(jnp.asarray, init_state)
         self._inputs_to_array = inputs_to_array
         self._with_checksums = with_checksums
         self._checksum = jax.jit(checksum_device)
+        self._spec = speculation
+        self.spec_hits = 0
+        self.spec_misses = 0
 
         def _burst(state: Any, inputs: Any) -> Tuple[Any, Any, Any]:
             def body(st: Any, inp: Any) -> Tuple[Any, Tuple[Any, Any]]:
@@ -84,32 +106,62 @@ class DeviceRequestExecutor:
             req = requests[i]
             if isinstance(req, SaveGameState):
                 self._do_save(req)
+                if self._spec is not None and self._spec.root_frame is None:
+                    self._spec.root(req.frame, self._state)
                 i += 1
             elif isinstance(req, LoadGameState):
-                self._do_load(req)
-                i += 1
-            elif isinstance(req, AdvanceFrame):
-                # fuse a run of (Advance, Save)* pairs into one scan dispatch
-                j = i
-                pairs: List[AdvanceFrame] = []
-                saves: List[Optional[SaveGameState]] = []
-                while j < n and isinstance(requests[j], AdvanceFrame):
-                    pairs.append(requests[j])
-                    j += 1
-                    if j < n and isinstance(requests[j], SaveGameState):
-                        saves.append(requests[j])
-                        j += 1
-                    else:
-                        saves.append(None)
-                if len(pairs) == 1:
-                    self._do_advance(pairs[0])
-                    if saves[0] is not None:
-                        self._do_save(saves[0])
+                pairs, saves, i = self._collect_burst(requests, i + 1)
+                if self._spec is not None and pairs:
+                    self._run_rollback_spec(req, pairs, saves)
                 else:
-                    self._do_burst(pairs, saves)
-                i = j
+                    if self._spec is not None:
+                        # a rollback we can't resolve disproves the predicted
+                        # inputs the branch prefixes were validated against
+                        self._spec.invalidate()
+                    self._do_load(req)
+                    self._run_pairs(pairs, saves)
+            elif isinstance(req, AdvanceFrame):
+                pairs, saves, i = self._collect_burst(requests, i)
+                self._run_pairs(pairs, saves)
             else:  # pragma: no cover
                 raise TypeError(f"unknown request {req!r}")
+
+    @staticmethod
+    def _collect_burst(
+        requests: List[GgrsRequest], start: int
+    ) -> Tuple[List[AdvanceFrame], List[Optional[SaveGameState]], int]:
+        """Collect the (Advance, Save?)* run starting at ``start``."""
+        j = start
+        n = len(requests)
+        pairs: List[AdvanceFrame] = []
+        saves: List[Optional[SaveGameState]] = []
+        while j < n and isinstance(requests[j], AdvanceFrame):
+            pairs.append(requests[j])
+            j += 1
+            if j < n and isinstance(requests[j], SaveGameState):
+                saves.append(requests[j])
+                j += 1
+            else:
+                saves.append(None)
+        return pairs, saves, j
+
+    def _run_pairs(
+        self,
+        pairs: List[AdvanceFrame],
+        saves: List[Optional[SaveGameState]],
+        arrays: Optional[List[Any]] = None,
+    ) -> List[Tuple[int, SaveGameState, Any]]:
+        """Execute an (Advance, Save?)* run, fused when it's a real burst.
+        Returns the fulfilled saves as ``(pair_index, request, snapshot)``."""
+        if not pairs:
+            return []
+        if len(pairs) == 1:
+            self._do_advance(pairs[0], inputs=arrays[0] if arrays else None)
+            if saves[0] is not None:
+                self._do_save(saves[0])
+                return [(0, saves[0], self._state)]
+            return []
+        return self._do_burst(pairs, saves, arrays=arrays)
 
     # ------------------------------------------------------------------
 
@@ -126,24 +178,38 @@ class DeviceRequestExecutor:
         assert data is not None, f"loading frame {req.frame} from an empty cell"
         self._state = data
 
-    def _do_advance(self, req: AdvanceFrame) -> None:
-        self._state = self._advance(
-            self._state, self._inputs_to_array(req.inputs)
-        )
+    def _do_advance(self, req: AdvanceFrame, inputs: Any = None) -> None:
+        if inputs is None:
+            inputs = self._inputs_to_array(req.inputs)
+        self._state = self._advance(self._state, inputs)
+        if self._spec is not None:
+            self._spec.extend(inputs)
 
     def _do_burst(
-        self, pairs: List[AdvanceFrame], saves: List[Optional[SaveGameState]]
-    ) -> None:
+        self,
+        pairs: List[AdvanceFrame],
+        saves: List[Optional[SaveGameState]],
+        arrays: Optional[List[Any]] = None,
+    ) -> List[Tuple[int, SaveGameState, Any]]:
         """(Advance, Save?)×N as one scan; save cells receive views of the
-        stacked pre-advance trajectory (still on device)."""
-        arrays = [self._inputs_to_array(p.inputs) for p in pairs]
+        stacked pre-advance trajectory (still on device).  Returns the
+        fulfilled saves as ``(pair_index, request, snapshot)`` so callers can
+        re-anchor speculation without refetching."""
+        if arrays is None:
+            arrays = [self._inputs_to_array(p.inputs) for p in pairs]
         stacked = jax.tree_util.tree_map(
             lambda *leaves: jnp.stack(leaves), *arrays
         )
         final, post_states, post_cs = self._burst(self._state, stacked)
         self._state = final
+        if self._spec is not None:
+            # keep the one-extend-per-executed-advance invariant resolve()
+            # depends on (no-op while unrooted, e.g. on the rollback miss path)
+            for arr in arrays:
+                self._spec.extend(arr)
         if self._with_checksums and any(s is not None for s in saves):
             all_lanes = jax.device_get(post_cs)  # one transfer per burst
+        fulfilled: List[Tuple[int, SaveGameState, Any]] = []
         for k, save in enumerate(saves):
             if save is None:
                 continue
@@ -152,3 +218,76 @@ class DeviceRequestExecutor:
                 checksum_to_u128(all_lanes[k]) if self._with_checksums else None
             )
             save.cell.save(save.frame, snap, cs)
+            fulfilled.append((k, save, snap))
+        return fulfilled
+
+    # ------------------------------------------------------------------
+    # speculative rollback fulfillment
+    # ------------------------------------------------------------------
+
+    def _run_rollback_spec(
+        self,
+        load: LoadGameState,
+        pairs: List[AdvanceFrame],
+        saves: List[Optional[SaveGameState]],
+    ) -> None:
+        """Fulfill ``Load + (Advance, Save?)*`` via branch selection when a
+        speculative branch hypothesized this exact input window; otherwise
+        fall back to load + fused replay.
+
+        The burst's trailing advance carries the *live* (not resimulated)
+        frame exactly when it has no trailing save — the session always saves
+        the current frame before the live advance — so the resolve window is
+        all advances except a saveless last one.  (When every advance has a
+        save — e.g. sparse saving hit the threshold — treating them all as
+        resim frames is equally correct: resolve only ever matches branches
+        whose inputs are bit-equal, so trajectory states equal replay states.)
+        """
+        g = load.frame
+        m = len(pairs)
+        n_resim = m if saves[-1] is not None else m - 1
+        arrays = [self._inputs_to_array(p.inputs) for p in pairs]
+
+        traj = None
+        if n_resim >= 1:
+            traj = self._spec.resolve(g, arrays[:n_resim])
+
+        if traj is not None:
+            # HIT: the matching branch already holds every resimulated state —
+            # no replay dispatch; saves are filled from the trajectory.
+            self.spec_hits += 1
+            to_save = [
+                (j, saves[j]) for j in range(n_resim) if saves[j] is not None
+            ]
+            if to_save and self._with_checksums:
+                # batch all trajectory digests into ONE host transfer
+                lanes = jax.device_get(
+                    [self._checksum(traj[j]) for j, _ in to_save]
+                )
+                sums = [checksum_to_u128(l) for l in lanes]
+            else:
+                sums = [None] * len(to_save)
+            for (j, save), cs in zip(to_save, sums):
+                save.cell.save(save.frame, traj[j], cs)
+            self._state = traj[n_resim - 1]
+            # re-anchor at frame g+1 (the steady-state target of the NEXT
+            # rollback) and re-hypothesize the still-unconfirmed tail
+            self._spec.root(g + 1, traj[0])
+            for arr in arrays[1:n_resim]:
+                self._spec.extend(arr)
+            if n_resim < m:  # the live advance (extends via _do_advance)
+                self._do_advance(pairs[-1], inputs=arrays[-1])
+        else:
+            # MISS: load + fused replay, then re-anchor at the first saved
+            # frame of the burst.  A burst with no save to anchor on leaves
+            # the window unsound (the rollback disproved its prefix inputs):
+            # invalidate until the next save re-roots.
+            self.spec_misses += 1
+            self._spec.invalidate()
+            self._do_load(load)
+            fulfilled = self._run_pairs(pairs, saves, arrays=arrays)
+            if fulfilled:
+                j0, save0, snap0 = fulfilled[0]
+                self._spec.root(save0.frame, snap0)
+                for arr in arrays[j0 + 1 :]:
+                    self._spec.extend(arr)

@@ -11,9 +11,11 @@ load→(advance, save)^N replay into a device-side select.  Misses fall back
 to the fused replay — correctness never depends on a hit.
 
 ``SpeculativeRollback`` is session-agnostic: it works on input *arrays* (the
-same ones the user's ``advance`` consumes).  ``DeviceRequestExecutor`` uses
-it through the ``speculation`` constructor argument, keying branches to the
-frames of Save/Load requests.
+same ones the user's ``advance`` consumes).  ``DeviceRequestExecutor`` uses it
+through its ``speculation`` constructor argument: it anchors (``root``) the
+branches at the first save of each rollback burst, ``extend``s them on every
+executed advance, and ``resolve``s against the burst inputs on every Load —
+see ``ops.executor`` and ``tests/test_spec_integration.py``.
 """
 
 from __future__ import annotations
@@ -24,10 +26,11 @@ import jax
 import jax.numpy as jnp
 
 AdvanceFn = Callable[[Any, Any], Any]
-# branch_inputs(branch_k, tick_local_inputs_array) -> full inputs array for
-# branch k this frame (local players' real inputs merged with hypothesis k's
-# remote inputs)
-BranchInputsFn = Callable[[int, Any], Any]
+# branch_inputs(branch_k, frame, tick_inputs_array) -> full inputs array for
+# branch k at ``frame`` (local players' real inputs merged with hypothesis
+# k's remote inputs; the session's own prediction arrives as ``tick_inputs``
+# so the identity function is the "trust the predictor" branch)
+BranchInputsFn = Callable[[int, int, Any], Any]
 
 
 class SpeculativeRollback:
@@ -58,9 +61,25 @@ class SpeculativeRollback:
         self._states: Any = None  # [K, ...] current branch states
         self._traj: List[Any] = []  # per-step [K, ...] states (post-advance)
         self._inputs: List[Any] = []  # per-step [K, ...] hypothesized inputs
+        # per-step cumulative [K] mask: hypothesis equalled the session's own
+        # input array for every step so far (supports resolving at an offset
+        # past the root, see resolve())
+        self._prefix_ok: List[jax.Array] = []
 
         self._step_all = jax.jit(
             lambda states, inputs_k: jax.vmap(advance)(states, inputs_k)
+        )
+
+    def _match_step(self, hyp: Any, target: Any) -> jax.Array:
+        """[K] mask: which branches' step hypothesis equals ``target``."""
+
+        def leaf_eq(h: jax.Array, c: Any) -> jax.Array:
+            c = jnp.asarray(c)
+            return jnp.all((h == c[None, ...]).reshape(self.K, -1), axis=1)
+
+        eqs = jax.tree_util.tree_map(leaf_eq, hyp, target)
+        return jax.tree_util.tree_reduce(
+            jnp.logical_and, eqs, jnp.ones((self.K,), bool)
         )
 
     # ------------------------------------------------------------------
@@ -73,6 +92,18 @@ class SpeculativeRollback:
     def root_frame(self) -> Optional[int]:
         return self._root_frame
 
+    def invalidate(self) -> None:
+        """Drop the anchor and all trajectories.  Callers MUST invalidate on
+        any rollback that is not fulfilled by ``resolve`` + a fresh ``root``:
+        a rollback disproves the predicted inputs the prefix masks were
+        validated against, so the whole window is unsound from then on.
+        ``extend`` no-ops and ``resolve`` misses until the next ``root``."""
+        self._root_frame = None
+        self._states = None
+        self._traj = []
+        self._inputs = []
+        self._prefix_ok = []
+
     def root(self, frame: int, state: Any) -> None:
         """Re-anchor all branches at ``state`` (the save of ``frame``)."""
         self._root_frame = frame
@@ -84,49 +115,59 @@ class SpeculativeRollback:
         )
         self._traj = []
         self._inputs = []
+        self._prefix_ok = []
 
     def extend(self, local_inputs: Any) -> None:
-        """Advance every branch one frame under its hypothesis."""
+        """Advance every branch one frame under its hypothesis.  The frame
+        being hypothesized is ``root_frame + window`` (extensions are
+        sequential from the anchor)."""
         if self._root_frame is None or len(self._traj) >= self.max_window:
             return
-        per_branch = [self._branch_inputs(k, local_inputs) for k in range(self.K)]
+        frame = self._root_frame + len(self._traj)
+        per_branch = [
+            self._branch_inputs(k, frame, local_inputs) for k in range(self.K)
+        ]
         inputs_k = jax.tree_util.tree_map(
             lambda *leaves: jnp.stack([jnp.asarray(l) for l in leaves]), *per_branch
         )
         self._states = self._step_all(self._states, inputs_k)
+        # which branches hypothesized exactly what the session itself used
+        # this frame (local real inputs + the predictor's remote guesses)
+        step_ok = self._match_step(inputs_k, local_inputs)
+        prev = self._prefix_ok[-1] if self._prefix_ok else jnp.ones((self.K,), bool)
         self._traj.append(self._states)
         self._inputs.append(inputs_k)
+        self._prefix_ok.append(prev & step_ok)
 
     def resolve(
         self, frame: int, confirmed: Sequence[Any]
     ) -> Optional[List[Any]]:
         """Match hypotheses against the ``confirmed`` input arrays for the
-        frames after ``frame``.  On a hit, returns the per-step states of the
-        matching branch (``len(confirmed)`` entries, post-advance each step);
-        on any miss condition, returns None."""
+        frames from ``frame`` on.  On a hit, returns the per-step states of
+        the matching branch (``len(confirmed)`` entries, post-advance each
+        step, the first being the state at ``frame + 1``); on any miss
+        condition, returns None.
+
+        ``frame`` may lie *past* the root: rollback targets are the first
+        mispredicted frame, so every frame between the root and the target
+        was predicted correctly — a branch is then valid iff its hypotheses
+        equalled the session's own inputs over that prefix (tracked
+        incrementally in ``_prefix_ok``) and the confirmed inputs from the
+        target on."""
         n = len(confirmed)
-        if (
-            self._root_frame is None
-            or frame != self._root_frame
-            or n == 0
-            or n > len(self._traj)
-        ):
+        if self._root_frame is None or n == 0:
+            return None
+        offset = frame - self._root_frame
+        if offset < 0 or offset + n > len(self._traj):
             return None
 
-        match = jnp.ones((self.K,), bool)
-        for step, conf in enumerate(confirmed):
-            hyp = self._inputs[step]
-
-            def leaf_eq(h: jax.Array, c: Any) -> jax.Array:
-                c = jnp.asarray(c)
-                return jnp.all(
-                    (h == c[None, ...]).reshape(self.K, -1), axis=1
-                )
-
-            eqs = jax.tree_util.tree_map(leaf_eq, hyp, conf)
-            match = match & jax.tree_util.tree_reduce(
-                jnp.logical_and, eqs, jnp.ones((self.K,), bool)
-            )
+        match = (
+            self._prefix_ok[offset - 1]
+            if offset > 0
+            else jnp.ones((self.K,), bool)
+        )
+        for t, conf in enumerate(confirmed):
+            match = match & self._match_step(self._inputs[offset + t], conf)
         idx = jnp.argmax(match)
         if not bool(jnp.any(match)):  # one scalar read per rollback
             return None
@@ -136,4 +177,4 @@ class SpeculativeRollback:
             ),
             tree,
         )
-        return [take(self._traj[step]) for step in range(n)]
+        return [take(self._traj[offset + t]) for t in range(n)]

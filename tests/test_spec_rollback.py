@@ -14,7 +14,7 @@ def _mk(game, K=4):
     # candidate held-button guesses
     candidates = jnp.asarray([0, 1, 4, 8], jnp.uint8)
 
-    def branch_inputs(k, local_inputs):
+    def branch_inputs(k, frame, local_inputs):
         return jnp.asarray(
             [jnp.asarray(local_inputs)[0], candidates[k]], jnp.uint8
         )
