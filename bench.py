@@ -1,20 +1,23 @@
-"""Benchmark: resimulated frames/sec at 8-frame rollback (BASELINE config 2).
+"""Benchmarks: one JSON line per BASELINE config, flagship last.
 
-Measures the flagship path — BoxGame under ``DeviceSyncTestSession`` with
-check_distance=8, the fused load→(advance, save)^8 replay as one XLA program —
-against a host-side baseline that executes the same session semantics the way
-the reference does: one Python-level request at a time over NumPy state
-(save = copy + checksum, advance = vectorized NumPy step).  The reference
-itself publishes no numbers (BASELINE.md), so ``vs_baseline`` is the ratio of
-the device path to that host request-loop on this machine.
+Configs (BASELINE.md "targets to measure"):
+  1. BoxGame host SyncTest, cd=2     — the CPU request-loop reference point
+  2. BoxGame device SyncTest, cd=8   — the flagship fused-replay path
+  3. BoxGame P2P 4p, 8-branch speculation — speculative rollback vs replay
+  4. EcsWorld device SyncTest, cd=16 — entity-world, long rollback window
+  5. 256 batched ChipVM sessions     — massed session parallelism on 1 chip
 
-Prints exactly one JSON line:
-  {"metric": ..., "value": ..., "unit": ..., "vs_baseline": ...}
+Each line is ``{"metric", "value", "unit", "vs_baseline"}``.  The reference
+publishes no numbers (BASELINE.md), so every ``vs_baseline`` is the ratio of
+the measured path to the equivalent host/NumPy request loop on this machine
+(config 3: ratio to the same P2P loop with speculation disabled).  The
+flagship config-2 line prints LAST.
 """
 
 from __future__ import annotations
 
 import json
+import random
 import time
 import zlib
 
@@ -23,43 +26,59 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
-from ggrs_tpu.games import BoxGame
+from ggrs_tpu.games import BoxGame, ChipVM, EcsWorld, boxgame_config
 from ggrs_tpu.sessions import DeviceSyncTestSession
 
 CHECK_DISTANCE = 8
 PLAYERS = 2
 
 
-def _inputs(n: int, seed: int) -> np.ndarray:
+def _inputs(n: int, players: int, seed: int) -> np.ndarray:
     rng = np.random.default_rng(seed)
-    return rng.integers(0, 16, size=(n, PLAYERS)).astype(np.uint8)
+    return rng.integers(0, 16, size=(n, players)).astype(np.uint8)
 
 
-def bench_device(total_ticks: int, chunk: int) -> float:
+def emit(metric: str, value: float, unit: str, vs_baseline: float) -> None:
+    print(
+        json.dumps(
+            {
+                "metric": metric,
+                "value": round(value, 1),
+                "unit": unit,
+                "vs_baseline": round(vs_baseline, 2),
+            }
+        ),
+        flush=True,
+    )
+
+
+# ---------------------------------------------------------------------------
+# device synctest harness (configs 2 and 4)
+# ---------------------------------------------------------------------------
+
+
+def bench_device_synctest(
+    advance, init_state, input_template, input_fn, d: int, total_ticks: int, chunk: int
+) -> float:
     """Resim frames/sec through the fused device session.
 
     Inputs are pre-staged to device and the desync check deferred to the end:
     the timed loop contains zero host↔device transfers (each costs a full
     round-trip on a tunneled TPU), exactly how a throughput consumer would
     drive the session."""
-    game = BoxGame(PLAYERS)
     sess = DeviceSyncTestSession(
-        game.advance,
-        game.init_state(),
-        jnp.zeros((PLAYERS,), jnp.uint8),
-        check_distance=CHECK_DISTANCE,
-        max_prediction=CHECK_DISTANCE,
+        advance, init_state, input_template, check_distance=d, max_prediction=d
     )
     # No device->host read may happen before or inside the timed loop: on a
     # tunneled TPU the first D2H permanently degrades dispatch throughput by
     # ~1000x (measured), so desync verification runs once, after timing.
-    warm = _inputs(chunk, seed=100)
+    warm = input_fn(chunk, seed=100)
     sess.run_ticks(warm, check=False)  # warmup ticks + compiles both programs
     sess.run_ticks(warm, check=False)  # steady-state program now cached
     sess.block_until_ready()
 
     chunks = [
-        jnp.asarray(_inputs(chunk, seed=i)) for i in range(total_ticks // chunk)
+        jnp.asarray(input_fn(chunk, seed=i)) for i in range(total_ticks // chunk)
     ]
     jax.block_until_ready(chunks)
 
@@ -68,23 +87,30 @@ def bench_device(total_ticks: int, chunk: int) -> float:
         sess.run_ticks(staged, check=False)
     sess.block_until_ready()
     dt = time.perf_counter() - t0
-    sess.verify()  # zero desyncs required for the number to count
-    return len(chunks) * chunk * CHECK_DISTANCE / dt
+    # zero desyncs required for the number to count; the caller runs verify()
+    # (a D2H read) only after ALL device-timed configs have finished
+    return len(chunks) * chunk * d / dt, sess.verify
 
 
-def bench_host_baseline(ticks: int) -> float:
-    """The same synctest semantics executed the reference's way: a Python
-    request loop, one save/load/advance at a time, NumPy state."""
-    game = BoxGame(PLAYERS)
+# ---------------------------------------------------------------------------
+# host request-loop harness (configs 1 and the vs_baseline denominators)
+# ---------------------------------------------------------------------------
+
+
+def bench_host_synctest(game, players: int, d: int, ticks: int, seed: int = 7) -> float:
+    """Synctest semantics executed the reference's way: a Python request
+    loop, one save/load/advance at a time, NumPy state."""
     state = game.init_state_np()
     saved = {}  # frame -> (state copy, checksum)
     history = {}
     inputs_by_frame = {}
-    d = CHECK_DISTANCE
-    ins = _inputs(ticks, seed=7)
+    ins = _inputs(ticks, players, seed)
 
     def checksum(s):
-        return zlib.crc32(s["pos"].tobytes() + s["vel"].tobytes() + s["rot"].tobytes())
+        return zlib.crc32(b"".join(np.ascontiguousarray(v).tobytes() for v in s.values()))
+
+    def copy(s):
+        return {k: np.copy(v) for k, v in s.items()}
 
     t0 = time.perf_counter()
     resim_frames = 0
@@ -95,14 +121,14 @@ def bench_host_baseline(ticks: int) -> float:
             for f in range(frame - d, frame):
                 if f in history and f in saved and saved[f][1] != history[f]:
                     raise AssertionError("desync in baseline")
-            state = {k: v.copy() for k, v in saved[frame - d][0].items()}
+            state = copy(saved[frame - d][0])
             for f in range(frame - d, frame):
                 if f > frame - d:
-                    saved[f] = ({k: v.copy() for k, v in state.items()}, checksum(state))
+                    saved[f] = (copy(state), checksum(state))
                 state = game.advance_np(state, inputs_by_frame[f])
                 resim_frames += 1
         cs = checksum(state)
-        saved[frame] = ({k: v.copy() for k, v in state.items()}, cs)
+        saved[frame] = (copy(state), cs)
         history.setdefault(frame, cs)
         state = game.advance_np(state, ins[frame])
         # drop data outside the ring, like the real session
@@ -112,21 +138,190 @@ def bench_host_baseline(ticks: int) -> float:
     return max(resim_frames, 1) / dt
 
 
+# ---------------------------------------------------------------------------
+# config 3: speculative P2P (4 players, 8 branches)
+# ---------------------------------------------------------------------------
+
+
+def bench_speculative_p2p(ticks: int, speculate: bool) -> tuple:
+    """Four P2P peers over the in-memory net, each fulfilling requests with a
+    device executor; peer 0 optionally speculates with 8 branches.  Returns
+    (ticks/sec, rollbacks, hits)."""
+    from ggrs_tpu.core import Local, Remote
+    from ggrs_tpu.net import InMemoryNetwork
+    from ggrs_tpu.ops import DeviceRequestExecutor
+    from ggrs_tpu.parallel import SpeculativeRollback
+    from ggrs_tpu.sessions import SessionBuilder
+
+    game = BoxGame(4)
+    peers = ["P0", "P1", "P2", "P3"]
+
+    def sched(player, i):
+        return ((i + player) // 3) % 16  # transitions force regular rollbacks
+
+    def to_arr(pairs):
+        return jnp.asarray(np.asarray([p[0] for p in pairs], np.uint8))
+
+    def branch_inputs(k, frame, arr):
+        arr = jnp.asarray(arr, jnp.uint8)
+        if k < 7:
+            return arr.at[1:].set(np.uint8(k))
+        vals = np.asarray([sched(p, frame) for p in (1, 2, 3)], np.uint8)
+        return arr.at[1:].set(jnp.asarray(vals))
+
+    net = InMemoryNetwork()
+    sessions, executors = [], []
+    for me in range(4):
+        b = (
+            SessionBuilder(boxgame_config())
+            .with_num_players(4)
+            .with_max_prediction_window(8)
+            .with_clock(lambda: 0)
+            .with_rng(random.Random(91 + me))
+        )
+        for p in range(4):
+            b = b.add_player(Local() if p == me else Remote(peers[p]), p)
+        sessions.append(b.start_p2p_session(net.socket(peers[me])))
+        spec = (
+            SpeculativeRollback(game.advance, 8, branch_inputs, max_window=8)
+            if (speculate and me == 0)
+            else None
+        )
+        executors.append(
+            DeviceRequestExecutor(
+                game.advance, game.init_state(), to_arr,
+                with_checksums=False, speculation=spec,
+            )
+        )
+
+    def tick(i):
+        for s in sessions:
+            s.poll_remote_clients()
+        for p, (s, ex) in enumerate(zip(sessions, executors)):
+            s.add_local_input(p, sched(p, i))
+            ex.run(s.advance_frame())
+
+    for i in range(24):  # warm caches + compiles
+        tick(i)
+    jax.block_until_ready(executors[0].state)
+
+    t0 = time.perf_counter()
+    for i in range(24, 24 + ticks):
+        tick(i)
+    jax.block_until_ready([ex.state for ex in executors])
+    dt = time.perf_counter() - t0
+    ex0 = executors[0]
+    return ticks / dt, ex0.spec_hits + ex0.spec_misses, ex0.spec_hits
+
+
+# ---------------------------------------------------------------------------
+# config 5: massed batched sessions
+# ---------------------------------------------------------------------------
+
+
+def bench_batched_chipvm(batch: int, total_ticks: int, chunk: int, d: int) -> float:
+    """Aggregate resim frames/sec across ``batch`` independent ChipVM
+    synctest sessions on one chip (shard_map over a 1-device mesh — the same
+    program the 8-chip dry-run validates)."""
+    from ggrs_tpu.parallel import BatchedSessions, make_mesh
+
+    vm = ChipVM(2)
+    batched = BatchedSessions(
+        vm.advance,
+        vm.init_state(),
+        jnp.zeros((2,), jnp.uint8),
+        batch_size=batch,
+        mesh=make_mesh(1),
+        check_distance=d,
+        max_prediction=d,
+    )
+    def chunk_inputs(seed):
+        return jnp.asarray(
+            np.random.default_rng(seed).integers(
+                0, 256, size=(batch, chunk, 2)
+            ).astype(np.uint8)
+        )
+
+    batched.run_ticks(chunk_inputs(100), check=False)  # compiles both programs
+    batched.block_until_ready()
+
+    staged = [chunk_inputs(i) for i in range(total_ticks // chunk)]
+    jax.block_until_ready(staged)
+
+    t0 = time.perf_counter()
+    for c in staged:
+        batched.run_ticks(c, check=False)  # fully async: no D2H in the loop
+    batched.block_until_ready()
+    dt = time.perf_counter() - t0
+
+    def verify():
+        assert batched.verify()["mismatches"] == 0
+
+    return batch * len(staged) * chunk * d / dt, verify
+
+
+# ---------------------------------------------------------------------------
+
+
 def main() -> None:
     backend = jax.default_backend()
-    # enough work to dwarf dispatch overhead; chunked so inputs stream H2D
-    total_ticks, chunk = (16384, 1024) if backend == "tpu" else (4096, 512)
-    device_fps = bench_device(total_ticks, chunk)
-    host_fps = bench_host_baseline(600)
-    print(
-        json.dumps(
-            {
-                "metric": f"boxgame_synctest_resim_frames_per_sec_cd{CHECK_DISTANCE}",
-                "value": round(device_fps, 1),
-                "unit": "resim_frames/sec",
-                "vs_baseline": round(device_fps / host_fps, 2),
-            }
-        )
+    on_tpu = backend == "tpu"
+
+    # MEASUREMENT order: all pure-dispatch device configs run BEFORE anything
+    # that reads device→host (on a tunneled TPU the first D2H permanently
+    # degrades dispatch throughput).  PRINT order: configs 1, 3, 4, 5, then
+    # the flagship config 2 last.
+
+    # config 2 (flagship): BoxGame device synctest at cd=8 — measured FIRST
+    game = BoxGame(PLAYERS)
+    total_ticks, chunk = (16384, 1024) if on_tpu else (4096, 512)
+    device_fps, verify2 = bench_device_synctest(
+        game.advance, game.init_state(), jnp.zeros((PLAYERS,), jnp.uint8),
+        lambda n, seed: _inputs(n, PLAYERS, seed),
+        CHECK_DISTANCE, total_ticks, chunk,
+    )
+
+    # config 4: EcsWorld, 4 players, 16-frame rollback window
+    ecs = EcsWorld(4, entities_per_player=32)
+    ticks4, chunk4 = (4096, 512) if on_tpu else (768, 256)
+    ecs_fps, verify4 = bench_device_synctest(
+        ecs.advance, ecs.init_state(), jnp.zeros((4,), jnp.uint8),
+        lambda n, seed: _inputs(n, 4, seed), 16, ticks4, chunk4,
+    )
+
+    # config 5: 256 concurrent ChipVM sessions on one chip
+    ticks5, chunk5 = (1024, 256) if on_tpu else (128, 64)
+    vm_rate, verify5 = bench_batched_chipvm(256, ticks5, chunk5, d=8)
+
+    # all device timing done — desync gates (D2H reads) are safe now
+    verify2()
+    verify4()
+    verify5()
+
+    # config 3: speculative P2P vs the same loop with speculation off
+    # (host-driven: D2H per rollback is inherent to the live session path)
+    spec_rate, rollbacks, hits = bench_speculative_p2p(200, speculate=True)
+    plain_rate, _, _ = bench_speculative_p2p(200, speculate=False)
+
+    # host request-loop denominators (pure NumPy, no device)
+    host_cd2 = bench_host_synctest(BoxGame(PLAYERS), PLAYERS, d=2, ticks=600)
+    host_fps = bench_host_synctest(game, PLAYERS, d=CHECK_DISTANCE, ticks=600)
+    ecs_host = bench_host_synctest(ecs, 4, d=16, ticks=300)
+    vm_host = bench_host_synctest(ChipVM(2), 2, d=8, ticks=300)
+
+    emit("boxgame_synctest_host_resim_frames_per_sec_cd2", host_cd2,
+         "resim_frames/sec", 1.0)
+    emit("p2p4_speculative_8branch_ticks_per_sec", spec_rate,
+         f"ticks/sec (hit {hits}/{rollbacks} rollbacks)"
+         if rollbacks else "ticks/sec",
+         spec_rate / plain_rate if plain_rate else 0.0)
+    emit("ecs_synctest_resim_frames_per_sec_cd16", ecs_fps,
+         "resim_frames/sec", ecs_fps / ecs_host)
+    emit("chipvm_256sessions_resim_frames_per_sec", vm_rate,
+         "resim_frames/sec", vm_rate / vm_host)
+    emit(
+        f"boxgame_synctest_resim_frames_per_sec_cd{CHECK_DISTANCE}",
+        device_fps, "resim_frames/sec", device_fps / host_fps,
     )
 
 

@@ -76,6 +76,7 @@ class BatchedSessions:
         )
         self.check_distance = check_distance
         self._ticks_run = 0
+        self._last_stats: Optional[Dict[str, Any]] = None
 
         spec_b = P(SESSION_AXIS)  # shard leading (session) axis
         sharding = NamedSharding(self.mesh, spec_b)
@@ -122,17 +123,21 @@ class BatchedSessions:
     def current_frame(self) -> int:
         return self._ticks_run
 
-    def run_ticks(self, inputs: Any) -> Dict[str, int]:
+    def run_ticks(self, inputs: Any, check: bool = True) -> Optional[Dict[str, int]]:
         """Advance all sessions ``n`` frames.  ``inputs`` leading axes are
         ``(B, n, ...per-frame...)``.  Returns global stats from the on-mesh
         reduction: total mismatches and earliest bad frame across all
-        sessions."""
+        sessions.
+
+        ``check=False`` defers the stats fetch: the call stays fully async
+        (no device→host read — a full round-trip on tunneled TPUs) and
+        returns None; read the accumulated result later with ``verify()``."""
         inputs = jax.tree_util.tree_map(jnp.asarray, inputs)
         leaf0 = jax.tree_util.tree_leaves(inputs)[0]
         assert leaf0.shape[0] == self.batch_size
         n = leaf0.shape[1]
         if n == 0:
-            return {"mismatches": 0, "first_bad": np.iinfo(np.int32).max}
+            return {"mismatches": 0, "first_bad": np.iinfo(np.int32).max} if check else None
         n_warm = self._programs.split_at_warmup(self._ticks_run, n)
         stats = None
         if n_warm:
@@ -142,10 +147,19 @@ class BatchedSessions:
             tail = jax.tree_util.tree_map(lambda a: a[:, n_warm:], inputs)
             self._carry, stats = self._run_steady(self._carry, tail)
         self._ticks_run += n
-        return {
-            "mismatches": int(jax.device_get(stats["mismatches"])),
-            "first_bad": int(jax.device_get(stats["first_bad"])),
-        }
+        self._last_stats = stats  # device scalars; fetched on demand
+        if not check:
+            return None
+        return self.verify()
+
+    def verify(self) -> Dict[str, int]:
+        """Fetch the deferred global stats (one transfer for both scalars)."""
+        if self._last_stats is None:
+            return {"mismatches": 0, "first_bad": np.iinfo(np.int32).max}
+        mismatches, first_bad = jax.device_get(
+            (self._last_stats["mismatches"], self._last_stats["first_bad"])
+        )
+        return {"mismatches": int(mismatches), "first_bad": int(first_bad)}
 
     def live_states(self) -> Any:
         """All B live states, gathered to host (leading axis B)."""

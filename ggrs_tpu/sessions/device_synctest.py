@@ -6,9 +6,10 @@ frames every tick with first-seen checksum comparison,
 is a fused XLA program (`ggrs_tpu.ops.replay`) and ``run_ticks`` dispatches
 hundreds of ticks per device call.  The observable contract differs in one
 documented way: checksum mismatches surface at the end of a ``run_ticks``
-batch (as ``MismatchedChecksum`` with the earliest offending frame), not at
-the exact tick — the price of never syncing the device per frame, and the
-reason this session is the benchmark harness (BASELINE configs 1-2).
+batch (as ``MismatchedChecksum`` carrying every divergent frame still in the
+ring window plus the earliest offender overall), not at the exact tick — the
+price of never syncing the device per frame, and the reason this session is
+the benchmark harness (BASELINE configs 1-2).
 
 Use the host ``SyncTestSession`` when you need per-tick request lists or
 arbitrary Python state; use this one when the game is a JAX pytree.
@@ -134,5 +135,38 @@ class DeviceSyncTestSession:
             (self._carry["mismatches"], self._carry["first_bad"])
         )
         if int(mismatches):
-            frames = [int(first_bad)] if int(first_bad) != _I32_MAX else []
-            raise MismatchedChecksum(self._ticks_run, frames)
+            raise MismatchedChecksum(
+                self._ticks_run, self._window_mismatched_frames(int(first_bad))
+            )
+
+    def _window_mismatched_frames(self, first_bad: int) -> list:
+        """Every frame still in the ring whose saved (resimulated) digest
+        differs from its first-seen history digest, plus the earliest bad
+        frame overall — the full-report analog of the reference's mismatched
+        frame list (/root/reference/src/sessions/sync_test_session.rs:93-102).
+
+        Only runs on the failure path (one extra device fetch); per-slot
+        digests are already resident, so the hot loop pays nothing for this.
+        A slot is comparable when it still holds the newest frame for both
+        arrays: ring saves lag the history by one frame (the history entry for
+        the live frame lands before its resim save), so the slot of the
+        current frame is history-only and excluded."""
+        ring_frames, ring_cs, hist = jax.device_get(
+            (
+                self._carry["ring"]["frames"],
+                self._carry["ring"]["checksums"],
+                self._carry["hist"],
+            )
+        )
+        t = self._ticks_run
+        r = len(ring_frames)
+        frames = set()
+        if first_bad != _I32_MAX:
+            frames.add(first_bad)
+        for i in range(r):
+            f = int(ring_frames[i])
+            if f < 0 or f + r <= t or i == t % r:
+                continue  # never saved / stale slot / history is one ahead
+            if np.any(ring_cs[i] != hist[i]):
+                frames.add(f)
+        return sorted(frames)

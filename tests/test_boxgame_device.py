@@ -131,6 +131,25 @@ class TestDeviceSyncTest:
             sess.run_ticks(_random_inputs(10, 2, seed=2))
         assert ei.value.mismatched_frames == [9]
 
+    def test_all_window_mismatches_reported(self):
+        # Corrupting the first-seen history of TWO window frames makes the
+        # next tick's resimulations of both diverge; the error must list every
+        # divergent frame, matching the reference's full mismatched-frames
+        # report (/root/reference/src/sessions/sync_test_session.rs:93-102).
+        game = BoxGame(2)
+        sess = DeviceSyncTestSession(
+            game.advance, game.init_state(), jnp.zeros((2,), jnp.uint8), check_distance=2
+        )
+        sess.run_ticks(_random_inputs(10, 2, seed=1))
+        ring_len = sess._programs.ring.length
+        for frame in (9, 10):
+            sess._carry["hist"] = (
+                sess._carry["hist"].at[frame % ring_len].set(jnp.uint32(0xBAD))
+            )
+        with pytest.raises(MismatchedChecksum) as ei:
+            sess.run_ticks(_random_inputs(1, 2, seed=2))
+        assert ei.value.mismatched_frames == [9, 10]
+
     def test_check_distance_zero_rejected(self):
         game = BoxGame(2)
         with pytest.raises(InvalidRequest):

@@ -241,7 +241,37 @@ def test_lockstep_mode_never_saves_or_loads():
 
     # lockstep advances at most one frame behind the slowest confirmation
     assert advanced1 > 0 and advanced2 > 0
-    assert stub1.gs.state == stub2.gs.state or abs(stub1.gs.frame - stub2.gs.frame) <= 1
+    assert abs(stub1.gs.frame - stub2.gs.frame) <= 1
+
+    # after a drain both peers must pin the SAME frame and state exactly
+    for i in range(5):
+        sess1.poll_remote_clients()
+        sess2.poll_remote_clients()
+        sess1.add_local_input(0, 0)
+        stub1.handle_requests(sess1.advance_frame())
+        sess2.add_local_input(1, 0)
+        stub2.handle_requests(sess2.advance_frame())
+    assert stub1.gs.frame == stub2.gs.frame
+    assert stub1.gs.state == stub2.gs.state
+    # lockstep needs a full confirmation round-trip per frame (~2 ticks each)
+    assert stub1.gs.frame >= 15
+
+
+def test_confirmed_frame_asserts_when_all_players_disconnected():
+    """Parity with the reference's panic: confirmed_frame() over zero
+    connected players is a programming error, surfaced as an assertion
+    (reference: p2p_session.rs:542-553)."""
+    net = InMemoryNetwork()
+    sess = (
+        SessionBuilder(stub_config())
+        .add_player(Local(), 0)
+        .add_player(Remote("R"), 1)
+        .start_p2p_session(net.socket("me"))
+    )
+    for status in sess.local_connect_status:
+        status.disconnected = True
+    with pytest.raises(AssertionError):
+        sess.confirmed_frame()
 
 
 def test_advance_frame_p2p_sessions_real_udp():

@@ -1,0 +1,390 @@
+"""The endpoint protocol's timer machinery, driven by an injected clock.
+
+Every timer in ``net/protocol.py``'s poll path (retry, quality/RTT,
+keep-alive, the two-phase NetworkInterrupted→Disconnected failure detector,
+NetworkResumed, and the shutdown linger) must observably fire — parity with
+/root/reference/src/network/protocol.rs:329-376,349-366.
+"""
+
+import random
+
+import pytest
+
+from ggrs_tpu.core import DesyncDetection, StatsUnavailable
+from ggrs_tpu.core.frame_info import PlayerInput
+from ggrs_tpu.net.messages import (
+    ConnectionStatus,
+    InputAck,
+    InputMessage,
+    KeepAlive,
+    Message,
+    QualityReply,
+    QualityReport,
+)
+from ggrs_tpu.net.protocol import (
+    EvDisconnected,
+    EvInput,
+    EvNetworkInterrupted,
+    EvNetworkResumed,
+    PeerProtocol,
+)
+
+from stubs import stub_config
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.now = 0
+
+    def __call__(self) -> int:
+        return self.now
+
+
+class CaptureSocket:
+    """Records every sent message so tests can inspect and forward them."""
+
+    def __init__(self) -> None:
+        self.sent = []
+
+    def send_to(self, msg: Message, addr) -> None:
+        self.sent.append((addr, msg))
+
+    def receive_all_messages(self):
+        return []
+
+    def drain(self):
+        out = [m for _, m in self.sent]
+        self.sent.clear()
+        return out
+
+
+def make_proto(clock, seed=5, **overrides):
+    kwargs = dict(
+        config=stub_config(),
+        handles=[1],
+        peer_addr="B",
+        num_players=2,
+        local_players=1,
+        max_prediction=8,
+        disconnect_timeout_ms=2000,
+        disconnect_notify_start_ms=500,
+        fps=60,
+        desync_detection=DesyncDetection.off(),
+        clock=clock,
+        rng=random.Random(seed),
+    )
+    kwargs.update(overrides)
+    return PeerProtocol(**kwargs)
+
+
+def connect_status(n=2):
+    return [ConnectionStatus() for _ in range(n)]
+
+
+def bodies(msgs):
+    return [type(m.body).__name__ for m in msgs]
+
+
+class TestRetryTimer:
+    def test_pending_output_resent_after_silence(self):
+        clock = FakeClock()
+        proto = make_proto(clock)
+        sock = CaptureSocket()
+        status = connect_status()
+
+        proto.send_input({1: PlayerInput(0, 7)}, status)
+        proto.send_all_messages(sock)
+        first = [m for m in sock.drain() if isinstance(m.body, InputMessage)]
+        assert len(first) == 1
+
+        # under 200ms of input silence: no retry
+        clock.now = 150
+        proto.poll(status)
+        proto.send_all_messages(sock)
+        assert not any(isinstance(m.body, InputMessage) for m in sock.drain())
+
+        # past 200ms: the unacked input goes out again, byte-identical window
+        clock.now = 250
+        proto.poll(status)
+        proto.send_all_messages(sock)
+        retried = [m for m in sock.drain() if isinstance(m.body, InputMessage)]
+        assert len(retried) == 1
+        assert retried[0].body.start_frame == first[0].body.start_frame == 0
+        assert retried[0].body.bytes == first[0].body.bytes
+
+    def test_ack_stops_retries(self):
+        clock = FakeClock()
+        proto = make_proto(clock)
+        sock = CaptureSocket()
+        status = connect_status()
+        proto.send_input({1: PlayerInput(0, 7)}, status)
+        proto.send_all_messages(sock)
+        sock.drain()
+
+        proto.handle_message(Message(magic=1, body=InputAck(ack_frame=0)))
+        clock.now = 250
+        proto.poll(status)
+        proto.send_all_messages(sock)
+        assert not any(isinstance(m.body, InputMessage) for m in sock.drain())
+
+
+class TestQualityAndKeepAlive:
+    def test_quality_roundtrip_measures_ping_into_stats(self):
+        clock = FakeClock()
+        a = make_proto(clock, seed=1)
+        b = make_proto(clock, seed=2)
+        sock_a, sock_b = CaptureSocket(), CaptureSocket()
+        status = connect_status()
+
+        clock.now = 201
+        a.poll(status)
+        a.send_all_messages(sock_a)
+        reports = [m for m in sock_a.drain() if isinstance(m.body, QualityReport)]
+        assert len(reports) == 1
+        assert reports[0].body.ping == 201
+
+        for m in reports:
+            b.handle_message(m)
+        b.send_all_messages(sock_b)
+        replies = [m for m in sock_b.drain() if isinstance(m.body, QualityReply)]
+        assert len(replies) == 1 and replies[0].body.pong == 201
+
+        clock.now = 231  # 30ms later the reply arrives
+        for m in replies:
+            a.handle_message(m)
+
+        clock.now = 1300  # stats need >= 1 elapsed second
+        stats = a.network_stats()
+        assert stats.ping == 30
+        assert stats.kbps_sent >= 0
+
+    def test_quality_report_carries_frame_advantage(self):
+        clock = FakeClock()
+        a = make_proto(clock, seed=1)
+        b = make_proto(clock, seed=2)
+        sock = CaptureSocket()
+        a.local_frame_advantage = 4
+        clock.now = 201
+        a.poll(connect_status())
+        a.send_all_messages(sock)
+        report = next(m for m in sock.drain() if isinstance(m.body, QualityReport))
+        assert report.body.frame_advantage == 4
+        b.handle_message(report)
+        assert b.remote_frame_advantage == 4
+
+    def test_stats_unavailable_before_time_elapses(self):
+        clock = FakeClock()
+        proto = make_proto(clock)
+        with pytest.raises(StatsUnavailable):
+            proto.network_stats()
+
+    def test_keepalive_fires_when_nothing_else_sent(self):
+        clock = FakeClock()
+        proto = make_proto(clock)
+        sock = CaptureSocket()
+        # the quality timer shares the 200ms cadence and normally refreshes
+        # last-send first; push it into the future to expose the keep-alive
+        # branch on its own
+        proto._last_quality_report_time = 10_000
+        clock.now = 250
+        proto.poll(connect_status())
+        proto.send_all_messages(sock)
+        assert any(isinstance(m.body, KeepAlive) for m in sock.drain())
+
+    def test_keepalive_suppressed_while_traffic_flows(self):
+        clock = FakeClock()
+        proto = make_proto(clock)
+        sock = CaptureSocket()
+        proto._last_quality_report_time = 10_000
+        clock.now = 150  # under the 200ms threshold
+        proto.poll(connect_status())
+        proto.send_all_messages(sock)
+        assert not any(isinstance(m.body, KeepAlive) for m in sock.drain())
+
+
+class TestFailureDetector:
+    def test_interrupted_then_disconnected_then_resumed(self):
+        clock = FakeClock()
+        proto = make_proto(clock)
+        status = connect_status()
+
+        # silence past disconnect_notify_start: one interrupt, no duplicates
+        clock.now = 501
+        events = proto.poll(status)
+        assert [e for e in events if isinstance(e, EvNetworkInterrupted)] != []
+        interrupted = next(
+            e for e in events if isinstance(e, EvNetworkInterrupted)
+        )
+        assert interrupted.disconnect_timeout == 2000 - 500
+        clock.now = 900
+        assert not any(
+            isinstance(e, EvNetworkInterrupted) for e in proto.poll(status)
+        )
+
+        # a packet arrives: NetworkResumed, detector re-arms
+        proto.handle_message(Message(magic=1, body=KeepAlive()))
+        events = proto.poll(status)
+        assert any(isinstance(e, EvNetworkResumed) for e in events)
+
+        # fresh silence: interrupt again, then the hard disconnect
+        clock.now = 900 + 501
+        assert any(
+            isinstance(e, EvNetworkInterrupted) for e in proto.poll(status)
+        )
+        clock.now = 900 + 2001
+        events = proto.poll(status)
+        assert any(isinstance(e, EvDisconnected) for e in events)
+        # disconnect fires exactly once
+        clock.now = 900 + 3000
+        assert not any(isinstance(e, EvDisconnected) for e in proto.poll(status))
+
+    def test_shutdown_linger_then_silent(self):
+        clock = FakeClock()
+        proto = make_proto(clock)
+        sock = CaptureSocket()
+
+        clock.now = 100
+        proto.disconnect()
+        assert not proto.is_running()
+
+        # during the linger the endpoint still flushes queued messages
+        proto.send_checksum_report(5, 123)
+        proto.send_all_messages(sock)
+        assert len(sock.drain()) == 1
+
+        # after the 5s linger: shutdown — queued messages are dropped and
+        # inbound traffic is ignored
+        clock.now = 100 + 5001
+        proto.poll(connect_status())
+        proto.send_checksum_report(6, 456)
+        proto.send_all_messages(sock)
+        assert sock.drain() == []
+        proto.handle_message(Message(magic=1, body=KeepAlive()))
+        assert proto.poll(connect_status()) == []
+
+
+class TestSessionFailurePath:
+    """The detector surfaced through a live P2P session: interrupted /
+    disconnected events, rollback to the disconnect frame, and resume."""
+
+    def _pair(self, clock):
+        from ggrs_tpu.net import InMemoryNetwork
+        from ggrs_tpu.sessions import SessionBuilder
+        from ggrs_tpu.core import Local, Remote
+
+        net = InMemoryNetwork()
+        sessions = []
+        for me, other, local_handle in (("A", "B", 0), ("B", "A", 1)):
+            sessions.append(
+                SessionBuilder(stub_config())
+                .with_clock(clock)
+                .with_rng(random.Random(11 + local_handle))
+                .add_player(Local(), local_handle)
+                .add_player(Remote(other), 1 - local_handle)
+                .start_p2p_session(net.socket(me))
+            )
+        return net, sessions
+
+    def test_peer_silence_interrupts_then_disconnects_with_rollback(self):
+        from ggrs_tpu.core import (
+            Disconnected,
+            InputStatus,
+            LoadGameState,
+            NetworkInterrupted,
+        )
+        from stubs import GameStub
+
+        clock = FakeClock()
+        net, (sess_a, sess_b) = self._pair(clock)
+        stub_a, stub_b = GameStub(), GameStub()
+
+        for i in range(10):
+            clock.now += 16
+            sess_a.poll_remote_clients()
+            sess_b.poll_remote_clients()
+            sess_a.add_local_input(0, i)
+            stub_a.handle_requests(sess_a.advance_frame())
+            sess_b.add_local_input(1, i)
+            stub_b.handle_requests(sess_b.advance_frame())
+        sess_a.events()
+
+        # B goes silent; A keeps ticking on predictions
+        interrupted = disconnected = False
+        saw_load_after_disconnect = False
+        for i in range(10, 400):
+            clock.now += 16
+            sess_a.poll_remote_clients()
+            events = sess_a.events()
+            if any(isinstance(e, NetworkInterrupted) for e in events):
+                assert not disconnected, "interrupt must precede disconnect"
+                interrupted = True
+            if any(isinstance(e, Disconnected) for e in events):
+                assert interrupted
+                disconnected = True
+            sess_a.add_local_input(0, i)
+            reqs = sess_a.advance_frame()
+            if disconnected and any(
+                isinstance(r, LoadGameState) for r in reqs
+            ):
+                saw_load_after_disconnect = True
+            stub_a.handle_requests(reqs)
+            if disconnected and saw_load_after_disconnect:
+                break
+
+        assert interrupted and disconnected
+        # the disconnect erased predictions via a rollback...
+        assert saw_load_after_disconnect
+        assert sess_a.local_connect_status[1].disconnected
+
+        # ...and the session keeps advancing with disconnect dummies
+        frame_before = sess_a.current_frame
+        for i in range(3):
+            clock.now += 16
+            sess_a.poll_remote_clients()
+            sess_a.add_local_input(0, 0)
+            reqs = sess_a.advance_frame()
+            stub_a.handle_requests(reqs)
+            for r in reqs:
+                if hasattr(r, "inputs"):
+                    assert r.inputs[1][1] == InputStatus.DISCONNECTED
+        assert sess_a.current_frame > frame_before
+
+    def test_resume_before_timeout_emits_network_resumed(self):
+        from ggrs_tpu.core import Disconnected, NetworkInterrupted, NetworkResumed
+        from stubs import GameStub
+
+        clock = FakeClock()
+        net, (sess_a, sess_b) = self._pair(clock)
+        stub_a, stub_b = GameStub(), GameStub()
+
+        for i in range(5):
+            clock.now += 16
+            sess_a.poll_remote_clients()
+            sess_b.poll_remote_clients()
+            sess_a.add_local_input(0, i)
+            stub_a.handle_requests(sess_a.advance_frame())
+            sess_b.add_local_input(1, i)
+            stub_b.handle_requests(sess_b.advance_frame())
+        sess_a.events()
+
+        # drain B's in-flight packets first (receive time is poll time)
+        clock.now += 16
+        sess_a.poll_remote_clients()
+        sess_a.events()
+
+        # B pauses just past the notify threshold, then comes back
+        clock.now += 600
+        sess_a.poll_remote_clients()
+        assert any(
+            isinstance(e, NetworkInterrupted) for e in sess_a.events()
+        )
+
+        clock.now += 16
+        sess_b.poll_remote_clients()
+        sess_b.add_local_input(1, 5)
+        stub_b.handle_requests(sess_b.advance_frame())  # sends packets to A
+        sess_a.poll_remote_clients()
+        events = sess_a.events()
+        assert any(isinstance(e, NetworkResumed) for e in events)
+        assert not any(isinstance(e, Disconnected) for e in events)
+        assert not sess_a.local_connect_status[1].disconnected

@@ -1,0 +1,115 @@
+"""WaitRecommendation behavior through a live P2P pair: emission threshold,
+60-frame cadence, skip_frames magnitude, and the throttling loop consuming
+the recommendation (reference: /root/reference/src/sessions/p2p_session.rs:20-21,
+804-817 and the example's slow-down loop, ex_game_p2p.rs:110-136).
+
+The underlying frame-advantage averaging itself is covered by
+tests/test_time_sync.py (parity with /root/reference/src/time_sync.rs:46-115).
+"""
+
+import random
+
+from ggrs_tpu.core import Local, Remote, WaitRecommendation
+from ggrs_tpu.net import InMemoryNetwork
+from ggrs_tpu.sessions import SessionBuilder
+from ggrs_tpu.sessions.p2p import MIN_RECOMMENDATION, RECOMMENDATION_INTERVAL
+
+from stubs import GameStub, stub_config
+
+
+class FakeClock:
+    def __init__(self) -> None:
+        self.now = 0
+
+    def __call__(self) -> int:
+        return self.now
+
+
+def make_pair(clock):
+    net = InMemoryNetwork()
+    sessions = []
+    for me, other, local_handle in (("A", "B", 0), ("B", "A", 1)):
+        sessions.append(
+            SessionBuilder(stub_config())
+            .with_clock(clock)
+            .with_rng(random.Random(71 + local_handle))
+            .add_player(Local(), local_handle)
+            .add_player(Remote(other), 1 - local_handle)
+            .start_p2p_session(net.socket(me))
+        )
+    return sessions
+
+
+def run_scenario(iterations, throttle):
+    """A ticks every iteration; B starts 12 iterations late, then runs at the
+    same rate — so A runs ahead until the prediction window caps it.  With
+    ``throttle`` A honors each recommendation by skipping ``skip_frames``
+    ticks, letting B catch up (the example's slow-down loop)."""
+    clock = FakeClock()
+    sess_a, sess_b = make_pair(clock)
+    stub_a, stub_b = GameStub(), GameStub()
+
+    rec_frames = []
+    recs = []
+    b_ticks = 0
+    skip = 0
+    for i in range(iterations):
+        clock.now += 100  # generous: quality reports flow every other tick
+        sess_a.poll_remote_clients()
+        sess_b.poll_remote_clients()
+
+        for e in sess_a.events():
+            if isinstance(e, WaitRecommendation):
+                recs.append(e)
+                rec_frames.append(sess_a.current_frame)
+                if throttle:
+                    skip = e.skip_frames
+        if skip > 0:
+            skip -= 1
+        else:
+            sess_a.add_local_input(0, i % 4)
+            stub_a.handle_requests(sess_a.advance_frame())
+
+        if i >= 12:
+            sess_b.add_local_input(1, b_ticks % 4)
+            stub_b.handle_requests(sess_b.advance_frame())
+            b_ticks += 1
+    return sess_a, recs, rec_frames
+
+
+def test_recommendations_fire_with_threshold_and_cadence():
+    sess_a, recs, rec_frames = run_scenario(300, throttle=False)
+    assert len(recs) >= 3, "a peer running ahead must be told to wait"
+    # magnitude: always at least the minimum advantage that triggers it
+    assert all(r.skip_frames >= MIN_RECOMMENDATION for r in recs)
+    # cadence: at most one recommendation per 60-frame interval
+    gaps = [b - a for a, b in zip(rec_frames, rec_frames[1:])]
+    assert all(g >= RECOMMENDATION_INTERVAL for g in gaps), gaps
+    # the session's own ahead-ness metric agrees
+    assert sess_a.frames_ahead() >= MIN_RECOMMENDATION
+
+
+def test_throttling_consumes_recommendation():
+    sess_a, recs, _ = run_scenario(300, throttle=True)
+    # honoring the waits lets the late peer catch up: after the initial
+    # transient, recommendations stop and the advantage falls below threshold
+    assert 1 <= len(recs) <= 2, [r.skip_frames for r in recs]
+    assert sess_a.frames_ahead() < MIN_RECOMMENDATION
+
+
+def test_no_recommendation_when_in_sync():
+    clock = FakeClock()
+    sess_a, sess_b = make_pair(clock)
+    stub_a, stub_b = GameStub(), GameStub()
+    recs = []
+    for i in range(150):
+        clock.now += 100
+        sess_a.poll_remote_clients()
+        sess_b.poll_remote_clients()
+        recs += [e for e in sess_a.events() if isinstance(e, WaitRecommendation)]
+        recs += [e for e in sess_b.events() if isinstance(e, WaitRecommendation)]
+        sess_a.add_local_input(0, i % 4)
+        stub_a.handle_requests(sess_a.advance_frame())
+        sess_b.add_local_input(1, i % 3)
+        stub_b.handle_requests(sess_b.advance_frame())
+    assert recs == []
