@@ -31,6 +31,7 @@ from ggrs_tpu.sessions import DeviceSyncTestSession
 
 CHECK_DISTANCE = 8
 PLAYERS = 2
+REPEATS = 3  # timed passes per config; best-of counters tunnel drift
 
 
 def _inputs(n: int, players: int, seed: int) -> np.ndarray:
@@ -82,14 +83,20 @@ def bench_device_synctest(
     ]
     jax.block_until_ready(chunks)
 
-    t0 = time.perf_counter()
-    for staged in chunks:
-        sess.run_ticks(staged, check=False)
-    sess.block_until_ready()
-    dt = time.perf_counter() - t0
+    # the tunneled chip's effective throughput drifts ~3x on a scale of tens
+    # of seconds (shared link): take the best of REPEATS passes — the one
+    # least polluted by external contention
+    best = 0.0
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        for staged in chunks:
+            sess.run_ticks(staged, check=False)
+        sess.block_until_ready()
+        dt = time.perf_counter() - t0
+        best = max(best, len(chunks) * chunk * d / dt)
     # zero desyncs required for the number to count; the caller runs verify()
     # (a D2H read) only after ALL device-timed configs have finished
-    return len(chunks) * chunk * d / dt, sess.verify
+    return best, sess.verify
 
 
 # ---------------------------------------------------------------------------
@@ -143,10 +150,10 @@ def bench_host_synctest(game, players: int, d: int, ticks: int, seed: int = 7) -
 # ---------------------------------------------------------------------------
 
 
-def bench_speculative_p2p(ticks: int, speculate: bool) -> tuple:
+def _speculative_p2p_setup(speculate: bool) -> tuple:
     """Four P2P peers over the in-memory net, each fulfilling requests with a
     device executor; peer 0 optionally speculates with 8 branches.  Returns
-    (ticks/sec, rollbacks, hits)."""
+    (tick_fn, executors)."""
     from ggrs_tpu.core import Local, Remote
     from ggrs_tpu.net import InMemoryNetwork
     from ggrs_tpu.ops import DeviceRequestExecutor
@@ -155,19 +162,24 @@ def bench_speculative_p2p(ticks: int, speculate: bool) -> tuple:
 
     game = BoxGame(4)
     peers = ["P0", "P1", "P2", "P3"]
+    max_prediction = 8  # BASELINE config 3: 8-frame prediction window
 
     def sched(player, i):
         return ((i + player) // 3) % 16  # transitions force regular rollbacks
 
+    # NumPy end to end on the host side: inputs_to_array and branch_inputs
+    # never touch the device, so hypothesis construction costs no dispatches
+    # (H2D happens once per fused call inside the executor/speculation)
     def to_arr(pairs):
-        return jnp.asarray(np.asarray([p[0] for p in pairs], np.uint8))
+        return np.asarray([p[0] for p in pairs], np.uint8)
 
     def branch_inputs(k, frame, arr):
-        arr = jnp.asarray(arr, jnp.uint8)
+        out = np.array(arr, np.uint8, copy=True)
         if k < 7:
-            return arr.at[1:].set(np.uint8(k))
-        vals = np.asarray([sched(p, frame) for p in (1, 2, 3)], np.uint8)
-        return arr.at[1:].set(jnp.asarray(vals))
+            out[1:] = np.uint8(k)
+        else:
+            out[1:] = [sched(p, frame) for p in (1, 2, 3)]
+        return out
 
     net = InMemoryNetwork()
     sessions, executors = [], []
@@ -175,7 +187,7 @@ def bench_speculative_p2p(ticks: int, speculate: bool) -> tuple:
         b = (
             SessionBuilder(boxgame_config())
             .with_num_players(4)
-            .with_max_prediction_window(8)
+            .with_max_prediction_window(max_prediction)
             .with_clock(lambda: 0)
             .with_rng(random.Random(91 + me))
         )
@@ -187,12 +199,18 @@ def bench_speculative_p2p(ticks: int, speculate: bool) -> tuple:
             if (speculate and me == 0)
             else None
         )
-        executors.append(
-            DeviceRequestExecutor(
-                game.advance, game.init_state(), to_arr,
-                with_checksums=False, speculation=spec,
-            )
+        ex = DeviceRequestExecutor(
+            game.advance, game.init_state(), to_arr,
+            with_checksums=False, speculation=spec,
         )
+        # pre-compile everything (advance, bursts, speculation programs):
+        # no jit compile may land inside the timed loop; the deepest burst
+        # is max_prediction resim pairs + the trailing live advance
+        ex.warmup(
+            np.zeros((4,), np.uint8),
+            burst_depths=range(2, max_prediction + 2),
+        )
+        executors.append(ex)
 
     def tick(i):
         for s in sessions:
@@ -201,17 +219,47 @@ def bench_speculative_p2p(ticks: int, speculate: bool) -> tuple:
             s.add_local_input(p, sched(p, i))
             ex.run(s.advance_frame())
 
-    for i in range(24):  # warm caches + compiles
-        tick(i)
-    jax.block_until_ready(executors[0].state)
+    return tick, executors
 
-    t0 = time.perf_counter()
-    for i in range(24, 24 + ticks):
-        tick(i)
-    jax.block_until_ready([ex.state for ex in executors])
-    dt = time.perf_counter() - t0
-    ex0 = executors[0]
-    return ticks / dt, ex0.spec_hits + ex0.spec_misses, ex0.spec_hits
+
+def bench_speculative_p2p(seg_ticks: int = 100, segments: int = 4) -> tuple:
+    """Time the speculative and plain variants in ALTERNATING segments so the
+    tunneled chip's minute-scale throughput drift hits both equally, and take
+    each variant's best segment.  Returns (spec_rate, plain_rate,
+    fetch_stats); ``fetch_stats()`` reads the device hit counter — a D2H read
+    that PERMANENTLY degrades this process's dispatch throughput on a
+    tunneled TPU, so the caller must not invoke it until every timed
+    measurement in the process has finished."""
+    variants = {
+        name: _speculative_p2p_setup(speculate=(name == "spec"))
+        for name in ("spec", "plain")
+    }
+    counters = {name: 0 for name in variants}
+    rates = {name: [] for name in variants}
+
+    def run(name, n):
+        tick, executors = variants[name]
+        start = counters[name]
+        for i in range(start, start + n):
+            tick(i)
+        jax.block_until_ready([ex.state for ex in executors])
+        counters[name] = start + n
+
+    for name in variants:
+        run(name, 24)  # warm caches (compiles were handled by warmup())
+
+    for _ in range(segments):
+        for name in variants:
+            t0 = time.perf_counter()
+            run(name, seg_ticks)
+            rates[name].append(seg_ticks / (time.perf_counter() - t0))
+
+    ex0 = variants["spec"][1][0]
+
+    def fetch_stats():
+        return ex0.spec_hits + ex0.spec_misses, ex0.spec_hits
+
+    return max(rates["spec"]), max(rates["plain"]), fetch_stats
 
 
 # ---------------------------------------------------------------------------
@@ -242,22 +290,26 @@ def bench_batched_chipvm(batch: int, total_ticks: int, chunk: int, d: int) -> fl
             ).astype(np.uint8)
         )
 
-    batched.run_ticks(chunk_inputs(100), check=False)  # compiles both programs
+    batched.run_ticks(chunk_inputs(100), check=False)  # warmup ticks + compiles
+    batched.run_ticks(chunk_inputs(101), check=False)  # full-chunk steady program
     batched.block_until_ready()
 
     staged = [chunk_inputs(i) for i in range(total_ticks // chunk)]
     jax.block_until_ready(staged)
 
-    t0 = time.perf_counter()
-    for c in staged:
-        batched.run_ticks(c, check=False)  # fully async: no D2H in the loop
-    batched.block_until_ready()
-    dt = time.perf_counter() - t0
+    best = 0.0
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        for c in staged:
+            batched.run_ticks(c, check=False)  # fully async: no D2H inside
+        batched.block_until_ready()
+        dt = time.perf_counter() - t0
+        best = max(best, batch * len(staged) * chunk * d / dt)
 
     def verify():
         assert batched.verify()["mismatches"] == 0
 
-    return batch * len(staged) * chunk * d / dt, verify
+    return best, verify
 
 
 # ---------------------------------------------------------------------------
@@ -267,10 +319,14 @@ def main() -> None:
     backend = jax.default_backend()
     on_tpu = backend == "tpu"
 
-    # MEASUREMENT order: all pure-dispatch device configs run BEFORE anything
-    # that reads device→host (on a tunneled TPU the first D2H permanently
-    # degrades dispatch throughput).  PRINT order: configs 1, 3, 4, 5, then
-    # the flagship config 2 last.
+    # MEASUREMENT order: every timed device config — including the
+    # dispatch-rate-sensitive speculative P2P loop — runs BEFORE the first
+    # device→host read.  On a tunneled TPU, one D2H permanently drops the
+    # process's dispatch throughput ~50×: measured here, ~80k dispatches/sec
+    # before the first read, ~1k/sec after, unrecoverable even by
+    # clearing/rebuilding JAX backends (the regression lives in the tunnel
+    # daemon, not the client).  All verifies/stat fetches happen at the end.
+    # PRINT order: configs 1, 3, 4, 5, then the flagship config 2 last.
 
     # config 2 (flagship): BoxGame device synctest at cd=8 — measured FIRST
     game = BoxGame(PLAYERS)
@@ -293,15 +349,16 @@ def main() -> None:
     ticks5, chunk5 = (1024, 256) if on_tpu else (128, 64)
     vm_rate, verify5 = bench_batched_chipvm(256, ticks5, chunk5, d=8)
 
-    # all device timing done — desync gates (D2H reads) are safe now
+    # config 3: speculative P2P vs the same loop with speculation off.  The
+    # whole live path (fused resolve-or-replay, lazy checksums, device hit
+    # counters) performs zero D2H, so both variants run at full dispatch rate.
+    spec_rate, plain_rate, fetch_spec_stats = bench_speculative_p2p()
+
+    # ALL device timing done — D2H reads (desync gates, counters) safe now
     verify2()
     verify4()
     verify5()
-
-    # config 3: speculative P2P vs the same loop with speculation off
-    # (host-driven: D2H per rollback is inherent to the live session path)
-    spec_rate, rollbacks, hits = bench_speculative_p2p(200, speculate=True)
-    plain_rate, _, _ = bench_speculative_p2p(200, speculate=False)
+    rollbacks, hits = fetch_spec_stats()
 
     # host request-loop denominators (pure NumPy, no device)
     host_cd2 = bench_host_synctest(BoxGame(PLAYERS), PLAYERS, d=2, ticks=600)

@@ -10,11 +10,25 @@ so the examples run headless; pass --render to watch.
 
 from __future__ import annotations
 
+import os
 import sys
 import time
 from typing import List, Optional
 
 import numpy as np
+
+import jax
+
+# Honor an explicit JAX_PLATFORMS env var even where the container's
+# interpreter startup pre-registers a tunneled accelerator and overrides the
+# normal env handling (same situation tests/conftest.py documents): apply it
+# through the config directly, which wins as long as no backend has
+# initialized yet — true at example startup.
+if os.environ.get("JAX_PLATFORMS"):
+    try:
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    except RuntimeError:  # pragma: no cover - backend already up; keep as-is
+        pass
 
 import jax.numpy as jnp
 
@@ -23,6 +37,9 @@ from ggrs_tpu.games.boxgame import WINDOW_H, WINDOW_W, _FP  # fixed-point consts
 from ggrs_tpu.ops import DeviceRequestExecutor
 
 FPS = 60
+# prediction window shared by the example sessions and the jit warmup —
+# sessions built by the drivers leave the builder default (8) untouched
+MAX_PREDICTION = 8
 
 box_config = boxgame_config
 
@@ -30,7 +47,13 @@ box_config = boxgame_config
 class Game:
     """Owns the device executor and renders / reports state."""
 
-    def __init__(self, num_players: int, render: bool = False) -> None:
+    def __init__(
+        self,
+        num_players: int,
+        render: bool = False,
+        rollbacks: bool = True,
+        max_prediction: int = MAX_PREDICTION,
+    ) -> None:
         self.box = BoxGame(num_players)
         self.num_players = num_players
         self.render = render
@@ -38,6 +61,15 @@ class Game:
             self.box.advance,
             self.box.init_state(),
             lambda pairs: jnp.asarray([p[0] for p in pairs], jnp.uint8),
+        )
+        # compile ALL programs the session can dispatch before its loop
+        # starts: a mid-session compile pause stalls the poll/ack pump long
+        # enough to trip peers' disconnect timers.  Spectators never roll
+        # back (rollbacks=False skips the burst-depth compiles).  The deepest
+        # burst is max_prediction resim pairs + the trailing live advance.
+        self.executor.warmup(
+            jnp.zeros((num_players,), jnp.uint8),
+            burst_depths=range(2, max_prediction + 2) if rollbacks else (),
         )
         self.frames_run = 0
 
@@ -82,6 +114,11 @@ class FrameClock:
         now = time.perf_counter()
         self.acc += now - self.last
         self.last = now
+        # drop backlog beyond one burst: after a long pause (e.g. a jit
+        # compile) a game resumes at real-time cadence rather than fast-
+        # forwarding hundreds of frames — which would outrun remote peers'
+        # input rings (a spectator follows at most 60 frames behind)
+        self.acc = min(self.acc, max_frames * self.dt)
         n = 0
         while self.acc >= self.dt and n < max_frames:
             self.acc -= self.dt

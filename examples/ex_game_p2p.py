@@ -40,6 +40,10 @@ def run_session(local_port: int, players, spectators, frames: int, render: bool)
         .with_num_players(len(players))
         .with_desync_detection_mode(DesyncDetection.on(60))
         .with_fps(FPS)
+        # example peers share a machine with each other (and CI noise): use
+        # WAN-grade timers so a scheduling hiccup isn't a spurious disconnect
+        .with_disconnect_timeout(5_000)
+        .with_disconnect_notify_delay(2_000)
     )
     local_handles = []
     for handle, spec in enumerate(players):
@@ -51,8 +55,10 @@ def run_session(local_port: int, players, spectators, frames: int, render: bool)
     for i, spec in enumerate(spectators):
         builder = builder.add_player(Spectator(parse_addr(spec)), len(players) + i)
 
-    sess = builder.start_p2p_session(UdpNonBlockingSocket.bind_to_port(local_port))
+    # build (and jit-warm) the game BEFORE the session: endpoint disconnect
+    # timers start at session creation, and warmup takes seconds
     game = Game(len(players), render=render)
+    sess = builder.start_p2p_session(UdpNonBlockingSocket.bind_to_port(local_port))
     clock = FrameClock(FPS)
 
     frame = 0
@@ -80,6 +86,14 @@ def run_session(local_port: int, players, spectators, frames: int, render: bool)
                     except Exception:
                         pass
         time.sleep(0.0005)
+    # drain: keep pumping retransmissions/acks briefly so peers and
+    # spectators that are still behind receive the tail of our inputs
+    # (the reference's protocol lingers on shutdown for the same reason,
+    # /root/reference/src/network/protocol.rs:311-319)
+    deadline = time.perf_counter() + 1.0
+    while time.perf_counter() < deadline:
+        sess.poll_remote_clients()
+        time.sleep(0.005)
     print(f"[:{local_port}] done: {frame} frames")
 
 

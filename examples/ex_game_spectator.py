@@ -30,28 +30,50 @@ def main() -> None:
     args = ap.parse_args()
 
     from ex_game import FPS, FrameClock, Game, box_config
+    from ggrs_tpu.core import Disconnected
     from ggrs_tpu.core.errors import PredictionThreshold, SpectatorTooFarBehind
     from ggrs_tpu.net import UdpNonBlockingSocket
     from ggrs_tpu.sessions import SessionBuilder
 
     host, _, port = args.host.rpartition(":")
+    # build (and jit-warm) the game BEFORE the session: the disconnect timer
+    # runs from session creation, and warmup takes seconds.  Spectators never
+    # roll back, so skip the burst-program compiles entirely.
+    game = Game(args.num_players, render=args.render, rollbacks=False)
     sess = (
         SessionBuilder(box_config())
         .with_num_players(args.num_players)
         .with_fps(FPS)
+        # this fork has no sync handshake — the disconnect timer runs from
+        # session creation, and a host can spend tens of seconds importing
+        # jax + pre-compiling its programs before it sends frame 0.  A
+        # spectator cannot distinguish "host still starting" from "host
+        # gone", so use a follow-stream-grade window (the timer still
+        # catches a real host exit, just patiently)
+        .with_disconnect_timeout(120_000)
+        .with_disconnect_notify_delay(5_000)
+        # recover quickly when the host briefly runs ahead of real time
+        .with_max_frames_behind(15)
+        .with_catchup_speed(4)
         .start_spectator_session(
             (host or "127.0.0.1", int(port)),
             UdpNonBlockingSocket.bind_to_port(args.local_port),
         )
     )
-    game = Game(args.num_players, render=args.render)
     clock = FrameClock(FPS)
+    # ready line: scripts (and the smoke test) wait for this before starting
+    # the host, so the no-handshake stream never races our socket bind
+    print(f"[spectator] listening on :{args.local_port}", flush=True)
 
     frame = 0
     while frame < args.frames:
         sess.poll_remote_clients()
         for ev in sess.events():
             print(f"[spectator] event: {ev}")
+            if isinstance(ev, Disconnected):
+                # the host is gone — no more confirmed inputs will ever come
+                print(f"[spectator] host disconnected at frame {frame}; exiting")
+                return
         for _ in range(clock.ready_frames()):
             try:
                 game.handle_requests(sess.advance_frame())

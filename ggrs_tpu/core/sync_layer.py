@@ -43,13 +43,21 @@ class GameStateCell(Generic[S]):
         self._lock = threading.Lock()
         self._state: GameState[S] = GameState()
 
-    def save(self, frame: Frame, data: Optional[S], checksum: Optional[int]) -> None:
+    def save(self, frame: Frame, data: Optional[S], checksum) -> None:
+        """``checksum`` is a non-negative u128 int, None, or a lazy object
+        with a ``materialize() -> int`` method (e.g. ``ops.DeviceChecksum``) —
+        laziness keeps device→host reads off the per-save hot path; the value
+        is fetched the first time the ``checksum`` property is read."""
         assert frame != NULL_FRAME
-        if checksum is not None and not 0 <= checksum < (1 << 128):
-            # the wire carries checksums as u128; reject out-of-range values
-            # here rather than silently truncating on send, which would make
-            # synchronized peers report false desyncs
-            raise ValueError("checksum must fit in an unsigned 128-bit integer")
+        if checksum is not None and not hasattr(checksum, "materialize"):
+            checksum = int(checksum)  # accept numpy integers etc.
+            if not 0 <= checksum < (1 << 128):
+                # the wire carries checksums as u128; reject out-of-range
+                # values here rather than silently truncating on send, which
+                # would make synchronized peers report false desyncs
+                raise ValueError(
+                    "checksum must fit in an unsigned 128-bit integer"
+                )
         with self._lock:
             self._state.frame = frame
             self._state.data = data
@@ -73,10 +81,26 @@ class GameStateCell(Generic[S]):
     @property
     def checksum(self) -> Optional[int]:
         with self._lock:
-            return self._state.checksum
+            cs = self._state.checksum
+            if cs is not None and not isinstance(cs, int):
+                cs = int(cs.materialize())  # first read pays the device fetch
+                if not 0 <= cs < (1 << 128):
+                    # same u128 wire guarantee save() enforces eagerly: never
+                    # let an out-of-range lazy value truncate silently on send
+                    raise ValueError(
+                        "checksum must fit in an unsigned 128-bit integer"
+                    )
+                self._state.checksum = cs
+            return cs
 
     def __repr__(self) -> str:  # pragma: no cover
-        return f"GameStateCell(frame={self.frame}, checksum={self.checksum})"
+        # format the RAW stored checksum: going through the property would
+        # materialize a lazy DeviceChecksum (a device→host read) from a mere
+        # debug print
+        with self._lock:
+            cs = self._state.checksum
+            frame = self._state.frame
+        return f"GameStateCell(frame={frame}, checksum={cs!r})"
 
 
 class SavedStates(Generic[S]):

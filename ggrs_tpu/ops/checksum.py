@@ -21,7 +21,7 @@ Design notes:
 
 from __future__ import annotations
 
-from typing import Any, Tuple
+from typing import Any, Optional, Tuple
 
 import numpy as np
 
@@ -108,3 +108,41 @@ def checksum_to_u128(lanes: Any) -> int:
 def pytree_checksum(state: Any) -> int:
     """One-call convenience: device digest + host composition → u128 int."""
     return checksum_to_u128(jax.device_get(checksum_device(state)))
+
+
+class DeviceChecksum:
+    """A lazily-materialized checksum: holds the ``(4,)`` u32 lane array on
+    device and converts to the u128 wire integer only when something actually
+    needs the value (``int(cs)`` / ``materialize()``).
+
+    This keeps device→host reads off the save path entirely: the executor
+    attaches one of these per ``SaveGameState``, and the P2P session's desync
+    exchange (which sends a checksum every ``DesyncDetection`` interval, not
+    every frame) pays the transfer only for the frames it reports —
+    reference parity: /root/reference/src/sessions/p2p_session.rs:939-975.
+    """
+
+    __slots__ = ("_lanes", "_value")
+
+    def __init__(self, lanes: jax.Array) -> None:
+        self._lanes = lanes
+        self._value: Optional[int] = None
+
+    def materialize(self) -> int:
+        if self._value is None:
+            self._value = checksum_to_u128(jax.device_get(self._lanes))
+            self._lanes = None  # free the device handle
+        return self._value
+
+    __int__ = materialize
+
+    def __eq__(self, other: Any) -> bool:
+        if isinstance(other, DeviceChecksum):
+            other = other.materialize()
+        return self.materialize() == other
+
+    def __hash__(self) -> int:
+        return hash(self.materialize())
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"DeviceChecksum({self._value if self._value is not None else '<unread>'})"

@@ -4,24 +4,27 @@ The host sessions (P2P / Spectator / SyncTest) keep the reference's contract —
 they emit an ordered list of Save/Load/Advance requests and never touch game
 state (/root/reference/src/lib.rs:170-195).  This executor is the device-side
 fulfillment: game state is a JAX pytree held on HBM, Save stores the *device
-handle* (zero-copy) plus an on-device checksum into the request's
-``GameStateCell``, Load swaps the handle back, and Advance dispatches the
-jitted user ``advance``.  Only the checksum scalar crosses to host (the P2P
-desync exchange needs it as a u128 wire value).
+handle* (zero-copy) plus a lazily-fetched on-device checksum into the
+request's ``GameStateCell``, Load swaps the handle back, and Advance
+dispatches the jitted user ``advance``.
 
-Rollback bursts — a Load followed by a run of Save/Advance pairs — are
-executed as one fused scan dispatch instead of 2N python-level dispatches,
-recovering the ``ops.replay`` fast path inside the generic request protocol.
+The live path performs ZERO device→host reads: checksums ride in
+``DeviceChecksum`` handles that materialize only when the session actually
+reports one over the wire (every DesyncDetection interval), and rollback
+bursts — a Load followed by a run of Save/Advance pairs — are one fused scan
+dispatch whose per-step states come back as jit outputs (no post-hoc device
+slicing).  On a tunneled TPU a single D2H read permanently degrades dispatch
+throughput (measured in ``bench.py``), so "no reads" is the difference
+between the device path beating and losing to the host loop.
 
 With a ``speculation`` strategy (``parallel.SpeculativeRollback``) attached,
-the executor additionally keeps K branch trajectories alive between ticks and
-lets a rollback be fulfilled by *branch selection* instead of replay: when the
-Load's target frame matches the branch anchor and one branch's hypothesized
-inputs equal the inputs of the following resimulation burst, the burst's
-Save cells are filled straight from the matching branch's stored states and
-no replay scan is dispatched at all (the TPU answer to the reference's
-rollback hot loop, /root/reference/src/sessions/p2p_session.rs:658-714).
-Misses fall back to the fused replay — correctness never depends on a hit.
+the executor keeps K branch trajectories alive between ticks and lets a
+rollback be fulfilled by *branch selection* instead of replay: matching,
+selection, and the fallback replay are ONE fused ``lax.cond`` dispatch
+(``SpeculativeRollback.fulfill``), so the host never reads whether it hit —
+the TPU answer to the reference's rollback hot loop
+(/root/reference/src/sessions/p2p_session.rs:658-714).  Misses cost one
+replay inside that same dispatch — correctness never depends on a hit.
 """
 
 from __future__ import annotations
@@ -39,7 +42,7 @@ from ..core.types import (
     SaveGameState,
 )
 from ..parallel.spec_rollback import SpeculativeRollback
-from .checksum import checksum_device, checksum_to_u128
+from .checksum import DeviceChecksum, checksum_device
 
 InputsToArray = Callable[[Sequence[Tuple[Any, InputStatus]]], Any]
 
@@ -53,13 +56,12 @@ class DeviceRequestExecutor:
                        the array ``advance`` consumes (e.g. u8 bitmask vector
                        for BoxGame).  Disconnected players already arrive as
                        default inputs, matching the reference's dummy inputs.
-    ``speculation``    optional ``SpeculativeRollback``: K vmap'd branch
-                       trajectories that turn a matching rollback into a
-                       device-side select (see module docstring).  The
-                       executor re-anchors the branches at the first save of
-                       every rollback burst (frame ``load+1`` — the next
-                       rollback's steady-state target) and extends them by one
-                       hypothesized frame per executed advance.
+    ``speculation``    optional ``SpeculativeRollback``: K branch trajectories
+                       that turn a rollback into a device-side select (see
+                       module docstring).  The executor re-anchors the
+                       branches at frame ``load+1`` after every rollback (the
+                       next rollback's steady-state target) and extends them
+                       by one hypothesized frame per executed advance.
     """
 
     def __init__(
@@ -76,18 +78,26 @@ class DeviceRequestExecutor:
         self._with_checksums = with_checksums
         self._checksum = jax.jit(checksum_device)
         self._spec = speculation
-        self.spec_hits = 0
-        self.spec_misses = 0
+        self._spec_rollbacks = 0  # host-side: rollbacks seen while speculating
 
-        def _burst(state: Any, inputs: Any) -> Tuple[Any, Any, Any]:
-            def body(st: Any, inp: Any) -> Tuple[Any, Tuple[Any, Any]]:
+        def _burst(state: Any, inputs: Any):
+            def body(st: Any, inp: Any):
                 nxt = advance(st, inp)
-                # emit the post-advance state and its digest; digests ride the
-                # scan so the host fetches them in ONE transfer per burst
-                return nxt, (nxt, checksum_device(nxt) if with_checksums else None)
+                return nxt, nxt
 
-            final, (post_states, post_cs) = jax.lax.scan(body, state, inputs)
-            return final, post_states, post_cs
+            final, post = jax.lax.scan(body, state, inputs)
+            n = jax.tree_util.tree_leaves(inputs)[0].shape[0]
+            # unstack inside the jit: per-step states (and digests) come back
+            # as program outputs, so fulfilling N Save cells costs zero
+            # additional dispatches or transfers
+            steps = [
+                jax.tree_util.tree_map(lambda l, _k=k: l[_k], post)
+                for k in range(n)
+            ]
+            sums = (
+                [checksum_device(s) for s in steps] if with_checksums else None
+            )
+            return final, steps, sums
 
         self._burst = jax.jit(_burst)
 
@@ -97,6 +107,53 @@ class DeviceRequestExecutor:
     def state(self) -> Any:
         """The live device state pytree."""
         return self._state
+
+    def warmup(self, example_inputs: Any, burst_depths: Sequence[int] = ()) -> None:
+        """Compile the executor's programs without mutating live state.  Call
+        before entering a latency-sensitive loop: a first-use compile stall
+        inside a live session stops the host's poll/ack pump long enough to
+        trip peers' disconnect timers (spurious Disconnected + split-brain
+        rollback) or overflow a spectator's 128-pending-input window
+        (/root/reference/src/network/protocol.rs:441-445).
+
+        ``burst_depths``: rollback depths to pre-compile the fused replay
+        for — the scan specializes per depth, so pass the depths the session
+        can emit: ``range(2, max_prediction + 2)``, because a full-window
+        rollback of ``max_prediction`` resim pairs groups with the trailing
+        live advance into one ``max_prediction + 1``-deep burst (depth 1 uses
+        the single-advance path)."""
+        outs = [self._advance(self._state, example_inputs)]
+        if self._with_checksums:
+            outs.append(self._checksum(self._state))
+        for n in burst_depths:
+            if n < 2:
+                continue
+            stacked = jax.tree_util.tree_map(
+                lambda l: jnp.stack([jnp.asarray(l)] * n), example_inputs
+            )
+            outs.append(self._burst(self._state, stacked))
+        jax.block_until_ready(outs)
+        if self._spec is not None:
+            # the fused speculation programs (extend, advance+extend, and
+            # per-depth fulfill/refill) compile lazily too — warm them all
+            self._spec.warmup(
+                self._state,
+                example_inputs,
+                range(1, self._spec.max_window + 1),
+                self._with_checksums,
+            )
+
+    @property
+    def spec_hits(self) -> int:
+        """Rollbacks fulfilled by a branch hit.  Reads the device counter —
+        call outside timed paths."""
+        return 0 if self._spec is None else self._spec.hits
+
+    @property
+    def spec_misses(self) -> int:
+        """Rollbacks that fell back to replay (including windows the host
+        already knew were unanswerable)."""
+        return self._spec_rollbacks - self.spec_hits
 
     def run(self, requests: List[GgrsRequest]) -> None:
         """Execute a session's request list in order."""
@@ -165,10 +222,10 @@ class DeviceRequestExecutor:
 
     # ------------------------------------------------------------------
 
-    def _cell_checksum(self, state: Any) -> Optional[int]:
+    def _cell_checksum(self, state: Any) -> Optional[DeviceChecksum]:
         if not self._with_checksums:
             return None
-        return checksum_to_u128(jax.device_get(self._checksum(state)))
+        return DeviceChecksum(self._checksum(state))
 
     def _do_save(self, req: SaveGameState) -> None:
         req.cell.save(req.frame, self._state, self._cell_checksum(self._state))
@@ -181,9 +238,13 @@ class DeviceRequestExecutor:
     def _do_advance(self, req: AdvanceFrame, inputs: Any = None) -> None:
         if inputs is None:
             inputs = self._inputs_to_array(req.inputs)
-        self._state = self._advance(self._state, inputs)
         if self._spec is not None:
-            self._spec.extend(inputs)
+            # live advance + K branch extensions fused into one dispatch
+            nxt = self._spec.advance_and_extend(self._state, inputs)
+            if nxt is not None:
+                self._state = nxt
+                return
+        self._state = self._advance(self._state, inputs)
 
     def _do_burst(
         self,
@@ -191,34 +252,29 @@ class DeviceRequestExecutor:
         saves: List[Optional[SaveGameState]],
         arrays: Optional[List[Any]] = None,
     ) -> List[Tuple[int, SaveGameState, Any]]:
-        """(Advance, Save?)×N as one scan; save cells receive views of the
-        stacked pre-advance trajectory (still on device).  Returns the
-        fulfilled saves as ``(pair_index, request, snapshot)`` so callers can
-        re-anchor speculation without refetching."""
+        """(Advance, Save?)×N as one scan dispatch; save cells receive the
+        per-step jit outputs directly (device handles, lazy checksums).
+        Returns the fulfilled saves as ``(pair_index, request, snapshot)`` so
+        callers can re-anchor speculation without refetching."""
         if arrays is None:
             arrays = [self._inputs_to_array(p.inputs) for p in pairs]
         stacked = jax.tree_util.tree_map(
             lambda *leaves: jnp.stack(leaves), *arrays
         )
-        final, post_states, post_cs = self._burst(self._state, stacked)
+        final, steps, sums = self._burst(self._state, stacked)
         self._state = final
         if self._spec is not None:
-            # keep the one-extend-per-executed-advance invariant resolve()
+            # keep the one-extend-per-executed-advance invariant fulfill()
             # depends on (no-op while unrooted, e.g. on the rollback miss path)
             for arr in arrays:
                 self._spec.extend(arr)
-        if self._with_checksums and any(s is not None for s in saves):
-            all_lanes = jax.device_get(post_cs)  # one transfer per burst
         fulfilled: List[Tuple[int, SaveGameState, Any]] = []
         for k, save in enumerate(saves):
             if save is None:
                 continue
-            snap = jax.tree_util.tree_map(lambda a, _k=k: a[_k], post_states)
-            cs = (
-                checksum_to_u128(all_lanes[k]) if self._with_checksums else None
-            )
-            save.cell.save(save.frame, snap, cs)
-            fulfilled.append((k, save, snap))
+            cs = DeviceChecksum(sums[k]) if self._with_checksums else None
+            save.cell.save(save.frame, steps[k], cs)
+            fulfilled.append((k, save, steps[k]))
         return fulfilled
 
     # ------------------------------------------------------------------
@@ -231,58 +287,51 @@ class DeviceRequestExecutor:
         pairs: List[AdvanceFrame],
         saves: List[Optional[SaveGameState]],
     ) -> None:
-        """Fulfill ``Load + (Advance, Save?)*`` via branch selection when a
-        speculative branch hypothesized this exact input window; otherwise
-        fall back to load + fused replay.
+        """Fulfill ``Load + (Advance, Save?)*`` with one fused
+        resolve-or-replay dispatch when the speculation window can answer it;
+        otherwise fall back to load + fused replay.
 
         The burst's trailing advance carries the *live* (not resimulated)
         frame exactly when it has no trailing save — the session always saves
         the current frame before the live advance — so the resolve window is
         all advances except a saveless last one.  (When every advance has a
         save — e.g. sparse saving hit the threshold — treating them all as
-        resim frames is equally correct: resolve only ever matches branches
-        whose inputs are bit-equal, so trajectory states equal replay states.)
+        resim frames is equally correct: the fused program only selects
+        branches whose inputs are bit-equal, so trajectory states equal
+        replay states.)
         """
         g = load.frame
         m = len(pairs)
         n_resim = m if saves[-1] is not None else m - 1
         arrays = [self._inputs_to_array(p.inputs) for p in pairs]
+        self._spec_rollbacks += 1
 
-        traj = None
-        if n_resim >= 1:
-            traj = self._spec.resolve(g, arrays[:n_resim])
-
-        if traj is not None:
-            # HIT: the matching branch already holds every resimulated state —
-            # no replay dispatch; saves are filled from the trajectory.
-            self.spec_hits += 1
-            to_save = [
-                (j, saves[j]) for j in range(n_resim) if saves[j] is not None
-            ]
-            if to_save and self._with_checksums:
-                # batch all trajectory digests into ONE host transfer
-                lanes = jax.device_get(
-                    [self._checksum(traj[j]) for j, _ in to_save]
-                )
-                sums = [checksum_to_u128(l) for l in lanes]
-            else:
-                sums = [None] * len(to_save)
-            for (j, save), cs in zip(to_save, sums):
-                save.cell.save(save.frame, traj[j], cs)
-            self._state = traj[n_resim - 1]
+        if n_resim >= 1 and self._spec.window_valid(g, n_resim):
+            # ONE dispatch: hypothesis match + branch select, or the fallback
+            # replay — the host never reads which happened.
+            steps, sums = self._spec.fulfill(
+                g, arrays[:n_resim], load.cell.data(), self._with_checksums
+            )
+            for j in range(n_resim):
+                if saves[j] is not None:
+                    cs = (
+                        DeviceChecksum(sums[j])
+                        if self._with_checksums
+                        else None
+                    )
+                    saves[j].cell.save(saves[j].frame, steps[j], cs)
+            self._state = steps[n_resim - 1]
             # re-anchor at frame g+1 (the steady-state target of the NEXT
-            # rollback) and re-hypothesize the still-unconfirmed tail
-            self._spec.root(g + 1, traj[0])
-            for arr in arrays[1:n_resim]:
-                self._spec.extend(arr)
+            # rollback) and re-hypothesize the still-unconfirmed tail — one
+            # fused dispatch
+            self._spec.refill(g + 1, steps[0], arrays[1:n_resim])
             if n_resim < m:  # the live advance (extends via _do_advance)
                 self._do_advance(pairs[-1], inputs=arrays[-1])
         else:
-            # MISS: load + fused replay, then re-anchor at the first saved
-            # frame of the burst.  A burst with no save to anchor on leaves
-            # the window unsound (the rollback disproved its prefix inputs):
-            # invalidate until the next save re-roots.
-            self.spec_misses += 1
+            # window can't answer this rollback (host-known): the rollback
+            # disproved the predicted inputs the prefixes were validated
+            # against — invalidate, replay, and re-anchor at the first saved
+            # frame of the burst.
             self._spec.invalidate()
             self._do_load(load)
             fulfilled = self._run_pairs(pairs, saves, arrays=arrays)

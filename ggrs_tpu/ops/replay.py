@@ -45,9 +45,20 @@ class ReplayPrograms:
       inputs     — input ring, same slotting as the state ring
       hist       — (R, 4) u32 first-seen checksum per frame slot
       live       — the current (unsaved) game state
-      frame      — i32 scalar, the session's current frame
+      frame      — i32 scalar, the session's current frame (bookkeeping only)
       mismatches — i32 count of resimulated frames whose digest diverged
       first_bad  — i32 earliest mismatched frame (INT32_MAX if none)
+
+    The tick programs take the starting frame as a SEPARATE scalar argument
+    (``run_steady(carry, inputs, start_frame)``) rather than reading
+    ``carry["frame"]``: when sessions are batched with ``vmap`` the carry is
+    per-session, and a per-session traced frame would turn every ring
+    save/load and history update into a batched scatter/gather over the whole
+    ``[B, R, ...]`` buffer — measured ~30× slower on the 256-session ChipVM
+    bench.  Sessions tick in lockstep, so the slot index is a function of the
+    host-known tick count; passing it unbatched (``in_axes=None`` under vmap)
+    keeps every ring op a shared-index slice update.  ``carry["frame"]`` is
+    still maintained (one vector add per call) for inspection and tests.
     """
 
     ring: DeviceStateRing
@@ -122,9 +133,8 @@ def build_replay_programs(
     if donate is None:
         donate = jax.default_backend() == "tpu"
 
-    def warmup_tick(carry: Any, inp: Any) -> Any:
+    def warmup_tick(carry: Any, inp: Any, frame: jax.Array) -> Any:
         # [Save, Advance] — the pre-rollback request pattern
-        frame = carry["frame"]
         cs = checksum(carry["live"])
         new_ring = ring.save(carry["ring"], frame, carry["live"], cs)
         hist = jax.lax.dynamic_update_index_in_dim(
@@ -146,12 +156,10 @@ def build_replay_programs(
             "inputs": inputs,
             "hist": hist,
             "live": live,
-            "frame": frame + 1,
         }
 
-    def steady_tick(carry: Any, inp: Any) -> Any:
+    def steady_tick(carry: Any, inp: Any, frame: jax.Array) -> Any:
         # [Load, (Save, Advance)×d resim, Save, Advance] — 2d+2 requests fused
-        frame = carry["frame"]  # F
         inputs = _store_input(ring, carry["inputs"], frame, inp)
 
         loaded = ring.load(carry["ring"], frame - d)
@@ -196,16 +204,34 @@ def build_replay_programs(
             "inputs": inputs,
             "hist": hist,
             "live": live,
-            "frame": frame + 1,
             "mismatches": mismatches,
             "first_bad": first_bad,
         }
 
-    def _scan_ticks(tick: Callable, carry: Any, tick_inputs: Any) -> Any:
-        def body(c: Any, inp: Any) -> Tuple[Any, None]:
-            return tick(c, inp), None
+    def _scan_ticks(
+        tick: Callable, carry: Any, tick_inputs: Any, start_frame: Any = None
+    ) -> Any:
+        """Run ``tick`` over the leading axis of ``tick_inputs``.  The frame
+        for each tick is ``start_frame + i`` — a scalar sequence passed as
+        scan xs, NOT read from the (possibly vmapped) carry, so ring slots
+        stay shared-index slice ops under session batching (see class doc).
+        ``start_frame`` defaults to the carry's own frame counter."""
+        n = jax.tree_util.tree_leaves(tick_inputs)[0].shape[0]
+        if start_frame is None:
+            start_frame = carry["frame"]
+        start_frame = jnp.asarray(start_frame, jnp.int32)
+        frames = start_frame + jnp.arange(n, dtype=jnp.int32)
+        frame_counter = carry["frame"]
+        carry = {k: v for k, v in carry.items() if k != "frame"}
 
-        out, _ = jax.lax.scan(body, carry, tick_inputs, unroll=unroll_ticks)
+        def body(c: Any, xs: Any) -> Tuple[Any, None]:
+            inp, f = xs
+            return tick(c, inp, f), None
+
+        out, _ = jax.lax.scan(
+            body, carry, (tick_inputs, frames), unroll=unroll_ticks
+        )
+        out["frame"] = frame_counter + n
         return out
 
     donate_argnums = (0,) if donate else ()

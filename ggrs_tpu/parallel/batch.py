@@ -93,9 +93,16 @@ class BatchedSessions:
             lambda leaf: jax.device_put(leaf, sharding), batched
         )
 
-        def _sharded(scan_fn, carry: Any, inputs: Any) -> Tuple[Any, Dict[str, Any]]:
+        def _sharded(
+            scan_fn, carry: Any, inputs: Any, start_frame: Any
+        ) -> Tuple[Any, Dict[str, Any]]:
             def local(carry_l: Any, inputs_l: Any):
-                out = jax.vmap(scan_fn)(carry_l, inputs_l)
+                # start_frame enters as an UNBATCHED scalar closure: ring
+                # slots stay shared-index slice ops instead of per-session
+                # scatters (see ReplayPrograms doc — ~30× on this bench)
+                out = jax.vmap(lambda c, i: scan_fn(c, i, start_frame))(
+                    carry_l, inputs_l
+                )
                 stats = {
                     "mismatches": jax.lax.psum(
                         jnp.sum(out["mismatches"]), SESSION_AXIS
@@ -142,10 +149,14 @@ class BatchedSessions:
         stats = None
         if n_warm:
             head = jax.tree_util.tree_map(lambda a: a[:, :n_warm], inputs)
-            self._carry, stats = self._run_warmup(self._carry, head)
+            self._carry, stats = self._run_warmup(
+                self._carry, head, np.int32(self._ticks_run)
+            )
         if n > n_warm:
             tail = jax.tree_util.tree_map(lambda a: a[:, n_warm:], inputs)
-            self._carry, stats = self._run_steady(self._carry, tail)
+            self._carry, stats = self._run_steady(
+                self._carry, tail, np.int32(self._ticks_run + n_warm)
+            )
         self._ticks_run += n
         self._last_stats = stats  # device scalars; fetched on demand
         if not check:

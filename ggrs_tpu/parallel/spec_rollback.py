@@ -3,24 +3,44 @@
 The reference rolls back and resimulates every time a prediction was wrong
 (/root/reference/src/sessions/p2p_session.rs:658-714) — and its single
 repeat-last predictor is wrong whenever a remote player changes input.  On
-TPU we can afford K predictions at once (`parallel.speculation`): this module
-keeps K branch trajectories *incrementally extended each tick* under K
-different remote-input hypotheses, so when confirmed inputs arrive and a
-rollback is requested, a matching branch turns the whole
-load→(advance, save)^N replay into a device-side select.  Misses fall back
-to the fused replay — correctness never depends on a hit.
+TPU we can afford K predictions at once: this module keeps K branch
+trajectories *incrementally extended each tick* under K different
+remote-input hypotheses, so when confirmed inputs arrive and a rollback is
+requested, a matching branch turns the whole load→(advance, save)^N replay
+into a device-side select.  Misses fall back to the replay — correctness
+never depends on a hit.
 
-``SpeculativeRollback`` is session-agnostic: it works on input *arrays* (the
-same ones the user's ``advance`` consumes).  ``DeviceRequestExecutor`` uses it
-through its ``speculation`` constructor argument: it anchors (``root``) the
-branches at the first save of each rollback burst, ``extend``s them on every
-executed advance, and ``resolve``s against the burst inputs on every Load —
-see ``ops.executor`` and ``tests/test_spec_integration.py``.
+Zero device→host reads on the live path.  The round-1 design read the
+hit/miss flag back to the host per rollback; on a tunneled TPU a single D2H
+read permanently degrades dispatch throughput (measured in ``bench.py``), so
+the redesign moves the decision on-device:
+
+- branch states, trajectories, hypothesized inputs, and prefix-validity masks
+  live in fixed-shape ``[W, K, ...]`` device ring buffers;
+- ``extend`` is ONE fused dispatch (vmap advance + hypothesis match + buffer
+  writes);
+- ``fulfill`` is ONE fused dispatch per rollback: hypothesis matching, branch
+  selection, and the fallback replay scan are a single ``lax.cond`` program,
+  so the host never learns (or needs to learn) whether it hit — it always
+  receives the correct per-step trajectory as device handles;
+- ``refill`` re-anchors and re-extends the window after a rollback as one
+  fused scan;
+- hit counters accumulate on device and are only fetched when the
+  ``spec_hits`` property is read (diagnostics, after timing).
+
+``branch_inputs(k, frame, local_inputs)`` builds hypothesis k's full input
+array for ``frame`` on the host; return **NumPy** arrays to keep hypothesis
+construction off the dispatch path (JAX arrays are accepted but each costs an
+eager device op).  ``DeviceRequestExecutor`` drives this through its
+``speculation`` constructor argument — see ``ops.executor`` and
+``tests/test_spec_integration.py``.
 """
 
 from __future__ import annotations
 
-from typing import Any, Callable, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
@@ -33,6 +53,29 @@ AdvanceFn = Callable[[Any, Any], Any]
 BranchInputsFn = Callable[[int, int, Any], Any]
 
 
+def _stack_pytrees(trees: Sequence[Any]) -> Any:
+    """Stack pytrees on a new leading axis (branch or time, per the caller),
+    staying on the host when every leaf is NumPy — the single H2D transfer
+    then happens inside the consuming jit instead of as eager device ops."""
+
+    def stack(*leaves: Any) -> Any:
+        if all(isinstance(l, np.ndarray) for l in leaves):
+            return np.stack(leaves)
+        return jnp.stack([jnp.asarray(l) for l in leaves])
+
+    return jax.tree_util.tree_map(stack, *trees)
+
+
+def _swap01(tree: Any) -> Any:
+    """Swap the two leading axes of every leaf, host-side when NumPy."""
+    return jax.tree_util.tree_map(
+        lambda l: np.swapaxes(l, 0, 1)
+        if isinstance(l, np.ndarray)
+        else jnp.swapaxes(jnp.asarray(l), 0, 1),
+        tree,
+    )
+
+
 class SpeculativeRollback:
     """K incrementally-extended branch trajectories rooted at a saved frame.
 
@@ -40,10 +83,10 @@ class SpeculativeRollback:
       - ``root(frame, state)`` whenever the rollback anchor moves (a Save of
         the confirmed frame);
       - ``extend(local_inputs)`` once per advanced frame: every branch steps
-        under its own hypothesis (ONE vmap dispatch for all K);
-      - on rollback to ``frame``: ``resolve(frame, confirmed)`` with the
-        confirmed full-input arrays for the window — returns the matched
-        branch's trajectory or None (miss → caller replays).
+        under its own hypothesis (ONE fused dispatch for all K);
+      - on rollback to ``frame``: if ``window_valid(frame, n)``, call
+        ``fulfill`` (one fused resolve-or-replay dispatch) then ``refill`` to
+        re-anchor; otherwise ``invalidate`` and replay normally.
     """
 
     def __init__(
@@ -56,22 +99,37 @@ class SpeculativeRollback:
         assert num_branches >= 1
         self.K = num_branches
         self.max_window = max_window
+        self._advance = advance
         self._branch_inputs = branch_inputs
+
         self._root_frame: Optional[int] = None
+        self._count = 0  # host-tracked window length (never read from device)
         self._states: Any = None  # [K, ...] current branch states
-        self._traj: List[Any] = []  # per-step [K, ...] states (post-advance)
-        self._inputs: List[Any] = []  # per-step [K, ...] hypothesized inputs
-        # per-step cumulative [K] mask: hypothesis equalled the session's own
-        # input array for every step so far (supports resolving at an offset
-        # past the root, see resolve())
-        self._prefix_ok: List[jax.Array] = []
+        self._traj_buf: Any = None  # [W, K, ...] post-advance states
+        self._inp_buf: Any = None  # [W, K, ...] hypothesized inputs
+        self._prefix_buf: Optional[jax.Array] = None  # [W, K] cumulative ok
+        self._hit_count = jnp.zeros((), jnp.uint32)
 
-        self._step_all = jax.jit(
-            lambda states, inputs_k: jax.vmap(advance)(states, inputs_k)
-        )
+        self._root_fn = jax.jit(self._root_impl)
+        self._extend_fn = jax.jit(self._extend_impl)
 
-    def _match_step(self, hyp: Any, target: Any) -> jax.Array:
-        """[K] mask: which branches' step hypothesis equals ``target``."""
+        def _adv_ext(live_state, live_inputs, *extend_args):
+            return (
+                advance(live_state, live_inputs),
+                *self._extend_impl(*extend_args),
+            )
+
+        self._adv_ext_fn = jax.jit(_adv_ext)
+        self._fulfill_cache: Dict[Tuple[int, bool], Any] = {}
+        self._refill_cache: Dict[int, Any] = {}
+        self._resolve_cache: Dict[int, Any] = {}
+
+    # ------------------------------------------------------------------
+    # fused programs
+    # ------------------------------------------------------------------
+
+    def _match(self, hyp: Any, target: Any) -> jax.Array:
+        """[K] mask: which branches' hypothesis pytree equals ``target``."""
 
         def leaf_eq(h: jax.Array, c: Any) -> jax.Array:
             c = jnp.asarray(c)
@@ -82,99 +140,427 @@ class SpeculativeRollback:
             jnp.logical_and, eqs, jnp.ones((self.K,), bool)
         )
 
+    def _root_impl(self, state: Any) -> Any:
+        return jax.tree_util.tree_map(
+            lambda leaf: jnp.broadcast_to(
+                jnp.asarray(leaf)[None, ...],
+                (self.K,) + jnp.shape(jnp.asarray(leaf)),
+            ),
+            state,
+        )
+
+    def _extend_impl(
+        self,
+        states: Any,
+        traj_buf: Any,
+        inp_buf: Any,
+        prefix_buf: jax.Array,
+        t: jax.Array,
+        inputs_k: Any,
+        local_inputs: Any,
+    ) -> Tuple[Any, Any, Any, jax.Array]:
+        new_states = jax.vmap(self._advance)(states, inputs_k)
+        step_ok = self._match(inputs_k, local_inputs)
+        prev = jnp.where(
+            t > 0, prefix_buf[jnp.maximum(t - 1, 0)], jnp.ones((self.K,), bool)
+        )
+        write = lambda buf, val: jax.tree_util.tree_map(
+            lambda b, v: b.at[t].set(v), buf, val
+        )
+        return (
+            new_states,
+            write(traj_buf, new_states),
+            write(inp_buf, inputs_k),
+            prefix_buf.at[t].set(prev & step_ok),
+        )
+
+    def _build_fulfill(self, n: int, with_checksums: bool):
+        from ..ops.checksum import checksum_device
+
+        def fulfill(
+            traj_buf: Any,
+            inp_buf: Any,
+            prefix_buf: jax.Array,
+            offset: jax.Array,
+            load_state: Any,
+            confirmed: Any,  # [n, ...] stacked
+            hit_count: jax.Array,
+        ):
+            sl = lambda buf: jax.tree_util.tree_map(
+                lambda b: jax.lax.dynamic_slice_in_dim(b, offset, n, axis=0),
+                buf,
+            )
+            win_inp, win_traj = sl(inp_buf), sl(traj_buf)
+            match = jnp.where(
+                offset > 0,
+                prefix_buf[jnp.maximum(offset - 1, 0)],
+                jnp.ones((self.K,), bool),
+            )
+            frame_at = lambda tree, t: jax.tree_util.tree_map(
+                lambda l: l[t], tree
+            )
+            for t in range(n):
+                match = match & self._match(
+                    frame_at(win_inp, t), frame_at(confirmed, t)
+                )
+            hit = jnp.any(match)
+            idx = jnp.argmax(match)
+
+            def take_branch(_):
+                return jax.tree_util.tree_map(
+                    lambda l: jax.lax.dynamic_index_in_dim(
+                        l, idx, axis=1, keepdims=False
+                    ),
+                    win_traj,
+                )
+
+            def replay(_):
+                def body(st: Any, inp: Any):
+                    nxt = self._advance(st, inp)
+                    return nxt, nxt
+
+                _, ys = jax.lax.scan(body, load_state, confirmed)
+                return ys
+
+            out = jax.lax.cond(hit, take_branch, replay, None)
+            steps = [frame_at(out, t) for t in range(n)]
+            sums = (
+                [checksum_device(s) for s in steps] if with_checksums else None
+            )
+            return steps, sums, hit_count + hit.astype(jnp.uint32)
+
+        return jax.jit(fulfill)
+
+    def _build_refill(self, m: int):
+        def refill(root_state: Any, hyps: Any, session_inputs: Any):
+            """Re-anchor at ``root_state`` and extend ``m`` steps under
+            ``hyps`` ([m, K, ...]), matching against ``session_inputs``
+            ([m, ...]); returns (states, traj [m,K,...], prefix [m,K])."""
+            states0 = self._root_impl(root_state)
+
+            def body(carry, xs):
+                states, prefix = carry
+                hyp_k, sess = xs
+                nxt = jax.vmap(self._advance)(states, hyp_k)
+                prefix = prefix & self._match(hyp_k, sess)
+                return (nxt, prefix), (nxt, prefix)
+
+            (states, _), (traj, prefixes) = jax.lax.scan(
+                body,
+                (states0, jnp.ones((self.K,), bool)),
+                (hyps, session_inputs),
+            )
+            return states, traj, prefixes
+
+        return jax.jit(refill)
+
+    # ------------------------------------------------------------------
+    # buffers
+    # ------------------------------------------------------------------
+
+    def _ensure_buffers(self, inputs_k: Any) -> None:
+        if self._traj_buf is not None:
+            return
+        W = self.max_window
+        alloc = lambda tree: jax.tree_util.tree_map(
+            lambda l: jnp.zeros((W,) + jnp.shape(l), jnp.asarray(l).dtype),
+            tree,
+        )
+        self._traj_buf = alloc(self._states)
+        self._inp_buf = alloc(
+            jax.tree_util.tree_map(jnp.asarray, inputs_k)
+        )
+        self._prefix_buf = jnp.zeros((W, self.K), bool)
+
+    def _hypotheses(self, frame: int, local_inputs: Any) -> Any:
+        per_branch = [
+            self._branch_inputs(k, frame, local_inputs) for k in range(self.K)
+        ]
+        return _stack_pytrees(per_branch)
+
+    # ------------------------------------------------------------------
+    # public API
     # ------------------------------------------------------------------
 
     @property
     def window(self) -> int:
-        return len(self._traj)
+        return self._count
 
     @property
     def root_frame(self) -> Optional[int]:
         return self._root_frame
 
+    @property
+    def hits(self) -> int:
+        """Fetches the device hit counter — call only outside timed paths."""
+        return int(jax.device_get(self._hit_count))
+
     def invalidate(self) -> None:
-        """Drop the anchor and all trajectories.  Callers MUST invalidate on
-        any rollback that is not fulfilled by ``resolve`` + a fresh ``root``:
-        a rollback disproves the predicted inputs the prefix masks were
-        validated against, so the whole window is unsound from then on.
-        ``extend`` no-ops and ``resolve`` misses until the next ``root``."""
+        """Drop the anchor and the whole window.  Callers MUST invalidate on
+        any rollback that is not fulfilled by ``fulfill`` + ``refill``: such a
+        rollback disproves the predicted inputs the prefix masks were
+        validated against, so the window is unsound from then on.  ``extend``
+        no-ops and ``window_valid`` is false until the next ``root``."""
         self._root_frame = None
         self._states = None
-        self._traj = []
-        self._inputs = []
-        self._prefix_ok = []
+        self._count = 0
 
     def root(self, frame: int, state: Any) -> None:
         """Re-anchor all branches at ``state`` (the save of ``frame``)."""
         self._root_frame = frame
-        self._states = jax.tree_util.tree_map(
-            lambda leaf: jnp.broadcast_to(
-                jnp.asarray(leaf)[None, ...], (self.K,) + jnp.asarray(leaf).shape
-            ),
-            state,
-        )
-        self._traj = []
-        self._inputs = []
-        self._prefix_ok = []
+        self._states = self._root_fn(state)
+        self._count = 0
 
     def extend(self, local_inputs: Any) -> None:
-        """Advance every branch one frame under its hypothesis.  The frame
-        being hypothesized is ``root_frame + window`` (extensions are
-        sequential from the anchor)."""
-        if self._root_frame is None or len(self._traj) >= self.max_window:
+        """Advance every branch one frame under its hypothesis — one fused
+        dispatch.  The frame being hypothesized is ``root_frame + window``
+        (extensions are sequential from the anchor)."""
+        if self._root_frame is None or self._count >= self.max_window:
             return
-        frame = self._root_frame + len(self._traj)
-        per_branch = [
-            self._branch_inputs(k, frame, local_inputs) for k in range(self.K)
-        ]
-        inputs_k = jax.tree_util.tree_map(
-            lambda *leaves: jnp.stack([jnp.asarray(l) for l in leaves]), *per_branch
+        inputs_k = self._hypotheses(self._root_frame + self._count, local_inputs)
+        self._ensure_buffers(inputs_k)
+        (
+            self._states,
+            self._traj_buf,
+            self._inp_buf,
+            self._prefix_buf,
+        ) = self._extend_fn(
+            self._states,
+            self._traj_buf,
+            self._inp_buf,
+            self._prefix_buf,
+            np.int32(self._count),
+            inputs_k,
+            local_inputs,
         )
-        self._states = self._step_all(self._states, inputs_k)
-        # which branches hypothesized exactly what the session itself used
-        # this frame (local real inputs + the predictor's remote guesses)
-        step_ok = self._match_step(inputs_k, local_inputs)
-        prev = self._prefix_ok[-1] if self._prefix_ok else jnp.ones((self.K,), bool)
-        self._traj.append(self._states)
-        self._inputs.append(inputs_k)
-        self._prefix_ok.append(prev & step_ok)
+        self._count += 1
+
+    def advance_and_extend(self, state: Any, inputs: Any) -> Optional[Any]:
+        """Advance the live ``state`` AND extend all K branches in ONE fused
+        dispatch — speculation's steady-state tick costs the same dispatch
+        count as running without it.  Returns the new live state, or None
+        when the window cannot extend (unrooted / full): the caller must then
+        advance the live state itself (``extend`` would no-op identically)."""
+        if self._root_frame is None or self._count >= self.max_window:
+            return None
+        inputs_k = self._hypotheses(self._root_frame + self._count, inputs)
+        self._ensure_buffers(inputs_k)
+        (
+            new_state,
+            self._states,
+            self._traj_buf,
+            self._inp_buf,
+            self._prefix_buf,
+        ) = self._adv_ext_fn(
+            state,
+            inputs,
+            self._states,
+            self._traj_buf,
+            self._inp_buf,
+            self._prefix_buf,
+            np.int32(self._count),
+            inputs_k,
+            inputs,
+        )
+        self._count += 1
+        return new_state
+
+    def window_valid(self, frame: int, n: int) -> bool:
+        """Host-side check (no device read): can a rollback to ``frame``
+        covering ``n`` resimulated frames be answered from this window?"""
+        if self._root_frame is None or n < 1:
+            return False
+        offset = frame - self._root_frame
+        return 0 <= offset and offset + n <= self._count
+
+    def fulfill(
+        self,
+        frame: int,
+        confirmed: Sequence[Any],
+        load_state: Any,
+        with_checksums: bool,
+    ) -> Tuple[List[Any], Optional[List[Any]]]:
+        """Resolve-or-replay as ONE dispatch: returns the ``n`` per-step
+        post-advance states for the rollback window (device handles) and,
+        when requested, their device checksum lanes.  The states come from the
+        matching branch when one hypothesized exactly these inputs, else from
+        the fallback replay of ``load_state`` — the host never reads which.
+
+        Requires ``window_valid(frame, len(confirmed))``.  ``frame`` may lie
+        past the root: rollback targets are the first mispredicted frame, so
+        every frame between root and target was predicted correctly — a
+        branch is valid iff its hypotheses equalled the session's own inputs
+        over that prefix (the ``_prefix_buf`` masks) and the confirmed inputs
+        from the target on."""
+        n = len(confirmed)
+        assert self.window_valid(frame, n)
+        key = (n, with_checksums)
+        fn = self._fulfill_cache.get(key)
+        if fn is None:
+            fn = self._fulfill_cache[key] = self._build_fulfill(
+                n, with_checksums
+            )
+        steps, sums, self._hit_count = fn(
+            self._traj_buf,
+            self._inp_buf,
+            self._prefix_buf,
+            np.int32(frame - self._root_frame),
+            load_state,
+            _stack_pytrees(confirmed),
+            self._hit_count,
+        )
+        return steps, sums
+
+    def refill(self, frame: int, state: Any, local_inputs: Sequence[Any]) -> None:
+        """Re-anchor at ``(frame, state)`` and re-extend the still-unconfirmed
+        tail (``local_inputs``, one per frame from ``frame`` on) as one fused
+        dispatch — the post-rollback replacement for root + N×extend."""
+        m = min(len(local_inputs), self.max_window)
+        local_inputs = list(local_inputs)[:m]
+        self._root_frame = frame
+        if m == 0:
+            self._states = self._root_fn(state)
+            self._count = 0
+            return
+        hyps = _stack_pytrees(
+            [
+                _stack_pytrees(
+                    [
+                        self._branch_inputs(k, frame + t, local_inputs[t])
+                        for t in range(m)
+                    ]
+                )
+                for k in range(self.K)
+            ]
+        )
+        # scan wants [m, K, ...]: swap the (K, m) stacking order
+        hyps = _swap01(hyps)
+        sess = _stack_pytrees(local_inputs)
+        fn = self._refill_cache.get(m)
+        if fn is None:
+            fn = self._refill_cache[m] = self._build_refill(m)
+        self._states, traj, prefixes = fn(state, hyps, sess)
+        if self._traj_buf is None:
+            # allocate from the first hypothesis row; states are already [K,..]
+            self._ensure_buffers(
+                jax.tree_util.tree_map(lambda l: l[0], hyps)
+            )
+        put = lambda buf, val: jax.tree_util.tree_map(
+            lambda b, v: jax.lax.dynamic_update_slice_in_dim(b, v, 0, axis=0),
+            buf,
+            val,
+        )
+        self._traj_buf = put(self._traj_buf, traj)
+        self._inp_buf = put(self._inp_buf, hyps)
+        self._prefix_buf = jax.lax.dynamic_update_slice_in_dim(
+            self._prefix_buf, prefixes, 0, axis=0
+        )
+        self._count = m
+
+    def warmup(
+        self,
+        state: Any,
+        example_inputs: Any,
+        depths: Sequence[int],
+        with_checksums: bool,
+    ) -> None:
+        """Pre-compile every program a live session can dispatch — the fused
+        extend, advance+extend, and per-depth fulfill/refill — so no jit
+        compile ever stalls the poll/ack pump mid-session.  Runs on scratch
+        data; all window state (including the device hit counter) is restored
+        afterwards."""
+        saved = (
+            self._root_frame,
+            self._count,
+            self._states,
+            self._traj_buf,
+            self._inp_buf,
+            self._prefix_buf,
+            self._hit_count,
+        )
+        try:
+            self.root(0, state)
+            self.advance_and_extend(state, example_inputs)
+            for n in sorted(set(depths)):
+                if not 1 <= n <= self.max_window:
+                    continue
+                self.root(0, state)
+                for _ in range(n):
+                    self.extend(example_inputs)
+                self.fulfill(
+                    0, [example_inputs] * n, state, with_checksums
+                )
+                self.refill(1, state, [example_inputs] * (n - 1))
+            jax.block_until_ready(self._states)
+        finally:
+            (
+                self._root_frame,
+                self._count,
+                self._states,
+                self._traj_buf,
+                self._inp_buf,
+                self._prefix_buf,
+                self._hit_count,
+            ) = saved
+
+    # ------------------------------------------------------------------
+    # diagnostic / test API (reads device→host; not for the live path)
+    # ------------------------------------------------------------------
 
     def resolve(
         self, frame: int, confirmed: Sequence[Any]
     ) -> Optional[List[Any]]:
         """Match hypotheses against the ``confirmed`` input arrays for the
-        frames from ``frame`` on.  On a hit, returns the per-step states of
-        the matching branch (``len(confirmed)`` entries, post-advance each
-        step, the first being the state at ``frame + 1``); on any miss
-        condition, returns None.
-
-        ``frame`` may lie *past* the root: rollback targets are the first
-        mispredicted frame, so every frame between the root and the target
-        was predicted correctly — a branch is then valid iff its hypotheses
-        equalled the session's own inputs over that prefix (tracked
-        incrementally in ``_prefix_ok``) and the confirmed inputs from the
-        target on."""
+        frames from ``frame`` on; returns the matched branch's per-step states
+        or None.  Reads the hit flag back to the host — use ``fulfill`` on
+        live paths."""
         n = len(confirmed)
-        if self._root_frame is None or n == 0:
+        if not self.window_valid(frame, n):
             return None
-        offset = frame - self._root_frame
-        if offset < 0 or offset + n > len(self._traj):
-            return None
+        fn = self._resolve_cache.get(n)
+        if fn is None:
 
-        match = (
-            self._prefix_ok[offset - 1]
-            if offset > 0
-            else jnp.ones((self.K,), bool)
+            def resolve_n(
+                traj_buf, inp_buf, prefix_buf, offset, confirmed_stacked
+            ):
+                sl = lambda buf: jax.tree_util.tree_map(
+                    lambda b: jax.lax.dynamic_slice_in_dim(
+                        b, offset, n, axis=0
+                    ),
+                    buf,
+                )
+                win_inp, win_traj = sl(inp_buf), sl(traj_buf)
+                match = jnp.where(
+                    offset > 0,
+                    prefix_buf[jnp.maximum(offset - 1, 0)],
+                    jnp.ones((self.K,), bool),
+                )
+                for t in range(n):
+                    match = match & self._match(
+                        jax.tree_util.tree_map(lambda l: l[t], win_inp),
+                        jax.tree_util.tree_map(lambda l: l[t], confirmed_stacked),
+                    )
+                hit = jnp.any(match)
+                idx = jnp.argmax(match)
+                traj = jax.tree_util.tree_map(
+                    lambda l: jax.lax.dynamic_index_in_dim(
+                        l, idx, axis=1, keepdims=False
+                    ),
+                    win_traj,
+                )
+                return hit, traj
+
+            fn = self._resolve_cache[n] = jax.jit(resolve_n)
+        hit, traj = fn(
+            self._traj_buf,
+            self._inp_buf,
+            self._prefix_buf,
+            np.int32(frame - self._root_frame),
+            _stack_pytrees(confirmed),
         )
-        for t, conf in enumerate(confirmed):
-            match = match & self._match_step(self._inputs[offset + t], conf)
-        idx = jnp.argmax(match)
-        if not bool(jnp.any(match)):  # one scalar read per rollback
+        if not bool(jax.device_get(hit)):
             return None
-        take = lambda tree: jax.tree_util.tree_map(
-            lambda leaf: jax.lax.dynamic_index_in_dim(
-                leaf, idx, axis=0, keepdims=False
-            ),
-            tree,
-        )
-        return [take(self._traj[offset + t]) for t in range(n)]
+        return [
+            jax.tree_util.tree_map(lambda l, _t=t: l[_t], traj)
+            for t in range(n)
+        ]

@@ -201,7 +201,21 @@ class P2PSession(Generic[I, S, A]):
                 self._disconnect_frame
             )
             if first_incorrect != NULL_FRAME:
-                self._adjust_gamestate(first_incorrect, confirmed_frame, requests)
+                if first_incorrect < self._sync_layer.current_frame:
+                    self._adjust_gamestate(
+                        first_incorrect, confirmed_frame, requests
+                    )
+                # else: nothing has been simulated past the incorrect frame —
+                # possible only via a disconnect at the current frame (e.g. a
+                # peer that vanished before sending any input, where
+                # disconnect_frame == current_frame == 0).  There is no wrong
+                # state to rewind and no request to emit; disconnect-dummy
+                # inputs apply from this frame on.  Prediction tracking is
+                # deliberately left untouched: other players' outstanding
+                # predictions still need reconciling when their real inputs
+                # arrive.  The reference panics in its load-frame window
+                # assert on this edge (/root/reference/src/sync_layer.rs:229-249);
+                # we treat the empty rollback as the no-op it is.
                 self._disconnect_frame = NULL_FRAME
 
             last_saved = self._sync_layer.last_saved_frame

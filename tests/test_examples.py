@@ -7,14 +7,32 @@ parsing, UDP sockets) with a small frame budget.
 """
 
 import os
+import select
 import subprocess
 import sys
+import time
 from pathlib import Path
 
 import pytest
 
 REPO = Path(__file__).resolve().parents[1]
 EXAMPLES = REPO / "examples"
+
+
+def wait_for_line(proc, needle: str, timeout: float = 120.0) -> bool:
+    """Wait until ``proc`` prints a stdout line containing ``needle``.
+    Non-invasive readiness signal (a port-bind probe could steal the port
+    out from under the child for a microsecond and crash its own bind)."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if proc.poll() is not None:
+            return False  # child exited before signalling ready
+        ready, _, _ = select.select([proc.stdout], [], [], 0.5)
+        if ready:
+            line = proc.stdout.readline()
+            if needle in line:
+                return True
+    return False
 
 
 def run_example(args, timeout=240):
@@ -63,10 +81,30 @@ class TestExampleSmoke:
         assert "done" in out
 
     def test_p2p_with_spectator(self):
-        """Host + second peer + spectator as three real processes over UDP."""
+        """Host + second peer + spectator as three real processes over UDP.
+
+        The spectator starts FIRST and the host waits for its socket: the
+        host streams from frame 0 with no handshake (fork delta #4), so a
+        spectator that is still importing jax while the host runs ahead
+        trips the 128-pending-input overflow force-disconnect
+        (/root/reference/src/network/protocol.rs:441-445) by design.
+        """
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env.pop("XLA_FLAGS", None)
+        spec = subprocess.Popen(
+            [
+                sys.executable, EXAMPLES / "ex_game_spectator.py",
+                "--local-port", "9999",
+                "--host", "127.0.0.1:7777",
+                "--frames", "100",
+            ],
+            cwd=REPO, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True,
+        )
+        assert wait_for_line(
+            spec, "[spectator] listening"
+        ), "spectator never signalled ready"
         host = subprocess.Popen(
             [
                 sys.executable, EXAMPLES / "ex_game_p2p.py",
@@ -88,16 +126,6 @@ class TestExampleSmoke:
             cwd=REPO, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
             text=True,
         )
-        spec = subprocess.Popen(
-            [
-                sys.executable, EXAMPLES / "ex_game_spectator.py",
-                "--local-port", "9999",
-                "--host", "127.0.0.1:7777",
-                "--frames", "100",
-            ],
-            cwd=REPO, env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
-            text=True,
-        )
         try:
             results = [p.communicate(timeout=300) for p in (host, peer, spec)]
         except subprocess.TimeoutExpired:
@@ -106,3 +134,7 @@ class TestExampleSmoke:
             pytest.fail("example trio timed out")
         for p, (out, err) in zip((host, peer, spec), results):
             assert p.returncode == 0, f"rc={p.returncode}\n{out}\n{err}"
+        # the spectator must actually have followed the full frame budget,
+        # not bailed early on a disconnect
+        spec_out = results[2][0]
+        assert "[spectator] done" in spec_out, spec_out

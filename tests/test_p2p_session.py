@@ -274,6 +274,31 @@ def test_confirmed_frame_asserts_when_all_players_disconnected():
         sess.confirmed_frame()
 
 
+def test_disconnect_before_any_frame_is_not_a_rollback():
+    """A peer that vanishes before sending a single input schedules a
+    'rollback to frame 0' while the session is still AT frame 0 — there is
+    nothing simulated to rewind, and advance_frame must treat it as a no-op
+    instead of tripping the load-frame window assert (found by the example
+    trio smoke test; the reference panics on this edge,
+    /root/reference/src/sync_layer.rs:229-249)."""
+    net = InMemoryNetwork()
+    sess = (
+        SessionBuilder(stub_config())
+        .add_player(Local(), 0)
+        .add_player(Remote("R"), 1)
+        .start_p2p_session(net.socket("me"))
+    )
+    sess.disconnect_player(1)  # last received frame is NULL_FRAME
+    sess.add_local_input(0, 1)
+    stub = GameStub()
+    stub.handle_requests(sess.advance_frame())  # must not raise
+    # the session keeps working with disconnect-dummy inputs for the peer
+    for i in range(2, 6):
+        sess.add_local_input(0, i)
+        stub.handle_requests(sess.advance_frame())
+    assert sess.current_frame >= 4
+
+
 def test_advance_frame_p2p_sessions_real_udp():
     """Same as the in-memory test but over real loopback UDP sockets
     (reference: test_p2p_session.rs:69-110)."""
