@@ -31,7 +31,7 @@ def parse_addr(s: str):
 def run_session(local_port: int, players, spectators, frames: int, render: bool):
     from ex_game import FPS, FrameClock, Game, box_config
     from ggrs_tpu.core import DesyncDetection, Local, Remote, Spectator
-    from ggrs_tpu.core.errors import PredictionThreshold
+    from ggrs_tpu.core.errors import NotSynchronized, PredictionThreshold
     from ggrs_tpu.net import UdpNonBlockingSocket
     from ggrs_tpu.sessions import SessionBuilder
 
@@ -40,8 +40,12 @@ def run_session(local_port: int, players, spectators, frames: int, render: bool)
         .with_num_players(len(players))
         .with_desync_detection_mode(DesyncDetection.on(60))
         .with_fps(FPS)
-        # example peers share a machine with each other (and CI noise): use
-        # WAN-grade timers so a scheduling hiccup isn't a spurious disconnect
+        # handshake before streaming: peers may start seconds apart (jax
+        # import + warmup), and without it the disconnect timers cannot tell
+        # "not started yet" from "gone" (disconnect timers are paused until
+        # the handshake completes)
+        .with_sync_handshake(True)
+        # share-a-machine CI tolerance for mid-run scheduling hiccups
         .with_disconnect_timeout(5_000)
         .with_disconnect_notify_delay(2_000)
     )
@@ -74,6 +78,8 @@ def run_session(local_port: int, players, spectators, frames: int, render: bool)
                 sess.add_local_input(h, game.bot_input(h, frame))
             try:
                 requests = sess.advance_frame()
+            except NotSynchronized:
+                continue  # handshake still completing
             except PredictionThreshold:
                 continue  # waiting on remote inputs
             game.handle_requests(requests)

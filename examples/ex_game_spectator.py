@@ -31,7 +31,11 @@ def main() -> None:
 
     from ex_game import FPS, FrameClock, Game, box_config
     from ggrs_tpu.core import Disconnected
-    from ggrs_tpu.core.errors import PredictionThreshold, SpectatorTooFarBehind
+    from ggrs_tpu.core.errors import (
+        NotSynchronized,
+        PredictionThreshold,
+        SpectatorTooFarBehind,
+    )
     from ggrs_tpu.net import UdpNonBlockingSocket
     from ggrs_tpu.sessions import SessionBuilder
 
@@ -44,14 +48,12 @@ def main() -> None:
         SessionBuilder(box_config())
         .with_num_players(args.num_players)
         .with_fps(FPS)
-        # this fork has no sync handshake — the disconnect timer runs from
-        # session creation, and a host can spend tens of seconds importing
-        # jax + pre-compiling its programs before it sends frame 0.  A
-        # spectator cannot distinguish "host still starting" from "host
-        # gone", so use a follow-stream-grade window (the timer still
-        # catches a real host exit, just patiently)
-        .with_disconnect_timeout(120_000)
-        .with_disconnect_notify_delay(5_000)
+        # handshake before following: the disconnect timers pause until the
+        # host actually appears (it may spend tens of seconds importing jax
+        # and pre-compiling before sending frame 0), then catch a real exit
+        .with_sync_handshake(True)
+        .with_disconnect_timeout(5_000)
+        .with_disconnect_notify_delay(2_000)
         # recover quickly when the host briefly runs ahead of real time
         .with_max_frames_behind(15)
         .with_catchup_speed(4)
@@ -79,6 +81,8 @@ def main() -> None:
                 game.handle_requests(sess.advance_frame())
                 frame = sess.current_frame
                 game.draw()
+            except NotSynchronized:
+                pass  # handshake still completing
             except PredictionThreshold:
                 pass  # host inputs not here yet
             except SpectatorTooFarBehind:

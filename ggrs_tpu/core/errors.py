@@ -42,7 +42,8 @@ class MismatchedChecksum(GgrsError):
 
 
 class NotSynchronized(GgrsError):
-    """Kept for API parity; this fork's sessions are always Running."""
+    """Raised by advance_frame while the opt-in sync handshake is still
+    completing (vestigial in the reference fork, which has no handshake)."""
 
     def __init__(self) -> None:
         super().__init__("The session is not yet synchronized with all remote sessions.")

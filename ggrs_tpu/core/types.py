@@ -32,9 +32,10 @@ class InputStatus(enum.Enum):
 
 
 class SessionState(enum.Enum):
-    """Session lifecycle state (reference: src/lib.rs:93-102).  This fork of the
-    reference never actually produces SYNCHRONIZING (handshake removed); the
-    variant is kept for API parity."""
+    """Session lifecycle state (reference: src/lib.rs:93-102).  The reference
+    fork never produces SYNCHRONIZING (handshake removed; its variant is
+    vestigial) — here it is real when the opt-in handshake is enabled
+    (``SessionBuilder.with_sync_handshake``), and vestigial otherwise."""
 
     SYNCHRONIZING = "synchronizing"
     RUNNING = "running"
@@ -126,8 +127,9 @@ GgrsRequest = SaveGameState | LoadGameState | AdvanceFrame
 
 @dataclass(frozen=True)
 class Synchronizing(Generic[A]):
-    """Kept for API parity: this fork's protocol starts Running and never emits
-    synchronization progress (reference fork delta: protocol.rs:117-121)."""
+    """Handshake progress.  Vestigial in the reference fork (its protocol
+    starts Running, fork delta: protocol.rs:117-121); emitted for real here
+    when ``SessionBuilder.with_sync_handshake(True)`` is set."""
 
     addr: A
     total: int

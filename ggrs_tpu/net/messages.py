@@ -68,8 +68,30 @@ class KeepAlive:
     pass
 
 
+@dataclass
+class SyncRequest:
+    """Handshake probe (opt-in; see PeerProtocol ``sync_required``).  The
+    reference fork removed the handshake entirely (fork delta #4); upstream
+    GGRS/GGPO carries a random nonce echoed by the reply so stale replies
+    can't complete a new handshake."""
+
+    random: int = 0
+
+
+@dataclass
+class SyncReply:
+    random: int = 0
+
+
 MessageBody = Union[
-    InputMessage, InputAck, QualityReport, QualityReply, ChecksumReport, KeepAlive
+    InputMessage,
+    InputAck,
+    QualityReport,
+    QualityReply,
+    ChecksumReport,
+    KeepAlive,
+    SyncRequest,
+    SyncReply,
 ]
 
 _TAG_INPUT = 0
@@ -78,6 +100,8 @@ _TAG_QUALITY_REPORT = 2
 _TAG_QUALITY_REPLY = 3
 _TAG_CHECKSUM_REPORT = 4
 _TAG_KEEP_ALIVE = 5
+_TAG_SYNC_REQUEST = 6
+_TAG_SYNC_REPLY = 7
 
 # Bound player count on decode so a malicious length prefix can't allocate
 # unbounded memory.
@@ -127,6 +151,12 @@ class Message:
             w.u128(b.checksum)
         elif isinstance(b, KeepAlive):
             w.u8(_TAG_KEEP_ALIVE)
+        elif isinstance(b, SyncRequest):
+            w.u8(_TAG_SYNC_REQUEST)
+            w.uvarint(b.random)
+        elif isinstance(b, SyncReply):
+            w.u8(_TAG_SYNC_REPLY)
+            w.uvarint(b.random)
         else:  # pragma: no cover
             raise TypeError(f"unknown message body {type(b)}")
         out = w.finish()
@@ -168,6 +198,10 @@ class Message:
             body = ChecksumReport(checksum=checksum, frame=frame)
         elif tag == _TAG_KEEP_ALIVE:
             body = KeepAlive()
+        elif tag == _TAG_SYNC_REQUEST:
+            body = SyncRequest(random=r.uvarint())
+        elif tag == _TAG_SYNC_REPLY:
+            body = SyncReply(random=r.uvarint())
         else:
             raise WireError(f"unknown message tag {tag}")
         r.expect_end()

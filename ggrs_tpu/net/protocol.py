@@ -39,6 +39,8 @@ from .messages import (
     Message,
     QualityReply,
     QualityReport,
+    SyncReply,
+    SyncRequest,
 )
 from .sockets import NonBlockingSocket
 from .stats import NetworkStats
@@ -54,6 +56,9 @@ RUNNING_RETRY_INTERVAL_MS = 200
 KEEP_ALIVE_INTERVAL_MS = 200
 QUALITY_REPORT_INTERVAL_MS = 200
 MAX_CHECKSUM_HISTORY_SIZE = 32
+# opt-in handshake (sync_required=True): round trips to confirm + retry cadence
+NUM_SYNC_PACKETS = 5
+SYNC_RETRY_INTERVAL_MS = 200
 
 
 def monotonic_ms() -> int:
@@ -86,10 +91,31 @@ class EvNetworkResumed:
     pass
 
 
-ProtocolEvent = EvInput | EvDisconnected | EvNetworkInterrupted | EvNetworkResumed
+@dataclass
+class EvSynchronizing:
+    """Handshake progress (only with ``sync_required=True``)."""
+
+    total: int
+    count: int
+
+
+@dataclass
+class EvSynchronized:
+    pass
+
+
+ProtocolEvent = (
+    EvInput
+    | EvDisconnected
+    | EvNetworkInterrupted
+    | EvNetworkResumed
+    | EvSynchronizing
+    | EvSynchronized
+)
 
 
 class _State:
+    SYNCHRONIZING = "synchronizing"
     RUNNING = "running"
     DISCONNECTED = "disconnected"
     SHUTDOWN = "shutdown"
@@ -123,9 +149,19 @@ def _decode_player_bytes(data: bytes, expected_players: int) -> Optional[List[by
 
 
 class PeerProtocol(Generic[I, A]):
-    """The reliability endpoint for one remote address.  As in the reference
-    fork, it starts in RUNNING (no sync handshake; fork delta #4,
-    protocol.rs:117-121)."""
+    """The reliability endpoint for one remote address.
+
+    By default it starts in RUNNING with no sync handshake, exactly like the
+    reference fork (fork delta #4, protocol.rs:117-121).  With
+    ``sync_required=True`` it starts in SYNCHRONIZING and completes
+    ``NUM_SYNC_PACKETS`` nonce-echo round trips before entering RUNNING —
+    the upstream GGRS/GGPO behavior the fork removed, restored as an opt-in
+    because a handshake-free stream cannot distinguish a slow-starting peer
+    from a dead one (no input flows until both ends exist, so the disconnect
+    timers misfire; see SURVEY fork delta #4 note).  While synchronizing:
+    inputs are neither sent nor required, disconnect timers are paused, and
+    incoming Sync messages are always answered so the two ends can come up
+    in any order."""
 
     def __init__(
         self,
@@ -141,6 +177,7 @@ class PeerProtocol(Generic[I, A]):
         desync_detection: DesyncDetection,
         clock: Callable[[], int] = monotonic_ms,
         rng: Optional[random.Random] = None,
+        sync_required: bool = False,
     ) -> None:
         self._config = config
         self.handles = sorted(handles)
@@ -163,13 +200,17 @@ class PeerProtocol(Generic[I, A]):
         self._send_queue: Deque[Tuple[Message, int]] = deque()  # (msg, encoded size)
         self._event_queue: Deque[ProtocolEvent] = deque()
 
-        self._state = _State.RUNNING
+        self._rng = rng
+        self._state = _State.SYNCHRONIZING if sync_required else _State.RUNNING
         now = clock()
         self._last_quality_report_time = now
         self._last_input_recv_time = now
         self._disconnect_notify_sent = False
         self._disconnect_event_sent = False
         self._shutdown_timeout = now
+        self._sync_remaining = NUM_SYNC_PACKETS
+        self._sync_random = 0
+        self._last_sync_request_time: Optional[int] = None
 
         self.peer_connect_status: List[ConnectionStatus] = [
             ConnectionStatus() for _ in range(num_players)
@@ -208,6 +249,9 @@ class PeerProtocol(Generic[I, A]):
 
     def is_running(self) -> bool:
         return self._state == _State.RUNNING
+
+    def is_synchronizing(self) -> bool:
+        return self._state == _State.SYNCHRONIZING
 
     def is_handling_message(self, addr: A) -> bool:
         return self.peer_addr == addr
@@ -256,7 +300,15 @@ class PeerProtocol(Generic[I, A]):
 
     def poll(self, connect_status: Sequence[ConnectionStatus]) -> List[ProtocolEvent]:
         now = self._clock()
-        if self._state == _State.RUNNING:
+        if self._state == _State.SYNCHRONIZING:
+            # (re)send the probe; no other timers run until synchronized —
+            # a peer that hasn't appeared yet is not "interrupted"
+            if (
+                self._last_sync_request_time is None
+                or self._last_sync_request_time + SYNC_RETRY_INTERVAL_MS < now
+            ):
+                self._send_sync_request()
+        elif self._state == _State.RUNNING:
             # retry pending inputs if nothing moved for a while
             if self._last_input_recv_time + RUNNING_RETRY_INTERVAL_MS < now:
                 self._send_pending_output(connect_status)
@@ -357,6 +409,18 @@ class PeerProtocol(Generic[I, A]):
         )
         self._queue_message(body)
 
+    def _send_sync_request(self) -> None:
+        # The nonce is per ROUND TRIP, not per send: a retry re-sends the
+        # same nonce, so a reply that took longer than the retry interval
+        # still completes the round (regenerating per send would livelock
+        # any link with RTT > SYNC_RETRY_INTERVAL_MS — every reply would
+        # look stale).  _on_sync_reply zeroes the nonce to start a new round.
+        if self._sync_random == 0:
+            rng = self._rng if self._rng is not None else random
+            self._sync_random = rng.randrange(1, 1 << 32)
+        self._last_sync_request_time = self._clock()
+        self._queue_message(SyncRequest(random=self._sync_random))
+
     def _send_quality_report(self) -> None:
         self._last_quality_report_time = self._clock()
         advantage = max(-32768, min(32767, self.local_frame_advantage))
@@ -388,7 +452,14 @@ class PeerProtocol(Generic[I, A]):
             self._event_queue.append(EvNetworkResumed())
 
         body = msg.body
-        if isinstance(body, InputMessage):
+        if isinstance(body, SyncRequest):
+            # always answer, in any live state: the two ends may come up in
+            # either order, and a running endpoint must still echo probes so
+            # a restarted/slow peer can finish its own handshake
+            self._queue_message(SyncReply(random=body.random))
+        elif isinstance(body, SyncReply):
+            self._on_sync_reply(body)
+        elif isinstance(body, InputMessage):
             self._on_input(body)
         elif isinstance(body, InputAck):
             self._pop_pending_output(body.ack_frame)
@@ -403,6 +474,30 @@ class PeerProtocol(Generic[I, A]):
             self._on_checksum_report(body)
         elif isinstance(body, KeepAlive):
             pass
+
+    def _on_sync_reply(self, body) -> None:
+        if self._state != _State.SYNCHRONIZING:
+            return  # late/duplicate reply after sync completed
+        if body.random != self._sync_random or self._sync_random == 0:
+            return  # stale reply to an earlier round: ignore
+        self._sync_random = 0  # round complete; next send starts a new one
+        self._sync_remaining -= 1
+        self._event_queue.append(
+            EvSynchronizing(
+                total=NUM_SYNC_PACKETS,
+                count=NUM_SYNC_PACKETS - self._sync_remaining,
+            )
+        )
+        if self._sync_remaining == 0:
+            self._state = _State.RUNNING
+            self._event_queue.append(EvSynchronized())
+            # timers start fresh from the moment the link is proven live
+            now = self._clock()
+            self._last_input_recv_time = now
+            self._last_quality_report_time = now
+            self._stats_start_time = now
+        else:
+            self._send_sync_request()  # next round trip immediately
 
     def _pop_pending_output(self, ack_frame: Frame) -> None:
         while self._pending_output and self._pending_output[0].frame <= ack_frame:

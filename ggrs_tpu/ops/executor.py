@@ -41,7 +41,7 @@ from ..core.types import (
     LoadGameState,
     SaveGameState,
 )
-from ..parallel.spec_rollback import SpeculativeRollback
+from ..parallel.spec_rollback import SpeculativeRollback, _stack_pytrees
 from .checksum import DeviceChecksum, checksum_device
 
 InputsToArray = Callable[[Sequence[Tuple[Any, InputStatus]]], Any]
@@ -258,9 +258,9 @@ class DeviceRequestExecutor:
         callers can re-anchor speculation without refetching."""
         if arrays is None:
             arrays = [self._inputs_to_array(p.inputs) for p in pairs]
-        stacked = jax.tree_util.tree_map(
-            lambda *leaves: jnp.stack(leaves), *arrays
-        )
+        # host-side stack when the arrays are NumPy: the single H2D then
+        # happens inside the fused call instead of as eager device ops
+        stacked = _stack_pytrees(arrays)
         final, steps, sums = self._burst(self._state, stacked)
         self._state = final
         if self._spec is not None:

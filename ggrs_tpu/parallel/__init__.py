@@ -15,12 +15,15 @@ design, not ports:
 
 from .speculation import SpeculativeBranches, build_speculation_programs
 from .spec_rollback import SpeculativeRollback
-from .batch import BatchedSessions, make_mesh
+from .batch import BatchedSessions, HOST_AXIS, SESSION_AXIS, make_mesh, make_mesh2d
 
 __all__ = [
     "BatchedSessions",
+    "HOST_AXIS",
+    "SESSION_AXIS",
     "SpeculativeBranches",
     "SpeculativeRollback",
     "build_speculation_programs",
     "make_mesh",
+    "make_mesh2d",
 ]

@@ -45,6 +45,32 @@ def make_mesh(n_devices: Optional[int] = None, axis: str = SESSION_AXIS) -> Mesh
     return Mesh(np.asarray(devs), (axis,))
 
 
+HOST_AXIS = "hosts"
+
+
+def make_mesh2d(
+    n_hosts: int,
+    chips_per_host: int,
+    axes: Tuple[str, str] = (HOST_AXIS, SESSION_AXIS),
+) -> Mesh:
+    """2-D ``(hosts, chips)`` mesh — the multi-host shape.
+
+    On a real multi-host job (``jax.distributed``), ``jax.devices()`` spans
+    every host and the natural factorization puts the slow interconnect (DCN)
+    on the outer axis and ICI on the inner one, so XLA routes the per-host
+    partial reductions over ICI and only the scalar host-level combine over
+    DCN — the hierarchy SURVEY §2's backend note calls for.  ``BatchedSessions``
+    accepts either mesh rank and shards its session axis over ALL mesh axes,
+    so moving from one host to N is a mesh swap, not a program change.  Tests
+    exercise the same program on a virtual ``(2, 4)`` CPU mesh.
+    """
+    devs = jax.devices()
+    need = n_hosts * chips_per_host
+    assert need <= len(devs), f"asked for {need} devices, have {len(devs)}"
+    grid = np.asarray(devs[:need]).reshape(n_hosts, chips_per_host)
+    return Mesh(grid, axes)
+
+
 class BatchedSessions:
     """B independent device-synctest sessions as one sharded program.
 
@@ -78,7 +104,12 @@ class BatchedSessions:
         self._ticks_run = 0
         self._last_stats: Optional[Dict[str, Any]] = None
 
-        spec_b = P(SESSION_AXIS)  # shard leading (session) axis
+        # shard the leading (session) axis over EVERY mesh axis: on a 1-D
+        # mesh that's plain chip-sharding; on a 2-D (hosts, chips) mesh the
+        # batch splits host-major so reductions combine over ICI first, DCN
+        # last (see make_mesh2d)
+        axis_names = tuple(self.mesh.axis_names)
+        spec_b = P(axis_names)
         sharding = NamedSharding(self.mesh, spec_b)
 
         # one carry per session, stacked on a leading B axis and sharded
@@ -105,10 +136,10 @@ class BatchedSessions:
                 )
                 stats = {
                     "mismatches": jax.lax.psum(
-                        jnp.sum(out["mismatches"]), SESSION_AXIS
+                        jnp.sum(out["mismatches"]), axis_names
                     ),
                     "first_bad": jax.lax.pmin(
-                        jnp.min(out["first_bad"]), SESSION_AXIS
+                        jnp.min(out["first_bad"]), axis_names
                     ),
                 }
                 return out, stats

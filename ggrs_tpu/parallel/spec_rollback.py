@@ -111,7 +111,15 @@ class SpeculativeRollback:
         self._hit_count = jnp.zeros((), jnp.uint32)
 
         self._root_fn = jax.jit(self._root_impl)
-        self._extend_fn = jax.jit(self._extend_impl)
+        # donate the [W, K, ...] ring buffers on TPU so the per-tick slot
+        # write updates HBM in place instead of copying the whole window
+        # (same treatment as ops.replay's carry; donation on CPU is a noisy
+        # no-op, so gate it — and warmup() must hand scratch buffers to
+        # these programs, never the live ones it restores afterwards)
+        on_tpu = jax.default_backend() == "tpu"
+        self._extend_fn = jax.jit(
+            self._extend_impl, donate_argnums=(1, 2, 3) if on_tpu else ()
+        )
 
         def _adv_ext(live_state, live_inputs, *extend_args):
             return (
@@ -119,7 +127,9 @@ class SpeculativeRollback:
                 *self._extend_impl(*extend_args),
             )
 
-        self._adv_ext_fn = jax.jit(_adv_ext)
+        self._adv_ext_fn = jax.jit(
+            _adv_ext, donate_argnums=(3, 4, 5) if on_tpu else ()
+        )
         self._fulfill_cache: Dict[Tuple[int, bool], Any] = {}
         self._refill_cache: Dict[int, Any] = {}
         self._resolve_cache: Dict[int, Any] = {}
@@ -479,6 +489,12 @@ class SpeculativeRollback:
             self._hit_count,
         )
         try:
+            # fresh scratch buffers: the fused programs donate their ring
+            # buffers on TPU, so the saved live buffers must never be
+            # handed to them here (they would be invalidated)
+            self._traj_buf = None
+            self._inp_buf = None
+            self._prefix_buf = None
             self.root(0, state)
             self.advance_and_extend(state, example_inputs)
             for n in sorted(set(depths)):

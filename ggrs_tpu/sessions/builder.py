@@ -56,6 +56,7 @@ class SessionBuilder(Generic[I, S, A]):
         self._catchup_speed = DEFAULT_CATCHUP_SPEED
         self._clock: Callable[[], int] = monotonic_ms
         self._rng: Optional[random.Random] = None
+        self._sync_handshake = False  # fork parity: no handshake by default
 
     # ------------------------------------------------------------------
     # players
@@ -121,6 +122,17 @@ class SessionBuilder(Generic[I, S, A]):
         self, desync_detection: DesyncDetection
     ) -> "SessionBuilder[I, S, A]":
         self._desync_detection = desync_detection
+        return self
+
+    def with_sync_handshake(self, enabled: bool) -> "SessionBuilder[I, S, A]":
+        """Opt into the upstream-GGRS sync handshake the reference fork
+        removed (fork delta #4): endpoints start SYNCHRONIZING, complete
+        nonce-echo round trips before carrying inputs, and the session
+        reports ``SessionState.SYNCHRONIZING`` / raises ``NotSynchronized``
+        until every remote is up — turning the fork's vestigial
+        Synchronizing/Synchronized event vocabulary back into real events.
+        Default off (wire-compatible with handshake-less peers)."""
+        self._sync_handshake = enabled
         return self
 
     def with_disconnect_timeout(self, timeout_ms: int) -> "SessionBuilder[I, S, A]":
@@ -234,6 +246,7 @@ class SessionBuilder(Generic[I, S, A]):
             desync_detection=DesyncDetection.off(),
             clock=self._clock,
             rng=self._rng,
+            sync_required=self._sync_handshake,
         )
         return SpectatorSession(
             config=self._config,
@@ -273,4 +286,5 @@ class SessionBuilder(Generic[I, S, A]):
             desync_detection=self._desync_detection,
             clock=self._clock,
             rng=self._rng,
+            sync_required=self._sync_handshake,
         )

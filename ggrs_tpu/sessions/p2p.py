@@ -16,7 +16,12 @@ from collections import deque
 from typing import Callable, Deque, Dict, Generic, Hashable, List, Optional, TypeVar
 
 from ..core.config import Config
-from ..core.errors import BadPlayerHandle, GgrsError, InvalidRequest
+from ..core.errors import (
+    BadPlayerHandle,
+    GgrsError,
+    InvalidRequest,
+    NotSynchronized,
+)
 from ..core.frame_info import PlayerInput
 from ..core.sync_layer import SyncLayer
 from ..core.types import (
@@ -36,6 +41,8 @@ from ..core.types import (
     Remote,
     SessionState,
     Spectator,
+    Synchronized,
+    Synchronizing,
     WaitRecommendation,
 )
 from ..net.messages import ConnectionStatus
@@ -44,6 +51,8 @@ from ..net.protocol import (
     EvInput,
     EvNetworkInterrupted,
     EvNetworkResumed,
+    EvSynchronized,
+    EvSynchronizing,
     MAX_CHECKSUM_HISTORY_SIZE,
     PeerProtocol,
     ProtocolEvent,
@@ -159,12 +168,24 @@ class P2PSession(Generic[I, S, A]):
         )
 
     def current_state(self) -> SessionState:
+        """RUNNING, unless the opt-in sync handshake (builder
+        ``with_sync_handshake``) is still in flight on any endpoint.  With
+        the handshake off this is always RUNNING, like the reference fork
+        (p2p_session.rs:250-252)."""
+        endpoints = list(self._player_reg.remotes.values()) + list(
+            self._player_reg.spectators.values()
+        )
+        if any(e.is_synchronizing() for e in endpoints):
+            return SessionState.SYNCHRONIZING
         return SessionState.RUNNING
 
     def advance_frame(self) -> List[GgrsRequest]:
         """The main entry point; see the reference call stack
         (p2p_session.rs:265-426).  Returns the ordered request list."""
         self.poll_remote_clients()
+
+        if self.current_state() is SessionState.SYNCHRONIZING:
+            raise NotSynchronized()
 
         for handle in self._player_reg.local_player_handles():
             if handle not in self._local_inputs:
@@ -535,6 +556,12 @@ class P2PSession(Generic[I, S, A]):
             )
         elif isinstance(event, EvNetworkResumed):
             self._push_event(NetworkResumed(addr=addr))
+        elif isinstance(event, EvSynchronizing):
+            self._push_event(
+                Synchronizing(addr=addr, total=event.total, count=event.count)
+            )
+        elif isinstance(event, EvSynchronized):
+            self._push_event(Synchronized(addr=addr))
         elif isinstance(event, EvDisconnected):
             for handle in player_handles:
                 last_frame = (

@@ -13,7 +13,11 @@ from collections import deque
 from typing import Deque, Generic, Hashable, List, TypeVar
 
 from ..core.config import Config
-from ..core.errors import PredictionThreshold, SpectatorTooFarBehind
+from ..core.errors import (
+    NotSynchronized,
+    PredictionThreshold,
+    SpectatorTooFarBehind,
+)
 from ..core.frame_info import PlayerInput
 from ..core.types import (
     AdvanceFrame,
@@ -25,6 +29,9 @@ from ..core.types import (
     NetworkInterrupted,
     NetworkResumed,
     NULL_FRAME,
+    SessionState,
+    Synchronized,
+    Synchronizing,
 )
 from ..net.messages import ConnectionStatus
 from ..net.protocol import (
@@ -32,6 +39,8 @@ from ..net.protocol import (
     EvInput,
     EvNetworkInterrupted,
     EvNetworkResumed,
+    EvSynchronized,
+    EvSynchronizing,
     PeerProtocol,
     ProtocolEvent,
 )
@@ -98,6 +107,9 @@ class SpectatorSession(Generic[I, A]):
         (reference: p2p_spectator_session.rs:103-129)."""
         self.poll_remote_clients()
 
+        if self.current_state() is SessionState.SYNCHRONIZING:
+            raise NotSynchronized()
+
         requests: List[GgrsRequest] = []
         frames_to_advance = (
             self._catchup_speed
@@ -123,6 +135,13 @@ class SpectatorSession(Generic[I, A]):
             self._handle_event(event, addr)
 
         self._host.send_all_messages(self._socket)
+
+    def current_state(self) -> SessionState:
+        """RUNNING, unless the opt-in sync handshake (builder
+        ``with_sync_handshake``) is still completing against the host."""
+        if self._host.is_synchronizing():
+            return SessionState.SYNCHRONIZING
+        return SessionState.RUNNING
 
     @property
     def current_frame(self) -> Frame:
@@ -164,6 +183,12 @@ class SpectatorSession(Generic[I, A]):
             )
         elif isinstance(event, EvNetworkResumed):
             self._push_event(NetworkResumed(addr=addr))
+        elif isinstance(event, EvSynchronizing):
+            self._push_event(
+                Synchronizing(addr=addr, total=event.total, count=event.count)
+            )
+        elif isinstance(event, EvSynchronized):
+            self._push_event(Synchronized(addr=addr))
         elif isinstance(event, EvDisconnected):
             self._push_event(Disconnected(addr=addr))
         elif isinstance(event, EvInput):

@@ -13,6 +13,8 @@ from ggrs_tpu.net.messages import (
     Message,
     QualityReply,
     QualityReport,
+    SyncReply,
+    SyncRequest,
 )
 from ggrs_tpu.net.wire import WireError
 
@@ -52,6 +54,13 @@ def test_quality_roundtrip():
 def test_input_ack_roundtrip():
     m = roundtrip(Message(magic=1, body=InputAck(ack_frame=99)))
     assert m.body == InputAck(ack_frame=99)
+
+
+def test_sync_messages_roundtrip():
+    m = roundtrip(Message(magic=1, body=SyncRequest(random=0xDEADBEEF)))
+    assert m.body == SyncRequest(random=0xDEADBEEF)
+    m = roundtrip(Message(magic=1, body=SyncReply(random=1)))
+    assert m.body == SyncReply(random=1)
 
 
 def test_checksum_report_roundtrip_u128():

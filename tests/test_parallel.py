@@ -13,6 +13,7 @@ from ggrs_tpu.parallel import (
     BatchedSessions,
     build_speculation_programs,
     make_mesh,
+    make_mesh2d,
 )
 
 
@@ -114,6 +115,55 @@ class TestBatchedSessions:
                 batch_size=9,
                 mesh=make_mesh(8),
             )
+
+    def test_2d_host_mesh_matches_1d_mesh_bitwise(self):
+        """The multi-host shape: a (2 hosts × 4 chips) mesh must produce
+        bit-identical states and the same global stats as the flat 8-chip
+        mesh — moving to multi-host is a mesh swap, not a program change."""
+        game = BoxGame(2)
+        B, n = 16, 24
+        inputs = _random_inputs((B, n, 2), seed=23)
+        results = []
+        for mesh in (make_mesh(8), make_mesh2d(2, 4)):
+            batch = BatchedSessions(
+                game.advance,
+                game.init_state(),
+                jnp.zeros((2,), jnp.uint8),
+                batch_size=B,
+                mesh=mesh,
+                check_distance=2,
+            )
+            stats = batch.run_ticks(inputs)
+            assert stats["mismatches"] == 0
+            results.append(batch.live_states())
+        flat, two_d = results
+        for k in ("pos", "vel", "rot"):
+            np.testing.assert_array_equal(
+                np.asarray(flat[k]), np.asarray(two_d[k]), err_msg=k
+            )
+
+    def test_2d_mesh_detects_corruption_across_hosts(self):
+        """The psum/pmin health reduction must cross BOTH mesh axes: corrupt
+        a session owned by the second host row and read the global stats."""
+        game = BoxGame(2)
+        B = 16
+        batch = BatchedSessions(
+            game.advance,
+            game.init_state(),
+            jnp.zeros((2,), jnp.uint8),
+            batch_size=B,
+            mesh=make_mesh2d(2, 4),
+            check_distance=2,
+        )
+        batch.run_ticks(_random_inputs((B, 10, 2), seed=3))
+        ring_len = batch._programs.ring.length
+        slot = 8 % ring_len
+        states = batch._carry["ring"]["states"]
+        # session 12 lives in the second host row (sessions are host-major)
+        states["pos"] = states["pos"].at[12, slot, 0, 0].add(1)
+        stats = batch.run_ticks(_random_inputs((B, 5, 2), seed=4))
+        assert stats["mismatches"] >= 1
+        assert stats["first_bad"] == 9
 
     def test_corruption_in_one_session_detected_globally(self):
         game = BoxGame(2)

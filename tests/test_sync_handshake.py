@@ -1,0 +1,191 @@
+"""Opt-in sync handshake (builder ``with_sync_handshake``).
+
+The reference fork removed the upstream handshake and ships vestigial
+Synchronizing/Synchronized events plus a NotSynchronized error that nothing
+produces (SURVEY fork delta #4).  With the handshake enabled those become
+real: endpoints complete nonce-echo round trips before carrying inputs,
+sessions report SYNCHRONIZING / raise NotSynchronized until every remote is
+up, and the disconnect timers don't run while waiting — so a slow-starting
+peer is not misdiagnosed as dead (the failure mode that motivated this)."""
+
+import random
+
+import pytest
+
+from ggrs_tpu.core import (
+    Local,
+    Remote,
+    SessionState,
+    Spectator,
+    Synchronized,
+    Synchronizing,
+)
+from ggrs_tpu.core.errors import NotSynchronized
+from ggrs_tpu.net import InMemoryNetwork
+from ggrs_tpu.sessions import SessionBuilder
+
+from stubs import GameStub, stub_config
+
+
+def _make_pair(net, clock, handshake=True):
+    sessions = []
+    for me, other, local_handle in (("A", "B", 0), ("B", "A", 1)):
+        sessions.append(
+            SessionBuilder(stub_config())
+            .with_clock(clock)
+            .with_rng(random.Random(7 + local_handle))
+            .with_sync_handshake(handshake)
+            .add_player(Local(), local_handle)
+            .add_player(Remote(other), 1 - local_handle)
+            .start_p2p_session(net.socket(me))
+        )
+    return sessions
+
+
+class TestSyncHandshake:
+    def test_default_off_is_fork_parity(self):
+        net = InMemoryNetwork()
+        sess1, sess2 = _make_pair(net, lambda: 0, handshake=False)
+        assert sess1.current_state() is SessionState.RUNNING
+        sess1.add_local_input(0, 1)
+        sess1.advance_frame()  # no NotSynchronized without the handshake
+
+    def test_not_synchronized_until_handshake_completes(self):
+        net = InMemoryNetwork()
+        sess1, sess2 = _make_pair(net, lambda: 0)
+        assert sess1.current_state() is SessionState.SYNCHRONIZING
+        sess1.add_local_input(0, 1)
+        with pytest.raises(NotSynchronized):
+            sess1.advance_frame()
+
+    def test_handshake_completes_and_emits_events(self):
+        net = InMemoryNetwork()
+        sess1, sess2 = _make_pair(net, lambda: 0)
+        for _ in range(12):  # a few pump rounds: 5 round trips each way
+            sess1.poll_remote_clients()
+            sess2.poll_remote_clients()
+
+        assert sess1.current_state() is SessionState.RUNNING
+        assert sess2.current_state() is SessionState.RUNNING
+
+        ev1 = sess1.events()
+        progress = [e for e in ev1 if isinstance(e, Synchronizing)]
+        done = [e for e in ev1 if isinstance(e, Synchronized)]
+        assert [e.count for e in progress] == [1, 2, 3, 4, 5]
+        assert all(e.total == 5 for e in progress)
+        assert len(done) == 1 and done[0].addr == "B"
+
+    def test_sessions_play_normally_after_handshake(self):
+        net = InMemoryNetwork()
+        sess1, sess2 = _make_pair(net, lambda: 0)
+        for _ in range(12):
+            sess1.poll_remote_clients()
+            sess2.poll_remote_clients()
+        stub1, stub2 = GameStub(), GameStub()
+        for i in range(20):
+            sess1.poll_remote_clients()
+            sess2.poll_remote_clients()
+            sess1.add_local_input(0, i)
+            stub1.handle_requests(sess1.advance_frame())
+            sess2.add_local_input(1, i)
+            stub2.handle_requests(sess2.advance_frame())
+        # drain so predictions resolve, then both states must pin exactly
+        for i in range(8):
+            sess1.poll_remote_clients()
+            sess2.poll_remote_clients()
+            sess1.add_local_input(0, 0)
+            stub1.handle_requests(sess1.advance_frame())
+            sess2.add_local_input(1, 0)
+            stub2.handle_requests(sess2.advance_frame())
+        assert stub1.gs.frame > 20
+        assert abs(stub1.gs.frame - stub2.gs.frame) <= 1
+
+    def test_no_disconnect_timer_while_waiting_for_peer(self):
+        """A peer that hasn't started yet must not be declared interrupted or
+        dead, no matter how long it takes (the handshake-free stream cannot
+        make this distinction — the whole point of opting in)."""
+        clock_now = [0]
+        net = InMemoryNetwork()
+        sess1 = (
+            SessionBuilder(stub_config())
+            .with_clock(lambda: clock_now[0])
+            .with_rng(random.Random(3))
+            .with_sync_handshake(True)
+            .add_player(Local(), 0)
+            .add_player(Remote("B"), 1)
+            .start_p2p_session(net.socket("A"))
+        )
+        for step in range(40):
+            clock_now[0] += 1000  # way past the 2000ms disconnect timeout
+            sess1.poll_remote_clients()
+        names = {type(e).__name__ for e in sess1.events()}
+        assert "NetworkInterrupted" not in names
+        assert "Disconnected" not in names
+        assert sess1.current_state() is SessionState.SYNCHRONIZING
+
+    def test_handshake_survives_packet_loss(self):
+        clock_now = [0]
+        net = InMemoryNetwork(loss=0.3, seed=11)
+        sess1, sess2 = _make_pair(net, lambda: clock_now[0])
+        for _ in range(200):
+            clock_now[0] += 100  # let the 200ms sync retry fire
+            sess1.poll_remote_clients()
+            sess2.poll_remote_clients()
+            if (
+                sess1.current_state() is SessionState.RUNNING
+                and sess2.current_state() is SessionState.RUNNING
+            ):
+                break
+        assert sess1.current_state() is SessionState.RUNNING
+        assert sess2.current_state() is SessionState.RUNNING
+
+    def test_handshake_completes_when_rtt_exceeds_retry_interval(self):
+        """The probe nonce is per round trip, not per send: with RTT above
+        the 200ms retry interval every reply arrives after a retry has gone
+        out, and regenerating the nonce on retry would make every reply look
+        stale — a silent livelock (review finding, round 3)."""
+        clock_now = [0]
+        # 3 network ticks of latency; each loop iteration = 100ms and one
+        # tick, so RTT = 600ms >> the 200ms sync retry interval
+        net = InMemoryNetwork(latency_ticks=3)
+        sess1, sess2 = _make_pair(net, lambda: clock_now[0])
+        for _ in range(300):
+            clock_now[0] += 100
+            net.tick()
+            sess1.poll_remote_clients()
+            sess2.poll_remote_clients()
+            if (
+                sess1.current_state() is SessionState.RUNNING
+                and sess2.current_state() is SessionState.RUNNING
+            ):
+                break
+        assert sess1.current_state() is SessionState.RUNNING
+        assert sess2.current_state() is SessionState.RUNNING
+
+    def test_spectator_handshake(self):
+        net = InMemoryNetwork()
+        host = (
+            SessionBuilder(stub_config())
+            .with_clock(lambda: 0)
+            .with_rng(random.Random(5))
+            .with_sync_handshake(True)
+            .add_player(Local(), 0)
+            .add_player(Local(), 1)
+            .add_player(Spectator("S"), 2)
+            .start_p2p_session(net.socket("H"))
+        )
+        spec = (
+            SessionBuilder(stub_config())
+            .with_clock(lambda: 0)
+            .with_rng(random.Random(6))
+            .with_sync_handshake(True)
+            .start_spectator_session("H", net.socket("S"))
+        )
+        assert spec.current_state() is SessionState.SYNCHRONIZING
+        with pytest.raises(NotSynchronized):
+            spec.advance_frame()
+        for _ in range(12):
+            host.poll_remote_clients()
+            spec.poll_remote_clients()
+        assert spec.current_state() is SessionState.RUNNING
+        assert any(isinstance(e, Synchronized) for e in spec.events())
