@@ -159,7 +159,7 @@ def encode(reference: bytes, inputs: Sequence[bytes]) -> Optional[bytes]:
     )
     if rc != 0:  # pragma: no cover - encode can only fail on a bad bound
         return None  # fall back to the Python encoder rather than fail
-    return out.raw[: out_len.value]
+    return ctypes.string_at(out, out_len.value)  # .raw would copy all of cap
 
 
 def decode(reference: bytes, data: bytes) -> Optional[List[bytes]]:
@@ -194,10 +194,14 @@ def decode(reference: bytes, data: bytes) -> Optional[List[bytes]]:
             return None  # legal-but-huge packet: Python path handles it
         if rc != 0:
             raise CodecError(_ERROR_NAMES.get(rc, f"native error {rc}"))
+        # copy only the decoded bytes out of the scratch buffer — .raw would
+        # materialize the whole 1MB cap on every access (measured ~100us per
+        # packet; string_at of the used prefix is ~2us)
+        sizes = out_sizes[: out_count.value]
+        blob = ctypes.string_at(out, sum(sizes))
         result: List[bytes] = []
         pos = 0
-        for i in range(out_count.value):
-            size = out_sizes[i]
-            result.append(out.raw[pos : pos + size])
+        for size in sizes:
+            result.append(blob[pos : pos + size])
             pos += size
         return result

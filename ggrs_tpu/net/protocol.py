@@ -59,6 +59,11 @@ MAX_CHECKSUM_HISTORY_SIZE = 32
 # opt-in handshake (sync_required=True): round trips to confirm + retry cadence
 NUM_SYNC_PACKETS = 5
 SYNC_RETRY_INTERVAL_MS = 200
+# how long to probe for a peer that hasn't appeared before giving up —
+# deliberately generous (peers may spend tens of seconds starting up; that is
+# what the handshake exists to tolerate) but bounded, so a dead address still
+# surfaces a Disconnected event the application can act on
+DEFAULT_SYNC_TIMEOUT_MS = 60_000
 
 
 def monotonic_ms() -> int:
@@ -178,6 +183,7 @@ class PeerProtocol(Generic[I, A]):
         clock: Callable[[], int] = monotonic_ms,
         rng: Optional[random.Random] = None,
         sync_required: bool = False,
+        sync_timeout_ms: int = DEFAULT_SYNC_TIMEOUT_MS,
     ) -> None:
         self._config = config
         self.handles = sorted(handles)
@@ -211,6 +217,7 @@ class PeerProtocol(Generic[I, A]):
         self._sync_remaining = NUM_SYNC_PACKETS
         self._sync_random = 0
         self._last_sync_request_time: Optional[int] = None
+        self._sync_deadline = now + sync_timeout_ms
 
         self.peer_connect_status: List[ConnectionStatus] = [
             ConnectionStatus() for _ in range(num_players)
@@ -224,6 +231,7 @@ class PeerProtocol(Generic[I, A]):
         )
         # inbound: received frame bytes, keyed by frame; NULL_FRAME holds the
         # zeroed decode base (reference: protocol.rs:208-209)
+        self._last_recv_frame: Frame = NULL_FRAME  # cached max of _recv_inputs
         self._recv_inputs: Dict[Frame, _FrameBytes] = {
             NULL_FRAME: _FrameBytes(
                 NULL_FRAME, _encode_player_bytes([default_bytes] * len(self.handles))
@@ -301,9 +309,15 @@ class PeerProtocol(Generic[I, A]):
     def poll(self, connect_status: Sequence[ConnectionStatus]) -> List[ProtocolEvent]:
         now = self._clock()
         if self._state == _State.SYNCHRONIZING:
-            # (re)send the probe; no other timers run until synchronized —
-            # a peer that hasn't appeared yet is not "interrupted"
-            if (
+            # (re)send the probe; the normal timers don't run until
+            # synchronized — a peer that hasn't appeared yet is not
+            # "interrupted" — but the probing itself is bounded so a dead
+            # address still surfaces Disconnected
+            if now > self._sync_deadline:
+                self._event_queue.append(EvDisconnected())
+                self._disconnect_event_sent = True
+                self.disconnect()
+            elif (
                 self._last_sync_request_time is None
                 or self._last_sync_request_time + SYNC_RETRY_INTERVAL_MS < now
             ):
@@ -416,8 +430,9 @@ class PeerProtocol(Generic[I, A]):
         # any link with RTT > SYNC_RETRY_INTERVAL_MS — every reply would
         # look stale).  _on_sync_reply zeroes the nonce to start a new round.
         if self._sync_random == 0:
-            rng = self._rng if self._rng is not None else random
-            self._sync_random = rng.randrange(1, 1 << 32)
+            # self._rng is always set (__init__ normalizes None to a fresh
+            # random.Random before assigning it)
+            self._sync_random = self._rng.randrange(1, 1 << 32)
         self._last_sync_request_time = self._clock()
         self._queue_message(SyncRequest(random=self._sync_random))
 
@@ -553,6 +568,7 @@ class PeerProtocol(Generic[I, A]):
                 return  # undecodable input payload: drop
 
             self._recv_inputs[frame] = _FrameBytes(frame, frame_payload)
+            self._last_recv_frame = max(self._last_recv_frame, frame)
             for handle, value in zip(self.handles, player_inputs):
                 self._event_queue.append(
                     EvInput(PlayerInput(frame, value), handle)
@@ -576,4 +592,6 @@ class PeerProtocol(Generic[I, A]):
         self.pending_checksums[body.frame] = body.checksum
 
     def last_recv_frame(self) -> Frame:
-        return max(self._recv_inputs.keys())
+        # cached: this is called several times per received message, and
+        # max() over the ring dict showed up in the session-loop profile
+        return self._last_recv_frame

@@ -307,9 +307,11 @@ class DeviceRequestExecutor:
         self._spec_rollbacks += 1
 
         if n_resim >= 1 and self._spec.window_valid(g, n_resim):
-            # ONE dispatch: hypothesis match + branch select, or the fallback
-            # replay — the host never reads which happened.
-            steps, sums = self._spec.fulfill(
+            # ONE dispatch for the whole rollback: hypothesis match + branch
+            # select (or the fallback replay — the host never reads which),
+            # plus re-anchoring the branches at frame g+1 and
+            # re-hypothesizing the still-unconfirmed tail.
+            steps, sums = self._spec.fulfill_and_refill(
                 g, arrays[:n_resim], load.cell.data(), self._with_checksums
             )
             for j in range(n_resim):
@@ -321,10 +323,6 @@ class DeviceRequestExecutor:
                     )
                     saves[j].cell.save(saves[j].frame, steps[j], cs)
             self._state = steps[n_resim - 1]
-            # re-anchor at frame g+1 (the steady-state target of the NEXT
-            # rollback) and re-hypothesize the still-unconfirmed tail — one
-            # fused dispatch
-            self._spec.refill(g + 1, steps[0], arrays[1:n_resim])
             if n_resim < m:  # the live advance (extends via _do_advance)
                 self._do_advance(pairs[-1], inputs=arrays[-1])
         else:

@@ -131,6 +131,7 @@ class SpeculativeRollback:
             _adv_ext, donate_argnums=(3, 4, 5) if on_tpu else ()
         )
         self._fulfill_cache: Dict[Tuple[int, bool], Any] = {}
+        self._fulfill_refill_cache: Dict[Tuple[int, bool], Any] = {}
         self._refill_cache: Dict[int, Any] = {}
         self._resolve_cache: Dict[int, Any] = {}
 
@@ -184,9 +185,84 @@ class SpeculativeRollback:
             prefix_buf.at[t].set(prev & step_ok),
         )
 
-    def _build_fulfill(self, n: int, with_checksums: bool):
+    def _resolve_window(
+        self,
+        traj_buf: Any,
+        inp_buf: Any,
+        prefix_buf: jax.Array,
+        offset: jax.Array,
+        load_state: Any,
+        confirmed: Any,  # [n, ...] stacked
+        n: int,
+        with_checksums: bool,
+    ):
+        """Traced core shared by every fulfill program: hypothesis matching,
+        branch selection, and the fallback replay as one ``lax.cond``.
+        Returns ``(steps, sums, hit)`` — the n per-step post-advance states,
+        their digests (or None), and the device hit flag."""
         from ..ops.checksum import checksum_device
 
+        sl = lambda buf: jax.tree_util.tree_map(
+            lambda b: jax.lax.dynamic_slice_in_dim(b, offset, n, axis=0),
+            buf,
+        )
+        win_inp, win_traj = sl(inp_buf), sl(traj_buf)
+        match = jnp.where(
+            offset > 0,
+            prefix_buf[jnp.maximum(offset - 1, 0)],
+            jnp.ones((self.K,), bool),
+        )
+        frame_at = lambda tree, t: jax.tree_util.tree_map(
+            lambda l: l[t], tree
+        )
+        for t in range(n):
+            match = match & self._match(
+                frame_at(win_inp, t), frame_at(confirmed, t)
+            )
+        hit = jnp.any(match)
+        idx = jnp.argmax(match)
+
+        def take_branch(_):
+            return jax.tree_util.tree_map(
+                lambda l: jax.lax.dynamic_index_in_dim(
+                    l, idx, axis=1, keepdims=False
+                ),
+                win_traj,
+            )
+
+        def replay(_):
+            def body(st: Any, inp: Any):
+                nxt = self._advance(st, inp)
+                return nxt, nxt
+
+            _, ys = jax.lax.scan(body, load_state, confirmed)
+            return ys
+
+        out = jax.lax.cond(hit, take_branch, replay, None)
+        steps = [frame_at(out, t) for t in range(n)]
+        sums = [checksum_device(s) for s in steps] if with_checksums else None
+        return steps, sums, hit
+
+    def _extend_scan(self, states0: Any, hyps: Any, session_inputs: Any):
+        """Traced core shared by refill programs: extend K branches over the
+        [m, K, ...] hypotheses, matching each step against the session's own
+        [m, ...] inputs.  Returns (states, traj, prefixes)."""
+
+        def body(carry, xs):
+            st, prefix = carry
+            hyp_k, sess = xs
+            nxt = jax.vmap(self._advance)(st, hyp_k)
+            prefix = prefix & self._match(hyp_k, sess)
+            return (nxt, prefix), (nxt, prefix)
+
+        (states, _), (traj, prefixes) = jax.lax.scan(
+            body,
+            (states0, jnp.ones((self.K,), bool)),
+            (hyps, session_inputs),
+        )
+        return states, traj, prefixes
+
+    def _build_fulfill(self, n: int, with_checksums: bool):
         def fulfill(
             traj_buf: Any,
             inp_buf: Any,
@@ -196,71 +272,71 @@ class SpeculativeRollback:
             confirmed: Any,  # [n, ...] stacked
             hit_count: jax.Array,
         ):
-            sl = lambda buf: jax.tree_util.tree_map(
-                lambda b: jax.lax.dynamic_slice_in_dim(b, offset, n, axis=0),
-                buf,
-            )
-            win_inp, win_traj = sl(inp_buf), sl(traj_buf)
-            match = jnp.where(
-                offset > 0,
-                prefix_buf[jnp.maximum(offset - 1, 0)],
-                jnp.ones((self.K,), bool),
-            )
-            frame_at = lambda tree, t: jax.tree_util.tree_map(
-                lambda l: l[t], tree
-            )
-            for t in range(n):
-                match = match & self._match(
-                    frame_at(win_inp, t), frame_at(confirmed, t)
-                )
-            hit = jnp.any(match)
-            idx = jnp.argmax(match)
-
-            def take_branch(_):
-                return jax.tree_util.tree_map(
-                    lambda l: jax.lax.dynamic_index_in_dim(
-                        l, idx, axis=1, keepdims=False
-                    ),
-                    win_traj,
-                )
-
-            def replay(_):
-                def body(st: Any, inp: Any):
-                    nxt = self._advance(st, inp)
-                    return nxt, nxt
-
-                _, ys = jax.lax.scan(body, load_state, confirmed)
-                return ys
-
-            out = jax.lax.cond(hit, take_branch, replay, None)
-            steps = [frame_at(out, t) for t in range(n)]
-            sums = (
-                [checksum_device(s) for s in steps] if with_checksums else None
+            steps, sums, hit = self._resolve_window(
+                traj_buf, inp_buf, prefix_buf, offset, load_state,
+                confirmed, n, with_checksums,
             )
             return steps, sums, hit_count + hit.astype(jnp.uint32)
 
         return jax.jit(fulfill)
+
+    def _build_fulfill_refill(self, n: int, with_checksums: bool):
+        """fulfill + re-anchor + re-extend as ONE program: the rollback's
+        resolve-or-replay, rooting the branches at the window's first frame,
+        and re-hypothesizing the confirmed tail — so a speculative rollback
+        costs exactly one dispatch, the same as the plain fused replay."""
+        m = n - 1
+        on_tpu = jax.default_backend() == "tpu"
+
+        def fused(
+            traj_buf: Any,
+            inp_buf: Any,
+            prefix_buf: jax.Array,
+            offset: jax.Array,
+            load_state: Any,
+            confirmed: Any,  # [n, ...] stacked
+            hyps: Any,  # [m, K, ...] stacked (None when m=0)
+            hit_count: jax.Array,
+        ):
+            steps, sums, hit = self._resolve_window(
+                traj_buf, inp_buf, prefix_buf, offset, load_state,
+                confirmed, n, with_checksums,
+            )
+            # re-anchor at steps[0] and extend the confirmed tail
+            states = self._root_impl(steps[0])
+            if m:
+                tail = jax.tree_util.tree_map(lambda l: l[1:], confirmed)
+                states, traj, prefixes = self._extend_scan(states, hyps, tail)
+                put = lambda buf, val: jax.tree_util.tree_map(
+                    lambda b, v: jax.lax.dynamic_update_slice_in_dim(
+                        b, v, 0, axis=0
+                    ),
+                    buf,
+                    val,
+                )
+                traj_buf = put(traj_buf, traj)
+                inp_buf = put(inp_buf, hyps)
+                prefix_buf = jax.lax.dynamic_update_slice_in_dim(
+                    prefix_buf, prefixes, 0, axis=0
+                )
+            return (
+                steps,
+                sums,
+                hit_count + hit.astype(jnp.uint32),
+                states,
+                traj_buf,
+                inp_buf,
+                prefix_buf,
+            )
+
+        return jax.jit(fused, donate_argnums=(0, 1, 2) if on_tpu else ())
 
     def _build_refill(self, m: int):
         def refill(root_state: Any, hyps: Any, session_inputs: Any):
             """Re-anchor at ``root_state`` and extend ``m`` steps under
             ``hyps`` ([m, K, ...]), matching against ``session_inputs``
             ([m, ...]); returns (states, traj [m,K,...], prefix [m,K])."""
-            states0 = self._root_impl(root_state)
-
-            def body(carry, xs):
-                states, prefix = carry
-                hyp_k, sess = xs
-                nxt = jax.vmap(self._advance)(states, hyp_k)
-                prefix = prefix & self._match(hyp_k, sess)
-                return (nxt, prefix), (nxt, prefix)
-
-            (states, _), (traj, prefixes) = jax.lax.scan(
-                body,
-                (states0, jnp.ones((self.K,), bool)),
-                (hyps, session_inputs),
-            )
-            return states, traj, prefixes
+            return self._extend_scan(self._root_impl(root_state), hyps, session_inputs)
 
         return jax.jit(refill)
 
@@ -421,6 +497,66 @@ class SpeculativeRollback:
         )
         return steps, sums
 
+    def fulfill_and_refill(
+        self,
+        frame: int,
+        confirmed: Sequence[Any],
+        load_state: Any,
+        with_checksums: bool,
+    ) -> Tuple[List[Any], Optional[List[Any]]]:
+        """``fulfill`` plus the post-rollback re-anchor/re-extend in ONE
+        dispatch: resolve-or-replay the window, root the branches at
+        ``frame + 1`` (the next rollback's steady-state target), and
+        re-hypothesize the still-unconfirmed tail.  Same return value as
+        ``fulfill``; the window afterwards equals ``refill(frame + 1,
+        steps[0], confirmed[1:])``."""
+        n = len(confirmed)
+        assert self.window_valid(frame, n)
+        m = n - 1
+        hyps = None
+        if m:
+            hyps = _stack_pytrees(
+                [
+                    _stack_pytrees(
+                        [
+                            self._branch_inputs(
+                                k, frame + 1 + t, confirmed[1 + t]
+                            )
+                            for t in range(m)
+                        ]
+                    )
+                    for k in range(self.K)
+                ]
+            )
+            hyps = _swap01(hyps)  # [m, K, ...]
+        key = (n, with_checksums)
+        fn = self._fulfill_refill_cache.get(key)
+        if fn is None:
+            fn = self._fulfill_refill_cache[key] = self._build_fulfill_refill(
+                n, with_checksums
+            )
+        (
+            steps,
+            sums,
+            self._hit_count,
+            self._states,
+            self._traj_buf,
+            self._inp_buf,
+            self._prefix_buf,
+        ) = fn(
+            self._traj_buf,
+            self._inp_buf,
+            self._prefix_buf,
+            np.int32(frame - self._root_frame),
+            load_state,
+            _stack_pytrees(confirmed),
+            hyps,
+            self._hit_count,
+        )
+        self._root_frame = frame + 1
+        self._count = m
+        return steps, sums
+
     def refill(self, frame: int, state: Any, local_inputs: Sequence[Any]) -> None:
         """Re-anchor at ``(frame, state)`` and re-extend the still-unconfirmed
         tail (``local_inputs``, one per frame from ``frame`` on) as one fused
@@ -503,10 +639,9 @@ class SpeculativeRollback:
                 self.root(0, state)
                 for _ in range(n):
                     self.extend(example_inputs)
-                self.fulfill(
+                self.fulfill_and_refill(
                     0, [example_inputs] * n, state, with_checksums
                 )
-                self.refill(1, state, [example_inputs] * (n - 1))
             jax.block_until_ready(self._states)
         finally:
             (

@@ -16,7 +16,7 @@ from typing import Callable, Generic, Hashable, List, Optional, TypeVar
 from ..core.config import Config
 from ..core.errors import InvalidRequest
 from ..core.types import DesyncDetection, Local, PlayerHandle, PlayerType, Remote, Spectator
-from ..net.protocol import PeerProtocol, monotonic_ms
+from ..net.protocol import DEFAULT_SYNC_TIMEOUT_MS, PeerProtocol, monotonic_ms
 from ..net.sockets import NonBlockingSocket
 from .p2p import P2PSession, PlayerRegistry
 from .spectator import SPECTATOR_BUFFER_SIZE, SpectatorSession
@@ -57,6 +57,7 @@ class SessionBuilder(Generic[I, S, A]):
         self._clock: Callable[[], int] = monotonic_ms
         self._rng: Optional[random.Random] = None
         self._sync_handshake = False  # fork parity: no handshake by default
+        self._sync_timeout_ms = DEFAULT_SYNC_TIMEOUT_MS
 
     # ------------------------------------------------------------------
     # players
@@ -133,6 +134,16 @@ class SessionBuilder(Generic[I, S, A]):
         Synchronizing/Synchronized event vocabulary back into real events.
         Default off (wire-compatible with handshake-less peers)."""
         self._sync_handshake = enabled
+        return self
+
+    def with_sync_timeout(self, timeout_ms: int) -> "SessionBuilder[I, S, A]":
+        """How long handshaking endpoints probe for a peer that hasn't
+        appeared before surfacing Disconnected (default 60s — generous, since
+        tolerating slow starts is the handshake's purpose, but bounded so a
+        dead address doesn't hang the session forever)."""
+        if timeout_ms <= 0:
+            raise InvalidRequest("Sync timeout must be positive.")
+        self._sync_timeout_ms = timeout_ms
         return self
 
     def with_disconnect_timeout(self, timeout_ms: int) -> "SessionBuilder[I, S, A]":
@@ -247,6 +258,7 @@ class SessionBuilder(Generic[I, S, A]):
             clock=self._clock,
             rng=self._rng,
             sync_required=self._sync_handshake,
+            sync_timeout_ms=self._sync_timeout_ms,
         )
         return SpectatorSession(
             config=self._config,
@@ -287,4 +299,5 @@ class SessionBuilder(Generic[I, S, A]):
             clock=self._clock,
             rng=self._rng,
             sync_required=self._sync_handshake,
+            sync_timeout_ms=self._sync_timeout_ms,
         )

@@ -139,6 +139,33 @@ class TestSyncHandshake:
         assert sess1.current_state() is SessionState.RUNNING
         assert sess2.current_state() is SessionState.RUNNING
 
+    def test_sync_timeout_surfaces_disconnected_for_dead_peer(self):
+        """Probing is bounded: a peer that never appears (dead address)
+        eventually surfaces Disconnected instead of hanging the session in
+        SYNCHRONIZING forever (review finding, round 3).  The default is a
+        generous 60s; here we shorten it via with_sync_timeout."""
+        clock_now = [0]
+        net = InMemoryNetwork()
+        sess = (
+            SessionBuilder(stub_config())
+            .with_clock(lambda: clock_now[0])
+            .with_rng(random.Random(4))
+            .with_sync_handshake(True)
+            .with_sync_timeout(3_000)
+            .add_player(Local(), 0)
+            .add_player(Remote("NOBODY"), 1)
+            .start_p2p_session(net.socket("A"))
+        )
+        events = []
+        for _ in range(40):
+            clock_now[0] += 100
+            sess.poll_remote_clients()
+            events.extend(sess.events())
+        names = [type(e).__name__ for e in events]
+        assert "Disconnected" in names
+        # before the deadline there must be no disconnect noise
+        assert "NetworkInterrupted" not in names
+
     def test_handshake_completes_when_rtt_exceeds_retry_interval(self):
         """The probe nonce is per round trip, not per send: with RTT above
         the 200ms retry interval every reply arrives after a retry has gone
