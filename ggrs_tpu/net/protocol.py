@@ -217,6 +217,7 @@ class PeerProtocol(Generic[I, A]):
         self._sync_remaining = NUM_SYNC_PACKETS
         self._sync_random = 0
         self._last_sync_request_time: Optional[int] = None
+        self._sync_timeout = sync_timeout_ms
         self._sync_deadline = now + sync_timeout_ms
 
         self.peer_connect_status: List[ConnectionStatus] = [
@@ -497,6 +498,10 @@ class PeerProtocol(Generic[I, A]):
             return  # stale reply to an earlier round: ignore
         self._sync_random = 0  # round complete; next send starts a new one
         self._sync_remaining -= 1
+        # progress extends the deadline: the timeout bounds true silence, not
+        # total handshake duration (5 round trips on a high-RTT link may
+        # legitimately take longer than one timeout)
+        self._sync_deadline = self._clock() + self._sync_timeout
         self._event_queue.append(
             EvSynchronizing(
                 total=NUM_SYNC_PACKETS,

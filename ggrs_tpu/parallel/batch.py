@@ -111,6 +111,7 @@ class BatchedSessions:
         axis_names = tuple(self.mesh.axis_names)
         spec_b = P(axis_names)
         sharding = NamedSharding(self.mesh, spec_b)
+        self._sharding = sharding  # kept for checkpoint restore
 
         # one carry per session, stacked on a leading B axis and sharded
         carry0 = self._programs.init_carry(init_state, input_template)
@@ -206,6 +207,53 @@ class BatchedSessions:
     def live_states(self) -> Any:
         """All B live states, gathered to host (leading axis B)."""
         return jax.device_get(self._carry["live"])
+
+    # ------------------------------------------------------------------
+    # durable checkpoints (beyond the reference — SURVEY §5 checkpoint note):
+    # the whole batch's sharded carry gathers to host and resumes bit-exactly
+    # on any mesh of the same total device count divisor (batch_size checks)
+    # ------------------------------------------------------------------
+
+    def save_checkpoint(self, path: str) -> None:
+        """Write every session's carry + the tick counter to ``path``."""
+        from ..utils.checkpoint import save_pytree
+
+        save_pytree(
+            path,
+            self._carry,
+            {
+                "ticks_run": self._ticks_run,
+                "check_distance": self.check_distance,
+                "batch_size": self.batch_size,
+            },
+        )
+
+    def load_checkpoint(self, path: str) -> None:
+        """Restore a checkpoint written by ``save_checkpoint`` into this
+        batch (same game, batch_size, and check_distance; the mesh may
+        differ — leaves are re-placed under this batch's sharding)."""
+        from ..core.errors import InvalidRequest
+        from ..utils.checkpoint import load_pytree
+
+        carry, meta = load_pytree(path, self._carry)
+        if meta["check_distance"] != self.check_distance:
+            raise InvalidRequest(
+                f"checkpoint was taken at check_distance="
+                f"{meta['check_distance']}, batch uses {self.check_distance}"
+            )
+        if meta["batch_size"] != self.batch_size:
+            raise InvalidRequest(
+                f"checkpoint holds {meta['batch_size']} sessions, batch was "
+                f"built for {self.batch_size}"
+            )
+        # device_put straight from the host arrays: shards across the mesh in
+        # one step (jnp.asarray first would commit each leaf to one device
+        # and then reshard device-to-device — wasted copies on restore)
+        self._carry = jax.tree_util.tree_map(
+            lambda leaf: jax.device_put(leaf, self._sharding), carry
+        )
+        self._ticks_run = int(meta["ticks_run"])
+        self._last_stats = None
 
     def block_until_ready(self) -> None:
         jax.block_until_ready(self._carry)

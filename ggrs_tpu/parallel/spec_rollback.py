@@ -364,6 +364,26 @@ class SpeculativeRollback:
         ]
         return _stack_pytrees(per_branch)
 
+    def _window_hypotheses(self, frame: int, inputs_seq: Sequence[Any]) -> Any:
+        """Hypotheses for a whole window as ``[m, K, ...]``: branch k's
+        inputs for frames ``frame + t`` built from ``inputs_seq[t]``.  Shared
+        by ``refill`` and ``fulfill_and_refill`` — their windows must stay
+        frame-offset-identical for the fused program's promise
+        ("equals refill(frame + 1, steps[0], confirmed[1:])") to hold."""
+        hyps = _stack_pytrees(
+            [
+                _stack_pytrees(
+                    [
+                        self._branch_inputs(k, frame + t, inputs_seq[t])
+                        for t in range(len(inputs_seq))
+                    ]
+                )
+                for k in range(self.K)
+            ]
+        )
+        # built as [K, m, ...]; scan wants [m, K, ...]
+        return _swap01(hyps)
+
     # ------------------------------------------------------------------
     # public API
     # ------------------------------------------------------------------
@@ -513,22 +533,9 @@ class SpeculativeRollback:
         n = len(confirmed)
         assert self.window_valid(frame, n)
         m = n - 1
-        hyps = None
-        if m:
-            hyps = _stack_pytrees(
-                [
-                    _stack_pytrees(
-                        [
-                            self._branch_inputs(
-                                k, frame + 1 + t, confirmed[1 + t]
-                            )
-                            for t in range(m)
-                        ]
-                    )
-                    for k in range(self.K)
-                ]
-            )
-            hyps = _swap01(hyps)  # [m, K, ...]
+        hyps = (
+            self._window_hypotheses(frame + 1, confirmed[1:]) if m else None
+        )
         key = (n, with_checksums)
         fn = self._fulfill_refill_cache.get(key)
         if fn is None:
@@ -568,19 +575,7 @@ class SpeculativeRollback:
             self._states = self._root_fn(state)
             self._count = 0
             return
-        hyps = _stack_pytrees(
-            [
-                _stack_pytrees(
-                    [
-                        self._branch_inputs(k, frame + t, local_inputs[t])
-                        for t in range(m)
-                    ]
-                )
-                for k in range(self.K)
-            ]
-        )
-        # scan wants [m, K, ...]: swap the (K, m) stacking order
-        hyps = _swap01(hyps)
+        hyps = self._window_hypotheses(frame, local_inputs)
         sess = _stack_pytrees(local_inputs)
         fn = self._refill_cache.get(m)
         if fn is None:

@@ -64,6 +64,39 @@ class DeviceSyncTestSession:
         self.check_distance = check_distance
 
     # ------------------------------------------------------------------
+    # durable checkpoints (beyond the reference, whose save/load machinery
+    # is in-memory only — SURVEY §5 checkpoint note)
+    # ------------------------------------------------------------------
+
+    def save_checkpoint(self, path: str) -> None:
+        """Write the full session carry (state/input/checksum rings, live
+        state, desync counters) plus the tick counter to ``path``; a fresh
+        session with the same game/config resumes bit-exactly via
+        ``load_checkpoint``."""
+        from ..utils.checkpoint import save_pytree
+
+        save_pytree(
+            path,
+            self._carry,
+            {"ticks_run": self._ticks_run, "check_distance": self.check_distance},
+        )
+
+    def load_checkpoint(self, path: str) -> None:
+        """Restore a checkpoint written by ``save_checkpoint``.  The session
+        must have been constructed with the same game and config (leaf
+        shapes/dtypes and check_distance are validated)."""
+        from ..utils.checkpoint import load_pytree
+
+        carry, meta = load_pytree(path, self._carry)
+        if meta["check_distance"] != self.check_distance:
+            raise InvalidRequest(
+                f"checkpoint was taken at check_distance="
+                f"{meta['check_distance']}, session uses {self.check_distance}"
+            )
+        self._carry = jax.tree_util.tree_map(jnp.asarray, carry)
+        self._ticks_run = int(meta["ticks_run"])
+
+    # ------------------------------------------------------------------
 
     @property
     def current_frame(self) -> int:

@@ -1,0 +1,54 @@
+"""Durable checkpoints for device sessions.
+
+The reference's save/load machinery is an in-memory checkpoint system only —
+a ring of ``max_prediction + 1`` cells that dies with the process
+(/root/reference/src/sync_layer.rs:144-166; "nothing persists to disk" per
+SURVEY §5).  On TPU, long-running resimulation/batch jobs run on preemptible
+hardware, so the device sessions additionally support writing their entire
+carry (state ring, input ring, checksum history, live state, desync
+counters) to disk and resuming bit-exactly in a fresh process.
+
+Format: a single ``.npz`` with the carry's flattened leaves plus a JSON
+metadata record (tick counter, config fingerprint).  Loading validates the
+fingerprint so a checkpoint can't silently resume under a different program
+(different check_distance or batch size would corrupt the ring semantics).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+import jax
+
+
+def save_pytree(path: str, tree: Any, meta: Dict[str, Any]) -> None:
+    """Write a pytree's leaves (fetched to host) + JSON metadata to ``path``."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    arrs = {f"leaf_{i}": np.asarray(jax.device_get(l)) for i, l in enumerate(leaves)}
+    np.savez_compressed(path, __meta__=np.asarray(json.dumps(meta)), **arrs)
+
+
+def load_pytree(path: str, template: Any) -> Tuple[Any, Dict[str, Any]]:
+    """Read leaves saved by :func:`save_pytree` back into ``template``'s
+    structure (shapes/dtypes must match) and return ``(tree, meta)``."""
+    with np.load(path, allow_pickle=False) as data:
+        meta = json.loads(str(data["__meta__"][()]))
+        leaves, treedef = jax.tree_util.tree_flatten(template)
+        loaded = []
+        for i, ref in enumerate(leaves):
+            arr = data[f"leaf_{i}"]
+            # .shape/.dtype read without materializing the leaf — np.asarray
+            # here would gather the whole live carry to host just to compare
+            ref_shape = np.shape(ref)
+            ref_dtype = np.dtype(getattr(ref, "dtype", type(ref)))
+            if arr.shape != ref_shape or arr.dtype != ref_dtype:
+                raise ValueError(
+                    f"checkpoint leaf {i} is {arr.dtype}{arr.shape}, session "
+                    f"expects {ref_dtype}{ref_shape} — wrong session config "
+                    "for this checkpoint?"
+                )
+            loaded.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, loaded), meta
