@@ -24,19 +24,34 @@ import numpy as np
 import jax
 
 
+def _normalize(path: str) -> str:
+    """np.savez appends ``.npz`` to extension-less paths; normalize here so
+    save/load agree on the filename whichever form the caller used."""
+    return path if path.endswith(".npz") else path + ".npz"
+
+
 def save_pytree(path: str, tree: Any, meta: Dict[str, Any]) -> None:
     """Write a pytree's leaves (fetched to host) + JSON metadata to ``path``."""
     leaves = jax.tree_util.tree_leaves(tree)
-    arrs = {f"leaf_{i}": np.asarray(jax.device_get(l)) for i, l in enumerate(leaves)}
-    np.savez_compressed(path, __meta__=np.asarray(json.dumps(meta)), **arrs)
+    host = jax.device_get(leaves)  # ONE transfer for the whole tree
+    arrs = {f"leaf_{i}": np.asarray(l) for i, l in enumerate(host)}
+    np.savez_compressed(
+        _normalize(path), __meta__=np.asarray(json.dumps(meta)), **arrs
+    )
 
 
 def load_pytree(path: str, template: Any) -> Tuple[Any, Dict[str, Any]]:
     """Read leaves saved by :func:`save_pytree` back into ``template``'s
     structure (shapes/dtypes must match) and return ``(tree, meta)``."""
-    with np.load(path, allow_pickle=False) as data:
+    with np.load(_normalize(path), allow_pickle=False) as data:
         meta = json.loads(str(data["__meta__"][()]))
         leaves, treedef = jax.tree_util.tree_flatten(template)
+        n_saved = sum(1 for k in data.files if k.startswith("leaf_"))
+        if n_saved != len(leaves):
+            raise ValueError(
+                f"checkpoint holds {n_saved} leaves, session expects "
+                f"{len(leaves)} — wrong session config for this checkpoint?"
+            )
         loaded = []
         for i, ref in enumerate(leaves):
             arr = data[f"leaf_{i}"]

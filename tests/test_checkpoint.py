@@ -49,6 +49,24 @@ class TestDeviceSynctestCheckpoint:
         for k in ("pos", "vel", "rot"):
             np.testing.assert_array_equal(np.asarray(sa[k]), np.asarray(sb[k]))
 
+    def test_extensionless_path_round_trips(self, tmp_path):
+        """np.savez silently appends .npz; save/load must agree on the name
+        whichever form the caller used (review finding, round 3)."""
+        game = BoxGame(2)
+        path = str(tmp_path / "ckpt")  # no extension
+        a = DeviceSyncTestSession(
+            game.advance, game.init_state(), jnp.zeros((2,), jnp.uint8),
+            check_distance=2,
+        )
+        a.run_ticks(_inputs(6, 2, seed=9))
+        a.save_checkpoint(path)
+        b = DeviceSyncTestSession(
+            game.advance, game.init_state(), jnp.zeros((2,), jnp.uint8),
+            check_distance=2,
+        )
+        b.load_checkpoint(path)
+        assert b.current_frame == 6
+
     def test_wrong_config_rejected(self, tmp_path):
         game = BoxGame(2)
         path = str(tmp_path / "sess.npz")

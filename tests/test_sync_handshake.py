@@ -189,6 +189,40 @@ class TestSyncHandshake:
         assert sess1.current_state() is SessionState.RUNNING
         assert sess2.current_state() is SessionState.RUNNING
 
+    def test_sync_timeout_bounds_silence_not_total_duration(self):
+        """Five round trips on a high-RTT link can exceed one sync timeout;
+        a peer making progress must not be disconnected mid-handshake — the
+        deadline extends on every completed round (review finding, round 3)."""
+        clock_now = [0]
+        net = InMemoryNetwork(latency_ticks=3)  # RTT 600ms at 100ms/loop
+        sessions = []
+        for me, other, local_handle in (("A", "B", 0), ("B", "A", 1)):
+            sessions.append(
+                SessionBuilder(stub_config())
+                .with_clock(lambda: clock_now[0])
+                .with_rng(random.Random(13 + local_handle))
+                .with_sync_handshake(True)
+                .with_sync_timeout(1_500)  # < 5 round trips x 600ms RTT
+                .add_player(Local(), local_handle)
+                .add_player(Remote(other), 1 - local_handle)
+                .start_p2p_session(net.socket(me))
+            )
+        sess1, sess2 = sessions
+        for _ in range(100):
+            clock_now[0] += 100
+            net.tick()
+            sess1.poll_remote_clients()
+            sess2.poll_remote_clients()
+            if (
+                sess1.current_state() is SessionState.RUNNING
+                and sess2.current_state() is SessionState.RUNNING
+            ):
+                break
+        assert sess1.current_state() is SessionState.RUNNING
+        assert sess2.current_state() is SessionState.RUNNING
+        names = {type(e).__name__ for e in sess1.events()}
+        assert "Disconnected" not in names
+
     def test_spectator_handshake(self):
         net = InMemoryNetwork()
         host = (
