@@ -17,6 +17,7 @@ fingerprint so a checkpoint can't silently resume under a different program
 from __future__ import annotations
 
 import json
+import os
 from typing import Any, Dict, Tuple
 
 import numpy as np
@@ -24,9 +25,11 @@ import numpy as np
 import jax
 
 
-def _normalize(path: str) -> str:
+def _normalize(path) -> str:
     """np.savez appends ``.npz`` to extension-less paths; normalize here so
-    save/load agree on the filename whichever form the caller used."""
+    save/load agree on the filename whichever form (str or PathLike) the
+    caller used."""
+    path = os.fspath(path)
     return path if path.endswith(".npz") else path + ".npz"
 
 
