@@ -95,6 +95,16 @@ def _drive(sessions, executors, ticks, record_loads=None, drain=12):
         ex_b.run(sess_b.advance_frame())
 
 
+def _assert_peers_identical(sessions, executors):
+    """Both peers reached the same frame with bit-identical device states."""
+    assert sessions[0].current_frame == sessions[1].current_frame
+    ex_a, ex_b = executors
+    for k in ("pos", "vel", "rot"):
+        np.testing.assert_array_equal(
+            np.asarray(ex_a.state[k]), np.asarray(ex_b.state[k]), err_msg=k
+        )
+
+
 def _oracle_spec(game):
     """K=2: branch 0 trusts the session's prediction, branch 1 knows peer B's
     actual schedule (a deterministic stand-in for a good guesser)."""
@@ -137,11 +147,7 @@ class TestSpeculativeP2P:
         assert bursts["n"] == 0, "a hit must not dispatch the replay scan"
 
         # speculative fulfillment is bit-identical to peer B's plain replay
-        assert sessions[0].current_frame == sessions[1].current_frame
-        for k in ("pos", "vel", "rot"):
-            np.testing.assert_array_equal(
-                np.asarray(ex_a.state[k]), np.asarray(ex_b.state[k]), err_msg=k
-            )
+        _assert_peers_identical(sessions, executors)
 
     def test_miss_falls_back_to_replay(self):
         net = InMemoryNetwork()
@@ -157,11 +163,7 @@ class TestSpeculativeP2P:
         # misses dispatch the fused replay (depth-1 rollbacks use the single-
         # advance path, so bursts may be fewer than misses but states must
         # still match)
-        assert sessions[0].current_frame == sessions[1].current_frame
-        for k in ("pos", "vel", "rot"):
-            np.testing.assert_array_equal(
-                np.asarray(ex_a.state[k]), np.asarray(ex_b.state[k]), err_msg=k
-            )
+        _assert_peers_identical(sessions, executors)
 
     def test_sparse_saving_with_speculation_stays_correct(self):
         """Sparse saving produces rollback bursts with few (or oddly placed)
@@ -190,12 +192,37 @@ class TestSpeculativeP2P:
             sessions.append(sess)
 
         _drive(sessions, executors, 40)
-        ex_a, ex_b = executors
-        assert sessions[0].current_frame == sessions[1].current_frame
-        for k in ("pos", "vel", "rot"):
-            np.testing.assert_array_equal(
-                np.asarray(ex_a.state[k]), np.asarray(ex_b.state[k]), err_msg=k
+        _assert_peers_identical(sessions, executors)
+
+    def test_speculation_under_packet_loss_mixes_hits_and_fallbacks(self):
+        """Lossy network + a deterministically IMPERFECT oracle (wrong on
+        every 5th frame): rollback windows containing a bad-guess frame take
+        the miss/fallback + invalidate + re-anchor path, the rest hit — both
+        paths must execute under loss-deepened irregular rollbacks, and the
+        peers must still drain to bit-identical states."""
+
+        def flaky_oracle(game):
+            def branch_inputs(k, frame, arr):
+                if k == 0:
+                    return jnp.asarray(arr, jnp.uint8)
+                guess = _b_sched(frame) ^ (0 if frame % 5 else 1)
+                return jnp.asarray(arr, jnp.uint8).at[1].set(np.uint8(guess))
+
+            return SpeculativeRollback(
+                game.advance, 2, branch_inputs, max_window=8
             )
+
+        net = InMemoryNetwork(loss=0.25, seed=37)
+        game, sessions, executors = _make_2p_pair(net, flaky_oracle)
+        ex_a, ex_b = executors
+
+        _drive(sessions, executors, 120, drain=40)
+
+        assert ex_a.spec_hits > 0, "clean windows must hit a branch"
+        assert ex_a.spec_misses > 0, (
+            "windows containing a bad-guess frame must take the fallback path"
+        )
+        _assert_peers_identical(sessions, executors)
 
     def test_four_players_eight_branches(self):
         """BASELINE config 3's exact shape: 4 players, 8-frame prediction,
