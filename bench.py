@@ -12,12 +12,24 @@ publishes no numbers (BASELINE.md), so every ``vs_baseline`` is the ratio of
 the measured path to the equivalent host/NumPy request loop on this machine
 (config 3: ratio to the same P2P loop with speculation disabled).  The
 flagship config-2 line prints LAST.
+
+PROCESS ISOLATION: with no argument, this script re-execs itself once per
+config (``python bench.py <config>``) and forwards each child's JSON line.
+Measured necessity, not hygiene: the tunneled-TPU client's dispatch path
+degrades irreversibly *process-wide* from events earlier in the run (a single
+D2H read costs ~50× dispatch throughput permanently; long runs drift further).
+In round 2 the two configs measured last in a shared process recorded
+~1000× under their isolated numbers.  A fresh process per config starts with
+a fresh tunnel client, so no config inherits another's degradation.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import random
+import subprocess
+import sys
 import time
 import zlib
 
@@ -32,6 +44,18 @@ from ggrs_tpu.sessions import DeviceSyncTestSession
 CHECK_DISTANCE = 8
 PLAYERS = 2
 REPEATS = 3  # timed passes per config; best-of counters tunnel drift
+
+# config name -> (function name, per-child wall-clock budget in seconds).
+# Print/exec order; the flagship runs and prints LAST (the driver reads the
+# final line as the headline metric).
+CONFIGS = {
+    "host_cd2": ("run_host_cd2", 600),
+    "spec_p2p": ("run_spec_p2p", 1500),
+    "ecs": ("run_ecs", 1200),
+    "chipvm256": ("run_chipvm256", 1200),
+    "pallas_checksum": ("run_pallas_checksum", 900),
+    "flagship": ("run_flagship", 1200),
+}
 
 
 def _inputs(n: int, players: int, seed: int) -> np.ndarray:
@@ -150,19 +174,25 @@ def bench_host_synctest(game, players: int, d: int, ticks: int, seed: int = 7) -
 # ---------------------------------------------------------------------------
 
 
-def _speculative_p2p_setup(speculate: bool) -> tuple:
+def _speculative_p2p_setup(speculate: bool, game=None, programs=None) -> tuple:
     """Four P2P peers over the in-memory net, each fulfilling requests with a
     device executor; peer 0 optionally speculates with 8 branches.  Returns
-    (tick_fn, executors)."""
+    (tick_fn, executors).  Pass the same ``game`` + shared ``ExecutorPrograms``
+    to both variants so all eight executors compile the burst/advance programs
+    once — on a remote-compile tunnel each duplicate compile costs ~1s wall
+    clock."""
     from ggrs_tpu.core import Local, Remote
     from ggrs_tpu.net import InMemoryNetwork
-    from ggrs_tpu.ops import DeviceRequestExecutor
+    from ggrs_tpu.ops import DeviceRequestExecutor, ExecutorPrograms
     from ggrs_tpu.parallel import SpeculativeRollback
     from ggrs_tpu.sessions import SessionBuilder
 
-    game = BoxGame(4)
+    if game is None:
+        game = BoxGame(4)
     peers = ["P0", "P1", "P2", "P3"]
     max_prediction = 8  # BASELINE config 3: 8-frame prediction window
+    if programs is None:
+        programs = ExecutorPrograms(game.advance, with_checksums=False)
 
     def sched(player, i):
         return ((i + player) // 3) % 16  # transitions force regular rollbacks
@@ -181,6 +211,16 @@ def _speculative_p2p_setup(speculate: bool) -> tuple:
             out[1:] = [sched(p, frame) for p in (1, 2, 3)]
         return out
 
+    hyp_base = np.zeros((8, 4), np.uint8)
+    hyp_base[:7, 1:] = np.arange(7, dtype=np.uint8)[:, None]
+
+    def branch_inputs_all(frame, arr):
+        # vectorized: all 8 hypotheses in one [K, players] array build
+        out = hyp_base.copy()
+        out[:, 0] = arr[0]
+        out[7, 1:] = [sched(p, frame) for p in (1, 2, 3)]
+        return out
+
     net = InMemoryNetwork()
     sessions, executors = [], []
     for me in range(4):
@@ -195,13 +235,16 @@ def _speculative_p2p_setup(speculate: bool) -> tuple:
             b = b.add_player(Local() if p == me else Remote(peers[p]), p)
         sessions.append(b.start_p2p_session(net.socket(peers[me])))
         spec = (
-            SpeculativeRollback(game.advance, 8, branch_inputs, max_window=8)
+            SpeculativeRollback(
+                game.advance, 8, branch_inputs, max_window=8,
+                branch_inputs_all=branch_inputs_all,
+            )
             if (speculate and me == 0)
             else None
         )
         ex = DeviceRequestExecutor(
             game.advance, game.init_state(), to_arr,
-            with_checksums=False, speculation=spec,
+            with_checksums=False, speculation=spec, programs=programs,
         )
         # pre-compile everything (advance, bursts, speculation programs):
         # no jit compile may land inside the timed loop; the deepest burst
@@ -230,8 +273,14 @@ def bench_speculative_p2p(seg_ticks: int = 100, segments: int = 4) -> tuple:
     that PERMANENTLY degrades this process's dispatch throughput on a
     tunneled TPU, so the caller must not invoke it until every timed
     measurement in the process has finished."""
+    from ggrs_tpu.ops import ExecutorPrograms
+
+    game = BoxGame(4)
+    shared = ExecutorPrograms(game.advance, with_checksums=False)
     variants = {
-        name: _speculative_p2p_setup(speculate=(name == "spec"))
+        name: _speculative_p2p_setup(
+            speculate=(name == "spec"), game=game, programs=shared
+        )
         for name in ("spec", "plain")
     }
     counters = {name: 0 for name in variants}
@@ -315,72 +364,179 @@ def bench_batched_chipvm(batch: int, total_ticks: int, chunk: int, d: int) -> fl
 # ---------------------------------------------------------------------------
 
 
-def main() -> None:
-    backend = jax.default_backend()
-    on_tpu = backend == "tpu"
+# ---------------------------------------------------------------------------
+# per-config entry points (each runs in its own process; see module docstring)
+# ---------------------------------------------------------------------------
 
-    # MEASUREMENT order: every timed device config — including the
-    # dispatch-rate-sensitive speculative P2P loop — runs BEFORE the first
-    # device→host read.  On a tunneled TPU, one D2H permanently drops the
-    # process's dispatch throughput ~50×: measured here, ~80k dispatches/sec
-    # before the first read, ~1k/sec after, unrecoverable even by
-    # clearing/rebuilding JAX backends (the regression lives in the tunnel
-    # daemon, not the client).  All verifies/stat fetches happen at the end.
-    # PRINT order: configs 1, 3, 4, 5, then the flagship config 2 last.
 
-    # config 2 (flagship): BoxGame device synctest at cd=8 — measured FIRST
+def _on_tpu() -> bool:
+    return jax.default_backend() == "tpu"
+
+
+def run_host_cd2() -> None:
+    """Config 1: the reference-shaped CPU request loop — the 1× denominator."""
+    host_cd2 = bench_host_synctest(BoxGame(PLAYERS), PLAYERS, d=2, ticks=600)
+    emit("boxgame_synctest_host_resim_frames_per_sec_cd2", host_cd2,
+         "resim_frames/sec", 1.0)
+
+
+def run_spec_p2p() -> None:
+    """Config 3: speculative P2P vs the same loop with speculation off.  The
+    whole live path (fused resolve-or-replay, lazy checksums, device hit
+    counters) performs zero D2H, so both variants run at full dispatch rate;
+    the stats fetch (a D2H read) happens after all timing."""
+    spec_rate, plain_rate, fetch_spec_stats = bench_speculative_p2p()
+    rollbacks, hits = fetch_spec_stats()
+    emit("p2p4_speculative_8branch_ticks_per_sec", spec_rate,
+         f"ticks/sec (hit {hits}/{rollbacks} rollbacks)"
+         if rollbacks else "ticks/sec",
+         spec_rate / plain_rate if plain_rate else 0.0)
+
+
+def run_ecs() -> None:
+    """Config 4: EcsWorld, 4 players, 16-frame rollback window."""
+    ecs = EcsWorld(4, entities_per_player=32)
+    ticks4, chunk4 = (4096, 512) if _on_tpu() else (768, 256)
+    ecs_fps, verify4 = bench_device_synctest(
+        ecs.advance, ecs.init_state(), jnp.zeros((4,), jnp.uint8),
+        lambda n, seed: _inputs(n, 4, seed), 16, ticks4, chunk4,
+    )
+    verify4()  # D2H desync gate — after timing
+    ecs_host = bench_host_synctest(ecs, 4, d=16, ticks=300)
+    emit("ecs_synctest_resim_frames_per_sec_cd16", ecs_fps,
+         "resim_frames/sec", ecs_fps / ecs_host)
+
+
+def run_chipvm256() -> None:
+    """Config 5: 256 concurrent ChipVM sessions batched on one chip."""
+    ticks5, chunk5 = (1024, 256) if _on_tpu() else (128, 64)
+    vm_rate, verify5 = bench_batched_chipvm(256, ticks5, chunk5, d=8)
+    verify5()  # D2H desync gate — after timing
+    vm_host = bench_host_synctest(ChipVM(2), 2, d=8, ticks=300)
+    emit("chipvm_256sessions_resim_frames_per_sec", vm_rate,
+         "resim_frames/sec", vm_rate / vm_host)
+
+
+def run_pallas_checksum() -> None:
+    """Supplemental: the pallas single-pass digest vs the XLA lane formulas
+    on a big (64 MiB) state leaf — the per-save hot op at large-state scale.
+    ``vs_baseline`` is pallas GB/s over XLA GB/s (>1 = the kernel wins)."""
+    from ggrs_tpu.ops import pallas_checksum as pc
+    from ggrs_tpu.ops.checksum import _leaf_digest
+
+    if not (pc.HAVE_PALLAS and _on_tpu()):
+        print("# skip: pallas_checksum needs TPU + pallas", flush=True)
+        return
+
+    words = jnp.asarray(
+        np.random.default_rng(3).integers(
+            0, 2**32, size=(16 * 1024 * 1024,), dtype=np.uint32
+        )
+    )
+    nbytes = words.size * 4
+
+    pallas_fn = jax.jit(pc.leaf_digest_pallas)
+    # pin the baseline to the pure-XLA lanes even if the caller exported
+    # GGRS_TPU_PALLAS_CHECKSUM=on (else this benchmark compares pallas to
+    # itself and the lane-equality assert below is vacuous)
+    pc.use_pallas_checksums(False)
+    xla_fn = jax.jit(_leaf_digest)
+
+    # compile + warm WITHOUT a D2H read (one read degrades this process's
+    # dispatch rate permanently — see module docstring); verify at the end
+    a, b = pallas_fn(words), xla_fn(words)
+    jax.block_until_ready((a, b))
+
+    def rate(fn) -> float:
+        best = 0.0
+        for _ in range(REPEATS):
+            t0 = time.perf_counter()
+            out = [fn(words) for _ in range(20)]
+            jax.block_until_ready(out)
+            best = max(best, 20 * nbytes / (time.perf_counter() - t0))
+        return best
+
+    pallas_gbs = rate(pallas_fn) / 1e9
+    xla_gbs = rate(xla_fn) / 1e9
+    assert np.array_equal(np.asarray(a), np.asarray(b)), "lane mismatch"
+    emit("pallas_checksum_digest_gb_per_sec", pallas_gbs, "GB/s (64MiB leaf)",
+         pallas_gbs / xla_gbs if xla_gbs else 0.0)
+
+
+def run_flagship() -> None:
+    """Config 2 (flagship): BoxGame device synctest at cd=8."""
     game = BoxGame(PLAYERS)
-    total_ticks, chunk = (16384, 1024) if on_tpu else (4096, 512)
+    total_ticks, chunk = (16384, 1024) if _on_tpu() else (4096, 512)
     device_fps, verify2 = bench_device_synctest(
         game.advance, game.init_state(), jnp.zeros((PLAYERS,), jnp.uint8),
         lambda n, seed: _inputs(n, PLAYERS, seed),
         CHECK_DISTANCE, total_ticks, chunk,
     )
-
-    # config 4: EcsWorld, 4 players, 16-frame rollback window
-    ecs = EcsWorld(4, entities_per_player=32)
-    ticks4, chunk4 = (4096, 512) if on_tpu else (768, 256)
-    ecs_fps, verify4 = bench_device_synctest(
-        ecs.advance, ecs.init_state(), jnp.zeros((4,), jnp.uint8),
-        lambda n, seed: _inputs(n, 4, seed), 16, ticks4, chunk4,
-    )
-
-    # config 5: 256 concurrent ChipVM sessions on one chip
-    ticks5, chunk5 = (1024, 256) if on_tpu else (128, 64)
-    vm_rate, verify5 = bench_batched_chipvm(256, ticks5, chunk5, d=8)
-
-    # config 3: speculative P2P vs the same loop with speculation off.  The
-    # whole live path (fused resolve-or-replay, lazy checksums, device hit
-    # counters) performs zero D2H, so both variants run at full dispatch rate.
-    spec_rate, plain_rate, fetch_spec_stats = bench_speculative_p2p()
-
-    # ALL device timing done — D2H reads (desync gates, counters) safe now
-    verify2()
-    verify4()
-    verify5()
-    rollbacks, hits = fetch_spec_stats()
-
-    # host request-loop denominators (pure NumPy, no device)
-    host_cd2 = bench_host_synctest(BoxGame(PLAYERS), PLAYERS, d=2, ticks=600)
+    verify2()  # D2H desync gate — after timing
     host_fps = bench_host_synctest(game, PLAYERS, d=CHECK_DISTANCE, ticks=600)
-    ecs_host = bench_host_synctest(ecs, 4, d=16, ticks=300)
-    vm_host = bench_host_synctest(ChipVM(2), 2, d=8, ticks=300)
-
-    emit("boxgame_synctest_host_resim_frames_per_sec_cd2", host_cd2,
-         "resim_frames/sec", 1.0)
-    emit("p2p4_speculative_8branch_ticks_per_sec", spec_rate,
-         f"ticks/sec (hit {hits}/{rollbacks} rollbacks)"
-         if rollbacks else "ticks/sec",
-         spec_rate / plain_rate if plain_rate else 0.0)
-    emit("ecs_synctest_resim_frames_per_sec_cd16", ecs_fps,
-         "resim_frames/sec", ecs_fps / ecs_host)
-    emit("chipvm_256sessions_resim_frames_per_sec", vm_rate,
-         "resim_frames/sec", vm_rate / vm_host)
     emit(
         f"boxgame_synctest_resim_frames_per_sec_cd{CHECK_DISTANCE}",
         device_fps, "resim_frames/sec", device_fps / host_fps,
     )
 
 
+# ---------------------------------------------------------------------------
+# orchestrator
+# ---------------------------------------------------------------------------
+
+
+def orchestrate() -> None:
+    """Run every config in its own subprocess, forwarding each child's JSON
+    line(s) in order (flagship last).  A child that dies or times out costs
+    its own line only — the rest of the suite still reports."""
+    here = os.path.abspath(__file__)
+    for name, (_, budget) in CONFIGS.items():
+        try:
+            proc = subprocess.run(
+                [sys.executable, here, name],
+                capture_output=True,
+                text=True,
+                timeout=budget,
+                cwd=os.path.dirname(here),
+            )
+            emitted = skipped = False
+            for line in proc.stdout.splitlines():
+                line = line.strip()
+                if line.startswith("# skip"):
+                    skipped = True  # a designed skip (e.g. pallas off-TPU)
+                elif line.startswith("{"):
+                    try:
+                        json.loads(line)
+                    except ValueError:
+                        continue
+                    print(line, flush=True)
+                    emitted = True
+            if skipped and not emitted:
+                sys.stderr.write(f"bench config {name!r} skipped by design\n")
+            elif not emitted:
+                sys.stderr.write(
+                    f"bench config {name!r} produced no metric "
+                    f"(rc={proc.returncode}); stderr tail:\n"
+                    f"{proc.stderr[-2000:]}\n"
+                )
+        except subprocess.TimeoutExpired:
+            sys.stderr.write(
+                f"bench config {name!r} exceeded its {budget}s budget; skipped\n"
+            )
+
+
+def main(argv: list) -> None:
+    if len(argv) > 1:
+        name = argv[1]
+        if name not in CONFIGS:
+            sys.stderr.write(
+                f"unknown bench config {name!r}; one of {list(CONFIGS)}\n"
+            )
+            raise SystemExit(2)
+        globals()[CONFIGS[name][0]]()
+    else:
+        orchestrate()
+
+
 if __name__ == "__main__":
-    main()
+    main(sys.argv)

@@ -14,7 +14,8 @@ from .checksum import (
     checksum_to_u128,
     pytree_checksum,
 )
-from .executor import DeviceRequestExecutor
+from .executor import DeviceRequestExecutor, ExecutorPrograms
+from .pallas_checksum import leaf_digest_pallas, use_pallas_checksums
 from .ring import DeviceStateRing
 from .replay import ReplayPrograms, build_replay_programs
 
@@ -24,7 +25,10 @@ __all__ = [
     "checksum_to_u128",
     "pytree_checksum",
     "DeviceRequestExecutor",
+    "ExecutorPrograms",
     "DeviceStateRing",
     "ReplayPrograms",
     "build_replay_programs",
+    "leaf_digest_pallas",
+    "use_pallas_checksums",
 ]

@@ -66,8 +66,17 @@ def _as_u32_words(x: jax.Array) -> jax.Array:
 
 
 def _leaf_digest(x: jax.Array) -> jax.Array:
-    """4-lane u32 digest of one array leaf; position-sensitive."""
+    """4-lane u32 digest of one array leaf; position-sensitive.
+
+    Large leaves on TPU can route through the pallas single-pass kernel
+    (``ops.pallas_checksum``, opt-in): bit-identical lanes, one guaranteed
+    read of HBM for all four."""
     w = _as_u32_words(x)
+    from .pallas_checksum import maybe_pallas_digest
+
+    fused = maybe_pallas_digest(w)
+    if fused is not None:
+        return fused
     n = w.shape[0]
     idx = jnp.arange(1, n + 1, dtype=jnp.uint32)
     lane0 = jnp.sum(w, dtype=jnp.uint32)

@@ -47,38 +47,26 @@ from .checksum import DeviceChecksum, checksum_device
 InputsToArray = Callable[[Sequence[Tuple[Any, InputStatus]]], Any]
 
 
-class DeviceRequestExecutor:
-    """Executes GgrsRequest lists with device-resident state.
+class ExecutorPrograms:
+    """The compiled device programs for one ``advance`` function — the jitted
+    single advance, the fused rollback burst, and the checksum — shareable
+    across every ``DeviceRequestExecutor`` driving the same game.
 
-    ``advance``        pure JAX ``(state, inputs_array) -> state``.
-    ``init_state``     initial pytree (device arrays).
-    ``inputs_to_array`` maps the request's ``[(input, status), ...]`` list to
-                       the array ``advance`` consumes (e.g. u8 bitmask vector
-                       for BoxGame).  Disconnected players already arrive as
-                       default inputs, matching the reference's dummy inputs.
-    ``speculation``    optional ``SpeculativeRollback``: K branch trajectories
-                       that turn a rollback into a device-side select (see
-                       module docstring).  The executor re-anchors the
-                       branches at frame ``load+1`` after every rollback (the
-                       next rollback's steady-state target) and extends them
-                       by one hypothesized frame per executed advance.
+    jit caches hang off the wrapped callables, so N peers in one process (or
+    the speculation-on/off variants of a benchmark) that each build their own
+    executor would otherwise compile every program N times; on a
+    remote-compile TPU tunnel each compile costs ~1s of wall clock.  Build one
+    of these and pass it to each executor's ``programs`` argument to compile
+    once.
     """
 
     def __init__(
-        self,
-        advance: Callable[[Any, Any], Any],
-        init_state: Any,
-        inputs_to_array: InputsToArray,
-        with_checksums: bool = True,
-        speculation: Optional[SpeculativeRollback] = None,
+        self, advance: Callable[[Any, Any], Any], with_checksums: bool = True
     ) -> None:
-        self._advance = jax.jit(advance)
-        self._state = jax.tree_util.tree_map(jnp.asarray, init_state)
-        self._inputs_to_array = inputs_to_array
-        self._with_checksums = with_checksums
-        self._checksum = jax.jit(checksum_device)
-        self._spec = speculation
-        self._spec_rollbacks = 0  # host-side: rollbacks seen while speculating
+        self.with_checksums = with_checksums
+        self.raw_advance = advance  # for executor-side identity validation
+        self.advance = jax.jit(advance)
+        self.checksum = jax.jit(checksum_device)
 
         def _burst(state: Any, inputs: Any):
             def body(st: Any, inp: Any):
@@ -99,7 +87,60 @@ class DeviceRequestExecutor:
             )
             return final, steps, sums
 
-        self._burst = jax.jit(_burst)
+        self.burst = jax.jit(_burst)
+
+
+class DeviceRequestExecutor:
+    """Executes GgrsRequest lists with device-resident state.
+
+    ``advance``        pure JAX ``(state, inputs_array) -> state``.
+    ``init_state``     initial pytree (device arrays).
+    ``inputs_to_array`` maps the request's ``[(input, status), ...]`` list to
+                       the array ``advance`` consumes (e.g. u8 bitmask vector
+                       for BoxGame).  Disconnected players already arrive as
+                       default inputs, matching the reference's dummy inputs.
+    ``speculation``    optional ``SpeculativeRollback``: K branch trajectories
+                       that turn a rollback into a device-side select (see
+                       module docstring).  The executor re-anchors the
+                       branches at frame ``load+1`` after every rollback (the
+                       next rollback's steady-state target) and extends them
+                       by one hypothesized frame per executed advance.
+    ``programs``       optional shared ``ExecutorPrograms`` (same ``advance``
+                       and ``with_checksums``): lets N executors in one
+                       process reuse one set of compiled programs.
+    """
+
+    def __init__(
+        self,
+        advance: Callable[[Any, Any], Any],
+        init_state: Any,
+        inputs_to_array: InputsToArray,
+        with_checksums: bool = True,
+        speculation: Optional[SpeculativeRollback] = None,
+        programs: Optional[ExecutorPrograms] = None,
+    ) -> None:
+        if programs is None:
+            programs = ExecutorPrograms(advance, with_checksums)
+        assert programs.with_checksums == with_checksums, (
+            "shared ExecutorPrograms was built with a different "
+            "with_checksums setting"
+        )
+        # == (not `is`): bound methods compare equal when they bind the same
+        # function on the same object, but a fresh object is created per
+        # attribute access, so identity would always fail for `game.advance`
+        assert programs.raw_advance == advance, (
+            "shared ExecutorPrograms was built for a different advance "
+            "function — its compiled programs would silently simulate the "
+            "wrong game"
+        )
+        self._advance = programs.advance
+        self._state = jax.tree_util.tree_map(jnp.asarray, init_state)
+        self._inputs_to_array = inputs_to_array
+        self._with_checksums = with_checksums
+        self._checksum = programs.checksum
+        self._spec = speculation
+        self._spec_rollbacks = 0  # host-side: rollbacks seen while speculating
+        self._burst = programs.burst
 
     # ------------------------------------------------------------------
 
@@ -307,13 +348,20 @@ class DeviceRequestExecutor:
         self._spec_rollbacks += 1
 
         if n_resim >= 1 and self._spec.window_valid(g, n_resim):
-            # ONE dispatch for the whole rollback: hypothesis match + branch
-            # select (or the fallback replay — the host never reads which),
-            # plus re-anchoring the branches at frame g+1 and
-            # re-hypothesizing the still-unconfirmed tail.
-            steps, sums = self._spec.fulfill_and_refill(
-                g, arrays[:n_resim], load.cell.data(), self._with_checksums
+            # ONE dispatch for the whole rollback TICK: hypothesis match +
+            # branch select (or the fallback replay — the host never reads
+            # which), re-anchoring the branches at frame g+1, re-hypothesizing
+            # the still-unconfirmed tail, and — when the burst has a trailing
+            # saveless live advance — that advance plus its window extension.
+            has_live = n_resim < m
+            out = self._spec.fulfill_and_refill(
+                g,
+                arrays[:n_resim],
+                load.cell.data(),
+                self._with_checksums,
+                live_inputs=arrays[-1] if has_live else None,
             )
+            steps, sums = out[0], out[1]
             for j in range(n_resim):
                 if saves[j] is not None:
                     cs = (
@@ -322,9 +370,7 @@ class DeviceRequestExecutor:
                         else None
                     )
                     saves[j].cell.save(saves[j].frame, steps[j], cs)
-            self._state = steps[n_resim - 1]
-            if n_resim < m:  # the live advance (extends via _do_advance)
-                self._do_advance(pairs[-1], inputs=arrays[-1])
+            self._state = out[2] if has_live else steps[n_resim - 1]
         else:
             # window can't answer this rollback (host-known): the rollback
             # disproved the predicted inputs the prefixes were validated

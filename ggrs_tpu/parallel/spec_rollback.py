@@ -38,7 +38,7 @@ eager device op).  ``DeviceRequestExecutor`` drives this through its
 
 from __future__ import annotations
 
-from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import numpy as np
 
@@ -95,12 +95,18 @@ class SpeculativeRollback:
         num_branches: int,
         branch_inputs: BranchInputsFn,
         max_window: int = 16,
+        branch_inputs_all: Optional[Callable[[int, Any], Any]] = None,
     ) -> None:
         assert num_branches >= 1
         self.K = num_branches
         self.max_window = max_window
         self._advance = advance
         self._branch_inputs = branch_inputs
+        # optional vectorized hypothesis builder: one call producing the whole
+        # [K, ...] stack for a frame instead of K per-branch calls — hypothesis
+        # construction runs on the host every extend, so for large K the
+        # per-branch Python loop becomes the tick's overhead
+        self._branch_inputs_all = branch_inputs_all
 
         self._root_frame: Optional[int] = None
         self._count = 0  # host-tracked window length (never read from device)
@@ -280,12 +286,21 @@ class SpeculativeRollback:
 
         return jax.jit(fulfill)
 
-    def _build_fulfill_refill(self, n: int, with_checksums: bool):
+    def _build_fulfill_refill(
+        self, n: int, with_checksums: bool, with_live: bool = False
+    ):
         """fulfill + re-anchor + re-extend as ONE program: the rollback's
         resolve-or-replay, rooting the branches at the window's first frame,
         and re-hypothesizing the confirmed tail — so a speculative rollback
-        costs exactly one dispatch, the same as the plain fused replay."""
+        costs exactly one dispatch, the same as the plain fused replay.
+
+        ``with_live`` additionally fuses the tick's trailing *live* advance
+        (the saveless AdvanceFrame that follows every rollback burst) and the
+        matching one-frame window extension into the same program: the whole
+        rollback tick then costs ONE dispatch, exactly like the plain path's
+        single load+replay+advance burst."""
         m = n - 1
+        m_ext = m + (1 if with_live else 0)
         on_tpu = jax.default_backend() == "tpu"
 
         def fused(
@@ -295,17 +310,27 @@ class SpeculativeRollback:
             offset: jax.Array,
             load_state: Any,
             confirmed: Any,  # [n, ...] stacked
-            hyps: Any,  # [m, K, ...] stacked (None when m=0)
+            hyps: Any,  # [m_ext, K, ...] stacked (None when m_ext=0)
             hit_count: jax.Array,
+            live_inputs: Any = None,  # only when with_live
         ):
             steps, sums, hit = self._resolve_window(
                 traj_buf, inp_buf, prefix_buf, offset, load_state,
                 confirmed, n, with_checksums,
             )
-            # re-anchor at steps[0] and extend the confirmed tail
+            # re-anchor at steps[0] and extend the confirmed tail (plus, when
+            # fused, the live frame hypothesized against the live inputs)
             states = self._root_impl(steps[0])
-            if m:
+            if m_ext:
                 tail = jax.tree_util.tree_map(lambda l: l[1:], confirmed)
+                if with_live:
+                    tail = jax.tree_util.tree_map(
+                        lambda c, lv: jnp.concatenate(
+                            [c, jnp.asarray(lv)[None]], axis=0
+                        ),
+                        tail,
+                        live_inputs,
+                    )
                 states, traj, prefixes = self._extend_scan(states, hyps, tail)
                 put = lambda buf, val: jax.tree_util.tree_map(
                     lambda b, v: jax.lax.dynamic_update_slice_in_dim(
@@ -319,6 +344,9 @@ class SpeculativeRollback:
                 prefix_buf = jax.lax.dynamic_update_slice_in_dim(
                     prefix_buf, prefixes, 0, axis=0
                 )
+            live = (
+                self._advance(steps[-1], live_inputs) if with_live else None
+            )
             return (
                 steps,
                 sums,
@@ -327,6 +355,7 @@ class SpeculativeRollback:
                 traj_buf,
                 inp_buf,
                 prefix_buf,
+                live,
             )
 
         return jax.jit(fused, donate_argnums=(0, 1, 2) if on_tpu else ())
@@ -359,6 +388,8 @@ class SpeculativeRollback:
         self._prefix_buf = jnp.zeros((W, self.K), bool)
 
     def _hypotheses(self, frame: int, local_inputs: Any) -> Any:
+        if self._branch_inputs_all is not None:
+            return self._branch_inputs_all(frame, local_inputs)
         per_branch = [
             self._branch_inputs(k, frame, local_inputs) for k in range(self.K)
         ]
@@ -370,6 +401,13 @@ class SpeculativeRollback:
         by ``refill`` and ``fulfill_and_refill`` — their windows must stay
         frame-offset-identical for the fused program's promise
         ("equals refill(frame + 1, steps[0], confirmed[1:])") to hold."""
+        if self._branch_inputs_all is not None:
+            return _stack_pytrees(
+                [
+                    self._branch_inputs_all(frame + t, inputs_seq[t])
+                    for t in range(len(inputs_seq))
+                ]
+            )
         hyps = _stack_pytrees(
             [
                 _stack_pytrees(
@@ -523,34 +561,38 @@ class SpeculativeRollback:
         confirmed: Sequence[Any],
         load_state: Any,
         with_checksums: bool,
-    ) -> Tuple[List[Any], Optional[List[Any]]]:
+        live_inputs: Any = None,
+    ) -> Union[
+        Tuple[List[Any], Optional[List[Any]]],
+        Tuple[List[Any], Optional[List[Any]], Any],
+    ]:
         """``fulfill`` plus the post-rollback re-anchor/re-extend in ONE
         dispatch: resolve-or-replay the window, root the branches at
         ``frame + 1`` (the next rollback's steady-state target), and
         re-hypothesize the still-unconfirmed tail.  Same return value as
         ``fulfill``; the window afterwards equals ``refill(frame + 1,
-        steps[0], confirmed[1:])``."""
+        steps[0], confirmed[1:])``.
+
+        With ``live_inputs``, the tick's trailing live advance rides the same
+        dispatch: the return gains a third element — the live state
+        ``advance(steps[-1], live_inputs)`` — and the window also extends one
+        hypothesized frame for the live frame (``frame + n``), exactly as a
+        subsequent ``advance_and_extend`` would have."""
         n = len(confirmed)
         assert self.window_valid(frame, n)
         m = n - 1
-        hyps = (
-            self._window_hypotheses(frame + 1, confirmed[1:]) if m else None
-        )
-        key = (n, with_checksums)
+        with_live = live_inputs is not None
+        tail = list(confirmed[1:])
+        if with_live:
+            tail.append(live_inputs)
+        hyps = self._window_hypotheses(frame + 1, tail) if tail else None
+        key = (n, with_checksums, with_live)
         fn = self._fulfill_refill_cache.get(key)
         if fn is None:
             fn = self._fulfill_refill_cache[key] = self._build_fulfill_refill(
-                n, with_checksums
+                n, with_checksums, with_live
             )
-        (
-            steps,
-            sums,
-            self._hit_count,
-            self._states,
-            self._traj_buf,
-            self._inp_buf,
-            self._prefix_buf,
-        ) = fn(
+        args = [
             self._traj_buf,
             self._inp_buf,
             self._prefix_buf,
@@ -559,9 +601,23 @@ class SpeculativeRollback:
             _stack_pytrees(confirmed),
             hyps,
             self._hit_count,
-        )
+        ]
+        if with_live:
+            args.append(live_inputs)
+        (
+            steps,
+            sums,
+            self._hit_count,
+            self._states,
+            self._traj_buf,
+            self._inp_buf,
+            self._prefix_buf,
+            live,
+        ) = fn(*args)
         self._root_frame = frame + 1
-        self._count = m
+        self._count = m + (1 if with_live else 0)
+        if with_live:
+            return steps, sums, live
         return steps, sums
 
     def refill(self, frame: int, state: Any, local_inputs: Sequence[Any]) -> None:
@@ -631,12 +687,14 @@ class SpeculativeRollback:
             for n in sorted(set(depths)):
                 if not 1 <= n <= self.max_window:
                     continue
-                self.root(0, state)
-                for _ in range(n):
-                    self.extend(example_inputs)
-                self.fulfill_and_refill(
-                    0, [example_inputs] * n, state, with_checksums
-                )
+                for live in (None, example_inputs):
+                    self.root(0, state)
+                    for _ in range(n):
+                        self.extend(example_inputs)
+                    self.fulfill_and_refill(
+                        0, [example_inputs] * n, state, with_checksums,
+                        live_inputs=live,
+                    )
             jax.block_until_ready(self._states)
         finally:
             (

@@ -149,6 +149,64 @@ class TestSpeculativeP2P:
         # speculative fulfillment is bit-identical to peer B's plain replay
         _assert_peers_identical(sessions, executors)
 
+    def test_rollback_tick_is_one_fused_dispatch(self):
+        """A speculative rollback tick whose burst ends in a saveless live
+        advance must cost exactly ONE device dispatch: fulfill_and_refill is
+        invoked with the live inputs fused in, and neither the plain advance
+        nor advance_and_extend runs for that tick — dispatch parity with the
+        plain path's single load+replay+advance burst."""
+        net = InMemoryNetwork()
+        game, sessions, executors = _make_2p_pair(net, _oracle_spec)
+        ex_a, _ = executors
+        bursts = _count_bursts(ex_a)
+
+        calls = {"fused_live": 0, "unfused": 0, "advances": 0, "adv_ext": 0}
+        spec = ex_a._spec
+        orig_fulfill = spec.fulfill_and_refill
+        orig_advance = ex_a._advance
+        orig_adv_ext = spec.advance_and_extend
+
+        def spy_fulfill(frame, confirmed, load_state, wc, live_inputs=None):
+            calls["fused_live" if live_inputs is not None else "unfused"] += 1
+            return orig_fulfill(
+                frame, confirmed, load_state, wc, live_inputs=live_inputs
+            )
+
+        def spy_advance(state, inputs):
+            calls["advances"] += 1
+            return orig_advance(state, inputs)
+
+        def spy_adv_ext(state, inputs):
+            out = orig_adv_ext(state, inputs)
+            if out is not None:  # None = no dispatch (caller advances plainly)
+                calls["adv_ext"] += 1
+            return out
+
+        spec.fulfill_and_refill = spy_fulfill
+        ex_a._advance = spy_advance
+        spec.advance_and_extend = spy_adv_ext
+        loads = {"n": 0}
+
+        _drive(sessions, executors, 40, record_loads=loads)
+
+        assert loads["n"] > 5
+        assert calls["fused_live"] > 0, "live advance must ride the fulfill"
+        assert bursts["n"] == 0
+        # the separate advance program may only run on non-rollback ticks and
+        # unrooted fallbacks — never once per rollback on top of the fused
+        # dispatch (ticks = 40 scheduled + 12 drain; every dispatch beyond
+        # one-per-tick would show up here)
+        total_ticks = 52
+        assert calls["fused_live"] + calls["unfused"] == loads["n"]
+        assert (
+            calls["advances"]
+            + calls["adv_ext"]
+            + calls["fused_live"]
+            + calls["unfused"]
+            == total_ticks
+        ), "a tick must cost exactly one device dispatch"
+        _assert_peers_identical(sessions, executors)
+
     def test_miss_falls_back_to_replay(self):
         net = InMemoryNetwork()
         game, sessions, executors = _make_2p_pair(net, _hopeless_spec)
