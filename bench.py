@@ -54,6 +54,7 @@ CONFIGS = {
     "ecs": ("run_ecs", 1200),
     "chipvm256": ("run_chipvm256", 1200),
     "pallas_checksum": ("run_pallas_checksum", 900),
+    "pool_hosting": ("run_pool_hosting", 1500),
     "flagship": ("run_flagship", 1200),
 }
 
@@ -463,6 +464,123 @@ def run_pallas_checksum() -> None:
          pallas_gbs / xla_gbs if xla_gbs else 0.0)
 
 
+def _hosting_setup(n_matches: int, pooled: bool):
+    """n_matches 2-peer BoxGame matches over one in-memory net; fulfillment
+    is either ONE BatchedRequestExecutor for all 2·n sessions (pooled) or a
+    per-session DeviceRequestExecutor pool sharing compiled programs.
+    Returns (tick_fn, finalize_fn)."""
+    import random
+
+    from ggrs_tpu.core import Local, Remote
+    from ggrs_tpu.net import InMemoryNetwork
+    from ggrs_tpu.ops import DeviceRequestExecutor, ExecutorPrograms
+    from ggrs_tpu.parallel import BatchedRequestExecutor
+    from ggrs_tpu.sessions import SessionBuilder
+
+    game = BoxGame(2)
+
+    def to_arr(pairs):
+        return np.asarray([p[0] for p in pairs], np.uint8)
+
+    net = InMemoryNetwork()
+    sessions, schedules = [], []
+    for m in range(n_matches):
+        names = (f"A{m}", f"B{m}")
+        for me in (0, 1):
+            b = (
+                SessionBuilder(boxgame_config())
+                .with_clock(lambda: 0)
+                .with_rng(random.Random(3 + 5 * m + me))
+                .add_player(Local(), me)
+                .add_player(Remote(names[1 - me]), 1 - me)
+            )
+            sessions.append(b.start_p2p_session(net.socket(names[me])))
+            schedules.append(
+                lambda i, m=m, me=me: ((i + 2 * m + me) // (2 + m % 3)) % 16
+            )
+    B = len(sessions)
+
+    if pooled:
+        pool = BatchedRequestExecutor(
+            game.advance, game.init_state(), to_arr,
+            batch_size=B, ring_length=10, max_burst=9,
+            with_checksums=False,
+        )
+        pool.warmup(np.zeros((2,), np.uint8))
+
+        def tick(i):
+            for s in sessions:
+                s.poll_remote_clients()
+            reqs = []
+            for h, (s, sched) in enumerate(zip(sessions, schedules)):
+                s.add_local_input(h % 2, sched(i))
+                reqs.append(s.advance_frame())
+            pool.run(reqs)
+
+        return tick, pool.block_until_ready
+
+    programs = ExecutorPrograms(game.advance, with_checksums=False)
+    executors = [
+        DeviceRequestExecutor(
+            game.advance, game.init_state(), to_arr,
+            with_checksums=False, programs=programs,
+        )
+        for _ in range(B)
+    ]
+    executors[0].warmup(np.zeros((2,), np.uint8), burst_depths=range(2, 10))
+
+    def tick(i):
+        for s in sessions:
+            s.poll_remote_clients()
+        for h, (s, sched, ex) in enumerate(zip(sessions, schedules, executors)):
+            s.add_local_input(h % 2, sched(i))
+            ex.run(s.advance_frame())
+
+    def finalize():
+        jax.block_until_ready([ex.state for ex in executors])
+
+    return tick, finalize
+
+
+def run_pool_hosting() -> None:
+    """Supplemental: massed hosting — 32 live P2P matches (64 sessions) on
+    one chip, every tick's 64 heterogeneous request lists fulfilled as ONE
+    batched dispatch (parallel.BatchedRequestExecutor) vs one device
+    executor per session.  Metric is aggregate session-ticks/sec;
+    ``vs_baseline`` is pooled over per-session (>1 = batching wins)."""
+    n_matches, seg, segments = 32, 60, 3
+    variants = {
+        name: _hosting_setup(n_matches, pooled=(name == "pooled"))
+        for name in ("pooled", "individual")
+    }
+    counters = {name: 0 for name in variants}
+    rates = {name: [] for name in variants}
+
+    def run(name, n):
+        tick, finalize = variants[name]
+        start = counters[name]
+        for i in range(start, start + n):
+            tick(i)
+        finalize()
+        counters[name] = start + n
+
+    for name in variants:
+        run(name, 16)  # warm
+    # alternate segments so tunnel drift hits both variants equally
+    for _ in range(segments):
+        for name in variants:
+            t0 = time.perf_counter()
+            run(name, seg)
+            rates[name].append(
+                2 * n_matches * seg / (time.perf_counter() - t0)
+            )
+
+    pooled, individual = max(rates["pooled"]), max(rates["individual"])
+    emit("p2p_pool_hosting_64sessions_session_ticks_per_sec", pooled,
+         "session_ticks/sec (one dispatch per tick)",
+         pooled / individual if individual else 0.0)
+
+
 def run_flagship() -> None:
     """Config 2 (flagship): BoxGame device synctest at cd=8."""
     game = BoxGame(PLAYERS)
@@ -485,11 +603,34 @@ def run_flagship() -> None:
 # ---------------------------------------------------------------------------
 
 
+def _forward_child_lines(name: str, stdout: str) -> bool:
+    """Print the child's valid JSON metric lines; True if any were emitted
+    (a '# skip' marker counts as an intentional no-metric outcome)."""
+    emitted = skipped = False
+    for line in (stdout or "").splitlines():
+        line = line.strip()
+        if line.startswith("# skip"):
+            skipped = True  # a designed skip (e.g. pallas off-TPU)
+        elif line.startswith("{"):
+            try:
+                json.loads(line)
+            except ValueError:
+                continue
+            print(line, flush=True)
+            emitted = True
+    if skipped and not emitted:
+        sys.stderr.write(f"bench config {name!r} skipped by design\n")
+    return emitted or skipped
+
+
 def orchestrate() -> None:
     """Run every config in its own subprocess, forwarding each child's JSON
     line(s) in order (flagship last).  A child that dies or times out costs
-    its own line only — the rest of the suite still reports."""
+    its own line only — the rest of the suite still reports.  Exits nonzero
+    if NO config produced a metric (total failure must not read as a clean
+    run to a driver that records the exit status)."""
     here = os.path.abspath(__file__)
+    any_metric = False
     for name, (_, budget) in CONFIGS.items():
         try:
             proc = subprocess.run(
@@ -499,30 +640,28 @@ def orchestrate() -> None:
                 timeout=budget,
                 cwd=os.path.dirname(here),
             )
-            emitted = skipped = False
-            for line in proc.stdout.splitlines():
-                line = line.strip()
-                if line.startswith("# skip"):
-                    skipped = True  # a designed skip (e.g. pallas off-TPU)
-                elif line.startswith("{"):
-                    try:
-                        json.loads(line)
-                    except ValueError:
-                        continue
-                    print(line, flush=True)
-                    emitted = True
-            if skipped and not emitted:
-                sys.stderr.write(f"bench config {name!r} skipped by design\n")
-            elif not emitted:
+            ok = _forward_child_lines(name, proc.stdout)
+            if not ok:
                 sys.stderr.write(
                     f"bench config {name!r} produced no metric "
                     f"(rc={proc.returncode}); stderr tail:\n"
                     f"{proc.stderr[-2000:]}\n"
                 )
-        except subprocess.TimeoutExpired:
+            any_metric = any_metric or ok
+        except subprocess.TimeoutExpired as exc:
+            # salvage metric lines the child printed before hanging (e.g. a
+            # measurement that completed but stalled in tunnel teardown)
+            out = exc.stdout
+            if isinstance(out, bytes):
+                out = out.decode(errors="replace")
+            ok = _forward_child_lines(name, out or "")
+            any_metric = any_metric or ok
             sys.stderr.write(
-                f"bench config {name!r} exceeded its {budget}s budget; skipped\n"
+                f"bench config {name!r} exceeded its {budget}s budget"
+                f"{' (metric salvaged from partial output)' if ok else ''}\n"
             )
+    if not any_metric:
+        raise SystemExit(1)
 
 
 def main(argv: list) -> None:

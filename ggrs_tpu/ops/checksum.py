@@ -65,6 +65,25 @@ def _as_u32_words(x: jax.Array) -> jax.Array:
     return jnp.sum(packed << shifts[None, :], axis=1, dtype=jnp.uint32)
 
 
+def lane_sums(words: jax.Array, offset=0) -> jax.Array:
+    """The four lane sums over a u32 word vector with 1-based global indices
+    starting at ``offset + 1`` — THE single definition of the lane math.
+    Every lane is a commutative mod-2^32 sum of per-word terms, so digests
+    of consecutive chunks add: ``lane_sums(w) == lane_sums(w[:k]) +
+    lane_sums(w[k:], k)`` (the property the pallas kernel's tail fold uses).
+    """
+    n = words.shape[0]
+    idx = jnp.asarray(offset, jnp.uint32) + jnp.arange(
+        1, n + 1, dtype=jnp.uint32
+    )
+    lane0 = jnp.sum(words, dtype=jnp.uint32)
+    lane1 = jnp.sum(words * idx, dtype=jnp.uint32)
+    lane2 = jnp.sum(words * (idx * _PRIME_A + jnp.uint32(1)), dtype=jnp.uint32)
+    rot = (words << jnp.uint32(13)) | (words >> jnp.uint32(19))
+    lane3 = jnp.sum(rot ^ (idx * _PRIME_B), dtype=jnp.uint32)
+    return jnp.stack([lane0, lane1, lane2, lane3])
+
+
 def _leaf_digest(x: jax.Array) -> jax.Array:
     """4-lane u32 digest of one array leaf; position-sensitive.
 
@@ -77,14 +96,7 @@ def _leaf_digest(x: jax.Array) -> jax.Array:
     fused = maybe_pallas_digest(w)
     if fused is not None:
         return fused
-    n = w.shape[0]
-    idx = jnp.arange(1, n + 1, dtype=jnp.uint32)
-    lane0 = jnp.sum(w, dtype=jnp.uint32)
-    lane1 = jnp.sum(w * idx, dtype=jnp.uint32)
-    lane2 = jnp.sum(w * (idx * _PRIME_A + jnp.uint32(1)), dtype=jnp.uint32)
-    rot = (w << jnp.uint32(13)) | (w >> jnp.uint32(19))
-    lane3 = jnp.sum(rot ^ (idx * _PRIME_B), dtype=jnp.uint32)
-    return jnp.stack([lane0, lane1, lane2, lane3])
+    return lane_sums(w)
 
 
 def checksum_device(state: Any) -> jax.Array:

@@ -43,10 +43,9 @@ try:  # pallas is part of jax.experimental; gate anyway for exotic builds
 except Exception:  # pragma: no cover
     HAVE_PALLAS = False
 
-# lane constants — MUST match ops.checksum exactly
-_GOLDEN = np.uint32(2654435761)
-_PRIME_A = np.uint32(40503)
-_PRIME_B = np.uint32(2246822519)
+# lane constants — imported from ops.checksum so the kernel's per-word terms
+# and the XLA formulas can never drift apart
+from .checksum import _PRIME_A, _PRIME_B, lane_sums
 
 # (sublanes, lanes) per grid step: 256×128 u32 = 128 KiB of VMEM per block,
 # comfortably inside the ~16 MiB VMEM budget with room for double-buffering
@@ -97,23 +96,6 @@ def _digest_kernel(w_ref, out_ref):
         out_ref[3] += lane3
 
 
-def _lanes_xla(words: jax.Array, offset: jax.Array) -> jax.Array:
-    """The four lane sums over ``words`` with 1-based global indices starting
-    at ``offset + 1`` — the same formulas as ``checksum._leaf_digest``, used
-    for the ragged tail the kernel's aligned grid does not cover.  Every lane
-    is a commutative mod-2^32 sum, so head + tail lane vectors add."""
-    n = words.shape[0]
-    idx = jnp.asarray(offset, jnp.uint32) + jnp.arange(
-        1, n + 1, dtype=jnp.uint32
-    )
-    lane0 = jnp.sum(words, dtype=jnp.uint32)
-    lane1 = jnp.sum(words * idx, dtype=jnp.uint32)
-    lane2 = jnp.sum(words * (idx * _PRIME_A + jnp.uint32(1)), dtype=jnp.uint32)
-    rot = (words << jnp.uint32(13)) | (words >> jnp.uint32(19))
-    lane3 = jnp.sum(rot ^ (idx * _PRIME_B), dtype=jnp.uint32)
-    return jnp.stack([lane0, lane1, lane2, lane3])
-
-
 def leaf_digest_pallas(words: jax.Array, interpret: bool = False) -> jax.Array:
     """4-lane digest of a 1-D u32 word vector — one pallas pass.
 
@@ -124,11 +106,16 @@ def leaf_digest_pallas(words: jax.Array, interpret: bool = False) -> jax.Array:
     right index offset, which is exact because every lane is a commutative
     mod-2^32 sum.
     """
+    if not HAVE_PALLAS:
+        raise RuntimeError(
+            "pallas is unavailable in this jax build; use the XLA digest "
+            "(ops.checksum._leaf_digest) instead"
+        )
     n = words.shape[0]
     per_block = _BLOCK_ROWS * _LANES
     blocks = n // per_block
     if blocks == 0:
-        return _lanes_xla(words, 0)
+        return lane_sums(words)
     n_aligned = blocks * per_block
     tiled = words[:n_aligned].reshape(blocks * _BLOCK_ROWS, _LANES)
     acc = pl.pallas_call(
@@ -147,7 +134,7 @@ def leaf_digest_pallas(words: jax.Array, interpret: bool = False) -> jax.Array:
     )(tiled)
     lanes = jax.lax.bitcast_convert_type(acc, jnp.uint32)
     if n != n_aligned:
-        lanes = lanes + _lanes_xla(words[n_aligned:], n_aligned)
+        lanes = lanes + lane_sums(words[n_aligned:], n_aligned)
     return lanes
 
 

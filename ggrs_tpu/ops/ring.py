@@ -78,6 +78,35 @@ class DeviceStateRing:
             "frames": ring["frames"].at[i].set(jnp.asarray(frame, jnp.int32)),
         }
 
+    def save_where(
+        self,
+        ring: Any,
+        frame: jax.Array,
+        state: Any,
+        checksum: jax.Array,
+        pred: jax.Array,
+    ) -> Any:
+        """Predicated ``save``: the slot keeps its current contents where
+        ``pred`` (scalar bool) is false.  This is the masked form batched
+        heterogeneous fulfillment needs — under ``vmap`` each session decides
+        independently whether this tick's write happens."""
+        i = self.slot(frame)
+
+        def upd(buf: jax.Array, leaf: Any) -> jax.Array:
+            cur = jax.lax.dynamic_index_in_dim(buf, i, axis=0, keepdims=False)
+            val = jnp.where(pred, jnp.asarray(leaf, buf.dtype), cur)
+            return jax.lax.dynamic_update_index_in_dim(buf, val, i, axis=0)
+
+        return {
+            "states": jax.tree_util.tree_map(
+                lambda buf, leaf: upd(buf, leaf), ring["states"], state
+            ),
+            "checksums": upd(ring["checksums"], checksum),
+            "frames": ring["frames"].at[i].set(
+                jnp.where(pred, jnp.asarray(frame, jnp.int32), ring["frames"][i])
+            ),
+        }
+
     def load(self, ring: Any, frame: jax.Array) -> Any:
         """Read the state stored in the slot for ``frame``."""
         i = self.slot(frame)

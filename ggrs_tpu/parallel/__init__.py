@@ -8,7 +8,10 @@ design, not ports:
 - **speculative** — ``vmap`` over K predicted-input branches with post-hoc
   selection on confirmed inputs (``speculation``);
 - **session** — ``shard_map`` batching of many independent sessions across a
-  device mesh with ICI collectives for global health counters (``batch``);
+  device mesh with ICI collectives for global health counters (``batch``),
+  plus massed request fulfillment for LIVE heterogeneous sessions — B
+  networked sessions' per-tick request lists executed as one predicated
+  device program (``session_pool``);
 - **player/entity** — vectorization inside one state pytree (the games do
   this by construction, e.g. BoxGame's (P, ...) arrays).
 """
@@ -16,8 +19,10 @@ design, not ports:
 from .speculation import SpeculativeBranches, build_speculation_programs
 from .spec_rollback import SpeculativeRollback
 from .batch import BatchedSessions, HOST_AXIS, SESSION_AXIS, make_mesh, make_mesh2d
+from .session_pool import BatchedRequestExecutor
 
 __all__ = [
+    "BatchedRequestExecutor",
     "BatchedSessions",
     "HOST_AXIS",
     "SESSION_AXIS",

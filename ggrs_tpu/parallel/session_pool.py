@@ -1,0 +1,486 @@
+"""Massed P2P hosting: fulfill MANY live sessions' request lists in ONE
+device dispatch per tick.
+
+The reference binds one rollback session to one process — a server hosting
+hundreds of matches runs hundreds of processes, each paying its own
+per-request state churn (/root/reference/src/sessions/p2p_session.rs:254-265).
+``ops.DeviceRequestExecutor`` already moves a single session's save/load/
+advance onto HBM, but a pool of N executors still costs N device dispatches
+per tick — on a tunneled TPU the dispatch overhead, not the game, is the
+bill.  This module batches the *fulfillment*: B independent host sessions
+(P2P, SyncTest, Spectator — anything that emits the reference's request
+grammar) hand their per-tick request lists to one ``BatchedRequestExecutor``,
+which compiles a single uniform tick program over ``[B, ...]`` state and
+dispatches it once for the whole pool.
+
+Uniformity is the TPU trade: every session's tick is normalized to the same
+fixed-shape descriptor —
+
+    [pre-save*] [load [post-load-save]*] (advance, save?) * <= max_burst
+
+— padded with masked no-ops, so heterogeneous ticks (one session rolling
+back 8 frames, another advancing once, a third skipping on prediction
+threshold) are ONE program with per-session predication, not B programs.
+Grammar parity: the same ``Save | Load (Adv Save?)* | Adv`` request shapes
+``ops.DeviceRequestExecutor`` executes (/root/reference/src/lib.rs:170-195).
+
+Saved states live in per-session device rings ``[B, R, ...]`` tagged with
+frame numbers and (optionally) 4-lane digests; ``GameStateCell``s are
+fulfilled with lazy slot references and lazy checksums, so desync detection
+and user ``cell.load()`` work unchanged while the live path performs ZERO
+device→host reads.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.types import (
+    AdvanceFrame,
+    Frame,
+    GgrsRequest,
+    LoadGameState,
+    SaveGameState,
+)
+from ..ops.checksum import CHECKSUM_LANES, checksum_device, checksum_to_u128
+
+
+def _tree_where(pred: jax.Array, a: Any, b: Any) -> Any:
+    return jax.tree_util.tree_map(
+        lambda x, y: jnp.where(pred, x, y), a, b
+    )
+
+
+class _BatchSlotRef:
+    """What a fulfilled SaveGameState cell holds: a reference into the pool's
+    device ring.  ``load()``/``data()`` on the cell returns this; materialize
+    via the owning executor (a device gather + transfer — diagnostics only,
+    the live path never calls it)."""
+
+    __slots__ = ("owner", "index", "frame")
+
+    def __init__(self, owner: "BatchedRequestExecutor", index: int, frame: Frame):
+        self.owner = owner
+        self.index = index
+        self.frame = frame
+
+    def materialize(self) -> Any:
+        return self.owner.ring_state(self.index, self.frame)
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"_BatchSlotRef(session={self.index}, frame={self.frame})"
+
+
+class _LazyBatchChecksum:
+    """Lazy u128 checksum handle backed by the pool's digest ring; satisfies
+    ``GameStateCell.save``'s ``materialize()`` protocol so the desync
+    exchange only pays a device read for frames it actually reports."""
+
+    __slots__ = ("_owner", "_index", "_frame", "_value")
+
+    def __init__(self, owner: "BatchedRequestExecutor", index: int, frame: Frame):
+        self._owner = owner
+        self._index = index
+        self._frame = frame
+        self._value: Optional[int] = None
+
+    def materialize(self) -> int:
+        if self._value is None:
+            self._value = self._owner.ring_checksum(self._index, self._frame)
+        return self._value
+
+
+class BatchedRequestExecutor:
+    """Fulfills B sessions' GgrsRequest lists with one dispatch per tick.
+
+    ``advance``         pure JAX ``(state, inputs_array) -> state`` (unbatched;
+                        the pool vmaps it).
+    ``init_state``      one session's initial state pytree.
+    ``inputs_to_array`` maps a request's ``[(input, status), ...]`` to the
+                        array ``advance`` consumes — same contract as
+                        ``ops.DeviceRequestExecutor``.
+    ``batch_size``      B, the number of pooled sessions (index 0..B-1).
+    ``ring_length``     saved-state slots per session; must exceed the
+                        sessions' ``max_prediction`` (the reference keeps
+                        ``max_prediction + 1`` cells, sync_layer.rs:144-166).
+    ``max_burst``       most advances one tick can carry (rollback resims +
+                        the live advance): ``max_prediction + 1`` for the
+                        stock P2P session.
+    ``mesh``            optional ``jax.sharding.Mesh``: shard the session
+                        axis over every mesh axis (``batch_size`` must divide
+                        the device count) so one pool spans chips — sessions
+                        are independent, so the tick program needs no
+                        collectives and scales linearly over ICI-attached
+                        devices.  Descriptor arrays are built host-side and
+                        split per-shard by ``shard_map``.
+    """
+
+    def __init__(
+        self,
+        advance: Callable[[Any, Any], Any],
+        init_state: Any,
+        inputs_to_array: Callable[[Sequence[Tuple[Any, Any]]], np.ndarray],
+        batch_size: int,
+        ring_length: int,
+        max_burst: int,
+        with_checksums: bool = True,
+        mesh: Optional["jax.sharding.Mesh"] = None,
+    ) -> None:
+        assert batch_size >= 1 and ring_length >= 2 and max_burst >= 1
+        self.batch_size = batch_size
+        self.ring_length = ring_length
+        self.max_burst = max_burst
+        self._inputs_to_array = inputs_to_array
+        self._with_checksums = with_checksums
+        self.mesh = mesh
+        if mesh is not None:
+            assert batch_size % mesh.devices.size == 0, (
+                f"batch_size {batch_size} must divide evenly over "
+                f"{mesh.devices.size} mesh devices"
+            )
+
+        from ..ops.ring import DeviceStateRing
+
+        state0 = jax.tree_util.tree_map(jnp.asarray, init_state)
+        B, R = batch_size, ring_length
+        self._ring = DeviceStateRing(R)
+        ring0 = self._ring.init(state0)
+        self._carry: Dict[str, Any] = {
+            "live": jax.tree_util.tree_map(
+                lambda l: jnp.broadcast_to(l[None, ...], (B,) + l.shape), state0
+            ),
+            # one DeviceStateRing (states / checksums / frames) per session,
+            # stacked on a leading B axis; its frame tags back the host-side
+            # accessors and the _parse-time ring-capacity guard
+            "ring": jax.tree_util.tree_map(
+                lambda l: jnp.broadcast_to(l[None, ...], (B,) + l.shape).copy(),
+                ring0,
+            ),
+        }
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            self._sharding = NamedSharding(
+                mesh, PartitionSpec(tuple(mesh.axis_names))
+            )
+            self._carry = jax.tree_util.tree_map(
+                lambda l: jax.device_put(l, self._sharding), self._carry
+            )
+        self._input_dtype: Optional[np.dtype] = None
+        self._input_shape: Optional[Tuple[int, ...]] = None
+        # host shadow of the ring frame tags: loud failure at _parse time if
+        # a session rolls back past ring_length (device aliasing is silent)
+        self._host_frames = np.full((B, R), -1, np.int64)
+
+        dring = self._ring
+        zero_cs = jnp.zeros((CHECKSUM_LANES,), jnp.uint32)
+
+        def session_tick(
+            live: Any,
+            ring: Any,
+            pre_save: jax.Array,
+            pre_frame: jax.Array,
+            do_load: jax.Array,
+            load_frame: jax.Array,
+            postload_save: jax.Array,
+            postload_frame: jax.Array,
+            n_adv: jax.Array,
+            inputs: Any,  # [max_burst, ...]
+            save_mask: jax.Array,  # [max_burst]
+            save_frame: jax.Array,  # [max_burst]
+        ):
+            def write(ring, frame, st, pred):
+                cs = checksum_device(st) if with_checksums else zero_cs
+                return dring.save_where(ring, frame, st, cs, pred)
+
+            ring = write(ring, pre_frame, live, pre_save)
+            st = _tree_where(do_load, dring.load(ring, load_frame), live)
+            # sparse saving can save the just-loaded state before any advance
+            # (reference: p2p_session.rs:666-672 — the min_confirmed save)
+            ring = write(ring, postload_frame, st, postload_save)
+
+            def step(carry, xs):
+                st, ring = carry
+                j, inp, smask, sframe = xs
+                act = j < n_adv
+                st = _tree_where(act, advance(st, inp), st)
+                ring = write(ring, sframe, st, act & smask)
+                return (st, ring), None
+
+            (st, ring), _ = jax.lax.scan(
+                step,
+                (st, ring),
+                (
+                    jnp.arange(max_burst, dtype=jnp.int32),
+                    inputs,
+                    save_mask,
+                    save_frame,
+                ),
+            )
+            return st, ring
+
+        def tick(carry: Dict[str, Any], desc: Dict[str, Any]) -> Dict[str, Any]:
+            live, ring = jax.vmap(session_tick)(
+                carry["live"],
+                carry["ring"],
+                desc["pre_save"],
+                desc["pre_frame"],
+                desc["do_load"],
+                desc["load_frame"],
+                desc["postload_save"],
+                desc["postload_frame"],
+                desc["n_adv"],
+                desc["inputs"],
+                desc["save_mask"],
+                desc["save_frame"],
+            )
+            return {"live": live, "ring": ring}
+
+        if mesh is not None:
+            # sessions are independent: shard the B axis, no collectives
+            try:  # jax >= 0.8
+                from jax import shard_map
+            except ImportError:  # pragma: no cover - older jax
+                from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec
+
+            spec_b = PartitionSpec(tuple(mesh.axis_names))
+            tick = shard_map(
+                tick,
+                mesh=mesh,
+                in_specs=(spec_b, spec_b),
+                out_specs=spec_b,
+                check_vma=False,
+            )
+
+        donate = (0,) if jax.default_backend() == "tpu" else ()
+        self._tick = jax.jit(tick, donate_argnums=donate)
+
+        # slot probe with TRACED indices: one compile covers every
+        # (session, slot) the desync exchange ever reads.  Eager integer
+        # indexing would bake the indices into the program and recompile per
+        # distinct pair — measured ~1s of compile per exchange interval,
+        # enough to trip real-clock disconnect timers mid-session.
+        def _fetch(frames: jax.Array, checksums: jax.Array, b, s):
+            row_f = jax.lax.dynamic_index_in_dim(frames, b, 0, keepdims=False)
+            row_c = jax.lax.dynamic_index_in_dim(checksums, b, 0, keepdims=False)
+            return (
+                jax.lax.dynamic_index_in_dim(row_f, s, 0, keepdims=False),
+                jax.lax.dynamic_index_in_dim(row_c, s, 0, keepdims=False),
+            )
+
+        self._fetch_slot = jax.jit(_fetch)
+
+    # ------------------------------------------------------------------
+    # request-list parsing (host, NumPy only — zero dispatches)
+    # ------------------------------------------------------------------
+
+    def _parse(
+        self, index: int, requests: List[GgrsRequest], desc: Dict[str, np.ndarray]
+    ) -> None:
+        """Normalize one session's tick into the descriptor row ``index``,
+        fulfilling its Save cells with lazy slot references."""
+        i = 0
+        n = len(requests)
+        b = index
+
+        def fulfill_save(req: SaveGameState) -> None:
+            self._host_frames[b, req.frame % self.ring_length] = req.frame
+            req.cell.save(
+                req.frame,
+                _BatchSlotRef(self, b, req.frame),
+                _LazyBatchChecksum(self, b, req.frame)
+                if self._with_checksums
+                else None,
+            )
+
+        # optional pre-save(s) of the live state (the frame-0 tick emits the
+        # initial save AND the per-frame save, both of frame 0 — reference:
+        # p2p_session.rs:307-310); all must label the same frame, since no
+        # advance runs between them
+        while i < n and isinstance(requests[i], SaveGameState):
+            if desc["pre_save"][b]:
+                assert desc["pre_frame"][b] == requests[i].frame, (
+                    f"session {b}: consecutive pre-saves of different frames "
+                    f"({desc['pre_frame'][b]} then {requests[i].frame})"
+                )
+            desc["pre_save"][b] = True
+            desc["pre_frame"][b] = requests[i].frame
+            fulfill_save(requests[i])
+            i += 1
+
+        if i < n and isinstance(requests[i], LoadGameState):
+            req = requests[i]
+            data = req.cell.data()
+            assert (
+                isinstance(data, _BatchSlotRef)
+                and data.owner is self
+                and data.index == b
+                and data.frame == req.frame
+            ), (
+                f"session {b} loads frame {req.frame} from a cell this pool "
+                f"did not save ({data!r})"
+            )
+            # ring-capacity guard: the device gather cannot tell an aliased
+            # slot from the right one, so check the host shadow of the frame
+            # tags loudly here (a session whose max_prediction reaches
+            # ring_length would otherwise silently load a NEWER frame)
+            held = self._host_frames[b, req.frame % self.ring_length]
+            assert held == req.frame, (
+                f"session {b}: rollback to frame {req.frame} but its ring "
+                f"slot holds frame {held} — ring_length={self.ring_length} "
+                f"is too small for this session's prediction window"
+            )
+            desc["do_load"][b] = True
+            desc["load_frame"][b] = req.frame
+            i += 1
+            # sparse saving: save of the just-loaded state before any advance
+            while i < n and isinstance(requests[i], SaveGameState):
+                if desc["postload_save"][b]:
+                    assert desc["postload_frame"][b] == requests[i].frame, (
+                        f"session {b}: consecutive post-load saves of "
+                        f"different frames"
+                    )
+                desc["postload_save"][b] = True
+                desc["postload_frame"][b] = requests[i].frame
+                fulfill_save(requests[i])
+                i += 1
+
+        j = 0
+        while i < n and isinstance(requests[i], AdvanceFrame):
+            assert j < self.max_burst, (
+                f"session {b}: tick carries more than max_burst="
+                f"{self.max_burst} advances"
+            )
+            # shapes were recorded by warmup(); _blank_desc asserts that
+            desc["inputs"][b, j] = np.asarray(
+                self._inputs_to_array(requests[i].inputs)
+            )
+            i += 1
+            if i < n and isinstance(requests[i], SaveGameState):
+                desc["save_mask"][b, j] = True
+                desc["save_frame"][b, j] = requests[i].frame
+                fulfill_save(requests[i])
+                i += 1
+            j += 1
+        desc["n_adv"][b] = j
+        assert i == n, (
+            f"session {b}: unsupported request shape at position {i}: "
+            f"{requests[i]!r}"
+        )
+
+    def _blank_desc(self) -> Dict[str, np.ndarray]:
+        B, D = self.batch_size, self.max_burst
+        assert self._input_shape is not None, (
+            "call warmup(example_inputs) before the first run()"
+        )
+        return {
+            "pre_save": np.zeros((B,), bool),
+            "pre_frame": np.zeros((B,), np.int32),
+            "do_load": np.zeros((B,), bool),
+            "load_frame": np.zeros((B,), np.int32),
+            "postload_save": np.zeros((B,), bool),
+            "postload_frame": np.zeros((B,), np.int32),
+            "n_adv": np.zeros((B,), np.int32),
+            "inputs": np.zeros((B, D) + self._input_shape, self._input_dtype),
+            "save_mask": np.zeros((B, D), bool),
+            "save_frame": np.zeros((B, D), np.int32),
+        }
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+
+    def warmup(self, example_inputs: Any) -> None:
+        """Record the per-frame input array shape and compile the tick
+        program before any live session exists (a compile stall inside a live
+        loop trips real-clock disconnect timers — see ops executor warmup)."""
+        arr = np.asarray(example_inputs)
+        self._input_dtype = arr.dtype
+        self._input_shape = arr.shape
+        desc = self._blank_desc()
+        out = self._tick(self._carry, desc)
+        jax.block_until_ready(out)
+        # a no-op tick leaves the carry semantically unchanged; keep the
+        # result so donation (TPU) doesn't invalidate the live buffers
+        self._carry = out
+        # the desync exchange's slot probe must be compiled up front too
+        jax.block_until_ready(
+            self._fetch_slot(
+                self._carry["ring"]["frames"],
+                self._carry["ring"]["checksums"],
+                np.int32(0),
+                np.int32(0),
+            )
+        )
+
+    def run(self, request_lists: Sequence[List[GgrsRequest]]) -> None:
+        """Fulfill all B sessions' request lists — ONE device dispatch (zero
+        if every list is empty).  ``request_lists[b]`` belongs to session
+        ``b``; sessions with nothing to do this tick pass ``[]``."""
+        assert len(request_lists) == self.batch_size
+        if all(not reqs for reqs in request_lists):
+            return
+        desc = self._blank_desc()
+        for b, reqs in enumerate(request_lists):
+            if reqs:
+                self._parse(b, reqs, desc)
+        self._carry = self._tick(self._carry, desc)
+
+    # ------------------------------------------------------------------
+    # accessors (device reads — diagnostics / desync exchange, not hot path)
+    # ------------------------------------------------------------------
+
+    @property
+    def live_states(self) -> Any:
+        """The [B, ...] live state pytree (device handles; no transfer)."""
+        return self._carry["live"]
+
+    def live_state(self, index: int) -> Any:
+        """One session's live state, fetched to host."""
+        return jax.device_get(
+            jax.tree_util.tree_map(lambda l: l[index], self._carry["live"])
+        )
+
+    def _slot_probe(self, index: int, frame: Frame):
+        """(slot, held_frame, checksum_lanes) via the precompiled traced-index
+        fetch — one program for every (session, slot), one transfer for both
+        scalars."""
+        slot = frame % self.ring_length
+        held, lanes = jax.device_get(
+            self._fetch_slot(
+                self._carry["ring"]["frames"],
+                self._carry["ring"]["checksums"],
+                np.int32(index),
+                np.int32(slot),
+            )
+        )
+        assert int(held) == frame, (
+            f"session {index}: ring slot {slot} holds frame {int(held)}, "
+            f"wanted {frame} (rolled past ring_length={self.ring_length}?)"
+        )
+        return slot, lanes
+
+    def ring_state(self, index: int, frame: Frame) -> Any:
+        """A saved state, fetched to host (validates the slot still holds
+        ``frame``).  Diagnostics path — eager slicing is fine here."""
+        slot, _ = self._slot_probe(index, frame)
+        return jax.device_get(
+            jax.tree_util.tree_map(
+                lambda buf: buf[index, slot], self._carry["ring"]["states"]
+            )
+        )
+
+    def ring_checksum(self, index: int, frame: Frame) -> int:
+        """A saved frame's u128 checksum (validates the slot)."""
+        assert self._with_checksums, "pool was built with with_checksums=False"
+        _, lanes = self._slot_probe(index, frame)
+        return checksum_to_u128(lanes)
+
+    def block_until_ready(self) -> None:
+        jax.block_until_ready(self._carry)

@@ -138,3 +138,13 @@ class TestExampleSmoke:
         # not bailed early on a disconnect
         spec_out = results[2][0]
         assert "[spectator] done" in spec_out, spec_out
+
+    def test_server_massed_hosting(self):
+        out = run_example(
+            [
+                EXAMPLES / "ex_game_server.py",
+                "--matches", "4",
+                "--frames", "80",
+            ]
+        )
+        assert "SERVER-EXAMPLE-OK" in out

@@ -1,0 +1,258 @@
+"""BatchedRequestExecutor: massed fulfillment of live sessions' requests.
+
+Oracle: a pool of B sessions fulfilled by ONE BatchedRequestExecutor must be
+bit-identical to the same B sessions each fulfilled by its own
+``ops.DeviceRequestExecutor`` (which is itself equivalence-tested against the
+host path).  Covers heterogeneous ticks — different rollback depths per
+session in the same dispatch — plus desync checksum fulfillment and sparse
+saving.
+"""
+
+import random
+
+import numpy as np
+
+import jax
+
+from ggrs_tpu.core import DesyncDetection, Local, Remote
+from ggrs_tpu.games import BoxGame, boxgame_config
+from ggrs_tpu.net import InMemoryNetwork
+from ggrs_tpu.ops import DeviceRequestExecutor, ExecutorPrograms
+from ggrs_tpu.parallel import BatchedRequestExecutor
+from ggrs_tpu.sessions import SessionBuilder
+
+
+def _to_arr(pairs):
+    return np.asarray([p[0] for p in pairs], np.uint8)
+
+
+def _make_matches(n_matches, seed, sparse=False, desync_interval=0):
+    """n_matches 2-peer BoxGame matches over one in-memory net.  Returns
+    (sessions, schedules): flat lists, session 2*m is match m's peer A."""
+    net = InMemoryNetwork()
+    sessions, schedules = [], []
+    for m in range(n_matches):
+        names = (f"A{m}", f"B{m}")
+        for me in (0, 1):
+            b = (
+                SessionBuilder(boxgame_config())
+                .with_clock(lambda: 0)
+                .with_rng(random.Random(seed + 7 * m + me))
+                .with_sparse_saving_mode(sparse)
+            )
+            if desync_interval:
+                b = b.with_desync_detection_mode(
+                    DesyncDetection(True, desync_interval)
+                )
+            b = b.add_player(Local(), me).add_player(
+                Remote(names[1 - me]), 1 - me
+            )
+            sessions.append(b.start_p2p_session(net.socket(names[me])))
+            # per-session input schedule; offsets differ per match so the
+            # pool sees heterogeneous rollback depths in one tick
+            schedules.append(
+                lambda i, m=m, me=me: ((i + 2 * m + me) // (2 + m % 3)) % 16
+            )
+    return sessions, schedules
+
+
+def _drive(sessions, schedules, fulfill, ticks, drain=14):
+    for i in range(ticks + drain):
+        for s in sessions:
+            s.poll_remote_clients()
+        all_reqs = []
+        for handle_owner, (s, sched) in enumerate(zip(sessions, schedules)):
+            s.add_local_input(handle_owner % 2, sched(min(i, ticks - 1)))
+            all_reqs.append(s.advance_frame())
+        fulfill(all_reqs)
+
+
+def _run_pool(n_matches, ticks, seed, sparse=False, desync_interval=0):
+    sessions, schedules = _make_matches(
+        n_matches, seed, sparse=sparse, desync_interval=desync_interval
+    )
+    game = BoxGame(2)
+    B = len(sessions)
+    pool = BatchedRequestExecutor(
+        game.advance, game.init_state(), _to_arr,
+        batch_size=B, ring_length=10, max_burst=9,
+    )
+    pool.warmup(np.zeros((2,), np.uint8))
+    _drive(sessions, schedules, pool.run, ticks)
+    states = [pool.live_state(b) for b in range(B)]
+    frames = [s.current_frame for s in sessions]
+    events = [list(s.events()) for s in sessions]
+    return states, frames, events, pool
+
+
+def _run_individual(n_matches, ticks, seed, sparse=False, desync_interval=0):
+    sessions, schedules = _make_matches(
+        n_matches, seed, sparse=sparse, desync_interval=desync_interval
+    )
+    game = BoxGame(2)
+    programs = ExecutorPrograms(game.advance)
+    executors = [
+        DeviceRequestExecutor(
+            game.advance, game.init_state(), _to_arr, programs=programs
+        )
+        for _ in sessions
+    ]
+
+    def fulfill(all_reqs):
+        for ex, reqs in zip(executors, all_reqs):
+            ex.run(reqs)
+
+    _drive(sessions, schedules, fulfill, ticks)
+    states = [jax.device_get(ex.state) for ex in executors]
+    frames = [s.current_frame for s in sessions]
+    events = [list(s.events()) for s in sessions]
+    return states, frames, events
+
+
+def _assert_states_equal(got, want, label):
+    for b, (g, w) in enumerate(zip(got, want)):
+        for k in w:
+            np.testing.assert_array_equal(
+                np.asarray(g[k]), np.asarray(w[k]),
+                err_msg=f"{label}: session {b} key {k}",
+            )
+
+
+class TestBatchedRequestExecutor:
+    def test_pool_matches_individual_executors(self):
+        """4 matches (8 sessions) with different rollback cadences: pooled
+        fulfillment must be bit-identical to per-session executors."""
+        pool_states, pool_frames, _, _ = _run_pool(4, 40, seed=11)
+        ind_states, ind_frames, _ = _run_individual(4, 40, seed=11)
+        assert pool_frames == ind_frames
+        _assert_states_equal(pool_states, ind_states, "pool-vs-individual")
+
+    def test_peers_converge_within_each_match(self):
+        states, frames, _, _ = _run_pool(3, 36, seed=23)
+        for m in range(3):
+            assert frames[2 * m] == frames[2 * m + 1]
+            for k in states[0]:
+                np.testing.assert_array_equal(
+                    np.asarray(states[2 * m][k]),
+                    np.asarray(states[2 * m + 1][k]),
+                    err_msg=f"match {m} key {k}",
+                )
+
+    def test_sparse_saving_through_the_pool(self):
+        pool_states, pool_frames, _, _ = _run_pool(2, 36, seed=31, sparse=True)
+        ind_states, ind_frames, _ = _run_individual(2, 36, seed=31, sparse=True)
+        assert pool_frames == ind_frames
+        _assert_states_equal(pool_states, ind_states, "sparse")
+
+    def test_desync_detection_rides_lazy_ring_checksums(self):
+        """With desync detection on, sessions exchange checksums the pool
+        serves lazily from the digest ring — no DesyncDetected events for
+        honest peers, and the checksum values match the individual path."""
+        _, _, events, _ = _run_pool(2, 40, seed=43, desync_interval=8)
+        for evs in events:
+            assert not any(
+                type(e).__name__ == "EvDesyncDetected" for e in evs
+            ), evs
+
+    def test_ring_accessors_validate_frames(self):
+        import pytest
+
+        states, frames, _, pool = _run_pool(1, 20, seed=5)
+        # a recent frame is retrievable and consistent with its checksum
+        f = frames[0] - 1
+        st = pool.ring_state(0, f)
+        assert set(st) == set(states[0])
+        cs = pool.ring_checksum(0, f)
+        assert isinstance(cs, int) and cs > 0
+        # a frame that has rolled out of the ring is refused
+        with pytest.raises(AssertionError):
+            pool.ring_state(0, max(0, f - 50))
+
+    def test_pool_sharded_over_virtual_mesh(self):
+        """The same pooled fulfillment sharded over the 8-device virtual
+        mesh: bit-identical to the unsharded pool (sessions are independent —
+        no collectives, linear scaling)."""
+        import jax as _jax
+
+        from ggrs_tpu.parallel import make_mesh
+
+        if len(_jax.devices()) < 8:
+            import pytest
+
+            pytest.skip("needs the 8-device virtual mesh")
+
+        sessions, schedules = _make_matches(4, seed=11)
+        game = BoxGame(2)
+        pool = BatchedRequestExecutor(
+            game.advance, game.init_state(), _to_arr,
+            batch_size=8, ring_length=10, max_burst=9,
+            mesh=make_mesh(8),
+        )
+        pool.warmup(np.zeros((2,), np.uint8))
+        _drive(sessions, schedules, pool.run, 40)
+        states = [pool.live_state(b) for b in range(8)]
+        frames = [s.current_frame for s in sessions]
+
+        ind_states, ind_frames, _ = _run_individual(4, 40, seed=11)
+        assert frames == ind_frames
+        _assert_states_equal(states, ind_states, "sharded-pool")
+
+    def test_undersized_ring_fails_loudly(self):
+        """A pool whose ring_length can't cover the sessions' prediction
+        window must raise at parse time — the device gather would otherwise
+        silently load a newer frame that aliased into the slot.  Rollback
+        depth must exceed ring_length for staleness to be possible (each
+        rollback re-saves its whole window), so delay delivery to deepen the
+        prediction tail."""
+        import pytest
+
+        net = InMemoryNetwork(latency_ticks=4)
+        sessions = []
+        for me, other, h in (("A", "B", 0), ("B", "A", 1)):
+            sessions.append(
+                SessionBuilder(boxgame_config())
+                .with_clock(lambda: 0)
+                .with_rng(random.Random(11 + h))
+                .add_player(Local(), h)
+                .add_player(Remote(other), 1 - h)
+                .start_p2p_session(net.socket(me))
+            )
+        game = BoxGame(2)
+        pool = BatchedRequestExecutor(
+            game.advance, game.init_state(), _to_arr,
+            batch_size=2, ring_length=3, max_burst=9,
+        )
+        pool.warmup(np.zeros((2,), np.uint8))
+        with pytest.raises(AssertionError, match="too small"):
+            for i in range(40):
+                net.tick()
+                for s in sessions:
+                    s.poll_remote_clients()
+                reqs = []
+                for h, s in enumerate(sessions):
+                    s.add_local_input(h, (i // 2) % 16)
+                    reqs.append(s.advance_frame())
+                pool.run(reqs)
+
+    def test_one_dispatch_per_tick(self):
+        """The pool's whole point: a tick with B heterogeneous request lists
+        costs exactly one program dispatch (zero when all-empty)."""
+        sessions, schedules = _make_matches(3, seed=3)
+        game = BoxGame(2)
+        pool = BatchedRequestExecutor(
+            game.advance, game.init_state(), _to_arr,
+            batch_size=6, ring_length=10, max_burst=9,
+        )
+        pool.warmup(np.zeros((2,), np.uint8))
+        calls = {"n": 0}
+        real_tick = pool._tick
+
+        def counting(carry, desc):
+            calls["n"] += 1
+            return real_tick(carry, desc)
+
+        pool._tick = counting
+        _drive(sessions, schedules, pool.run, 20, drain=0)
+        assert calls["n"] == 20
+        pool.run([[] for _ in range(6)])
+        assert calls["n"] == 20, "an all-empty tick must not dispatch"
