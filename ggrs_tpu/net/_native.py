@@ -20,6 +20,8 @@ import threading
 from pathlib import Path
 from typing import List, Optional, Sequence
 
+from .wire import WireError
+
 _LIB_NAME = "_ggrs_codec.so"
 # Resource caps for the fast path.  Real packets sit under the ~508-byte UDP
 # budget with at most the 128-input pending window; anything bigger (but
@@ -50,6 +52,47 @@ _ERROR_NAMES = {
     -10: "trailing bytes after message",
     -11: "output buffer too small",
     -12: "too many inputs",
+}
+
+
+# must mirror struct GgrsMsg in native/codec.cpp field-for-field (ctypes
+# reproduces the C compiler's alignment/padding for same-ordered fields)
+_MAX_PLAYERS_ON_WIRE = 64
+
+
+class _GgrsMsg(ctypes.Structure):
+    _fields_ = [
+        ("magic", ctypes.c_uint16),
+        ("tag", ctypes.c_uint8),
+        ("disconnect_requested", ctypes.c_uint8),
+        ("start_frame", ctypes.c_int64),
+        ("ack_frame", ctypes.c_int64),
+        ("frame", ctypes.c_int64),
+        ("frame_advantage", ctypes.c_int16),
+        ("ping", ctypes.c_uint64),
+        ("pong", ctypes.c_uint64),
+        ("checksum_lo", ctypes.c_uint64),
+        ("checksum_hi", ctypes.c_uint64),
+        ("random_nonce", ctypes.c_uint64),
+        ("n_status", ctypes.c_int32),
+        ("payload_off", ctypes.c_uint64),
+        ("payload_len", ctypes.c_uint64),
+        ("status_disconnected", ctypes.c_uint8 * _MAX_PLAYERS_ON_WIRE),
+        ("status_last_frame", ctypes.c_int64 * _MAX_PLAYERS_ON_WIRE),
+    ]
+
+
+# message-framing error codes (mirror codec.cpp's msg section); kMsgFallback
+# means "legal for Python's unbounded ints but not for the fast path" —
+# callers retry with the Python decoder
+_MSG_FALLBACK = -100
+_MSG_ERROR_NAMES = {
+    -1: "truncated data",
+    -2: "uvarint too long",
+    -20: "invalid bool byte",
+    -21: "unknown message tag",
+    -22: "too many connect statuses",
+    -23: "trailing bytes after message",
 }
 
 
@@ -128,6 +171,21 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_size_t,
             ctypes.POINTER(ctypes.c_size_t),
         ]
+        lib.ggrs_msg_decode.restype = ctypes.c_int
+        lib.ggrs_msg_decode.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.POINTER(_GgrsMsg),
+        ]
+        lib.ggrs_msg_encode.restype = ctypes.c_int
+        lib.ggrs_msg_encode.argtypes = [
+            ctypes.POINTER(_GgrsMsg),
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
         _lib = lib
         return _lib
 
@@ -160,6 +218,186 @@ def encode(reference: bytes, inputs: Sequence[bytes]) -> Optional[bytes]:
     if rc != 0:  # pragma: no cover - encode can only fail on a bad bound
         return None  # fall back to the Python encoder rather than fail
     return ctypes.string_at(out, out_len.value)  # .raw would copy all of cap
+
+
+_msg_scratch = _GgrsMsg()
+_msg_out_cap = 1 << 16
+_msg_out: Optional[ctypes.Array] = None
+_M = None  # lazily-bound ggrs_tpu.net.messages module (avoids import cycle
+#            at module load AND the per-call `from . import` lookup cost)
+
+
+def _messages():
+    global _M
+    if _M is None:
+        from . import messages
+
+        _M = messages
+    return _M
+
+_TAG_INPUT = 0
+_TAG_INPUT_ACK = 1
+_TAG_QUALITY_REPORT = 2
+_TAG_QUALITY_REPLY = 3
+_TAG_CHECKSUM_REPORT = 4
+_TAG_KEEP_ALIVE = 5
+_TAG_SYNC_REQUEST = 6
+_TAG_SYNC_REPLY = 7
+
+
+def msg_decode(data: bytes):
+    """Native Message decode; returns the built ``messages.Message``, or
+    ``None`` when the library is unavailable / the packet needs the Python
+    decoder (varints beyond u64).  Raises ``wire.WireError`` on malformed
+    data, like the Python decoder."""
+    lib = _load()
+    if lib is None:
+        return None
+    M = _messages()
+
+    with _lock:  # the scratch struct is reused; protocol use is 1-thread
+        m = _msg_scratch
+        rc = lib.ggrs_msg_decode(data, len(data), ctypes.byref(m))
+        if rc == _MSG_FALLBACK:
+            return None
+        if rc != 0:
+            raise WireError(_MSG_ERROR_NAMES.get(rc, f"native error {rc}"))
+        tag = m.tag
+        if tag == _TAG_INPUT:
+            n = m.n_status
+            body = M.InputMessage(
+                peer_connect_status=[
+                    M.ConnectionStatus(
+                        disconnected=bool(m.status_disconnected[i]),
+                        last_frame=m.status_last_frame[i],
+                    )
+                    for i in range(n)
+                ],
+                disconnect_requested=bool(m.disconnect_requested),
+                start_frame=m.start_frame,
+                ack_frame=m.ack_frame,
+                bytes=data[m.payload_off : m.payload_off + m.payload_len],
+            )
+        elif tag == _TAG_INPUT_ACK:
+            body = M.InputAck(ack_frame=m.ack_frame)
+        elif tag == _TAG_QUALITY_REPORT:
+            body = M.QualityReport(
+                frame_advantage=m.frame_advantage, ping=m.ping
+            )
+        elif tag == _TAG_QUALITY_REPLY:
+            body = M.QualityReply(pong=m.pong)
+        elif tag == _TAG_CHECKSUM_REPORT:
+            body = M.ChecksumReport(
+                checksum=m.checksum_lo | (m.checksum_hi << 64), frame=m.frame
+            )
+        elif tag == _TAG_KEEP_ALIVE:
+            body = M.KeepAlive()
+        elif tag == _TAG_SYNC_REQUEST:
+            body = M.SyncRequest(random=m.random_nonce)
+        else:  # _TAG_SYNC_REPLY (unknown tags already errored in C++)
+            body = M.SyncReply(random=m.random_nonce)
+        return M.Message(magic=m.magic, body=body)
+
+
+def msg_encode(msg) -> Optional[bytes]:
+    """Native Message encode; returns the wire bytes or ``None`` when the
+    library is unavailable or a field exceeds the fast path's 64-bit range
+    (caller falls back to the Python encoder)."""
+    lib = _load()
+    if lib is None:
+        return None
+    M = _messages()
+
+    global _msg_out
+    b = msg.body
+
+    # EXPLICIT range checks — ctypes structure-field assignment silently
+    # truncates out-of-range ints (no OverflowError), which would put bytes
+    # on the wire that differ from the Python encoder.  Any out-of-range
+    # field returns None so the Python path keeps its exact semantics
+    # (unbounded zigzag for huge frames, struct.error for i16 overflow,
+    # ValueError for negative nonces).
+    def i64_ok(v) -> bool:
+        return isinstance(v, int) and -(1 << 63) <= v < (1 << 63)
+
+    def i16_ok(v) -> bool:
+        return isinstance(v, int) and -(1 << 15) <= v < (1 << 15)
+
+    def u64_ok(v) -> bool:
+        return isinstance(v, int) and 0 <= v < (1 << 64)
+
+    with _lock:
+        m = _msg_scratch
+        payload = b""
+        try:
+            m.magic = msg.magic & 0xFFFF
+            if isinstance(b, M.InputMessage):
+                statuses = b.peer_connect_status
+                if len(statuses) > _MAX_PLAYERS_ON_WIRE:
+                    return None  # python encoder handles (and the wire rejects)
+                if not (i64_ok(b.start_frame) and i64_ok(b.ack_frame)):
+                    return None
+                if not all(i64_ok(cs.last_frame) for cs in statuses):
+                    return None
+                m.tag = _TAG_INPUT
+                m.n_status = len(statuses)
+                for i, cs in enumerate(statuses):
+                    m.status_disconnected[i] = 1 if cs.disconnected else 0
+                    m.status_last_frame[i] = cs.last_frame
+                m.disconnect_requested = 1 if b.disconnect_requested else 0
+                m.start_frame = b.start_frame
+                m.ack_frame = b.ack_frame
+                payload = b.bytes
+            elif isinstance(b, M.InputAck):
+                if not i64_ok(b.ack_frame):
+                    return None
+                m.tag = _TAG_INPUT_ACK
+                m.ack_frame = b.ack_frame
+            elif isinstance(b, M.QualityReport):
+                if not i16_ok(b.frame_advantage):
+                    return None  # python raises struct.error, as before
+                m.tag = _TAG_QUALITY_REPORT
+                m.frame_advantage = b.frame_advantage
+                m.ping = b.ping & 0xFFFFFFFFFFFFFFFF
+            elif isinstance(b, M.QualityReply):
+                m.tag = _TAG_QUALITY_REPLY
+                m.pong = b.pong & 0xFFFFFFFFFFFFFFFF
+            elif isinstance(b, M.ChecksumReport):
+                if not i64_ok(b.frame):
+                    return None
+                m.tag = _TAG_CHECKSUM_REPORT
+                m.frame = b.frame
+                m.checksum_lo = b.checksum & 0xFFFFFFFFFFFFFFFF
+                m.checksum_hi = (b.checksum >> 64) & 0xFFFFFFFFFFFFFFFF
+            elif isinstance(b, M.KeepAlive):
+                m.tag = _TAG_KEEP_ALIVE
+            elif isinstance(b, M.SyncRequest):
+                if not u64_ok(b.random):
+                    return None  # python raises ValueError on negatives
+                m.tag = _TAG_SYNC_REQUEST
+                m.random_nonce = b.random
+            elif isinstance(b, M.SyncReply):
+                if not u64_ok(b.random):
+                    return None
+                m.tag = _TAG_SYNC_REPLY
+                m.random_nonce = b.random
+            else:
+                return None  # unknown body: let the Python encoder raise
+        except (OverflowError, TypeError):
+            # belt-and-braces for non-int field types the checks above missed
+            return None
+        if _msg_out is None or len(payload) + 1024 > len(_msg_out):
+            _msg_out = ctypes.create_string_buffer(
+                max(_msg_out_cap, len(payload) + 1024)
+            )
+        out_len = ctypes.c_size_t(0)
+        rc = lib.ggrs_msg_encode(
+            ctypes.byref(m), payload, len(payload),
+            _msg_out, len(_msg_out), ctypes.byref(out_len),
+        )
+        if rc != 0:
+            return None  # python path as the universal fallback
+        return ctypes.string_at(_msg_out, out_len.value)
 
 
 def decode(reference: bytes, data: bytes) -> Optional[List[bytes]]:

@@ -13,6 +13,7 @@ from dataclasses import dataclass, field
 from typing import List, Union
 
 from ..core.types import Frame, NULL_FRAME
+from . import _native
 from .wire import Reader, WireError, Writer
 
 
@@ -122,6 +123,10 @@ class Message:
         cached = self.__dict__.get("_encoded")
         if cached is not None:
             return cached
+        fast = _native.msg_encode(self)
+        if fast is not None:
+            self.__dict__["_encoded"] = fast
+            return fast
         w = Writer()
         w.u16(self.magic)
         b = self.body
@@ -166,7 +171,13 @@ class Message:
     @staticmethod
     def decode(data: bytes) -> "Message":
         """Decode a datagram; raises WireError on malformed data (callers drop
-        undecodable packets, reference: udp_socket.rs:70-72)."""
+        undecodable packets, reference: udp_socket.rs:70-72).  Routes through
+        the native framing fast path (native/codec.cpp) when available; the
+        Python reader below remains the reference implementation and the
+        fallback for packets whose varints exceed u64."""
+        fast = _native.msg_decode(data)
+        if fast is not None:
+            return fast
         r = Reader(data)
         magic = r.u16()
         tag = r.u8()

@@ -112,8 +112,8 @@ class BatchedRequestExecutor:
                         the live advance): ``max_prediction + 1`` for the
                         stock P2P session.
     ``mesh``            optional ``jax.sharding.Mesh``: shard the session
-                        axis over every mesh axis (``batch_size`` must divide
-                        the device count) so one pool spans chips — sessions
+                        axis over every mesh axis (the device count must
+                        divide ``batch_size``) so one pool spans chips — sessions
                         are independent, so the tick program needs no
                         collectives and scales linearly over ICI-attached
                         devices.  Descriptor arrays are built host-side and

@@ -317,3 +317,219 @@ int ggrs_codec_decode(const uint8_t* reference, size_t reference_len,
 }
 
 }  // extern "C"
+
+// ===========================================================================
+// Message framing fast path (ggrs_tpu/net/messages.py + wire.py)
+// ===========================================================================
+//
+// The per-packet envelope — magic, tag, body fields, varints — is the other
+// host-side hot path: every peer parses every datagram through it.  The
+// format is wire.py's (little-endian fixed ints + LEB128 uvarints + zigzag
+// svarints); these functions are byte-compatible with messages.py's
+// encode/decode and are property-tested against them
+// (tests/test_native_codec.py).  Values a u64 cannot hold (Python's ints are
+// unbounded) return kMsgFallback so the caller can use the Python decoder —
+// identical observable behavior, just slower, on absurd-but-legal packets.
+
+namespace {
+
+constexpr int kMsgFallback = -100;
+constexpr int kMsgBadBool = -20;
+constexpr int kMsgUnknownTag = -21;
+constexpr int kMsgTooManyStatuses = -22;
+constexpr int kMsgTrailing = -23;
+constexpr size_t kMaxPlayersOnWire = 64;
+
+enum MsgTag : uint8_t {
+  kTagInput = 0,
+  kTagInputAck = 1,
+  kTagQualityReport = 2,
+  kTagQualityReply = 3,
+  kTagChecksumReport = 4,
+  kTagKeepAlive = 5,
+  kTagSyncRequest = 6,
+  kTagSyncReply = 7,
+};
+
+}  // namespace
+
+extern "C" {
+
+// Fixed-size decode target, caller-allocated and reused across packets.
+// payload_off/len index into the SOURCE buffer (zero-copy for input bytes).
+struct GgrsMsg {
+  uint16_t magic;
+  uint8_t tag;
+  uint8_t disconnect_requested;
+  int64_t start_frame;
+  int64_t ack_frame;
+  int64_t frame;
+  int16_t frame_advantage;
+  uint64_t ping;
+  uint64_t pong;
+  uint64_t checksum_lo;
+  uint64_t checksum_hi;
+  uint64_t random_nonce;
+  int32_t n_status;
+  uint64_t payload_off;
+  uint64_t payload_len;
+  uint8_t status_disconnected[kMaxPlayersOnWire];
+  int64_t status_last_frame[kMaxPlayersOnWire];
+};
+
+int ggrs_msg_decode(const uint8_t* buf, size_t len, GgrsMsg* out) {
+  Reader r{buf, len};
+  const uint8_t* p;
+  int rc = r.take(2, &p);
+  if (rc != kOk) return rc;
+  out->magic = static_cast<uint16_t>(p[0] | (p[1] << 8));
+  rc = r.u8(&out->tag);
+  if (rc != kOk) return rc;
+
+  auto read_bool = [&](uint8_t* v) -> int {
+    uint8_t b;
+    int rc2 = r.u8(&b);
+    if (rc2 != kOk) return rc2;
+    if (b > 1) return kMsgBadBool;
+    *v = b;
+    return kOk;
+  };
+
+  switch (out->tag) {
+    case kTagInput: {
+      uint64_t n;
+      rc = r.uvarint(&n);
+      if (rc != kOk) break;
+      if (n > kMaxPlayersOnWire) return kMsgTooManyStatuses;
+      out->n_status = static_cast<int32_t>(n);
+      for (uint64_t i = 0; i < n; ++i) {
+        rc = read_bool(&out->status_disconnected[i]);
+        if (rc != kOk) break;
+        rc = r.svarint(&out->status_last_frame[i]);
+        if (rc != kOk) break;
+      }
+      if (rc != kOk) break;
+      rc = read_bool(&out->disconnect_requested);
+      if (rc != kOk) break;
+      rc = r.svarint(&out->start_frame);
+      if (rc != kOk) break;
+      rc = r.svarint(&out->ack_frame);
+      if (rc != kOk) break;
+      const uint8_t* payload;
+      size_t payload_len;
+      rc = r.byte_string(&payload, &payload_len);
+      if (rc != kOk) break;
+      out->payload_off = static_cast<uint64_t>(payload - buf);
+      out->payload_len = payload_len;
+      break;
+    }
+    case kTagInputAck:
+      rc = r.svarint(&out->ack_frame);
+      break;
+    case kTagQualityReport: {
+      rc = r.take(2, &p);
+      if (rc != kOk) break;
+      out->frame_advantage =
+          static_cast<int16_t>(p[0] | (static_cast<uint16_t>(p[1]) << 8));
+      rc = r.take(8, &p);
+      if (rc != kOk) break;
+      std::memcpy(&out->ping, p, 8);
+      break;
+    }
+    case kTagQualityReply:
+      rc = r.take(8, &p);
+      if (rc != kOk) break;
+      std::memcpy(&out->pong, p, 8);
+      break;
+    case kTagChecksumReport:
+      rc = r.svarint(&out->frame);
+      if (rc != kOk) break;
+      rc = r.take(16, &p);
+      if (rc != kOk) break;
+      std::memcpy(&out->checksum_lo, p, 8);
+      std::memcpy(&out->checksum_hi, p + 8, 8);
+      break;
+    case kTagKeepAlive:
+      break;
+    case kTagSyncRequest:
+      rc = r.uvarint(&out->random_nonce);
+      break;
+    case kTagSyncReply:
+      rc = r.uvarint(&out->random_nonce);
+      break;
+    default:
+      return kMsgUnknownTag;
+  }
+  // a varint whose value needs > 64 bits decodes fine in Python (unbounded
+  // ints) — hand those packets back to the Python decoder for bit-identical
+  // observable behavior
+  if (rc == kErrTooLarge) return kMsgFallback;
+  if (rc != kOk) return rc;
+  if (r.remaining() != 0) return kMsgTrailing;
+  return kOk;
+}
+
+int ggrs_msg_encode(const GgrsMsg* m, const uint8_t* payload,
+                    size_t payload_len, uint8_t* out, size_t cap,
+                    size_t* out_len) {
+  Writer w;
+  w.buf.reserve(64 + payload_len);
+  w.u8(static_cast<uint8_t>(m->magic & 0xFF));
+  w.u8(static_cast<uint8_t>(m->magic >> 8));
+  w.u8(m->tag);
+  switch (m->tag) {
+    case kTagInput: {
+      if (m->n_status < 0 ||
+          static_cast<size_t>(m->n_status) > kMaxPlayersOnWire) {
+        return kMsgTooManyStatuses;
+      }
+      w.uvarint(static_cast<uint64_t>(m->n_status));
+      for (int32_t i = 0; i < m->n_status; ++i) {
+        w.u8(m->status_disconnected[i] ? 1 : 0);
+        w.svarint(m->status_last_frame[i]);
+      }
+      w.u8(m->disconnect_requested ? 1 : 0);
+      w.svarint(m->start_frame);
+      w.svarint(m->ack_frame);
+      w.uvarint(payload_len);
+      w.raw(payload, payload_len);
+      break;
+    }
+    case kTagInputAck:
+      w.svarint(m->ack_frame);
+      break;
+    case kTagQualityReport: {
+      uint16_t adv = static_cast<uint16_t>(m->frame_advantage);
+      w.u8(static_cast<uint8_t>(adv & 0xFF));
+      w.u8(static_cast<uint8_t>(adv >> 8));
+      for (int i = 0; i < 8; ++i)
+        w.u8(static_cast<uint8_t>(m->ping >> (8 * i)));
+      break;
+    }
+    case kTagQualityReply:
+      for (int i = 0; i < 8; ++i)
+        w.u8(static_cast<uint8_t>(m->pong >> (8 * i)));
+      break;
+    case kTagChecksumReport:
+      w.svarint(m->frame);
+      for (int i = 0; i < 8; ++i)
+        w.u8(static_cast<uint8_t>(m->checksum_lo >> (8 * i)));
+      for (int i = 0; i < 8; ++i)
+        w.u8(static_cast<uint8_t>(m->checksum_hi >> (8 * i)));
+      break;
+    case kTagKeepAlive:
+      break;
+    case kTagSyncRequest:
+    case kTagSyncReply:
+      w.uvarint(m->random_nonce);
+      break;
+    default:
+      return kMsgUnknownTag;
+  }
+  if (w.buf.size() > cap) return kErrBufferTooSmall;
+  std::memcpy(out, w.buf.data(), w.buf.size());
+  *out_len = w.buf.size();
+  return kOk;
+}
+
+}  // extern "C"

@@ -234,6 +234,69 @@ class TestBatchedRequestExecutor:
                     reqs.append(s.advance_frame())
                 pool.run(reqs)
 
+    def test_spectator_follows_through_the_pool(self):
+        """The pool serves ANY session emitting the request grammar: a
+        spectator (advance-only requests, sometimes none while waiting on the
+        host) shares the batch with its two P2P peers and tracks their
+        simulation bit-exactly."""
+        from ggrs_tpu.core import PredictionThreshold, Spectator
+
+        net = InMemoryNetwork()
+        clock = lambda: 0
+        host = (
+            SessionBuilder(boxgame_config())
+            .with_clock(clock)
+            .with_rng(random.Random(7))
+            .add_player(Local(), 0)
+            .add_player(Remote("B"), 1)
+            .add_player(Spectator("SPEC"), 2)
+            .start_p2p_session(net.socket("A"))
+        )
+        peer = (
+            SessionBuilder(boxgame_config())
+            .with_clock(clock)
+            .with_rng(random.Random(8))
+            .add_player(Remote("A"), 0)
+            .add_player(Local(), 1)
+            .start_p2p_session(net.socket("B"))
+        )
+        spec = (
+            SessionBuilder(boxgame_config())
+            .with_clock(clock)
+            .start_spectator_session("A", net.socket("SPEC"))
+        )
+        game = BoxGame(2)
+        pool = BatchedRequestExecutor(
+            game.advance, game.init_state(), _to_arr,
+            batch_size=3, ring_length=10, max_burst=9,
+        )
+        pool.warmup(np.zeros((2,), np.uint8))
+
+        for i in range(60):
+            host.poll_remote_clients()
+            peer.poll_remote_clients()
+            host.add_local_input(0, (min(i, 45) // 4) % 16)
+            reqs = [host.advance_frame()]
+            peer.add_local_input(1, (min(i, 45) // 3) % 16)
+            reqs.append(peer.advance_frame())
+            try:
+                reqs.append(spec.advance_frame())
+            except PredictionThreshold:
+                reqs.append([])  # still waiting on host input
+            pool.run(reqs)
+
+        assert spec.current_frame > 40, "spectator never followed"
+        # the spectator's live state after advancing frame f equals the
+        # host's save of frame f+1 (saves label the pre-advance frame, the
+        # spectator counts completed advances)
+        f = spec.current_frame
+        want = pool.ring_state(0, f + 1)
+        got = pool.live_state(2)
+        for k in want:
+            np.testing.assert_array_equal(
+                np.asarray(got[k]), np.asarray(want[k]), err_msg=k
+            )
+
     def test_one_dispatch_per_tick(self):
         """The pool's whole point: a tick with B heterogeneous request lists
         costs exactly one program dispatch (zero when all-empty)."""
