@@ -32,6 +32,7 @@ import subprocess
 import sys
 import time
 import zlib
+from typing import Optional, Tuple
 
 import numpy as np
 
@@ -46,8 +47,9 @@ PLAYERS = 2
 REPEATS = 3  # timed passes per config; best-of counters tunnel drift
 
 # config name -> (function name, per-child wall-clock budget in seconds).
-# Print/exec order; the flagship runs and prints LAST (the driver reads the
-# final line as the headline metric).
+# PRINT order (the driver reads the final line as the headline, so the
+# flagship prints last); EXECUTION order puts the flagship first so slow
+# configs can't starve the headline of wall clock — see orchestrate().
 CONFIGS = {
     "host_cd2": ("run_host_cd2", 600),
     "spec_p2p": ("run_spec_p2p", 1500),
@@ -624,42 +626,72 @@ def _forward_child_lines(name: str, stdout: str) -> bool:
 
 
 def orchestrate() -> None:
-    """Run every config in its own subprocess, forwarding each child's JSON
-    line(s) in order (flagship last).  A child that dies or times out costs
-    its own line only — the rest of the suite still reports.  Exits nonzero
+    """Run every config in its own subprocess; the flagship's line prints
+    LAST (the driver reads the final line as the headline) but its child runs
+    FIRST — so a day of slow/degraded configs can't starve the headline
+    measurement of wall-clock budget.
+    A child that dies or times out costs its own line only.  Exits nonzero
     if NO config produced a metric (total failure must not read as a clean
     run to a driver that records the exit status)."""
     here = os.path.abspath(__file__)
-    any_metric = False
-    for name, (_, budget) in CONFIGS.items():
-        try:
-            proc = subprocess.run(
-                [sys.executable, here, name],
-                capture_output=True,
-                text=True,
-                timeout=budget,
-                cwd=os.path.dirname(here),
-            )
-            ok = _forward_child_lines(name, proc.stdout)
-            if not ok:
-                sys.stderr.write(
-                    f"bench config {name!r} produced no metric "
-                    f"(rc={proc.returncode}); stderr tail:\n"
-                    f"{proc.stderr[-2000:]}\n"
+    names = list(CONFIGS)
+    run_order = ["flagship"] + [n for n in names if n != "flagship"]
+
+    def run_child(name: str) -> Tuple[str, str]:
+        """Returns (stdout, failure_note); failure_note is "" on a clean
+        exit, else a one-line diagnosis (timeout note or rc + stderr tail).
+
+        Child output goes to temp FILES, not pipes: this Python's
+        ``TimeoutExpired`` carries no partial pipe output (the thread-join
+        communicate path raises bare), but a file keeps whatever the child
+        printed before it hung — so a measurement that completed and then
+        stalled in tunnel teardown is still salvaged."""
+        import tempfile
+
+        budget = CONFIGS[name][1]
+        with tempfile.TemporaryFile(mode="w+") as out_f, \
+                tempfile.TemporaryFile(mode="w+") as err_f:
+            try:
+                proc = subprocess.run(
+                    [sys.executable, here, name],
+                    stdout=out_f,
+                    stderr=err_f,
+                    timeout=budget,
+                    cwd=os.path.dirname(here),
                 )
-            any_metric = any_metric or ok
-        except subprocess.TimeoutExpired as exc:
-            # salvage metric lines the child printed before hanging (e.g. a
-            # measurement that completed but stalled in tunnel teardown)
-            out = exc.stdout
-            if isinstance(out, bytes):
-                out = out.decode(errors="replace")
-            ok = _forward_child_lines(name, out or "")
-            any_metric = any_metric or ok
-            sys.stderr.write(
-                f"bench config {name!r} exceeded its {budget}s budget"
-                f"{' (metric salvaged from partial output)' if ok else ''}\n"
-            )
+                note = ""
+                if proc.returncode != 0:
+                    err_f.seek(0)
+                    note = (
+                        f"exited rc={proc.returncode}; stderr tail:\n"
+                        f"{err_f.read()[-2000:]}"
+                    )
+            except subprocess.TimeoutExpired:
+                note = f"exceeded its {budget}s budget"
+            out_f.seek(0)
+            return out_f.read(), note
+
+    def report(name: str, out: str, note: str) -> bool:
+        """Print the child's metric lines; surface every failure note (even
+        when a metric was salvaged, so recurring hangs stay visible)."""
+        ok = _forward_child_lines(name, out)
+        if note:
+            salvage = " (metric salvaged from partial output)" if ok else ""
+            sys.stderr.write(f"bench config {name!r} {note}{salvage}\n")
+        elif not ok:
+            sys.stderr.write(f"bench config {name!r} produced no metric\n")
+        return ok
+
+    any_metric = False
+    flagship_result: Optional[Tuple[str, str]] = None
+    for name in run_order:
+        out, note = run_child(name)
+        if name == "flagship":
+            flagship_result = (out, note)  # printed last, below
+        else:
+            any_metric |= report(name, out, note)
+    if flagship_result is not None:
+        any_metric |= report("flagship", *flagship_result)
     if not any_metric:
         raise SystemExit(1)
 
