@@ -637,20 +637,21 @@ def orchestrate() -> None:
     names = list(CONFIGS)
     run_order = ["flagship"] + [n for n in names if n != "flagship"]
 
-    def run_child(name: str) -> Tuple[str, str]:
-        """Returns (stdout, failure_note); failure_note is "" on a clean
-        exit, else a one-line diagnosis (timeout note or rc + stderr tail).
+    def run_child(name: str) -> Tuple[str, str, str]:
+        """Returns (stdout, failure_note, stderr_tail); failure_note is ""
+        on a clean exit, else a one-line diagnosis (timeout or nonzero rc).
 
         Child output goes to temp FILES, not pipes: this Python's
         ``TimeoutExpired`` carries no partial pipe output (the thread-join
         communicate path raises bare), but a file keeps whatever the child
         printed before it hung — so a measurement that completed and then
-        stalled in tunnel teardown is still salvaged."""
+        stalled in tunnel teardown is still salvaged.  Files are binary and
+        decoded with errors='replace': a child SIGKILLed mid-write must not
+        take the rest of the suite down with a UnicodeDecodeError."""
         import tempfile
 
         budget = CONFIGS[name][1]
-        with tempfile.TemporaryFile(mode="w+") as out_f, \
-                tempfile.TemporaryFile(mode="w+") as err_f:
+        with tempfile.TemporaryFile() as out_f, tempfile.TemporaryFile() as err_f:
             try:
                 proc = subprocess.run(
                     [sys.executable, here, name],
@@ -659,37 +660,44 @@ def orchestrate() -> None:
                     timeout=budget,
                     cwd=os.path.dirname(here),
                 )
-                note = ""
-                if proc.returncode != 0:
-                    err_f.seek(0)
-                    note = (
-                        f"exited rc={proc.returncode}; stderr tail:\n"
-                        f"{err_f.read()[-2000:]}"
-                    )
+                note = (
+                    "" if proc.returncode == 0
+                    else f"exited rc={proc.returncode}"
+                )
             except subprocess.TimeoutExpired:
                 note = f"exceeded its {budget}s budget"
             out_f.seek(0)
-            return out_f.read(), note
+            err_f.seek(0)
+            out = out_f.read().decode(errors="replace")
+            err_tail = err_f.read()[-2000:].decode(errors="replace")
+            return out, note, err_tail
 
-    def report(name: str, out: str, note: str) -> bool:
+    def report(name: str, out: str, note: str, err_tail: str) -> bool:
         """Print the child's metric lines; surface every failure note (even
-        when a metric was salvaged, so recurring hangs stay visible)."""
+        when a metric was salvaged, so recurring hangs stay visible), with
+        the child's stderr tail whenever something needs diagnosing."""
         ok = _forward_child_lines(name, out)
         if note:
             salvage = " (metric salvaged from partial output)" if ok else ""
-            sys.stderr.write(f"bench config {name!r} {note}{salvage}\n")
+            sys.stderr.write(
+                f"bench config {name!r} {note}{salvage}; stderr tail:\n"
+                f"{err_tail}\n"
+            )
         elif not ok:
-            sys.stderr.write(f"bench config {name!r} produced no metric\n")
+            sys.stderr.write(
+                f"bench config {name!r} produced no metric (rc=0); "
+                f"stderr tail:\n{err_tail}\n"
+            )
         return ok
 
     any_metric = False
-    flagship_result: Optional[Tuple[str, str]] = None
+    flagship_result: Optional[Tuple[str, str, str]] = None
     for name in run_order:
-        out, note = run_child(name)
+        result = run_child(name)
         if name == "flagship":
-            flagship_result = (out, note)  # printed last, below
+            flagship_result = result  # printed last, below
         else:
-            any_metric |= report(name, out, note)
+            any_metric |= report(name, *result)
     if flagship_result is not None:
         any_metric |= report("flagship", *flagship_result)
     if not any_metric:

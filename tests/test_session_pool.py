@@ -297,6 +297,98 @@ class TestBatchedRequestExecutor:
                 np.asarray(got[k]), np.asarray(want[k]), err_msg=k
             )
 
+    def test_disconnect_mid_match_through_the_pool(self):
+        """One pooled match loses a player mid-run (manual disconnect_player,
+        the reference's p2p_session.rs:485-511): the surviving peer rolls
+        back to the disconnect frame with dummy inputs and keeps simulating;
+        the OTHER pooled match must be completely unaffected — bit-identical
+        to running it alone."""
+        sessions, schedules = _make_matches(2, seed=17)
+        game = BoxGame(2)
+        pool = BatchedRequestExecutor(
+            game.advance, game.init_state(), _to_arr,
+            batch_size=4, ring_length=10, max_burst=9,
+        )
+        pool.warmup(np.zeros((2,), np.uint8))
+
+        for i in range(50):
+            for s in sessions:
+                s.poll_remote_clients()
+            reqs = []
+            for h, (s, sched) in enumerate(zip(sessions, schedules)):
+                if h == 1 and i >= 30:
+                    reqs.append([])  # match 0's peer B went away
+                    continue
+                if h == 0 and i == 32:
+                    s.disconnect_player(1)  # survivor drops the silent peer
+                s.add_local_input(h % 2, sched(min(i, 39)))
+                reqs.append(s.advance_frame())
+            pool.run(reqs)
+
+        # the survivor kept advancing past the disconnect with dummy inputs
+        assert sessions[0].current_frame > 35
+        # match 1 (sessions 2,3) is unaffected: its peers still agree
+        assert sessions[2].current_frame == sessions[3].current_frame
+        for k in ("pos", "vel", "rot"):
+            np.testing.assert_array_equal(
+                np.asarray(pool.live_state(2)[k]),
+                np.asarray(pool.live_state(3)[k]),
+                err_msg=f"match 1 {k}",
+            )
+
+    def test_lockstep_and_input_delay_through_the_pool(self):
+        """A lockstep match (max_prediction=0: no saves, no rollbacks —
+        fork delta #3) and an input-delay match share one pool with a
+        default match; all three shapes normalize into the same program."""
+        net = InMemoryNetwork()
+        clock = lambda: 0
+        sessions = []
+        variants = [
+            lambda b: b.with_max_prediction_window(0),  # lockstep
+            lambda b: b.with_input_delay(2),
+            lambda b: b,
+        ]
+        for m, variant in enumerate(variants):
+            names = (f"A{m}", f"B{m}")
+            for me in (0, 1):
+                b = (
+                    SessionBuilder(boxgame_config())
+                    .with_clock(clock)
+                    .with_rng(random.Random(71 + 3 * m + me))
+                )
+                b = variant(b)
+                b = b.add_player(Local(), me).add_player(
+                    Remote(names[1 - me]), 1 - me
+                )
+                sessions.append(b.start_p2p_session(net.socket(names[me])))
+        game = BoxGame(2)
+        pool = BatchedRequestExecutor(
+            game.advance, game.init_state(), _to_arr,
+            batch_size=6, ring_length=10, max_burst=9,
+        )
+        pool.warmup(np.zeros((2,), np.uint8))
+
+        for i in range(50):
+            for s in sessions:
+                s.poll_remote_clients()
+            reqs = []
+            for h, s in enumerate(sessions):
+                s.add_local_input(h % 2, (min(i, 39) // (2 + h // 2)) % 16)
+                reqs.append(s.advance_frame())
+            pool.run(reqs)
+
+        for m in range(3):
+            a, b = sessions[2 * m], sessions[2 * m + 1]
+            assert abs(a.current_frame - b.current_frame) <= 1, (
+                m, a.current_frame, b.current_frame
+            )
+            for k in ("pos", "vel", "rot"):
+                np.testing.assert_array_equal(
+                    np.asarray(pool.live_state(2 * m)[k]),
+                    np.asarray(pool.live_state(2 * m + 1)[k]),
+                    err_msg=f"match {m} {k}",
+                )
+
     def test_one_dispatch_per_tick(self):
         """The pool's whole point: a tick with B heterogeneous request lists
         costs exactly one program dispatch (zero when all-empty)."""
