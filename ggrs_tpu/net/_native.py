@@ -347,7 +347,17 @@ def msg_encode(msg) -> Optional[bytes]:
                 m.disconnect_requested = 1 if b.disconnect_requested else 0
                 m.start_frame = b.start_frame
                 m.ack_frame = b.ack_frame
-                payload = b.bytes
+                # normalize: the c_char_p argument below rejects bytearray/
+                # memoryview with a ctypes.ArgumentError the Python encoder
+                # would have accepted.  Go through memoryview rather than
+                # bytes() so an int payload (bytes(5) == five NULs!) falls
+                # through to the Python encoder's loud TypeError instead of
+                # fabricating zero inputs on the wire.
+                payload = (
+                    b.bytes
+                    if isinstance(b.bytes, bytes)
+                    else bytes(memoryview(b.bytes))
+                )
             elif isinstance(b, M.InputAck):
                 if not i64_ok(b.ack_frame):
                     return None

@@ -69,7 +69,10 @@ def _digest_kernel(w_ref, out_ref):
     steps (the caller folds any ragged tail in separately)."""
     i = pl.program_id(0)
     w = w_ref[...]
-    base = (i * np.uint32(_BLOCK_ROWS * _LANES)).astype(jnp.uint32)
+    # cast the int32 program id BEFORE multiplying: int32 × uint32 promotes
+    # to int64 under jax_enable_x64, and an int64 intermediate may fail to
+    # lower in Mosaic on real TPU
+    base = jnp.uint32(i) * np.uint32(_BLOCK_ROWS * _LANES)
     row = jax.lax.broadcasted_iota(jnp.uint32, w.shape, 0)
     col = jax.lax.broadcasted_iota(jnp.uint32, w.shape, 1)
     # 1-based global word index, as in checksum._leaf_digest

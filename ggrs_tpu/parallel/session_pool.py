@@ -173,6 +173,10 @@ class BatchedRequestExecutor:
             )
         self._input_dtype: Optional[np.dtype] = None
         self._input_shape: Optional[Tuple[int, ...]] = None
+        # set on a failed run(): once a tick aborts mid-parse, fulfilled
+        # cells reference slots that were never written — every later use
+        # must fail loudly instead of serving stale state
+        self._invalid: Optional[str] = None
         # host shadow of the ring frame tags: loud failure at _parse time if
         # a session rolls back past ring_length (device aliasing is silent)
         self._host_frames = np.full((B, R), -1, np.int64)
@@ -284,7 +288,16 @@ class BatchedRequestExecutor:
         self, index: int, requests: List[GgrsRequest], desc: Dict[str, np.ndarray]
     ) -> None:
         """Normalize one session's tick into the descriptor row ``index``,
-        fulfilling its Save cells with lazy slot references."""
+        fulfilling its Save cells with lazy slot references.
+
+        Fulfillment is eager (cell + ``_host_frames`` updated during parse)
+        because the ring-capacity guard below must see this tick's pre-saves
+        in DEVICE order — the tick program writes pre-saves before the load,
+        so a pre-save that aliases the load's slot means the gather returns
+        the pre-saved frame, and only the updated shadow catches that.  The
+        flip side — a parse failure partway through ``run()`` leaves earlier
+        sessions' cells pointing at slots the aborted dispatch never wrote —
+        is handled by invalidating the whole pool (see ``run``)."""
         i = 0
         n = len(requests)
         b = index
@@ -304,8 +317,8 @@ class BatchedRequestExecutor:
         # p2p_session.rs:307-310); all must label the same frame, since no
         # advance runs between them
         while i < n and isinstance(requests[i], SaveGameState):
-            if desc["pre_save"][b]:
-                assert desc["pre_frame"][b] == requests[i].frame, (
+            if desc["pre_save"][b] and desc["pre_frame"][b] != requests[i].frame:
+                raise ValueError(
                     f"session {b}: consecutive pre-saves of different frames "
                     f"({desc['pre_frame'][b]} then {requests[i].frame})"
                 )
@@ -317,34 +330,43 @@ class BatchedRequestExecutor:
         if i < n and isinstance(requests[i], LoadGameState):
             req = requests[i]
             data = req.cell.data()
-            assert (
+            # real exceptions, not asserts: these guards are the only thing
+            # standing between an undersized ring and a silent desync, and
+            # ``python -O`` strips asserts
+            if not (
                 isinstance(data, _BatchSlotRef)
                 and data.owner is self
                 and data.index == b
                 and data.frame == req.frame
-            ), (
-                f"session {b} loads frame {req.frame} from a cell this pool "
-                f"did not save ({data!r})"
-            )
+            ):
+                raise ValueError(
+                    f"session {b} loads frame {req.frame} from a cell this "
+                    f"pool did not save ({data!r})"
+                )
             # ring-capacity guard: the device gather cannot tell an aliased
             # slot from the right one, so check the host shadow of the frame
             # tags loudly here (a session whose max_prediction reaches
             # ring_length would otherwise silently load a NEWER frame)
             held = self._host_frames[b, req.frame % self.ring_length]
-            assert held == req.frame, (
-                f"session {b}: rollback to frame {req.frame} but its ring "
-                f"slot holds frame {held} — ring_length={self.ring_length} "
-                f"is too small for this session's prediction window"
-            )
+            if held != req.frame:
+                raise RuntimeError(
+                    f"session {b}: rollback to frame {req.frame} but its ring "
+                    f"slot holds frame {held} — ring_length={self.ring_length} "
+                    f"is too small for this session's prediction window"
+                )
             desc["do_load"][b] = True
             desc["load_frame"][b] = req.frame
             i += 1
             # sparse saving: save of the just-loaded state before any advance
             while i < n and isinstance(requests[i], SaveGameState):
-                if desc["postload_save"][b]:
-                    assert desc["postload_frame"][b] == requests[i].frame, (
+                if (
+                    desc["postload_save"][b]
+                    and desc["postload_frame"][b] != requests[i].frame
+                ):
+                    raise ValueError(
                         f"session {b}: consecutive post-load saves of "
-                        f"different frames"
+                        f"different frames ({desc['postload_frame'][b]} then "
+                        f"{requests[i].frame})"
                     )
                 desc["postload_save"][b] = True
                 desc["postload_frame"][b] = requests[i].frame
@@ -353,10 +375,11 @@ class BatchedRequestExecutor:
 
         j = 0
         while i < n and isinstance(requests[i], AdvanceFrame):
-            assert j < self.max_burst, (
-                f"session {b}: tick carries more than max_burst="
-                f"{self.max_burst} advances"
-            )
+            if j >= self.max_burst:
+                raise ValueError(
+                    f"session {b}: tick carries more than max_burst="
+                    f"{self.max_burst} advances"
+                )
             # shapes were recorded by warmup(); _blank_desc asserts that
             desc["inputs"][b, j] = np.asarray(
                 self._inputs_to_array(requests[i].inputs)
@@ -369,10 +392,11 @@ class BatchedRequestExecutor:
                 i += 1
             j += 1
         desc["n_adv"][b] = j
-        assert i == n, (
-            f"session {b}: unsupported request shape at position {i}: "
-            f"{requests[i]!r}"
-        )
+        if i != n:
+            raise ValueError(
+                f"session {b}: unsupported request shape at position {i}: "
+                f"{requests[i]!r}"
+            )
 
     def _blank_desc(self) -> Dict[str, np.ndarray]:
         B, D = self.batch_size, self.max_burst
@@ -423,14 +447,29 @@ class BatchedRequestExecutor:
         """Fulfill all B sessions' request lists — ONE device dispatch (zero
         if every list is empty).  ``request_lists[b]`` belongs to session
         ``b``; sessions with nothing to do this tick pass ``[]``."""
-        assert len(request_lists) == self.batch_size
+        self._check_valid()
+        if len(request_lists) != self.batch_size:
+            raise ValueError(
+                f"run() got {len(request_lists)} request lists for a pool of "
+                f"{self.batch_size} sessions"
+            )
         if all(not reqs for reqs in request_lists):
             return
         desc = self._blank_desc()
-        for b, reqs in enumerate(request_lists):
-            if reqs:
-                self._parse(b, reqs, desc)
-        self._carry = self._tick(self._carry, desc)
+        # parse fulfills cells eagerly (the ring-capacity guard needs this
+        # tick's pre-saves visible in device order — see _parse); if any
+        # session's list fails to parse, or the dispatch itself fails,
+        # earlier sessions already hold cells referencing slots this aborted
+        # tick never wrote, so the pool is unusable: poison it loudly rather
+        # than let a caller that caught the error keep running on stale loads
+        try:
+            for b, reqs in enumerate(request_lists):
+                if reqs:
+                    self._parse(b, reqs, desc)
+            self._carry = self._tick(self._carry, desc)
+        except BaseException as e:  # incl. KeyboardInterrupt mid-parse
+            self._invalid = f"{type(e).__name__}: {e}"
+            raise
 
     # ------------------------------------------------------------------
     # accessors (device reads — diagnostics / desync exchange, not hot path)
@@ -439,18 +478,29 @@ class BatchedRequestExecutor:
     @property
     def live_states(self) -> Any:
         """The [B, ...] live state pytree (device handles; no transfer)."""
+        self._check_valid()
         return self._carry["live"]
 
     def live_state(self, index: int) -> Any:
         """One session's live state, fetched to host."""
+        self._check_valid()
         return jax.device_get(
             jax.tree_util.tree_map(lambda l: l[index], self._carry["live"])
         )
+
+    def _check_valid(self) -> None:
+        if self._invalid is not None:
+            raise RuntimeError(
+                f"pool was invalidated by an earlier failed tick "
+                f"({self._invalid}); rebuild it — its rings and fulfilled "
+                f"cells are out of sync"
+            )
 
     def _slot_probe(self, index: int, frame: Frame):
         """(slot, held_frame, checksum_lanes) via the precompiled traced-index
         fetch — one program for every (session, slot), one transfer for both
         scalars."""
+        self._check_valid()
         slot = frame % self.ring_length
         held, lanes = jax.device_get(
             self._fetch_slot(
@@ -460,10 +510,11 @@ class BatchedRequestExecutor:
                 np.int32(slot),
             )
         )
-        assert int(held) == frame, (
-            f"session {index}: ring slot {slot} holds frame {int(held)}, "
-            f"wanted {frame} (rolled past ring_length={self.ring_length}?)"
-        )
+        if int(held) != frame:
+            raise RuntimeError(
+                f"session {index}: ring slot {slot} holds frame {int(held)}, "
+                f"wanted {frame} (rolled past ring_length={self.ring_length}?)"
+            )
         return slot, lanes
 
     def ring_state(self, index: int, frame: Frame) -> Any:

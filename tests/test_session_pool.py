@@ -165,7 +165,7 @@ class TestBatchedRequestExecutor:
         cs = pool.ring_checksum(0, f)
         assert isinstance(cs, int) and cs > 0
         # a frame that has rolled out of the ring is refused
-        with pytest.raises(AssertionError):
+        with pytest.raises(RuntimeError):
             pool.ring_state(0, max(0, f - 50))
 
     def test_pool_sharded_over_virtual_mesh(self):
@@ -223,7 +223,7 @@ class TestBatchedRequestExecutor:
             batch_size=2, ring_length=3, max_burst=9,
         )
         pool.warmup(np.zeros((2,), np.uint8))
-        with pytest.raises(AssertionError, match="too small"):
+        with pytest.raises(RuntimeError, match="too small"):
             for i in range(40):
                 net.tick()
                 for s in sessions:
@@ -233,6 +233,12 @@ class TestBatchedRequestExecutor:
                     s.add_local_input(h, (i // 2) % 16)
                     reqs.append(s.advance_frame())
                 pool.run(reqs)
+        # the aborted tick left fulfilled cells pointing at slots it never
+        # wrote — the pool must refuse ALL further use, not serve stale state
+        with pytest.raises(RuntimeError, match="invalidated"):
+            pool.run([[] for _ in range(2)])
+        with pytest.raises(RuntimeError, match="invalidated"):
+            pool.ring_state(0, 0)
 
     def test_spectator_follows_through_the_pool(self):
         """The pool serves ANY session emitting the request grammar: a
