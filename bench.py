@@ -46,13 +46,22 @@ CHECK_DISTANCE = 8
 PLAYERS = 2
 REPEATS = 3  # timed passes per config; best-of counters tunnel drift
 
-# config name -> (function name, per-child wall-clock budget in seconds).
-# PRINT order (the driver reads the final line as the headline, so the
-# flagship prints last); EXECUTION order puts the flagship first so slow
-# configs can't starve the headline of wall clock — see orchestrate().
+# config name -> (function name, per-child wall-clock budget in seconds[,
+# extra environment for the child]).  PRINT order (the driver reads the
+# final line as the headline, so the flagship prints last); EXECUTION order
+# puts the flagship first so slow configs can't starve the headline of wall
+# clock — see orchestrate().
 CONFIGS = {
     "host_cd2": ("run_host_cd2", 600),
+    "host_datapath": ("run_host_datapath", 600),
     "spec_p2p": ("run_spec_p2p", 1500),
+    # same speculation measurement on the CPU backend: approximates a
+    # direct-attached accelerator's µs dispatch, the regime DESIGN §5/§9
+    # predicts shrinks the speculation window-carry penalty
+    "spec_p2p_cpu": (
+        "run_spec_p2p", 900,
+        {"JAX_PLATFORMS": "cpu", "GGRS_BENCH_METRIC_PREFIX": "cpubackend_"},
+    ),
     "ecs": ("run_ecs", 1200),
     "chipvm256": ("run_chipvm256", 1200),
     "pallas_checksum": ("run_pallas_checksum", 900),
@@ -66,11 +75,16 @@ def _inputs(n: int, players: int, seed: int) -> np.ndarray:
     return rng.integers(0, 16, size=(n, players)).astype(np.uint8)
 
 
+# children run with a metric prefix when one measurement is repeated under a
+# different backend (e.g. "cpubackend_" for the CPU-dispatch speculation run)
+_METRIC_PREFIX = os.environ.get("GGRS_BENCH_METRIC_PREFIX", "")
+
+
 def emit(metric: str, value: float, unit: str, vs_baseline: float) -> None:
     print(
         json.dumps(
             {
-                "metric": metric,
+                "metric": _METRIC_PREFIX + metric,
                 "value": round(value, 1),
                 "unit": unit,
                 "vs_baseline": round(vs_baseline, 2),
@@ -258,12 +272,22 @@ def _speculative_p2p_setup(speculate: bool, game=None, programs=None) -> tuple:
         )
         executors.append(ex)
 
+    from ggrs_tpu.core.types import LoadGameState
+
     def tick(i):
+        """One tick of all four peers; True when peer 0's request list
+        carried a rollback (a Load) — the ticks whose latency the
+        speculation design claims to improve."""
+        rolled = False
         for s in sessions:
             s.poll_remote_clients()
         for p, (s, ex) in enumerate(zip(sessions, executors)):
             s.add_local_input(p, sched(p, i))
-            ex.run(s.advance_frame())
+            reqs = s.advance_frame()
+            if p == 0 and any(isinstance(r, LoadGameState) for r in reqs):
+                rolled = True
+            ex.run(reqs)
+        return rolled
 
     return tick, executors
 
@@ -306,12 +330,39 @@ def bench_speculative_p2p(seg_ticks: int = 100, segments: int = 4) -> tuple:
             run(name, seg_ticks)
             rates[name].append(seg_ticks / (time.perf_counter() - t0))
 
+    # ---- latency phase (VERDICT r3 item 1): per-tick wall time with the
+    # state actually materialized each tick (block_until_ready), so a
+    # rollback's stall is measured to COMPLETION, not to enqueue.  Alternate
+    # segments again so drift hits both variants equally.
+    latencies = {n: {"tick": [], "roll": []} for n in variants}
+
+    def run_latency(name, n):
+        tick, executors = variants[name]
+        ex0 = executors[0]
+        start = counters[name]
+        for i in range(start, start + n):
+            t0 = time.perf_counter()
+            rolled = tick(i)
+            jax.block_until_ready(ex0.state)
+            dt = time.perf_counter() - t0
+            latencies[name]["tick"].append(dt)
+            if rolled:
+                latencies[name]["roll"].append(dt)
+        counters[name] = start + n
+
+    for name in variants:
+        run_latency(name, 16)  # settle into the per-tick-blocking regime
+        latencies[name] = {"tick": [], "roll": []}
+    for _ in range(2):
+        for name in variants:
+            run_latency(name, 150)
+
     ex0 = variants["spec"][1][0]
 
     def fetch_stats():
         return ex0.spec_hits + ex0.spec_misses, ex0.spec_hits
 
-    return max(rates["spec"]), max(rates["plain"]), fetch_stats
+    return max(rates["spec"]), max(rates["plain"]), fetch_stats, latencies
 
 
 # ---------------------------------------------------------------------------
@@ -383,12 +434,99 @@ def run_host_cd2() -> None:
          "resim_frames/sec", 1.0)
 
 
+def run_host_datapath() -> None:
+    """Host-tick microbench (VERDICT r3 item 3): four live P2P peers over
+    the in-memory net with trivial (host, no-device) request fulfillment —
+    pure session + endpoint-datapath cost, the number that bounds massed
+    hosting.  ``vs_baseline`` is round 3's recorded 1.17 ms/tick over the
+    measured value (>1 = faster than round 3's host path)."""
+    import random as _random
+
+    from ggrs_tpu.core import Local, Remote
+    from ggrs_tpu.net import InMemoryNetwork
+    from ggrs_tpu.sessions import SessionBuilder
+
+    R3_US_PER_TICK = 1170.0  # docs/DESIGN.md §9, BENCH_r03 era measurement
+
+    P = 4
+    net = InMemoryNetwork()
+    names = [f"N{h}" for h in range(P)]
+    sessions = []
+    for h in range(P):
+        b = (
+            SessionBuilder(boxgame_config())
+            .with_num_players(P)
+            .with_clock(lambda: 0)
+            .with_rng(_random.Random(40 + h))
+        )
+        for o in range(P):
+            b = b.add_player(Local() if o == h else Remote(names[o]), o)
+        sessions.append(b.start_p2p_session(net.socket(names[h])))
+
+    state = [0] * P
+
+    def drive(ticks, base):
+        for i in range(base, base + ticks):
+            for s in sessions:
+                s.poll_remote_clients()
+            for h, s in enumerate(sessions):
+                s.add_local_input(h, (i * 7 + h) % 16)
+                for r in s.advance_frame():
+                    k = type(r).__name__
+                    if k == "SaveGameState":
+                        r.cell.save(r.frame, state[h], None)
+                    elif k == "LoadGameState":
+                        state[h] = r.cell.data()
+
+    drive(200, 0)  # warm
+    n, base = 2000, 200
+    best = float("inf")
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        drive(n, base)
+        best = min(best, (time.perf_counter() - t0) / n * 1e6)
+        base += n
+    emit("p2p4_host_datapath_us_per_tick", best, "us/tick (4 sessions)",
+         R3_US_PER_TICK / best if best else 0.0)
+
+
 def run_spec_p2p() -> None:
-    """Config 3: speculative P2P vs the same loop with speculation off.  The
-    whole live path (fused resolve-or-replay, lazy checksums, device hit
-    counters) performs zero D2H, so both variants run at full dispatch rate;
-    the stats fetch (a D2H read) happens after all timing."""
-    spec_rate, plain_rate, fetch_spec_stats = bench_speculative_p2p()
+    """Config 3: speculative P2P vs the same loop with speculation off —
+    throughput AND per-tick latency distributions (the axis the speculation
+    design actually targets: branch-select vs an 8-deep serial resim chain
+    on rollback ticks).  The whole live path performs zero D2H, so both
+    variants run at full dispatch rate; the stats fetch (a D2H read)
+    happens after all timing."""
+    spec_rate, plain_rate, fetch_spec_stats, lat = bench_speculative_p2p()
+
+    # latency lines first (the throughput line stays the config headline).
+    # For spec lines vs_baseline is plain/spec (>1 = speculation is FASTER
+    # on that percentile); plain lines carry 1.0.
+    pcts = {"p50": 50, "p99": 99}
+    kinds = [("rollback_stall", "roll")]
+    if any(len(lat[n]["roll"]) < len(lat[n]["tick"]) for n in lat):
+        # only when some ticks did NOT roll back is the all-ticks
+        # distribution a distinct measurement
+        kinds.append(("tick_latency", "tick"))
+    for kind, key in kinds:
+        vals = {n: np.asarray(lat[n][key]) * 1e6 for n in lat}  # µs
+        if any(v.size == 0 for v in vals.values()):
+            continue
+        stats = {
+            n: {
+                **{p: float(np.percentile(v, q)) for p, q in pcts.items()},
+                "max": float(v.max()),
+            }
+            for n, v in vals.items()
+        }
+        for p in list(pcts) + ["max"]:
+            emit(f"p2p4_plain_{kind}_us_{p}", stats["plain"][p],
+                 "us/tick" if key == "tick" else "us/rollback-tick", 1.0)
+            emit(f"p2p4_spec_{kind}_us_{p}", stats["spec"][p],
+                 "us/tick" if key == "tick" else "us/rollback-tick",
+                 stats["plain"][p] / stats["spec"][p]
+                 if stats["spec"][p] else 0.0)
+
     rollbacks, hits = fetch_spec_stats()
     emit("p2p4_speculative_8branch_ticks_per_sec", spec_rate,
          f"ticks/sec (hit {hits}/{rollbacks} rollbacks)"
@@ -650,7 +788,12 @@ def orchestrate() -> None:
         take the rest of the suite down with a UnicodeDecodeError."""
         import tempfile
 
-        budget = CONFIGS[name][1]
+        spec = CONFIGS[name]
+        budget = spec[1]
+        env = None
+        if len(spec) > 2 and spec[2]:
+            env = dict(os.environ)
+            env.update(spec[2])
         with tempfile.TemporaryFile() as out_f, tempfile.TemporaryFile() as err_f:
             try:
                 proc = subprocess.run(
@@ -659,6 +802,7 @@ def orchestrate() -> None:
                     stderr=err_f,
                     timeout=budget,
                     cwd=os.path.dirname(here),
+                    env=env,
                 )
                 note = (
                     "" if proc.returncode == 0

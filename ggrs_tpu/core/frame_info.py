@@ -23,7 +23,7 @@ class GameState(Generic[S]):
     checksum: Optional[int] = None
 
 
-@dataclass
+@dataclass(slots=True)
 class PlayerInput(Generic[I]):
     """An input for one player at one frame (reference: frame_info.rs:27-52)."""
 

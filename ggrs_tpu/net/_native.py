@@ -96,13 +96,27 @@ _MSG_ERROR_NAMES = {
 }
 
 
-def _source_path() -> Path:
-    return Path(__file__).resolve().parents[2] / "native" / "codec.cpp"
+def _native_dir() -> Path:
+    return Path(__file__).resolve().parents[2] / "native"
+
+
+def _sources() -> List[Path]:
+    return [_native_dir() / "codec.cpp", _native_dir() / "endpoint.cpp"]
+
+
+def _source_mtime() -> float:
+    """Newest mtime across the native sources and headers (staleness)."""
+    newest = 0.0
+    for p in list(_native_dir().glob("*.cpp")) + list(
+        _native_dir().glob("*.h")
+    ):
+        newest = max(newest, p.stat().st_mtime)
+    return newest
 
 
 def _build(lib_path: Path) -> bool:
-    src = _source_path()
-    if not src.exists():
+    srcs = _sources()
+    if not all(s.exists() for s in srcs):
         return False
     cmd = [
         "g++",
@@ -112,8 +126,7 @@ def _build(lib_path: Path) -> bool:
         "-std=c++17",
         "-o",
         str(lib_path),
-        str(src),
-    ]
+    ] + [str(s) for s in srcs]
     try:
         subprocess.run(
             cmd, check=True, capture_output=True, timeout=120
@@ -133,15 +146,28 @@ def _load() -> Optional[ctypes.CDLL]:
         if _lib is not None or _load_failed:
             return _lib
         lib_path = Path(__file__).resolve().parent / _LIB_NAME
-        src = _source_path()
         try:
-            stale = not lib_path.exists() or (
-                src.exists() and src.stat().st_mtime > lib_path.stat().st_mtime
+            stale = (
+                not lib_path.exists()
+                or _source_mtime() > lib_path.stat().st_mtime
             )
             if stale and not _build(lib_path):
                 _load_failed = True
                 return None
             lib = ctypes.CDLL(str(lib_path))
+            if not hasattr(lib, "ggrs_ep_new"):
+                # library predates the endpoint datapath: try a rebuild to a
+                # TEMP path first so a prebuilt .so without sources/toolchain
+                # is never destroyed — if the rebuild fails we keep serving
+                # the codec symbols and simply leave the endpoint fast path
+                # disabled (endpoint_lib() returns None)
+                tmp = lib_path.with_name(_LIB_NAME + ".new")
+                if _build(tmp):
+                    del lib
+                    tmp.replace(lib_path)  # new inode: dlopen loads fresh
+                    lib = ctypes.CDLL(str(lib_path))
+                else:
+                    tmp.unlink(missing_ok=True)
         except OSError:
             _load_failed = True
             return None
@@ -186,12 +212,93 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.c_size_t,
             ctypes.POINTER(ctypes.c_size_t),
         ]
+        # ---- endpoint datapath (native/endpoint.cpp) ----
+        # may be absent when a prebuilt pre-endpoint library is in use and
+        # no toolchain is available; the codec fast path still works then
+        if not hasattr(lib, "ggrs_ep_new"):
+            _lib = lib
+            return _lib
+        lib.ggrs_ep_new.restype = ctypes.c_void_p
+        lib.ggrs_ep_new.argtypes = [
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_int64,
+        ]
+        lib.ggrs_ep_free.restype = None
+        lib.ggrs_ep_free.argtypes = [ctypes.c_void_p]
+        lib.ggrs_ep_pending_len.restype = ctypes.c_int64
+        lib.ggrs_ep_pending_len.argtypes = [ctypes.c_void_p]
+        lib.ggrs_ep_last_recv_frame.restype = ctypes.c_int64
+        lib.ggrs_ep_last_recv_frame.argtypes = [ctypes.c_void_p]
+        lib.ggrs_ep_ack.restype = None
+        lib.ggrs_ep_ack.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.ggrs_ep_push.restype = ctypes.c_int64
+        lib.ggrs_ep_push.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_size_t,
+        ]
+        lib.ggrs_ep_emit_input.restype = ctypes.c_int
+        lib.ggrs_ep_emit_input.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint16,
+            ctypes.c_char_p, ctypes.c_char_p,  # disc bytes, LE-packed frames
+            ctypes.c_int32, ctypes.c_uint8,
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
+        lib.ggrs_ep_on_input.restype = ctypes.c_int
+        lib.ggrs_ep_on_input.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_size_t), ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_size_t),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.ggrs_ep_commit.restype = None
+        lib.ggrs_ep_commit.argtypes = [ctypes.c_void_p]
+        lib.ggrs_ep_handle_input_datagram.restype = ctypes.c_int
+        lib.ggrs_ep_handle_input_datagram.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint16), ctypes.POINTER(ctypes.c_uint8),
+            ctypes.POINTER(ctypes.c_uint8), ctypes.POINTER(ctypes.c_int64),
+            ctypes.POINTER(ctypes.c_int32), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_size_t), ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_size_t),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.ggrs_ep_fetch_base.restype = ctypes.c_int
+        lib.ggrs_ep_fetch_base.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64,
+            ctypes.c_char_p, ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_size_t),
+        ]
+        lib.ggrs_ep_store_one.restype = None
+        lib.ggrs_ep_store_one.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_size_t,
+        ]
         _lib = lib
         return _lib
 
 
+# endpoint-datapath return codes (mirror native/endpoint.cpp)
+EP_DROP = -30
+EP_FALLBACK = -31
+EP_BAD_PENDING_HEAD = -32
+EP_ERR_BUFFER_TOO_SMALL = -11
+
+
 def available() -> bool:
     return _load() is not None
+
+
+def endpoint_lib() -> Optional[ctypes.CDLL]:
+    """The loaded library for NativeEndpointCore, or None (use the Python
+    core).  Same load/fallback policy as the codec fast path, plus the
+    endpoint symbols must actually be present (a prebuilt pre-endpoint
+    library keeps its codec fast path but not this one)."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "ggrs_ep_new"):
+        return None
+    return lib
 
 
 def encode(reference: bytes, inputs: Sequence[bytes]) -> Optional[bytes]:
@@ -265,38 +372,37 @@ def msg_decode(data: bytes):
         tag = m.tag
         if tag == _TAG_INPUT:
             n = m.n_status
+            # bulk-slice the ctypes arrays (one C call each) and construct
+            # positionally — this wrapper runs for every received input
+            # packet, so per-element ctypes indexing and kwargs cost real time
+            CS = M.ConnectionStatus
+            disc = m.status_disconnected[:n]
+            frames = m.status_last_frame[:n]
+            off = m.payload_off
             body = M.InputMessage(
-                peer_connect_status=[
-                    M.ConnectionStatus(
-                        disconnected=bool(m.status_disconnected[i]),
-                        last_frame=m.status_last_frame[i],
-                    )
-                    for i in range(n)
-                ],
-                disconnect_requested=bool(m.disconnect_requested),
-                start_frame=m.start_frame,
-                ack_frame=m.ack_frame,
-                bytes=data[m.payload_off : m.payload_off + m.payload_len],
+                [CS(bool(disc[i]), frames[i]) for i in range(n)],
+                bool(m.disconnect_requested),
+                m.start_frame,
+                m.ack_frame,
+                data[off : off + m.payload_len],
             )
         elif tag == _TAG_INPUT_ACK:
-            body = M.InputAck(ack_frame=m.ack_frame)
+            body = M.InputAck(m.ack_frame)
         elif tag == _TAG_QUALITY_REPORT:
-            body = M.QualityReport(
-                frame_advantage=m.frame_advantage, ping=m.ping
-            )
+            body = M.QualityReport(m.frame_advantage, m.ping)
         elif tag == _TAG_QUALITY_REPLY:
-            body = M.QualityReply(pong=m.pong)
+            body = M.QualityReply(m.pong)
         elif tag == _TAG_CHECKSUM_REPORT:
             body = M.ChecksumReport(
-                checksum=m.checksum_lo | (m.checksum_hi << 64), frame=m.frame
+                m.checksum_lo | (m.checksum_hi << 64), m.frame
             )
         elif tag == _TAG_KEEP_ALIVE:
             body = M.KeepAlive()
         elif tag == _TAG_SYNC_REQUEST:
-            body = M.SyncRequest(random=m.random_nonce)
+            body = M.SyncRequest(m.random_nonce)
         else:  # _TAG_SYNC_REPLY (unknown tags already errored in C++)
-            body = M.SyncReply(random=m.random_nonce)
-        return M.Message(magic=m.magic, body=body)
+            body = M.SyncReply(m.random_nonce)
+        return M.Message(m.magic, body)
 
 
 def msg_encode(msg) -> Optional[bytes]:

@@ -1,0 +1,414 @@
+"""Endpoint datapath cores: the per-tick mechanism under PeerProtocol.
+
+``PeerProtocol`` (protocol.py) keeps the reliability *policy* — timers,
+events, the state machine, connect-status merging.  The per-tick *mechanism*
+lives here behind a two-implementation seam:
+
+- ``PyEndpointCore`` — the pure-Python semantic reference (always present);
+- ``NativeEndpointCore`` — the same state machine in C++
+  (native/endpoint.cpp) with ONE ctypes crossing per send / receive, which
+  removes the per-message object churn that dominated the live host tick.
+
+Both cores own, per endpoint: the unacked pending-output window with its
+last-acked delta base (reference: protocol.rs:421-487), the received-input
+ring that provides the decode base (reference: protocol.rs:534-682), and the
+InputMessage datagram build/decode.  Wire bytes are identical between cores
+(pinned by tests/test_native_endpoint.py); which core runs is invisible above
+this module.
+
+Receive flow is two-phase: ``on_input`` PEEKS (decodes and stages the new
+frames), the protocol validates the inner per-player framing, then
+``commit`` applies the staged frames.  A packet with any malformed inner
+frame is therefore dropped whole — no partial state advance.  (The previous
+single-phase code stored frames as it validated them; partial storage on a
+malformed packet was unreachable from an honest peer but made the native and
+Python paths impossible to keep bit-identical under attack.)
+"""
+
+from __future__ import annotations
+
+import ctypes
+import struct
+from collections import deque
+from typing import Deque, List, Optional, Sequence, Tuple
+
+from ..core.types import Frame, NULL_FRAME
+from . import _native, compression
+from .messages import ConnectionStatus, InputMessage, Message
+
+# The wire contract for frames is i64 (the reference's Frame type).  Python's
+# unbounded varint reader can surface values beyond that; both cores treat
+# such packets as malformed and drop them, with headroom so frame arithmetic
+# (start_frame + count, start_frame - 1) can never overflow the C side.
+_FRAME_SANE_MIN = -(1 << 62)
+_FRAME_SANE_MAX = 1 << 62
+_I64_MIN = -(1 << 63)
+_I64_MAX = (1 << 63) - 1
+
+
+class PyEndpointCore:
+    """Pure-Python endpoint datapath (the semantic reference)."""
+
+    def __init__(
+        self, send_base: bytes, recv_base: bytes, max_prediction: int
+    ) -> None:
+        # outbound: all inputs the peer hasn't acked yet, as (frame, payload)
+        self._pending: Deque[Tuple[Frame, bytes]] = deque()
+        self._last_acked_frame: Frame = NULL_FRAME
+        self._last_acked: bytes = send_base
+        # inbound: received frame payloads by frame; NULL_FRAME holds the
+        # zeroed decode base (reference: protocol.rs:208-209)
+        self._recv: dict[Frame, bytes] = {NULL_FRAME: recv_base}
+        self._last_recv: Frame = NULL_FRAME
+        self._max_prediction = max_prediction
+        self._staged: Optional[Tuple[Frame, List[bytes]]] = None
+
+    # ---- send side ----
+
+    def push_input(self, frame: Frame, payload: bytes) -> int:
+        self._pending.append((frame, payload))
+        return len(self._pending)
+
+    def emit_input(
+        self,
+        magic: int,
+        statuses: Sequence[ConnectionStatus],
+        disconnect_requested: bool,
+    ) -> Optional[bytes]:
+        if not self._pending:
+            return None
+        first_frame = self._pending[0][0]
+        if not (
+            self._last_acked_frame == NULL_FRAME
+            or self._last_acked_frame + 1 == first_frame
+        ):
+            raise RuntimeError(
+                f"pending output head {first_frame} does not follow "
+                f"last acked frame {self._last_acked_frame}"
+            )
+        body = InputMessage(
+            peer_connect_status=list(statuses),
+            disconnect_requested=disconnect_requested,
+            start_frame=first_frame,
+            ack_frame=self._last_recv,
+            bytes=compression.encode(
+                self._last_acked, [p for _, p in self._pending]
+            ),
+        )
+        return Message(magic=magic, body=body).encode()
+
+    def ack(self, ack_frame: Frame) -> None:
+        while self._pending and self._pending[0][0] <= ack_frame:
+            self._last_acked_frame, self._last_acked = self._pending.popleft()
+
+    def pending_len(self) -> int:
+        return len(self._pending)
+
+    # ---- receive side ----
+
+    def _base_for(self, start_frame: Frame) -> Optional[bytes]:
+        if self._last_recv == NULL_FRAME:
+            return self._recv[NULL_FRAME]
+        base_frame = start_frame - 1
+        # GC-cutoff at lookup time: an entry older than the window counts as
+        # collected even if the physical sweep hasn't run yet
+        if base_frame != NULL_FRAME and base_frame < (
+            self._last_recv - 2 * self._max_prediction
+        ):
+            return None
+        return self._recv.get(base_frame)
+
+    def on_input(
+        self, start_frame: Frame, comp: bytes
+    ) -> Optional[Tuple[Frame, List[bytes]]]:
+        """Peek: decode the packet and stage its NEW frames.  Returns
+        ``(first_new_frame, payloads)`` (possibly ``(NULL_FRAME, [])`` for a
+        pure-duplicate packet, which the caller still acks) or ``None`` when
+        the packet must be silently dropped."""
+        if not _FRAME_SANE_MIN <= start_frame <= _FRAME_SANE_MAX:
+            return None  # beyond the i64 wire contract: malformed, drop
+        lr = self._last_recv
+        # a gap between what we have and where the packet starts is
+        # unrecoverable — but also impossible from an honest peer, so drop
+        # rather than crash (reference asserts here, protocol.rs:588-590)
+        if lr != NULL_FRAME and lr + 1 < start_frame:
+            return None
+        base = self._base_for(start_frame)
+        if base is None:
+            return None
+        try:
+            decoded = compression.decode(base, comp)
+        except compression.CodecError:
+            return None  # malicious or corrupt: drop silently
+        payloads: List[bytes] = []
+        first_new: Frame = NULL_FRAME
+        for i, fp in enumerate(decoded):
+            frame = start_frame + i
+            if frame <= lr:
+                continue  # already have it
+            if first_new == NULL_FRAME:
+                first_new = frame
+            payloads.append(fp)
+        self._staged = (first_new, payloads)
+        return self._staged
+
+    def commit(self) -> None:
+        if self._staged is None:
+            return
+        first_new, payloads = self._staged
+        self._staged = None
+        for i, fp in enumerate(payloads):
+            frame = first_new + i
+            self._recv[frame] = fp
+            if frame > self._last_recv:
+                self._last_recv = frame
+        # physical GC sweep, throttled: correctness comes from the
+        # lookup-time cutoff above, so the sweep only bounds memory
+        if len(self._recv) > 4 * self._max_prediction + 8:
+            cutoff = self._last_recv - 2 * self._max_prediction
+            for f in [
+                f for f in self._recv if f != NULL_FRAME and f < cutoff
+            ]:
+                del self._recv[f]
+
+    def last_recv_frame(self) -> Frame:
+        return self._last_recv
+
+
+class NativeEndpointCore:
+    """C++-backed endpoint datapath (native/endpoint.cpp via ctypes)."""
+
+    # receive staging caps; a legal packet beyond these falls back to the
+    # Python codec through the fetch_base/store_one escape hatches
+    _RECV_CAP_BYTES = 1 << 16
+    _RECV_CAP_FRAMES = 512
+
+    def __init__(
+        self, lib: ctypes.CDLL, send_base: bytes, recv_base: bytes,
+        max_prediction: int
+    ) -> None:
+        self._lib = lib
+        self._ptr = lib.ggrs_ep_new(
+            send_base, len(send_base), recv_base, len(recv_base),
+            max_prediction,
+        )
+        if not self._ptr:
+            raise MemoryError("ggrs_ep_new failed")
+        self._max_prediction = max_prediction
+        self._out = ctypes.create_string_buffer(1 << 12)
+        self._out_len = ctypes.c_size_t(0)
+        self._recv_out = ctypes.create_string_buffer(self._RECV_CAP_BYTES)
+        self._recv_sizes = (ctypes.c_size_t * self._RECV_CAP_FRAMES)()
+        self._recv_count = ctypes.c_size_t(0)
+        self._first_new = ctypes.c_int64(0)
+        self._new_last_recv = ctypes.c_int64(0)
+        self._last_recv: Frame = NULL_FRAME  # mirror, updated on commit
+        # set when a fallback-path peek staged frames Python-side
+        self._py_staged: Optional[Tuple[Frame, List[bytes]]] = None
+        # fused-receive scratch (header outs for handle_input_datagram)
+        self._hdr_magic = ctypes.c_uint16(0)
+        self._hdr_dreq = ctypes.c_uint8(0)
+        self._hdr_disc = (ctypes.c_uint8 * 64)()
+        self._hdr_frames = (ctypes.c_int64 * 64)()
+        self._hdr_n = ctypes.c_int32(0)
+        self._hdr_start = ctypes.c_int64(0)
+
+    def __del__(self) -> None:  # pragma: no cover
+        try:
+            if self._ptr:
+                self._lib.ggrs_ep_free(self._ptr)
+                self._ptr = None
+        except Exception:
+            pass
+
+    # ---- send side ----
+
+    def push_input(self, frame: Frame, payload: bytes) -> int:
+        return self._lib.ggrs_ep_push(self._ptr, frame, payload, len(payload))
+
+    def emit_input(
+        self,
+        magic: int,
+        statuses: Sequence[ConnectionStatus],
+        disconnect_requested: bool,
+    ) -> Optional[bytes]:
+        n = len(statuses)
+        disc = bytes(1 if s.disconnected else 0 for s in statuses)
+        # status frames are session state and always i64 (the protocol drops
+        # packets carrying larger values before they can be merged in)
+        frames = struct.pack(f"<{n}q", *(s.last_frame for s in statuses))
+        while True:
+            rc = self._lib.ggrs_ep_emit_input(
+                self._ptr, magic, disc, frames, n,
+                1 if disconnect_requested else 0,
+                self._out, len(self._out), ctypes.byref(self._out_len),
+            )
+            if rc == _native.EP_ERR_BUFFER_TOO_SMALL:
+                # grow until the datagram fits — the Python core has no size
+                # limit here either (memory is bounded by the actual message)
+                self._out = ctypes.create_string_buffer(len(self._out) * 4)
+                continue
+            break
+        if rc == _native.EP_BAD_PENDING_HEAD:
+            raise RuntimeError(
+                "pending output head does not follow last acked frame"
+            )
+        if rc != 0:
+            raise RuntimeError(f"ggrs_ep_emit_input failed: {rc}")
+        if self._out_len.value == 0:
+            return None
+        return ctypes.string_at(self._out, self._out_len.value)
+
+    def ack(self, ack_frame: Frame) -> None:
+        # clamp rather than let ctypes silently wrap: stored frames are
+        # always in i64 range, so the clamped comparison pops exactly the
+        # same entries the Python core's unbounded comparison would
+        if ack_frame > _I64_MAX:
+            ack_frame = _I64_MAX
+        elif ack_frame < _I64_MIN:
+            ack_frame = _I64_MIN
+        self._lib.ggrs_ep_ack(self._ptr, ack_frame)
+
+    def pending_len(self) -> int:
+        return self._lib.ggrs_ep_pending_len(self._ptr)
+
+    # ---- receive side ----
+
+    def on_input(
+        self, start_frame: Frame, comp: bytes
+    ) -> Optional[Tuple[Frame, List[bytes]]]:
+        if not _FRAME_SANE_MIN <= start_frame <= _FRAME_SANE_MAX:
+            return None  # beyond the i64 wire contract: malformed, drop
+        self._py_staged = None
+        rc = self._lib.ggrs_ep_on_input(
+            self._ptr, start_frame, comp, len(comp),
+            self._recv_out, self._RECV_CAP_BYTES,
+            self._recv_sizes, self._RECV_CAP_FRAMES,
+            ctypes.byref(self._recv_count), ctypes.byref(self._first_new),
+            ctypes.byref(self._new_last_recv),
+        )
+        if rc == _native.EP_DROP:
+            return None
+        if rc == _native.EP_FALLBACK:
+            return self._on_input_py(start_frame, comp)
+        if rc != 0:
+            raise RuntimeError(f"ggrs_ep_on_input failed: {rc}")
+        payloads: List[bytes] = []
+        pos = 0
+        for i in range(self._recv_count.value):
+            size = self._recv_sizes[i]
+            payloads.append(ctypes.string_at(
+                ctypes.byref(self._recv_out, pos), size
+            ))
+            pos += size
+        first_new = (
+            self._first_new.value if payloads else NULL_FRAME
+        )
+        return first_new, payloads
+
+    def handle_input_datagram(self, data: bytes):
+        """The fused receive: parse + ack + decode + stage in ONE native
+        call.  Returns
+        ``(disconnect_requested, statuses, staged_or_None)`` where
+        ``statuses`` is ``(n, disc_array, frame_array)`` over reusable
+        scratch (read it before the next call) and ``staged_or_None``
+        mirrors ``on_input``'s return; or the string ``"fallback"`` when the
+        datagram needs the object path; or ``None`` when it is malformed and
+        must be dropped whole."""
+        self._py_staged = None
+        rc = self._lib.ggrs_ep_handle_input_datagram(
+            self._ptr, data, len(data),
+            ctypes.byref(self._hdr_magic), ctypes.byref(self._hdr_dreq),
+            self._hdr_disc, self._hdr_frames, ctypes.byref(self._hdr_n),
+            ctypes.byref(self._hdr_start),
+            self._recv_out, self._RECV_CAP_BYTES,
+            self._recv_sizes, self._RECV_CAP_FRAMES,
+            ctypes.byref(self._recv_count), ctypes.byref(self._first_new),
+            ctypes.byref(self._new_last_recv),
+        )
+        if rc == _native.EP_FALLBACK:
+            return "fallback"
+        if rc != 0 and rc != _native.EP_DROP:
+            return None  # malformed datagram: drop whole, nothing applied
+        # expose the scratch arrays directly (valid until the next call);
+        # the protocol's status merge reads them once, immediately
+        statuses = (self._hdr_n.value, self._hdr_disc, self._hdr_frames)
+        if rc == _native.EP_DROP:
+            staged = None
+        else:
+            payloads: List[bytes] = []
+            pos = 0
+            for i in range(self._recv_count.value):
+                size = self._recv_sizes[i]
+                payloads.append(ctypes.string_at(
+                    ctypes.byref(self._recv_out, pos), size
+                ))
+                pos += size
+            staged = (
+                self._first_new.value if payloads else NULL_FRAME,
+                payloads,
+            )
+        return bool(self._hdr_dreq.value), statuses, staged
+
+    def _on_input_py(
+        self, start_frame: Frame, comp: bytes
+    ) -> Optional[Tuple[Frame, List[bytes]]]:
+        """Python-codec fallback for legal-but-huge packets: same staging
+        semantics, committed via ggrs_ep_store_one."""
+        base_buf = ctypes.create_string_buffer(compression.MAX_DECODED_BYTES)
+        base_len = ctypes.c_size_t(0)
+        rc = self._lib.ggrs_ep_fetch_base(
+            self._ptr, start_frame, base_buf, len(base_buf),
+            ctypes.byref(base_len),
+        )
+        if rc != 0:
+            return None
+        base = ctypes.string_at(base_buf, base_len.value)
+        try:
+            decoded = compression.decode_py(base, comp)
+        except compression.CodecError:
+            return None
+        lr = self.last_recv_frame()
+        payloads: List[bytes] = []
+        first_new: Frame = NULL_FRAME
+        for i, fp in enumerate(decoded):
+            frame = start_frame + i
+            if frame <= lr:
+                continue
+            if first_new == NULL_FRAME:
+                first_new = frame
+            payloads.append(fp)
+        self._py_staged = (first_new, payloads)
+        return self._py_staged
+
+    def commit(self) -> None:
+        if self._py_staged is not None:
+            first_new, payloads = self._py_staged
+            self._py_staged = None
+            for i, fp in enumerate(payloads):
+                self._lib.ggrs_ep_store_one(
+                    self._ptr, first_new + i, fp, len(fp)
+                )
+            if payloads:
+                self._last_recv = max(
+                    self._last_recv, first_new + len(payloads) - 1
+                )
+            return
+        self._lib.ggrs_ep_commit(self._ptr)
+        if self._new_last_recv.value > self._last_recv:
+            self._last_recv = self._new_last_recv.value
+        self._recv_count.value = 0
+
+    def last_recv_frame(self) -> Frame:
+        return self._last_recv
+
+
+def make_endpoint_core(
+    send_base: bytes, recv_base: bytes, max_prediction: int
+):
+    """The native core when the toolchain/library is available, else the
+    pure-Python reference core."""
+    lib = _native.endpoint_lib()
+    if lib is not None:
+        return NativeEndpointCore(lib, send_base, recv_base, max_prediction)
+    return PyEndpointCore(send_base, recv_base, max_prediction)

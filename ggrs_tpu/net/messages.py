@@ -17,7 +17,7 @@ from . import _native
 from .wire import Reader, WireError, Writer
 
 
-@dataclass
+@dataclass(slots=True)
 class ConnectionStatus:
     """Per-player connection knowledge piggybacked on every Input message
     (reference: messages.rs:5-18)."""
@@ -26,7 +26,7 @@ class ConnectionStatus:
     last_frame: Frame = NULL_FRAME
 
 
-@dataclass
+@dataclass(slots=True)
 class InputMessage:
     """Redundant batch of all unacked inputs, delta+RLE compressed
     (reference: messages.rs:20-39)."""
@@ -38,12 +38,12 @@ class InputMessage:
     bytes: bytes = b""
 
 
-@dataclass
+@dataclass(slots=True)
 class InputAck:
     ack_frame: Frame = NULL_FRAME
 
 
-@dataclass
+@dataclass(slots=True)
 class QualityReport:
     """frame_advantage is i16, not i8: long pauses (debugger, background tab)
     can push it past +/-127 at common FPS (reference rationale:
@@ -53,23 +53,23 @@ class QualityReport:
     ping: int = 0
 
 
-@dataclass
+@dataclass(slots=True)
 class QualityReply:
     pong: int = 0
 
 
-@dataclass
+@dataclass(slots=True)
 class ChecksumReport:
     checksum: int = 0
     frame: Frame = NULL_FRAME
 
 
-@dataclass
+@dataclass(slots=True)
 class KeepAlive:
     pass
 
 
-@dataclass
+@dataclass(slots=True)
 class SyncRequest:
     """Handshake probe (opt-in; see PeerProtocol ``sync_required``).  The
     reference fork removed the handshake entirely (fork delta #4); upstream
@@ -79,7 +79,7 @@ class SyncRequest:
     random: int = 0
 
 
-@dataclass
+@dataclass(slots=True)
 class SyncReply:
     random: int = 0
 
@@ -109,23 +109,104 @@ _TAG_SYNC_REPLY = 7
 _MAX_PLAYERS_ON_WIRE = 64
 
 
-@dataclass
+class RawMessage:
+    """A message whose wire bytes are already built (the endpoint datapath
+    emits complete datagrams).  Sockets only ever call ``encode()`` on
+    outgoing messages, so this is a drop-in for ``Message`` on the send
+    side."""
+
+    __slots__ = ("data", "_decoded")
+
+    def __init__(self, data: bytes) -> None:
+        self.data = data
+        self._decoded = None
+
+    def encode(self) -> bytes:
+        return self.data
+
+    # lazy introspection (tests / debugging peek at queued messages; the
+    # hot path never touches these)
+    def _decode(self) -> "Message":
+        if self._decoded is None:
+            self._decoded = Message.decode(self.data)
+        return self._decoded
+
+    @property
+    def magic(self) -> int:
+        return self._decode().magic
+
+    @property
+    def body(self) -> "MessageBody":
+        return self._decode().body
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"RawMessage({len(self.data)} bytes)"
+
+
+def parse_input_ack_frame(data: bytes) -> "int | None":
+    """Fast parse of an InputAck datagram's ack_frame (LEB128 + zigzag,
+    identical to Reader.svarint).  Returns None for anything irregular —
+    the caller falls through to the generic decoders, which own the exact
+    error behavior.  Shared by Message.decode and the protocol's raw
+    datagram path so the hot parse exists exactly once."""
+    n = len(data)
+    if n < 4 or n > 13 or data[2] != _TAG_INPUT_ACK:
+        return None
+    result = 0
+    shift = 0
+    pos = 3
+    while pos < n and shift <= 63:
+        b = data[pos]
+        pos += 1
+        result |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            if pos == n:  # no trailing bytes
+                return (result >> 1) ^ -(result & 1)
+            return None
+        shift += 7
+    return None
+
+
+def encode_input_ack(magic: int, ack_frame: int) -> bytes:
+    """Wire bytes of ``Message(magic, InputAck(ack_frame))`` without the
+    object round trip — the ack is sent for every received input packet, so
+    it is the hottest small message."""
+    z = (ack_frame << 1) ^ (ack_frame >> 63) if ack_frame >= 0 else (
+        (-ack_frame << 1) - 1
+    )
+    out = bytearray((magic & 0xFF, (magic >> 8) & 0xFF, _TAG_INPUT_ACK))
+    while True:
+        b = z & 0x7F
+        z >>= 7
+        if z:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            break
+    return bytes(out)
+
+
+@dataclass(slots=True)
 class Message:
     """The unit a NonBlockingSocket sends/receives."""
 
     magic: int
     body: MessageBody
+    # memoized wire bytes (see encode); excluded from equality/repr
+    _encoded: "bytes | None" = field(
+        default=None, repr=False, compare=False
+    )
 
     def encode(self) -> bytes:
         # Memoized: the protocol encodes once for byte accounting and the
         # socket encodes again on send.  Messages must not be mutated after
         # the first encode.
-        cached = self.__dict__.get("_encoded")
+        cached = self._encoded
         if cached is not None:
             return cached
         fast = _native.msg_encode(self)
         if fast is not None:
-            self.__dict__["_encoded"] = fast
+            self._encoded = fast
             return fast
         w = Writer()
         w.u16(self.magic)
@@ -165,7 +246,7 @@ class Message:
         else:  # pragma: no cover
             raise TypeError(f"unknown message body {type(b)}")
         out = w.finish()
-        self.__dict__["_encoded"] = out
+        self._encoded = out
         return out
 
     @staticmethod
@@ -175,6 +256,11 @@ class Message:
         the native framing fast path (native/codec.cpp) when available; the
         Python reader below remains the reference implementation and the
         fallback for packets whose varints exceed u64."""
+        # InputAck is the hottest datagram (one per received input packet)
+        # and tiny; parse it inline without the ctypes round trip
+        ack = parse_input_ack_frame(data)
+        if ack is not None:
+            return Message(data[0] | (data[1] << 8), InputAck(ack))
         fast = _native.msg_decode(data)
         if fast is not None:
             return fast

@@ -29,7 +29,6 @@ from ..core.frame_info import PlayerInput
 from ..core.time_sync import TimeSync
 from ..core.types import DesyncDetection, Frame, NULL_FRAME, PlayerHandle
 from ..core.errors import StatsUnavailable
-from . import compression
 from .messages import (
     ChecksumReport,
     ConnectionStatus,
@@ -42,6 +41,8 @@ from .messages import (
     SyncReply,
     SyncRequest,
 )
+from .endpoint import make_endpoint_core
+from .messages import RawMessage, encode_input_ack, parse_input_ack_frame
 from .sockets import NonBlockingSocket
 from .stats import NetworkStats
 from .wire import Reader, WireError, Writer
@@ -75,7 +76,7 @@ def monotonic_ms() -> int:
 # ---------------------------------------------------------------------------
 
 
-@dataclass
+@dataclass(slots=True)
 class EvInput(Generic[I]):
     input: PlayerInput[I]
     player: PlayerHandle
@@ -126,16 +127,6 @@ class _State:
     SHUTDOWN = "shutdown"
 
 
-@dataclass
-class _FrameBytes:
-    """Byte-encoded inputs of one frame, possibly for several players at the
-    same endpoint (the analog of the reference's InputBytes,
-    protocol.rs:44-96)."""
-
-    frame: Frame
-    bytes: bytes
-
-
 def _encode_player_bytes(per_player: Sequence[bytes]) -> bytes:
     w = Writer()
     for b in per_player:
@@ -143,14 +134,51 @@ def _encode_player_bytes(per_player: Sequence[bytes]) -> bytes:
     return w.finish()
 
 
+def encode_local_inputs(config: Config, inputs) -> Tuple[Frame, bytes]:
+    """(frame, joined per-player payload) for one tick's local inputs — the
+    single definition of the wire payload layout, shared by
+    ``PeerProtocol.send_input`` and the session's encode-once-per-tick
+    fast path."""
+    frame: Frame = NULL_FRAME
+    per_player: List[bytes] = []
+    encode = config.input_encode
+    for handle in sorted(inputs.keys()):
+        pi = inputs[handle]
+        assert frame == NULL_FRAME or pi.frame == NULL_FRAME or frame == pi.frame
+        if pi.frame != NULL_FRAME:
+            frame = pi.frame
+        per_player.append(encode(pi.input))
+    return frame, _encode_player_bytes(per_player)
+
+
 def _decode_player_bytes(data: bytes, expected_players: int) -> Optional[List[bytes]]:
-    try:
-        r = Reader(data)
-        out = [r.bytes() for _ in range(expected_players)]
-        r.expect_end()
-        return out
-    except WireError:
+    """Split one frame's payload into per-player byte strings (inlined
+    uvarint parse — this runs for every received frame; same semantics as
+    Reader.bytes ``expected_players`` times + expect_end, with any
+    malformation returning None)."""
+    out: List[bytes] = []
+    pos = 0
+    n = len(data)
+    for _ in range(expected_players):
+        length = 0
+        shift = 0
+        while True:
+            if pos >= n or shift > 63:
+                return None
+            b = data[pos]
+            pos += 1
+            length |= (b & 0x7F) << shift
+            if not (b & 0x80):
+                break
+            shift += 7
+        end = pos + length
+        if end > n:
+            return None
+        out.append(data[pos:end])
+        pos = end
+    if pos != n:
         return None
+    return out
 
 
 class PeerProtocol(Generic[I, A]):
@@ -224,20 +252,21 @@ class PeerProtocol(Generic[I, A]):
             ConnectionStatus() for _ in range(num_players)
         ]
 
-        # outbound: all inputs the peer hasn't acked yet
-        self._pending_output: Deque[_FrameBytes] = deque()
+        # the per-tick datapath: pending-output window + its delta base,
+        # received-input ring + decode base, datagram build/decode.  Native
+        # (C++) when the toolchain is available, pure Python otherwise —
+        # wire-identical either way (net/endpoint.py).
         default_bytes = config.input_encode(config.input_default())
-        self._last_acked_input = _FrameBytes(
-            NULL_FRAME, _encode_player_bytes([default_bytes] * local_players)
+        self._core = make_endpoint_core(
+            send_base=_encode_player_bytes([default_bytes] * local_players),
+            recv_base=_encode_player_bytes(
+                [default_bytes] * len(self.handles)
+            ),
+            max_prediction=max_prediction,
         )
-        # inbound: received frame bytes, keyed by frame; NULL_FRAME holds the
-        # zeroed decode base (reference: protocol.rs:208-209)
-        self._last_recv_frame: Frame = NULL_FRAME  # cached max of _recv_inputs
-        self._recv_inputs: Dict[Frame, _FrameBytes] = {
-            NULL_FRAME: _FrameBytes(
-                NULL_FRAME, _encode_player_bytes([default_bytes] * len(self.handles))
-            )
-        }
+        self._last_recv_frame: Frame = NULL_FRAME  # mirror of core state
+        # fused-datagram receive (native core only; None → object path)
+        self._fused_recv = getattr(self._core, "handle_input_datagram", None)
 
         self._time_sync = TimeSync()
         self.local_frame_advantage = 0
@@ -280,7 +309,7 @@ class PeerProtocol(Generic[I, A]):
         bps = total_bytes_sent // seconds
         return NetworkStats(
             ping=self._round_trip_time,
-            send_queue_len=len(self._pending_output),
+            send_queue_len=self._core.pending_len(),
             kbps_sent=bps // 1024,
             local_frames_behind=self.local_frame_advantage,
             remote_frames_behind=self.remote_frame_advantage,
@@ -379,50 +408,42 @@ class PeerProtocol(Generic[I, A]):
         if self._state != _State.RUNNING:
             return
 
-        frame = NULL_FRAME
-        per_player: List[bytes] = []
-        for handle in sorted(inputs.keys()):
-            pi = inputs[handle]
-            assert frame == NULL_FRAME or pi.frame == NULL_FRAME or frame == pi.frame
-            if pi.frame != NULL_FRAME:
-                frame = pi.frame
-            per_player.append(self._config.input_encode(pi.input))
-        frame_bytes = _FrameBytes(frame, _encode_player_bytes(per_player))
+        frame, payload = encode_local_inputs(self._config, inputs)
+        self.send_encoded_input(frame, payload, connect_status)
+
+    def send_encoded_input(
+        self,
+        frame: Frame,
+        payload: bytes,
+        connect_status: Sequence[ConnectionStatus],
+    ) -> None:
+        """``send_input`` with the per-player payload already joined — a
+        session with several remote endpoints encodes its local inputs once
+        and hands every endpoint the same bytes."""
+        if self._state != _State.RUNNING:
+            return
 
         self._time_sync.advance_frame(
             frame, self.local_frame_advantage, self.remote_frame_advantage
         )
 
-        self._pending_output.append(frame_bytes)
+        pending = self._core.push_input(frame, payload)
         # A peer that never acks 128 inputs is a stuck spectator: disconnect
         # (reference: protocol.rs:441-445).
-        if len(self._pending_output) > PENDING_OUTPUT_SIZE:
+        if pending > PENDING_OUTPUT_SIZE:
             self._event_queue.append(EvDisconnected())
 
         self._send_pending_output(connect_status)
 
     def _send_pending_output(self, connect_status: Sequence[ConnectionStatus]) -> None:
-        if not self._pending_output:
-            return
-        first = self._pending_output[0]
-        assert (
-            self._last_acked_input.frame == NULL_FRAME
-            or self._last_acked_input.frame + 1 == first.frame
+        data = self._core.emit_input(
+            self.magic,
+            connect_status,
+            self._state == _State.DISCONNECTED,
         )
-        body = InputMessage(
-            peer_connect_status=[
-                ConnectionStatus(cs.disconnected, cs.last_frame)
-                for cs in connect_status
-            ],
-            disconnect_requested=self._state == _State.DISCONNECTED,
-            start_frame=first.frame,
-            ack_frame=self.last_recv_frame(),
-            bytes=compression.encode(
-                self._last_acked_input.bytes,
-                [fb.bytes for fb in self._pending_output],
-            ),
-        )
-        self._queue_message(body)
+        if data is None:
+            return  # nothing pending
+        self._queue_raw(data)
 
     def _send_sync_request(self) -> None:
         # The nonce is per ROUND TRIP, not per send: a retry re-sends the
@@ -453,19 +474,32 @@ class PeerProtocol(Generic[I, A]):
         self._bytes_sent += size
         self._send_queue.append((msg, size))
 
+    def _queue_raw(self, data: bytes) -> None:
+        """Queue a datagram whose wire bytes are already built (endpoint
+        datapath emissions and the per-packet input ack)."""
+        self._packets_sent += 1
+        self._last_send_time = self._clock()
+        self._bytes_sent += len(data)
+        self._send_queue.append((RawMessage(data), len(data)))
+
     # ------------------------------------------------------------------
     # receiving (reference: protocol.rs:534-682)
     # ------------------------------------------------------------------
+
+    def _mark_alive(self) -> None:
+        """Record inbound traffic for the disconnect timers; emit the
+        resume event when an interruption warning is standing.  The single
+        definition behind every receive entry (object, fused, inline-ack)."""
+        self._last_recv_time = self._clock()
+        if self._disconnect_notify_sent and self._state == _State.RUNNING:
+            self._disconnect_notify_sent = False
+            self._event_queue.append(EvNetworkResumed())
 
     def handle_message(self, msg: Message) -> None:
         if self._state == _State.SHUTDOWN:
             return
 
-        self._last_recv_time = self._clock()
-
-        if self._disconnect_notify_sent and self._state == _State.RUNNING:
-            self._disconnect_notify_sent = False
-            self._event_queue.append(EvNetworkResumed())
+        self._mark_alive()
 
         body = msg.body
         if isinstance(body, SyncRequest):
@@ -478,7 +512,7 @@ class PeerProtocol(Generic[I, A]):
         elif isinstance(body, InputMessage):
             self._on_input(body)
         elif isinstance(body, InputAck):
-            self._pop_pending_output(body.ack_frame)
+            self._core.ack(body.ack_frame)
         elif isinstance(body, QualityReport):
             self.remote_frame_advantage = body.frame_advantage
             self._queue_message(QualityReply(pong=body.ping))
@@ -519,12 +553,8 @@ class PeerProtocol(Generic[I, A]):
         else:
             self._send_sync_request()  # next round trip immediately
 
-    def _pop_pending_output(self, ack_frame: Frame) -> None:
-        while self._pending_output and self._pending_output[0].frame <= ack_frame:
-            self._last_acked_input = self._pending_output.popleft()
-
     def _on_input(self, body: InputMessage) -> None:
-        self._pop_pending_output(body.ack_frame)
+        self._core.ack(body.ack_frame)
 
         if body.disconnect_requested:
             if self._state != _State.DISCONNECTED and not self._disconnect_event_sent:
@@ -533,59 +563,118 @@ class PeerProtocol(Generic[I, A]):
         else:
             if len(body.peer_connect_status) != len(self.peer_connect_status):
                 return  # malformed: drop
+            for theirs in body.peer_connect_status:
+                # beyond the i64 wire contract (only reachable through the
+                # unbounded Python varint reader): malformed, drop before
+                # the merge can poison session state
+                if not -(1 << 63) <= theirs.last_frame < (1 << 63):
+                    return
             for ours, theirs in zip(self.peer_connect_status, body.peer_connect_status):
                 ours.disconnected = theirs.disconnected or ours.disconnected
                 ours.last_frame = max(ours.last_frame, theirs.last_frame)
 
-        # A gap between what we have and where the packet starts is
-        # unrecoverable — but also impossible from an honest peer, so drop
-        # rather than crash (reference asserts here, protocol.rs:588-590).
-        if (
-            self.last_recv_frame() != NULL_FRAME
-            and self.last_recv_frame() + 1 < body.start_frame
-        ):
+        # The core peeks: sequence-gap / missing-base / undecodable packets
+        # come back as None and are silently dropped (reference asserts on
+        # the gap, protocol.rs:588-590; we drop instead of crashing).
+        staged = self._core.on_input(body.start_frame, body.bytes)
+        if staged is None:
             return
+        self._finish_input(staged)
 
-        decode_frame = (
-            NULL_FRAME if self.last_recv_frame() == NULL_FRAME else body.start_frame - 1
-        )
-        base = self._recv_inputs.get(decode_frame)
-        if base is None:
-            return
-        try:
-            decoded = compression.decode(base.bytes, body.bytes)
-        except compression.CodecError:
-            return  # malicious or corrupt: drop silently
+    def _finish_input(self, staged) -> None:
+        """Validate, commit, and surface the frames staged by the core's
+        receive peek (shared by the object path and the fused-datagram
+        path)."""
+        first_new, payloads = staged
 
+        # validate ALL inner framing before committing, so a packet with any
+        # malformed frame is dropped whole with no state advance (an honest
+        # peer can never produce one; see endpoint.py docstring)
+        n_handles = len(self.handles)
+        decoded_inputs: List[List] = []
+        for frame_payload in payloads:
+            per_player = _decode_player_bytes(frame_payload, n_handles)
+            if per_player is None:
+                return  # malformed inner framing: drop the packet
+            try:
+                decoded_inputs.append(
+                    [self._config.input_decode(b) for b in per_player]
+                )
+            except Exception:
+                return  # undecodable input payload: drop the packet
+
+        self._core.commit()
+        if payloads:
+            self._last_recv_frame = first_new + len(payloads) - 1
         self._last_input_recv_time = self._clock()
 
-        for i, frame_payload in enumerate(decoded):
-            frame = body.start_frame + i
-            if frame <= self.last_recv_frame():
-                continue  # already have it
+        handles = self.handles
+        events = self._event_queue
+        for i, player_inputs in enumerate(decoded_inputs):
+            frame = first_new + i
+            for handle, value in zip(handles, player_inputs):
+                events.append(EvInput(PlayerInput(frame, value), handle))
 
-            per_player = _decode_player_bytes(frame_payload, len(self.handles))
-            if per_player is None:
-                return  # malformed inner framing: drop the rest
-            try:
-                player_inputs = [self._config.input_decode(b) for b in per_player]
-            except Exception:
-                return  # undecodable input payload: drop
+        # ack what we have now (hand-built bytes: this runs once per
+        # received input packet)
+        self._queue_raw(encode_input_ack(self.magic, self._last_recv_frame))
 
-            self._recv_inputs[frame] = _FrameBytes(frame, frame_payload)
-            self._last_recv_frame = max(self._last_recv_frame, frame)
-            for handle, value in zip(self.handles, player_inputs):
-                self._event_queue.append(
-                    EvInput(PlayerInput(frame, value), handle)
-                )
+    def _decode_and_dispatch(self, data: bytes) -> None:
+        """Object-path fallback for raw datagrams: decode, silently dropping
+        anything undecodable exactly as the socket layer used to
+        (reference: udp_socket.rs:70-72)."""
+        try:
+            msg = Message.decode(data)
+        except WireError:
+            return
+        self.handle_message(msg)
 
-        # ack what we have now
-        self._queue_message(InputAck(ack_frame=self.last_recv_frame()))
-
-        # GC inputs too old to ever be needed again
-        cutoff = self.last_recv_frame() - 2 * self._max_prediction
-        for frame in [f for f in self._recv_inputs if f != NULL_FRAME and f < cutoff]:
-            del self._recv_inputs[frame]
+    def handle_datagram(self, data: bytes) -> None:
+        """Receive entry for raw datagram bytes.  Input packets take the
+        fused native path (ONE crossing: parse + ack + decode + stage);
+        everything else — and every packet when the Python core is active —
+        goes through ``Message.decode`` + ``handle_message``.  Undecodable
+        datagrams are dropped silently, exactly as the socket layer drops
+        them on the object path (reference: udp_socket.rs:70-72)."""
+        if self._state == _State.SHUTDOWN:
+            return
+        ack = parse_input_ack_frame(data)  # the other hot tag
+        if ack is not None:
+            self._mark_alive()
+            self._core.ack(ack)
+            return
+        fused = self._fused_recv
+        if fused is None or len(data) < 3 or data[2] != 0:  # 0 = input tag
+            self._decode_and_dispatch(data)
+            return
+        res = fused(data)
+        if res == "fallback":
+            self._decode_and_dispatch(data)
+            return
+        if res is None:
+            return  # malformed: dropped whole, nothing applied
+        self._mark_alive()
+        disconnect_requested, (n_status, disc, frames), staged = res
+        if disconnect_requested:
+            if (
+                self._state != _State.DISCONNECTED
+                and not self._disconnect_event_sent
+            ):
+                self._event_queue.append(EvDisconnected())
+                self._disconnect_event_sent = True
+        else:
+            pcs = self.peer_connect_status
+            if n_status != len(pcs):
+                return  # malformed: drop
+            for i in range(n_status):
+                ours = pcs[i]
+                if disc[i]:
+                    ours.disconnected = True
+                last_frame = frames[i]
+                if last_frame > ours.last_frame:
+                    ours.last_frame = last_frame
+        if staged is not None:
+            self._finish_input(staged)
 
     def _on_checksum_report(self, body: ChecksumReport) -> None:
         interval = self.desync_detection.interval if self.desync_detection.enabled else 1

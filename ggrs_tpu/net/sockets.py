@@ -14,7 +14,7 @@ import logging
 import random
 import socket as _socket
 from collections import deque
-from typing import Deque, Dict, Hashable, List, Protocol, Tuple, TypeVar
+from typing import Deque, Dict, Hashable, List, Optional, Protocol, Tuple, TypeVar
 
 from .messages import Message
 from .wire import WireError
@@ -63,6 +63,20 @@ class UdpNonBlockingSocket:
 
     def receive_all_messages(self) -> List[Tuple[Tuple[str, int], Message]]:
         received: List[Tuple[Tuple[str, int], Message]] = []
+        for src, data in self.receive_all_datagrams():
+            try:
+                received.append((src, Message.decode(data)))
+            except WireError:
+                # drop undecodable packets (reference: udp_socket.rs:70-72)
+                continue
+        return received
+
+    def receive_all_datagrams(self) -> List[Tuple[Tuple[str, int], bytes]]:
+        """Raw variant of ``receive_all_messages``: undecoded datagram bytes.
+        Sessions prefer this when the endpoint datapath can parse natively;
+        undecodable packets are then dropped at the endpoint instead of here
+        (same observable behavior)."""
+        received: List[Tuple[Tuple[str, int], bytes]] = []
         while True:
             try:
                 data, src = self._sock.recvfrom(RECV_BUFFER_SIZE)
@@ -71,11 +85,7 @@ class UdpNonBlockingSocket:
             except ConnectionResetError:
                 # datagram sockets surface this after send_to on some OSes
                 continue
-            try:
-                received.append((src, Message.decode(data)))
-            except WireError:
-                # drop undecodable packets (reference: udp_socket.rs:70-72)
-                continue
+            received.append((src, data))
 
     def close(self) -> None:
         self._sock.close()
@@ -119,35 +129,62 @@ class InMemoryNetwork:
         self._tick += 1
 
     def _send(self, from_addr: Hashable, to_addr: Hashable, msg: Message) -> None:
-        if to_addr not in self._queues:
+        q = self._queues.get(to_addr)
+        if q is None:
             return  # unroutable: dropped silently, like real UDP
+        payload = msg.encode()  # serialize: real sockets don't share references
+        if self._faultless:
+            # fast path for the common perfect-link configuration: no RNG
+            # draws, no reordering checks
+            q.append((self._tick, from_addr, payload))
+            return
         if self._rng.random() < self.loss:
             return
-        payload = msg.encode()  # serialize: real sockets don't share references
         deliver_at = self._tick + self.latency_ticks
-        q = self._queues[to_addr]
         q.append((deliver_at, from_addr, payload))
         if self._rng.random() < self.duplicate:
             q.append((deliver_at, from_addr, payload))
         if len(q) >= 2 and self._rng.random() < self.reorder:
             q[-1], q[-2] = q[-2], q[-1]
 
-    def _receive(self, addr: Hashable) -> List[Tuple[Hashable, Message]]:
+    @property
+    def _faultless(self) -> bool:
+        return (
+            self.loss == 0.0
+            and self.duplicate == 0.0
+            and self.reorder == 0.0
+            and self.latency_ticks == 0
+        )
+
+    def _receive_raw(self, addr: Hashable) -> List[Tuple[Hashable, bytes]]:
         q = self._queues.get(addr)
-        out: List[Tuple[Hashable, Message]] = []
+        out: List[Tuple[Hashable, bytes]] = []
         if not q:
             return out
-        remaining: Deque[Tuple[int, Hashable, bytes]] = deque()
-        while q:
-            deliver_at, from_addr, payload = q.popleft()
-            if deliver_at > self._tick:
-                remaining.append((deliver_at, from_addr, payload))
+        tick = self._tick
+        # single pass; the requeue deque is only materialized when something
+        # is actually future-dated (never, on a zero-latency link)
+        remaining: Optional[Deque[Tuple[int, Hashable, bytes]]] = None
+        for item in q:
+            if item[0] > tick:
+                if remaining is None:
+                    remaining = deque()
+                remaining.append(item)
                 continue
+            out.append((item[1], item[2]))
+        if remaining is None:
+            q.clear()
+        else:
+            self._queues[addr] = remaining
+        return out
+
+    def _receive(self, addr: Hashable) -> List[Tuple[Hashable, Message]]:
+        out: List[Tuple[Hashable, Message]] = []
+        for from_addr, payload in self._receive_raw(addr):
             try:
                 out.append((from_addr, Message.decode(payload)))
             except WireError:
                 continue
-        self._queues[addr] = remaining
         return out
 
 
@@ -163,3 +200,6 @@ class FakeSocket:
 
     def receive_all_messages(self) -> List[Tuple[Hashable, Message]]:
         return self._network._receive(self.addr)
+
+    def receive_all_datagrams(self) -> List[Tuple[Hashable, bytes]]:
+        return self._network._receive_raw(self.addr)

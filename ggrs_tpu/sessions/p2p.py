@@ -56,6 +56,7 @@ from ..net.protocol import (
     MAX_CHECKSUM_HISTORY_SIZE,
     PeerProtocol,
     ProtocolEvent,
+    encode_local_inputs,
 )
 from ..net.sockets import NonBlockingSocket
 from ..net.stats import NetworkStats
@@ -152,6 +153,15 @@ class P2PSession(Generic[I, S, A]):
         self._local_checksum_history: Dict[Frame, int] = {}
         self._last_sent_checksum_frame: Frame = NULL_FRAME
 
+        # the registry is fixed once the session exists (players are added
+        # through the builder only), so cache the per-tick iteration targets
+        self._local_handles = players.local_player_handles()
+        self._local_handle_set = set(self._local_handles)
+        self._remote_endpoints = list(players.remotes.values())
+        self._all_endpoints = self._remote_endpoints + list(
+            players.spectators.values()
+        )
+
     # ------------------------------------------------------------------
     # public API
     # ------------------------------------------------------------------
@@ -159,7 +169,7 @@ class P2PSession(Generic[I, S, A]):
     def add_local_input(self, player_handle: PlayerHandle, input: I) -> None:
         """Register local input for the current frame; must be called for
         every local player before advance_frame()."""
-        if player_handle not in self._player_reg.local_player_handles():
+        if player_handle not in self._local_handle_set:
             raise InvalidRequest(
                 "The player handle you provided is not referring to a local player."
             )
@@ -172,10 +182,7 @@ class P2PSession(Generic[I, S, A]):
         ``with_sync_handshake``) is still in flight on any endpoint.  With
         the handshake off this is always RUNNING, like the reference fork
         (p2p_session.rs:250-252)."""
-        endpoints = list(self._player_reg.remotes.values()) + list(
-            self._player_reg.spectators.values()
-        )
-        if any(e.is_synchronizing() for e in endpoints):
+        if any(e.is_synchronizing() for e in self._all_endpoints):
             return SessionState.SYNCHRONIZING
         return SessionState.RUNNING
 
@@ -187,7 +194,7 @@ class P2PSession(Generic[I, S, A]):
         if self.current_state() is SessionState.SYNCHRONIZING:
             raise NotSynchronized()
 
-        for handle in self._player_reg.local_player_handles():
+        for handle in self._local_handles:
             if handle not in self._local_inputs:
                 raise InvalidRequest(
                     f"Missing local input for handle {handle} while calling "
@@ -252,7 +259,7 @@ class P2PSession(Generic[I, S, A]):
         self._check_wait_recommendation()
 
         # register local inputs and send them
-        for handle in self._player_reg.local_player_handles():
+        for handle in self._local_handles:
             player_input = self._local_inputs[handle]
             actual_frame = self._sync_layer.add_local_input(handle, player_input)
             player_input.frame = actual_frame
@@ -260,9 +267,17 @@ class P2PSession(Generic[I, S, A]):
                 self.local_connect_status[handle].last_frame = actual_frame
 
         if not any(pi.frame == NULL_FRAME for pi in self._local_inputs.values()):
-            for endpoint in self._player_reg.remotes.values():
-                endpoint.send_input(self._local_inputs, self.local_connect_status)
-                endpoint.send_all_messages(self._socket)
+            if self._remote_endpoints:
+                # every remote endpoint carries the same local inputs: join
+                # the per-player payload once, push it to each endpoint
+                frame, payload = encode_local_inputs(
+                    self._config, self._local_inputs
+                )
+                for endpoint in self._remote_endpoints:
+                    endpoint.send_encoded_input(
+                        frame, payload, self.local_connect_status
+                    )
+                    endpoint.send_all_messages(self._socket)
 
         # advance decision
         if lockstep:
@@ -294,31 +309,43 @@ class P2PSession(Generic[I, S, A]):
     def poll_remote_clients(self) -> None:
         """Drain the socket, route messages to endpoints, run timers, handle
         events, and flush outgoing packets (reference: p2p_session.rs:430-478)."""
-        for from_addr, msg in self._socket.receive_all_messages():
-            if from_addr in self._player_reg.remotes:
-                self._player_reg.remotes[from_addr].handle_message(msg)
-            if from_addr in self._player_reg.spectators:
-                self._player_reg.spectators[from_addr].handle_message(msg)
+        remotes = self._player_reg.remotes
+        spectators = self._player_reg.spectators
+        recv_raw = getattr(self._socket, "receive_all_datagrams", None)
+        if recv_raw is not None:
+            # raw path: endpoints parse natively (undecodable datagrams are
+            # dropped at the endpoint, same behavior as socket-level drops)
+            for from_addr, data in recv_raw():
+                ep = remotes.get(from_addr)
+                if ep is not None:
+                    ep.handle_datagram(data)
+                ep = spectators.get(from_addr)
+                if ep is not None:
+                    ep.handle_datagram(data)
+        else:
+            # user-provided sockets may only implement the message trait
+            for from_addr, msg in self._socket.receive_all_messages():
+                ep = remotes.get(from_addr)
+                if ep is not None:
+                    ep.handle_message(msg)
+                ep = spectators.get(from_addr)
+                if ep is not None:
+                    ep.handle_message(msg)
 
-        for endpoint in self._player_reg.remotes.values():
+        current_frame = self._sync_layer.current_frame
+        for endpoint in self._remote_endpoints:
             if endpoint.is_running():
-                endpoint.update_local_frame_advantage(self._sync_layer.current_frame)
+                endpoint.update_local_frame_advantage(current_frame)
 
         events: List = []
-        for endpoint in list(self._player_reg.remotes.values()) + list(
-            self._player_reg.spectators.values()
-        ):
-            handles = list(endpoint.handles)
-            addr = endpoint.peer_addr
+        for endpoint in self._all_endpoints:
             for event in endpoint.poll(self.local_connect_status):
-                events.append((event, handles, addr))
+                events.append((event, endpoint.handles, endpoint.peer_addr))
 
         for event, handles, addr in events:
             self._handle_event(event, handles, addr)
 
-        for endpoint in list(self._player_reg.remotes.values()) + list(
-            self._player_reg.spectators.values()
-        ):
+        for endpoint in self._all_endpoints:
             endpoint.send_all_messages(self._socket)
 
     def disconnect_player(self, player_handle: PlayerHandle) -> None:
@@ -460,7 +487,7 @@ class P2PSession(Generic[I, S, A]):
     def _send_confirmed_inputs_to_spectators(self, confirmed_frame: Frame) -> None:
         """Forward every newly-confirmed frame's inputs (for all players) to
         each spectator endpoint (reference: p2p_session.rs:717-744)."""
-        if self._player_reg.num_spectators() == 0:
+        if not self._player_reg.spectators:
             return
 
         while self._next_spectator_frame <= confirmed_frame:
@@ -485,26 +512,32 @@ class P2PSession(Generic[I, S, A]):
     def _update_player_disconnects(self) -> None:
         """Cross-peer disconnect consensus: adopt any peer's knowledge of an
         earlier disconnect (reference: p2p_session.rs:748-783)."""
-        for handle in range(self._num_players):
-            queue_connected = True
-            queue_min_confirmed = 2**31 - 1
+        n = self._num_players
+        queue_connected = [True] * n
+        queue_min_confirmed = [2**31 - 1] * n
+        # endpoint-outer loop: one is_running() probe per endpoint, not per
+        # (player, endpoint) pair — same consensus as the reference
+        for endpoint in self._remote_endpoints:
+            if not endpoint.is_running():
+                continue
+            for handle, status in enumerate(endpoint.peer_connect_status):
+                if status.disconnected:
+                    queue_connected[handle] = False
+                if status.last_frame < queue_min_confirmed[handle]:
+                    queue_min_confirmed[handle] = status.last_frame
 
-            for endpoint in self._player_reg.remotes.values():
-                if not endpoint.is_running():
-                    continue
-                status = endpoint.peer_connect_status[handle]
-                queue_connected = queue_connected and not status.disconnected
-                queue_min_confirmed = min(queue_min_confirmed, status.last_frame)
-
-            local_connected = not self.local_connect_status[handle].disconnected
-            local_min_confirmed = self.local_connect_status[handle].last_frame
+        for handle in range(n):
+            local_status = self.local_connect_status[handle]
+            local_connected = not local_status.disconnected
+            local_min_confirmed = local_status.last_frame
+            min_confirmed = queue_min_confirmed[handle]
             if local_connected:
-                queue_min_confirmed = min(queue_min_confirmed, local_min_confirmed)
+                min_confirmed = min(min_confirmed, local_min_confirmed)
 
-            if not queue_connected:
+            if not queue_connected[handle]:
                 # A peer saw the disconnect earlier than we did: re-adjust.
-                if local_connected or local_min_confirmed > queue_min_confirmed:
-                    self._disconnect_player_at_frame(handle, queue_min_confirmed)
+                if local_connected or local_min_confirmed > min_confirmed:
+                    self._disconnect_player_at_frame(handle, min_confirmed)
 
     def _max_frame_advantage(self) -> int:
         interval = None
@@ -550,7 +583,20 @@ class P2PSession(Generic[I, S, A]):
     ) -> None:
         """Translate protocol events into user events / session actions
         (reference: p2p_session.rs:846-902)."""
-        if isinstance(event, EvNetworkInterrupted):
+        if isinstance(event, EvInput):
+            # first: inputs outnumber every other event by orders of magnitude
+            player = event.player
+            assert player < self._num_players
+            status = self.local_connect_status[player]
+            if not status.disconnected:
+                current_remote_frame = status.last_frame
+                assert (
+                    current_remote_frame == NULL_FRAME
+                    or current_remote_frame + 1 == event.input.frame
+                )
+                status.last_frame = event.input.frame
+                self._sync_layer.add_remote_input(player, event.input)
+        elif isinstance(event, EvNetworkInterrupted):
             self._push_event(
                 NetworkInterrupted(addr=addr, disconnect_timeout=event.disconnect_timeout)
             )
@@ -571,17 +617,6 @@ class P2PSession(Generic[I, S, A]):
                 )
                 self._disconnect_player_at_frame(handle, last_frame)
             self._push_event(Disconnected(addr=addr))
-        elif isinstance(event, EvInput):
-            player = event.player
-            assert player < self._num_players
-            if not self.local_connect_status[player].disconnected:
-                current_remote_frame = self.local_connect_status[player].last_frame
-                assert (
-                    current_remote_frame == NULL_FRAME
-                    or current_remote_frame + 1 == event.input.frame
-                )
-                self.local_connect_status[player].last_frame = event.input.frame
-                self._sync_layer.add_remote_input(player, event.input)
 
     def _push_event(self, event: GgrsEvent) -> None:
         self._event_queue.append(event)

@@ -126,9 +126,15 @@ class SpectatorSession(Generic[I, A]):
         return requests
 
     def poll_remote_clients(self) -> None:
-        for from_addr, msg in self._socket.receive_all_messages():
-            if self._host.is_handling_message(from_addr):
-                self._host.handle_message(msg)
+        recv_raw = getattr(self._socket, "receive_all_datagrams", None)
+        if recv_raw is not None:
+            for from_addr, data in recv_raw():
+                if self._host.is_handling_message(from_addr):
+                    self._host.handle_datagram(data)
+        else:
+            for from_addr, msg in self._socket.receive_all_messages():
+                if self._host.is_handling_message(from_addr):
+                    self._host.handle_message(msg)
 
         addr = self._host.peer_addr
         for event in self._host.poll(self.host_connect_status):

@@ -1,0 +1,211 @@
+"""Parity pins for the endpoint datapath cores (net/endpoint.py).
+
+The C++ ``NativeEndpointCore`` and pure-Python ``PyEndpointCore`` must be
+indistinguishable above the ``make_endpoint_core`` seam: identical wire
+bytes, identical events, identical session outcomes — including under
+loss/duplication/reordering and under malformed or oversized input.  These
+tests run full two-peer protocol pumps twice, once per core, and compare
+the complete observable record.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from ggrs_tpu.core.config import Config
+from ggrs_tpu.core.frame_info import PlayerInput
+from ggrs_tpu.core.types import DesyncDetection, NULL_FRAME
+from ggrs_tpu.net import _native
+from ggrs_tpu.net import protocol as protocol_mod
+from ggrs_tpu.net.endpoint import NativeEndpointCore, PyEndpointCore
+from ggrs_tpu.net.messages import ConnectionStatus
+from ggrs_tpu.net.protocol import EvInput, PeerProtocol
+from ggrs_tpu.net.sockets import InMemoryNetwork
+
+pytestmark = pytest.mark.skipif(
+    not _native.available(), reason="native library unavailable"
+)
+
+
+def u8_config() -> Config:
+    return Config.for_uint(bits=8)
+
+
+def make_pair(core: str, seed: int, net: InMemoryNetwork):
+    """Two connected PeerProtocols using the requested core, plus their
+    sockets."""
+    protos = {}
+    socks = {}
+    orig = protocol_mod.make_endpoint_core
+
+    def py_core(send_base, recv_base, max_prediction):
+        return PyEndpointCore(send_base, recv_base, max_prediction)
+
+    factory = py_core if core == "py" else orig
+    protocol_mod.make_endpoint_core, saved = factory, orig
+    try:
+        for me, other, h in (("A", "B", 0), ("B", "A", 1)):
+            protos[me] = PeerProtocol(
+                config=u8_config(),
+                handles=[1 - h],
+                peer_addr=other,
+                num_players=2,
+                local_players=1,
+                max_prediction=8,
+                disconnect_timeout_ms=2000,
+                disconnect_notify_start_ms=500,
+                fps=60,
+                desync_detection=DesyncDetection.off(),
+                clock=lambda: 0,
+                rng=random.Random(seed + h),
+            )
+            socks[me] = net.socket(me)
+    finally:
+        protocol_mod.make_endpoint_core = saved
+    # sanity: the factory actually took effect
+    want = PyEndpointCore if core == "py" else NativeEndpointCore
+    assert isinstance(protos["A"]._core, want), type(protos["A"]._core)
+    return protos, socks
+
+
+def pump(core: str, seed: int, ticks: int, **faults):
+    """Drive two peers for ``ticks`` frames; record every delivered datagram
+    and every protocol event, in order."""
+    net = InMemoryNetwork(seed=seed, **faults)
+    protos, socks = make_pair(core, seed, net)
+    record = []
+    status = {
+        "A": [ConnectionStatus(), ConnectionStatus()],
+        "B": [ConnectionStatus(), ConnectionStatus()],
+    }
+    for i in range(ticks):
+        net.tick()
+        for me, other, h in (("A", "B", 0), ("B", "A", 1)):
+            p = protos[me]
+            for from_addr, data in socks[me].receive_all_datagrams():
+                record.append(("recv", me, bytes(data)))
+                p.handle_datagram(data)
+            for ev in p.poll(status[me]):
+                if isinstance(ev, EvInput):
+                    record.append(
+                        ("input", me, ev.player, ev.input.frame, ev.input.input)
+                    )
+                    status[me][ev.player].last_frame = ev.input.frame
+                else:
+                    record.append(("event", me, type(ev).__name__))
+            status[me][h].last_frame = i
+            p.send_input({h: PlayerInput(i, (i * 7 + h * 3) % 251)}, status[me])
+            p.send_all_messages(socks[me])
+    for me in ("A", "B"):
+        record.append(
+            ("final", me, protos[me].last_recv_frame(),
+             protos[me]._core.pending_len())
+        )
+    return record
+
+
+class TestCoreParity:
+    def test_clean_link_record_identical(self):
+        assert pump("native", seed=3, ticks=60) == pump("py", seed=3, ticks=60)
+
+    def test_lossy_link_record_identical(self):
+        for seed in (1, 7, 42):
+            a = pump("native", seed=seed, ticks=80, loss=0.2, duplicate=0.1,
+                     reorder=0.2)
+            b = pump("py", seed=seed, ticks=80, loss=0.2, duplicate=0.1,
+                     reorder=0.2)
+            assert a == b, f"seed {seed}: native and python cores diverge"
+
+    def test_latency_link_record_identical(self):
+        a = pump("native", seed=9, ticks=80, latency_ticks=3)
+        b = pump("py", seed=9, ticks=80, latency_ticks=3)
+        assert a == b
+
+
+class TestMalformedDatagrams:
+    """handle_datagram must drop garbage silently with no state change,
+    whichever core is active (the socket layer used to own this drop)."""
+
+    GARBAGE = [
+        b"",
+        b"\x00",
+        b"\xff\xff",
+        b"\xaa\xbb\x00",  # input tag, truncated body
+        b"\xaa\xbb\x00\x01\x02",  # bad bool in status
+        b"\xaa\xbb\x00\x00\x00\x00\x00\x05abc",  # payload len > data
+        b"\xaa\xbb\x63",  # unknown tag
+        bytes(range(256)),
+    ]
+
+    @pytest.mark.parametrize("core", ["native", "py"])
+    def test_garbage_dropped_silently(self, core):
+        net = InMemoryNetwork()
+        protos, socks = make_pair(core, seed=5, net=net)
+        p = protos["A"]
+        before = (p.last_recv_frame(), p._core.pending_len())
+        for g in self.GARBAGE:
+            p.handle_datagram(g)
+        assert p.poll([ConnectionStatus(), ConnectionStatus()]) == []
+        assert (p.last_recv_frame(), p._core.pending_len()) == before
+        p.send_all_messages(socks["A"])
+        # no acks or other responses were queued for garbage
+        assert socks["B"].receive_all_datagrams() == []
+
+
+class TestFrameSanityBound:
+    @pytest.mark.parametrize("core", ["native", "py"])
+    @pytest.mark.parametrize(
+        "start", [2**62 + 5, 2**63 - 1, -(2**62) - 7, -(2**63)]
+    )
+    def test_beyond_i64_contract_start_frames_dropped_on_every_path(
+        self, core, start
+    ):
+        """Frames beyond the i64 wire contract are malformed; the fused
+        native path, the object path, and the Python core must all drop
+        them with no state change (regression: the fused path once
+        committed them, diverging the cores and risking signed-overflow UB
+        in C++)."""
+        from ggrs_tpu.net import compression
+        from ggrs_tpu.net.messages import InputMessage, Message
+
+        net = InMemoryNetwork()
+        protos, _ = make_pair(core, seed=17, net=net)
+        p = protos["A"]
+        comp = compression.encode(b"", [b"\x01\x07"])
+        evil = Message(7, InputMessage(
+            peer_connect_status=[ConnectionStatus(), ConnectionStatus()],
+            disconnect_requested=False, start_frame=start, ack_frame=-1,
+            bytes=comp,
+        )).encode()
+        p.handle_datagram(evil)
+        assert p.last_recv_frame() == NULL_FRAME
+        assert not [
+            e for e in p.poll([ConnectionStatus(), ConnectionStatus()])
+            if isinstance(e, EvInput)
+        ]
+
+
+class TestOversizedFallback:
+    def test_huge_window_takes_python_codec_path_and_stays_consistent(self):
+        """More staged frames than the native caps (512) must fall back to
+        the Python codec via fetch_base/store_one and still deliver every
+        input in order."""
+        net = InMemoryNetwork()
+        protos, socks = make_pair("native", seed=11, net=net)
+        a, b = protos["A"], protos["B"]
+        status = [ConnectionStatus(), ConnectionStatus()]
+        # A sends 600 frames without ever hearing an ack
+        for i in range(600):
+            status[0].last_frame = i
+            a.send_input({0: PlayerInput(i, i % 251)}, status)
+        a.send_all_messages(socks["A"])
+        delivered = socks["B"].receive_all_datagrams()
+        assert delivered  # one giant datagram per send; take the last
+        b.handle_datagram(delivered[-1][1])
+        events = [e for e in b.poll(status) if isinstance(e, EvInput)]
+        assert len(events) == 600
+        assert [e.input.frame for e in events] == list(range(600))
+        assert [e.input.input for e in events] == [i % 251 for i in range(600)]
+        assert b.last_recv_frame() == 599
