@@ -71,3 +71,21 @@ class StatsUnavailable(NetworkStatsError):
 class BadPlayerHandle(NetworkStatsError):
     def __init__(self) -> None:
         super().__init__("Network statistics were requested for an invalid player handle.")
+
+
+class CrossThreadAccess(GgrsError):
+    """A session was driven from a thread other than its owner.
+
+    Sessions mirror the reference's concurrency contract (``Send`` but not
+    ``Sync``, /root/reference/src/lib.rs:204-240): a session may be handed
+    off between threads, but never driven from two threads concurrently.
+    The first driving call pins the owning thread; call
+    ``transfer_ownership()`` from the new thread to hand a session off.
+    """
+
+    def __init__(self) -> None:
+        super().__init__(
+            "Session driven from a thread other than its owner. Sessions "
+            "are single-threaded (the reference's Send-not-Sync contract); "
+            "call transfer_ownership() from the new thread to hand off."
+        )

@@ -18,7 +18,14 @@ design, not ports:
 
 from .speculation import SpeculativeBranches, build_speculation_programs
 from .spec_rollback import SpeculativeRollback
-from .batch import BatchedSessions, HOST_AXIS, SESSION_AXIS, make_mesh, make_mesh2d
+from .batch import (
+    BatchedSessions,
+    HOST_AXIS,
+    SESSION_AXIS,
+    make_distributed_mesh,
+    make_mesh,
+    make_mesh2d,
+)
 from .session_pool import BatchedRequestExecutor
 
 __all__ = [
@@ -29,6 +36,7 @@ __all__ = [
     "SpeculativeBranches",
     "SpeculativeRollback",
     "build_speculation_programs",
+    "make_distributed_mesh",
     "make_mesh",
     "make_mesh2d",
 ]

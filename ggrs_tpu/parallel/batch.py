@@ -71,6 +71,77 @@ def make_mesh2d(
     return Mesh(grid, axes)
 
 
+def make_distributed_mesh(
+    axes: Tuple[str, str] = (HOST_AXIS, SESSION_AXIS),
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> Mesh:
+    """The multi-host ``(hosts, chips)`` mesh for a real ``jax.distributed``
+    job — ``make_mesh2d``'s launchable form (VERDICT r3 item 9).
+
+    Call once per host process.  If the process is not yet part of a
+    distributed job and a coordinator is known (arguments or the standard
+    ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID``
+    environment), ``jax.distributed.initialize`` is called first; after
+    that ``jax.devices()`` spans every host and the mesh is built host-major
+    (outer axis = DCN between hosts, inner = ICI within a host), so
+    ``BatchedSessions``' health ``psum`` reduces over ICI first and crosses
+    DCN only for the per-host scalar combine.
+
+    Two-host launch recipe (same binary on both, e.g. examples or a
+    hosting server)::
+
+        # host 0 (also the coordinator)
+        JAX_COORDINATOR_ADDRESS=host0:8476 JAX_NUM_PROCESSES=2 \\
+            JAX_PROCESS_ID=0 python my_server.py
+        # host 1
+        JAX_COORDINATOR_ADDRESS=host0:8476 JAX_NUM_PROCESSES=2 \\
+            JAX_PROCESS_ID=1 python my_server.py
+
+    where ``my_server.py`` does ``mesh = make_distributed_mesh()`` and
+    passes it to ``BatchedSessions(..., mesh=mesh)`` — no other program
+    change versus single-host.  On a single process (including the virtual
+    CPU mesh) this degenerates to a ``(1, n_devices)`` mesh running the
+    identical program, which is how tests and the driver's multi-chip
+    dry-run keep it validated without multi-host hardware.
+    """
+    import os
+
+    if jax.process_count() == 1:
+        addr = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+        nproc = num_processes or int(
+            os.environ.get("JAX_NUM_PROCESSES", "0") or 0
+        )
+        if addr and nproc > 1:
+            jax.distributed.initialize(
+                coordinator_address=addr,
+                num_processes=nproc,
+                process_id=(
+                    process_id
+                    if process_id is not None
+                    else int(os.environ.get("JAX_PROCESS_ID", "0"))
+                ),
+            )
+
+    devs = jax.devices()  # global list: spans every host once initialized
+    n_hosts = jax.process_count()
+    per_host = len(devs) // n_hosts
+    assert per_host * n_hosts == len(devs), (
+        f"{len(devs)} devices do not divide over {n_hosts} hosts"
+    )
+    grid = np.empty((n_hosts, per_host), dtype=object)
+    fill = [0] * n_hosts
+    for d in devs:
+        p = d.process_index
+        grid[p, fill[p]] = d
+        fill[p] += 1
+    assert fill == [per_host] * n_hosts, (
+        f"devices are not evenly attached per host: {fill}"
+    )
+    return Mesh(grid, axes)
+
+
 class BatchedSessions:
     """B independent device-synctest sessions as one sharded program.
 

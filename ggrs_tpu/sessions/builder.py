@@ -100,6 +100,15 @@ class SessionBuilder(Generic[I, S, A]):
     # ------------------------------------------------------------------
 
     def with_num_players(self, num_players: int) -> "SessionBuilder[I, S, A]":
+        # the wire carries one connect status per player in every input
+        # message, capped at 64 on decode (messages._MAX_PLAYERS_ON_WIRE) —
+        # a bigger session could build, but its packets would be dropped by
+        # every receiver, so refuse loudly here
+        if not 1 <= num_players <= 64:
+            raise InvalidRequest(
+                f"num_players must be between 1 and 64 (the wire carries a "
+                f"connect status per player; got {num_players})"
+            )
         self._num_players = num_players
         return self
 

@@ -60,6 +60,7 @@ from ..net.protocol import (
 )
 from ..net.sockets import NonBlockingSocket
 from ..net.stats import NetworkStats
+from ..utils.ownership import ThreadOwned
 
 logger = logging.getLogger(__name__)
 
@@ -105,7 +106,7 @@ class PlayerRegistry(Generic[I, A]):
         )
 
 
-class P2PSession(Generic[I, S, A]):
+class P2PSession(ThreadOwned, Generic[I, S, A]):
     def __init__(
         self,
         config: Config,
@@ -169,6 +170,7 @@ class P2PSession(Generic[I, S, A]):
     def add_local_input(self, player_handle: PlayerHandle, input: I) -> None:
         """Register local input for the current frame; must be called for
         every local player before advance_frame()."""
+        self._check_owner()
         if player_handle not in self._local_handle_set:
             raise InvalidRequest(
                 "The player handle you provided is not referring to a local player."
@@ -189,6 +191,7 @@ class P2PSession(Generic[I, S, A]):
     def advance_frame(self) -> List[GgrsRequest]:
         """The main entry point; see the reference call stack
         (p2p_session.rs:265-426).  Returns the ordered request list."""
+        self._check_owner()
         self.poll_remote_clients()
 
         if self.current_state() is SessionState.SYNCHRONIZING:
@@ -309,6 +312,7 @@ class P2PSession(Generic[I, S, A]):
     def poll_remote_clients(self) -> None:
         """Drain the socket, route messages to endpoints, run timers, handle
         events, and flush outgoing packets (reference: p2p_session.rs:430-478)."""
+        self._check_owner()
         remotes = self._player_reg.remotes
         spectators = self._player_reg.spectators
         recv_raw = getattr(self._socket, "receive_all_datagrams", None)
@@ -395,6 +399,7 @@ class P2PSession(Generic[I, S, A]):
         return self._max_prediction == 0
 
     def events(self) -> List[GgrsEvent]:
+        self._check_owner()  # drains the queue: a driving call
         out = list(self._event_queue)
         self._event_queue.clear()
         return out

@@ -46,6 +46,7 @@ from ..net.protocol import (
 )
 from ..net.sockets import NonBlockingSocket
 from ..net.stats import NetworkStats
+from ..utils.ownership import ThreadOwned
 
 I = TypeVar("I")
 A = TypeVar("A", bound=Hashable)
@@ -57,7 +58,7 @@ SPECTATOR_BUFFER_SIZE = 60
 MAX_EVENT_QUEUE_SIZE = 100
 
 
-class SpectatorSession(Generic[I, A]):
+class SpectatorSession(ThreadOwned, Generic[I, A]):
     def __init__(
         self,
         config: Config,
@@ -96,6 +97,7 @@ class SpectatorSession(Generic[I, A]):
         return self._host.network_stats()
 
     def events(self) -> List[GgrsEvent]:
+        self._check_owner()  # drains the queue: a driving call
         out = list(self._event_queue)
         self._event_queue.clear()
         return out
@@ -105,6 +107,7 @@ class SpectatorSession(Generic[I, A]):
         PredictionThreshold while waiting for host input and
         SpectatorTooFarBehind when the ring has been lapped
         (reference: p2p_spectator_session.rs:103-129)."""
+        self._check_owner()
         self.poll_remote_clients()
 
         if self.current_state() is SessionState.SYNCHRONIZING:
@@ -126,6 +129,7 @@ class SpectatorSession(Generic[I, A]):
         return requests
 
     def poll_remote_clients(self) -> None:
+        self._check_owner()
         recv_raw = getattr(self._socket, "receive_all_datagrams", None)
         if recv_raw is not None:
             for from_addr, data in recv_raw():

@@ -21,12 +21,13 @@ from ..core.frame_info import PlayerInput
 from ..core.sync_layer import SyncLayer
 from ..core.types import AdvanceFrame, Frame, GgrsRequest, PlayerHandle
 from ..net.messages import ConnectionStatus
+from ..utils.ownership import ThreadOwned
 
 I = TypeVar("I")
 S = TypeVar("S")
 
 
-class SyncTestSession(Generic[I, S]):
+class SyncTestSession(ThreadOwned, Generic[I, S]):
     def __init__(
         self,
         config: Config,
@@ -52,6 +53,7 @@ class SyncTestSession(Generic[I, S]):
 
     def add_local_input(self, player_handle: PlayerHandle, input: I) -> None:
         """In a sync test all players are local; call once per player per frame."""
+        self._check_owner()
         if player_handle >= self._num_players:
             raise InvalidRequest("The player handle you provided is not valid.")
         self._local_inputs[player_handle] = PlayerInput(
@@ -61,6 +63,7 @@ class SyncTestSession(Generic[I, S]):
     def advance_frame(self) -> List[GgrsRequest]:
         """Advance one frame; every frame past the warm-up also rolls back
         ``check_distance`` frames and resimulates, verifying checksums."""
+        self._check_owner()
         requests: List[GgrsRequest] = []
 
         current_frame = self._sync_layer.current_frame

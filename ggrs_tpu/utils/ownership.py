@@ -1,0 +1,42 @@
+"""Thread-ownership guard for sessions.
+
+The reference makes its concurrency contract explicit through Rust's type
+system: sessions are ``Send`` but not ``Sync`` (an opt-in bound,
+/root/reference/src/lib.rs:204-240) — they may be handed off between
+threads but never driven from two threads at once.  Python can't encode
+that statically, so sessions mix this guard in: the first driving call pins
+the owning thread, later calls from any other thread raise
+``CrossThreadAccess``, and ``transfer_ownership()`` is the explicit analog
+of moving a ``Send`` value to a new thread.
+
+The check is one integer compare per driving call (~100 ns); reading
+already-returned values (request lists, events, stats objects) needs no
+guard — they are plain data owned by the caller.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from ..core.errors import CrossThreadAccess
+
+
+class ThreadOwned:
+    """Mixin: pin driving calls to one thread at a time."""
+
+    _owner_ident: Optional[int] = None
+
+    def _check_owner(self) -> None:
+        ident = threading.get_ident()
+        owner = self._owner_ident
+        if owner is None:
+            self._owner_ident = ident
+        elif owner != ident:
+            raise CrossThreadAccess()
+
+    def transfer_ownership(self) -> None:
+        """Re-pin this session to the calling thread (the analog of moving
+        a ``Send`` value across threads).  Call from the NEW thread, after
+        the previous thread has stopped driving the session."""
+        self._owner_ident = threading.get_ident()

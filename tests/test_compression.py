@@ -2,7 +2,7 @@
 (parity with /root/reference/src/network/compression.rs:188-231)."""
 
 import pytest
-from hypothesis import given, settings
+from hypothesis import example, given, settings
 from hypothesis import strategies as st
 
 from ggrs_tpu.net.compression import CodecError, decode, encode
@@ -27,11 +27,18 @@ def test_highly_redundant_inputs_compress_well():
     assert len(encoded) < 32  # 1600 raw bytes collapse under XOR+RLE
 
 
+# Committed regression seeds (the analog of the reference's
+# proptest-regressions/network/compression.txt): @example cases replay on
+# every checkout before hypothesis generates novel ones.
 @settings(max_examples=200)
 @given(
     reference=st.binary(max_size=32),
     inputs=st.lists(st.binary(max_size=32), max_size=32),
 )
+@example(reference=b"", inputs=[b"", b""])  # the reference's own shrunk case
+@example(reference=b"", inputs=[])
+@example(reference=b"\x00", inputs=[b"", b"\x00", b"\x00\x00"])
+@example(reference=b"\x07" * 32, inputs=[b"\x07" * 32] * 32)  # max redundancy
 def test_encode_decode_round_trip(reference, inputs):
     encoded = encode(reference, inputs)
     # empty reference with no explicit sizes cannot be decoded; the encoder
@@ -41,6 +48,16 @@ def test_encode_decode_round_trip(reference, inputs):
 
 @settings(max_examples=300)
 @given(reference=st.binary(max_size=2048), data=st.binary(max_size=2048))
+@example(reference=b"", data=b"\x01")  # size mode, then truncated
+@example(reference=b"", data=b"\x02")  # invalid size-mode byte
+@example(reference=b"", data=b"\x00\x01" + b"\xff" * 8 + b"\x01")
+@example(  # huge claimed zero run inside the RLE stream
+    reference=b"\x00",
+    data=b"\x00\x0a" + b"\xff\xff\xff\xff\xff\xff\xff\xff\xff\x01",
+)
+@example(  # negative input size via zigzag delta
+    reference=b"", data=b"\x01\x01\x03\x00"
+)
 def test_decode_arbitrary_input_never_crashes(reference, data):
     # bytes come from potentially malicious peers: CodecError is the only
     # acceptable failure mode

@@ -1,7 +1,7 @@
 """Wire message round-trip and hardening tests."""
 
 import pytest
-from hypothesis import given, settings
+from hypothesis import example, given, settings
 from hypothesis import strategies as st
 
 from ggrs_tpu.net.messages import (
@@ -69,8 +69,17 @@ def test_checksum_report_roundtrip_u128():
     assert m.body == ChecksumReport(checksum=checksum, frame=200)
 
 
+# Committed regression seeds (analog of proptest-regressions/): replay on
+# every checkout before hypothesis generates novel cases.
 @settings(max_examples=300)
 @given(data=st.binary(max_size=256))
+@example(data=b"")
+@example(data=b"\xaa\xbb\x63")  # unknown tag
+@example(data=b"\xaa\xbb\x00\x41")  # input msg claiming 65 statuses
+@example(data=b"\xaa\xbb\x00\x01\x02")  # invalid bool byte in status
+@example(data=b"\xaa\xbb\x01" + b"\xff" * 9 + b"\x01")  # 10-byte varint ack
+@example(data=b"\xaa\xbb\x05\x00")  # keepalive with trailing byte
+@example(data=b"\xaa\xbb\x00\x00\x00\x00\x00\x05abc")  # payload len > data
 def test_decode_arbitrary_bytes_never_crashes(data):
     try:
         Message.decode(data)

@@ -142,6 +142,34 @@ class TestBatchedSessions:
                 np.asarray(flat[k]), np.asarray(two_d[k]), err_msg=k
             )
 
+    def test_distributed_mesh_single_process_degenerate_form(self):
+        """make_distributed_mesh on one process: a (1, n_devices) mesh
+        running the identical program — the virtual-mesh gate for the
+        multi-host launch recipe (its two-host form differs only in
+        jax.distributed initialization, documented in its docstring)."""
+        from ggrs_tpu.parallel import make_distributed_mesh
+
+        mesh = make_distributed_mesh()
+        assert mesh.devices.shape == (1, len(jax.devices()))
+        assert mesh.axis_names == ("hosts", "sessions")
+
+        game = BoxGame(2)
+        B, n = 16, 12
+        inputs = _random_inputs((B, n, 2), seed=5)
+        results = []
+        for m in (make_mesh(8), mesh):
+            batch = BatchedSessions(
+                game.advance, game.init_state(), jnp.zeros((2,), jnp.uint8),
+                batch_size=B, mesh=m, check_distance=2,
+            )
+            stats = batch.run_ticks(inputs)
+            assert stats["mismatches"] == 0
+            results.append(batch.live_states())
+        for k in ("pos", "vel", "rot"):
+            np.testing.assert_array_equal(
+                np.asarray(results[0][k]), np.asarray(results[1][k])
+            )
+
     def test_2d_mesh_detects_corruption_across_hosts(self):
         """The psum/pmin health reduction must cross BOTH mesh axes: corrupt
         a session owned by the second host row and read the global stats."""

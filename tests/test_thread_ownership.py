@@ -1,0 +1,98 @@
+"""The sessions' thread-ownership contract (README "Threading"): the
+runtime analog of the reference's Send-but-not-Sync bounds
+(/root/reference/src/lib.rs:204-240)."""
+
+from __future__ import annotations
+
+import random
+import threading
+
+import pytest
+
+from ggrs_tpu.core.errors import CrossThreadAccess
+from ggrs_tpu.core.types import Local, Remote
+from ggrs_tpu.games.boxgame import boxgame_config
+from ggrs_tpu.net.sockets import InMemoryNetwork
+from ggrs_tpu.sessions.builder import SessionBuilder
+
+
+def make_pair():
+    net = InMemoryNetwork()
+    sessions = []
+    for me, other, h in (("A", "B", 0), ("B", "A", 1)):
+        sessions.append(
+            SessionBuilder(boxgame_config())
+            .with_clock(lambda: 0)
+            .with_rng(random.Random(61 + h))
+            .add_player(Local(), h)
+            .add_player(Remote(other), 1 - h)
+            .start_p2p_session(net.socket(me))
+        )
+    return sessions
+
+
+def drive_tick(sessions, i, state):
+    for s in sessions:
+        s.poll_remote_clients()
+    for h, s in enumerate(sessions):
+        s.add_local_input(h, i % 16)
+        for r in s.advance_frame():
+            k = type(r).__name__
+            if k == "SaveGameState":
+                r.cell.save(r.frame, state[h], None)
+            elif k == "LoadGameState":
+                state[h] = r.cell.data()
+
+
+def run_in_thread(fn):
+    box = {}
+
+    def wrapper():
+        try:
+            box["result"] = fn()
+        except BaseException as e:  # pragma: no cover - assertion transport
+            box["error"] = e
+
+    t = threading.Thread(target=wrapper)
+    t.start()
+    t.join()
+    return box
+
+
+class TestThreadOwnership:
+    def test_second_thread_driving_raises(self):
+        sessions = make_pair()
+        state = [0, 0]
+        drive_tick(sessions, 0, state)  # pins the owner (this thread)
+
+        box = run_in_thread(lambda: sessions[0].advance_frame())
+        assert isinstance(box.get("error"), CrossThreadAccess)
+        # ... and the owning thread may keep driving
+        drive_tick(sessions, 1, state)
+        assert all(s.current_frame == 2 for s in sessions)
+
+    def test_transfer_ownership_is_the_send_analog(self):
+        sessions = make_pair()
+        state = [0, 0]
+        drive_tick(sessions, 0, state)
+
+        def handed_off():
+            for s in sessions:
+                s.transfer_ownership()
+            for i in range(1, 4):
+                drive_tick(sessions, i, state)
+            return [s.current_frame for s in sessions]
+
+        box = run_in_thread(handed_off)
+        assert box.get("result") == [4, 4], box
+        # after the hand-off the ORIGINAL thread is now the foreign one
+        with pytest.raises(CrossThreadAccess):
+            sessions[0].advance_frame()
+
+    def test_reading_returned_data_needs_no_ownership(self):
+        sessions = make_pair()
+        state = [0, 0]
+        drive_tick(sessions, 0, state)
+        events = sessions[0].events()  # plain data once returned
+        box = run_in_thread(lambda: len(events))
+        assert box.get("result") == len(events)
