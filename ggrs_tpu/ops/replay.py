@@ -108,7 +108,7 @@ def build_replay_programs(
     check_distance: int,
     checksum: ChecksumFn = checksum_device,
     donate: Optional[bool] = None,
-    unroll_resim: bool = True,
+    unroll_resim: bool = False,
     unroll_ticks: int = 4,
 ) -> ReplayPrograms:
     """Compile the warmup/steady tick programs.
@@ -122,9 +122,12 @@ def build_replay_programs(
     defaults to on for TPU, off elsewhere (CPU/interpret donation is a no-op
     that only produces warnings).
     ``unroll_resim``/``unroll_ticks``: loop unrolling for the inner (resim)
-    and outer (tick) scans — scan iterations carry fixed launch overhead on
-    TPU that dwarfs this workload's tiny per-step compute, so the inner
-    d-step loop is fully unrolled by default and ticks unroll moderately.
+    and outer (tick) scans.  Defaults were retuned in round 4 under
+    completion-fenced timing: the ROLLED inner resim loop measures ~1.3x
+    faster than fully unrolled on the flagship config (the earlier
+    unroll-everything choice was tuned against enqueue-rate fiction —
+    smaller programs schedule better here), while moderate tick unroll (4)
+    stays best.  See docs/DESIGN.md §10.
     """
     assert check_distance >= 1, "device replay needs check_distance >= 1"
     assert ring_length > check_distance, "ring must cover the rollback window"

@@ -108,21 +108,33 @@ def make_distributed_mesh(
     """
     import os
 
-    if jax.process_count() == 1:
-        addr = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
-        nproc = num_processes or int(
-            os.environ.get("JAX_NUM_PROCESSES", "0") or 0
+    # jax.distributed.initialize must run before ANY jax call that could
+    # initialize the XLA backend (even jax.process_count() does) — so decide
+    # from args/env alone, touching no jax state first.  If the caller
+    # already ran jax.distributed.initialize themselves, they must NOT also
+    # provide coordinator args here (a second initialize raises).
+    addr = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    nproc = num_processes or int(
+        os.environ.get("JAX_NUM_PROCESSES", "0") or 0
+    )
+    if addr and nproc > 1:
+        pid = (
+            process_id
+            if process_id is not None
+            else os.environ.get("JAX_PROCESS_ID")
         )
-        if addr and nproc > 1:
-            jax.distributed.initialize(
-                coordinator_address=addr,
-                num_processes=nproc,
-                process_id=(
-                    process_id
-                    if process_id is not None
-                    else int(os.environ.get("JAX_PROCESS_ID", "0"))
-                ),
+        if pid is None:
+            raise ValueError(
+                "make_distributed_mesh: a coordinator and num_processes are "
+                "set but no process id — pass process_id= or export "
+                "JAX_PROCESS_ID (defaulting to 0 would register every host "
+                "as process 0 and deadlock the coordinator barrier)"
             )
+        jax.distributed.initialize(
+            coordinator_address=addr,
+            num_processes=nproc,
+            process_id=int(pid),
+        )
 
     devs = jax.devices()  # global list: spans every host once initialized
     n_hosts = jax.process_count()

@@ -100,17 +100,25 @@ class SessionBuilder(Generic[I, S, A]):
     # ------------------------------------------------------------------
 
     def with_num_players(self, num_players: int) -> "SessionBuilder[I, S, A]":
-        # the wire carries one connect status per player in every input
-        # message, capped at 64 on decode (messages._MAX_PLAYERS_ON_WIRE) —
-        # a bigger session could build, but its packets would be dropped by
-        # every receiver, so refuse loudly here
-        if not 1 <= num_players <= 64:
+        if num_players < 1:
             raise InvalidRequest(
-                f"num_players must be between 1 and 64 (the wire carries a "
-                f"connect status per player; got {num_players})"
+                f"num_players must be at least 1 (got {num_players})"
             )
         self._num_players = num_players
         return self
+
+    def _check_wire_player_cap(self) -> None:
+        # the wire carries one connect status per player in every input
+        # message, capped at 64 on decode (messages._MAX_PLAYERS_ON_WIRE) —
+        # a bigger NETWORKED session could build, but every receiver would
+        # drop its packets, so the wire-facing constructors refuse loudly.
+        # (SyncTest sessions are all-local and unconstrained.)
+        if self._num_players > 64:
+            raise InvalidRequest(
+                f"networked sessions support at most 64 players (the wire "
+                f"carries a connect status per player; got "
+                f"{self._num_players})"
+            )
 
     def with_max_prediction_window(self, window: int) -> "SessionBuilder[I, S, A]":
         """0 enables lockstep mode: only advance on fully-confirmed frames,
@@ -212,6 +220,7 @@ class SessionBuilder(Generic[I, S, A]):
     def start_p2p_session(self, socket: NonBlockingSocket) -> P2PSession[I, S, A]:
         """Group remote/spectator players by address into shared endpoints and
         start the session (reference: builder.rs:255-308)."""
+        self._check_wire_player_cap()
         for player_handle in range(self._num_players):
             if player_handle not in self._player_reg.handles:
                 raise InvalidRequest(
@@ -253,6 +262,7 @@ class SessionBuilder(Generic[I, S, A]):
     ) -> SpectatorSession[I, A]:
         """Connect to a host that broadcasts all confirmed inputs
         (reference: builder.rs:314-338)."""
+        self._check_wire_player_cap()
         host = PeerProtocol(
             config=self._config,
             handles=list(range(self._num_players)),

@@ -22,17 +22,26 @@ from typing import Optional
 from ..core.errors import CrossThreadAccess
 
 
+# guards only the one-time None→owner transition, so two threads racing
+# their FIRST driving call cannot both claim the session (shared across
+# sessions: contention exists only at pin time, never on the hot path)
+_pin_lock = threading.Lock()
+
+
 class ThreadOwned:
     """Mixin: pin driving calls to one thread at a time."""
 
     _owner_ident: Optional[int] = None
 
     def _check_owner(self) -> None:
-        ident = threading.get_ident()
         owner = self._owner_ident
         if owner is None:
-            self._owner_ident = ident
-        elif owner != ident:
+            with _pin_lock:
+                if self._owner_ident is None:
+                    self._owner_ident = threading.get_ident()
+                    return
+                owner = self._owner_ident
+        if owner != threading.get_ident():
             raise CrossThreadAccess()
 
     def transfer_ownership(self) -> None:

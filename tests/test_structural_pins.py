@@ -155,7 +155,10 @@ class TestBatchedSessionsPins:
             bs._carry, chunk, np.int32(9)
         ).as_text()
         lines = len(txt.splitlines())
-        assert txt.count("stablehlo.while") == 1, "tick scan must stay fused"
+        # exactly the two loops of the design: the outer ticks scan and the
+        # (rolled, round-4 retune) inner resim scan — anything more means
+        # the program split
+        assert 1 <= txt.count("stablehlo.while") <= 2, "tick scan must stay fused"
         assert lines < 2000, (
             f"sharded tick program grew to {lines} stablehlo lines "
             f"(was ~950); check for structure loss"
