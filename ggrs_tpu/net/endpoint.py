@@ -45,6 +45,9 @@ _FRAME_SANE_MAX = 1 << 62
 _I64_MIN = -(1 << 63)
 _I64_MAX = (1 << 63) - 1
 
+# per-player-count packers for the emit path's connect-status frames
+_FRAME_PACKERS: dict = {}
+
 
 class PyEndpointCore:
     """Pure-Python endpoint datapath (the semantic reference)."""
@@ -235,8 +238,12 @@ class NativeEndpointCore:
         n = len(statuses)
         disc = bytes(1 if s.disconnected else 0 for s in statuses)
         # status frames are session state and always i64 (the protocol drops
-        # packets carrying larger values before they can be merged in)
-        frames = struct.pack(f"<{n}q", *(s.last_frame for s in statuses))
+        # packets carrying larger values before they can be merged in);
+        # the Struct is cached per player count — this runs every send
+        packer = _FRAME_PACKERS.get(n)
+        if packer is None:
+            packer = _FRAME_PACKERS[n] = struct.Struct(f"<{n}q")
+        frames = packer.pack(*[s.last_frame for s in statuses])
         while True:
             rc = self._lib.ggrs_ep_emit_input(
                 self._ptr, magic, disc, frames, n,

@@ -13,9 +13,11 @@ The live path performs ZERO device→host reads: checksums ride in
 reports one over the wire (every DesyncDetection interval), and rollback
 bursts — a Load followed by a run of Save/Advance pairs — are one fused scan
 dispatch whose per-step states come back as jit outputs (no post-hoc device
-slicing).  On a tunneled TPU a single D2H read permanently degrades dispatch
-throughput (measured in ``bench.py``), so "no reads" is the difference
-between the device path beating and losing to the host loop.
+slicing).  A device→host read is a full round trip (~80 ms of sync RTT on a
+tunneled TPU — see bench.py "honest timing" for the round-4 measurement
+history) and a pipeline stall on any transport, so "no reads on the live
+path" is the difference between the device path beating and losing to the
+host loop.
 
 With a ``speculation`` strategy (``parallel.SpeculativeRollback``) attached,
 the executor keeps K branch trajectories alive between ticks and lets a

@@ -11,9 +11,9 @@ into a device-side select.  Misses fall back to the replay — correctness
 never depends on a hit.
 
 Zero device→host reads on the live path.  The round-1 design read the
-hit/miss flag back to the host per rollback; on a tunneled TPU a single D2H
-read permanently degrades dispatch throughput (measured in ``bench.py``), so
-the redesign moves the decision on-device:
+hit/miss flag back to the host per rollback; a D2H read is a full round
+trip (~80 ms of sync RTT on a tunneled TPU — bench.py "honest timing") and
+a pipeline stall anywhere, so the redesign moves the decision on-device:
 
 - branch states, trajectories, hypothesized inputs, and prefix-validity masks
   live in fixed-shape ``[W, K, ...]`` device ring buffers;
