@@ -115,9 +115,23 @@ def _source_mtime() -> float:
 
 
 def _build(lib_path: Path) -> bool:
+    """Compile the native library to ``lib_path``.
+
+    g++ writes to a pid-unique temp beside the target and the result is
+    moved in atomically: the module-level ``_lock`` is per-process, so two
+    concurrently-starting processes would otherwise race compiler output
+    into the same file and one would dlopen a torn .so (latching
+    ``_load_failed`` and disabling both fast paths for its lifetime).
+    """
     srcs = _sources()
     if not all(s.exists() for s in srcs):
         return False
+    # Sweep temps orphaned by hard-killed builds (different pid → never
+    # reused); safe under the module _lock plus pid-uniqueness.
+    for stale in lib_path.parent.glob(f"{lib_path.name}.build.*"):
+        if stale.name != f"{lib_path.name}.build.{os.getpid()}":
+            stale.unlink(missing_ok=True)
+    tmp = lib_path.with_name(f"{lib_path.name}.build.{os.getpid()}")
     cmd = [
         "g++",
         "-O2",
@@ -125,14 +139,16 @@ def _build(lib_path: Path) -> bool:
         "-fPIC",
         "-std=c++17",
         "-o",
-        str(lib_path),
+        str(tmp),
     ] + [str(s) for s in srcs]
     try:
         subprocess.run(
             cmd, check=True, capture_output=True, timeout=120
         )
+        tmp.replace(lib_path)
         return True
     except (subprocess.SubprocessError, OSError):
+        tmp.unlink(missing_ok=True)
         return False
 
 
@@ -156,18 +172,14 @@ def _load() -> Optional[ctypes.CDLL]:
                 return None
             lib = ctypes.CDLL(str(lib_path))
             if not hasattr(lib, "ggrs_ep_new"):
-                # library predates the endpoint datapath: try a rebuild to a
-                # TEMP path first so a prebuilt .so without sources/toolchain
-                # is never destroyed — if the rebuild fails we keep serving
-                # the codec symbols and simply leave the endpoint fast path
-                # disabled (endpoint_lib() returns None)
-                tmp = lib_path.with_name(_LIB_NAME + ".new")
-                if _build(tmp):
+                # library predates the endpoint datapath: try a rebuild —
+                # _build is atomic (temp + replace), so a prebuilt .so
+                # without sources/toolchain is never destroyed; on failure we
+                # keep serving the codec symbols and simply leave the
+                # endpoint fast path disabled (endpoint_lib() returns None)
+                if _build(lib_path):
                     del lib
-                    tmp.replace(lib_path)  # new inode: dlopen loads fresh
-                    lib = ctypes.CDLL(str(lib_path))
-                else:
-                    tmp.unlink(missing_ok=True)
+                    lib = ctypes.CDLL(str(lib_path))  # new inode: fresh load
         except OSError:
             _load_failed = True
             return None
@@ -284,6 +296,7 @@ EP_DROP = -30
 EP_FALLBACK = -31
 EP_BAD_PENDING_HEAD = -32
 EP_ERR_BUFFER_TOO_SMALL = -11
+EP_ERR_TOO_MANY_INPUTS = -12  # kErrTooManyInputs: > _MAX_PLAYERS_ON_WIRE
 
 
 def available() -> bool:

@@ -34,7 +34,12 @@ from typing import Deque, List, Optional, Sequence, Tuple
 
 from ..core.types import Frame, NULL_FRAME
 from . import _native, compression
-from .messages import ConnectionStatus, InputMessage, Message
+from .messages import (
+    ConnectionStatus,
+    InputMessage,
+    Message,
+    _MAX_PLAYERS_ON_WIRE,
+)
 
 # The wire contract for frames is i64 (the reference's Frame type).  Python's
 # unbounded varint reader can surface values beyond that; both cores treat
@@ -80,6 +85,15 @@ class PyEndpointCore:
     ) -> Optional[bytes]:
         if not self._pending:
             return None
+        # Wire cap shared with the native core (kErrTooManyInputs): the
+        # connect-status list is uvarint-counted on the wire but capped so
+        # the cores stay indistinguishable above the seam even for callers
+        # that bypass SessionBuilder's player-count validation.
+        if len(statuses) > _MAX_PLAYERS_ON_WIRE:
+            raise RuntimeError(
+                f"emit_input: {len(statuses)} connect statuses exceed the "
+                f"{_MAX_PLAYERS_ON_WIRE}-entry wire cap"
+            )
         first_frame = self._pending[0][0]
         if not (
             self._last_acked_frame == NULL_FRAME
@@ -259,6 +273,13 @@ class NativeEndpointCore:
         if rc == _native.EP_BAD_PENDING_HEAD:
             raise RuntimeError(
                 "pending output head does not follow last acked frame"
+            )
+        if rc == _native.EP_ERR_TOO_MANY_INPUTS:
+            # same message as PyEndpointCore: the cores must be
+            # indistinguishable above the seam
+            raise RuntimeError(
+                f"emit_input: {n} connect statuses exceed the "
+                f"{_MAX_PLAYERS_ON_WIRE}-entry wire cap"
             )
         if rc != 0:
             raise RuntimeError(f"ggrs_ep_emit_input failed: {rc}")

@@ -139,18 +139,22 @@ def make_distributed_mesh(
     devs = jax.devices()  # global list: spans every host once initialized
     n_hosts = jax.process_count()
     per_host = len(devs) // n_hosts
-    assert per_host * n_hosts == len(devs), (
-        f"{len(devs)} devices do not divide over {n_hosts} hosts"
-    )
+    if per_host * n_hosts != len(devs):
+        raise RuntimeError(
+            f"make_distributed_mesh: {len(devs)} devices do not divide over "
+            f"{n_hosts} hosts"
+        )
     grid = np.empty((n_hosts, per_host), dtype=object)
     fill = [0] * n_hosts
     for d in devs:
         p = d.process_index
         grid[p, fill[p]] = d
         fill[p] += 1
-    assert fill == [per_host] * n_hosts, (
-        f"devices are not evenly attached per host: {fill}"
-    )
+    if fill != [per_host] * n_hosts:
+        raise RuntimeError(
+            f"make_distributed_mesh: devices are not evenly attached per "
+            f"host: {fill}"
+        )
     return Mesh(grid, axes)
 
 

@@ -12,6 +12,19 @@
 #include <cstring>
 #include <vector>
 
+// The fast paths memcpy struct.pack('<q')-packed buffers straight into host
+// integers (endpoint.cpp emit_input), so a big-endian host would emit wire
+// bytes that differ from the Python reference core instead of failing the
+// parity contract loudly.  Refuse to build there; _native.py treats a failed
+// build as "no native library" and the wire-identical Python cores take over.
+#if defined(__BYTE_ORDER__) && defined(__ORDER_LITTLE_ENDIAN__)
+static_assert(__BYTE_ORDER__ == __ORDER_LITTLE_ENDIAN__,
+              "ggrs native fast paths require a little-endian host; "
+              "the Python cores are the big-endian fallback");
+#else
+#error "cannot determine host endianness; build the Python cores instead"
+#endif
+
 namespace ggrs {
 
 constexpr size_t kMaxDecodedBytes = size_t{1} << 22;

@@ -209,3 +209,25 @@ class TestOversizedFallback:
         assert [e.input.frame for e in events] == list(range(600))
         assert [e.input.input for e in events] == [i % 251 for i in range(600)]
         assert b.last_recv_frame() == 599
+
+
+class TestStatusCapParity:
+    @pytest.mark.parametrize("core_name", ["py", "native"])
+    def test_both_cores_reject_more_than_64_statuses(self, core_name):
+        """The 64-entry connect-status wire cap must hold identically in both
+        cores, not only via the SessionBuilder player-count guard — a caller
+        constructing PeerProtocol directly must observe the same behavior."""
+        if core_name == "py":
+            core = PyEndpointCore(b"\x00", b"\x00", 8)
+        else:
+            lib = _native.endpoint_lib()
+            if lib is None:  # prebuilt codec-only .so, no toolchain
+                pytest.skip("endpoint symbols unavailable")
+            core = NativeEndpointCore(lib, b"\x00", b"\x00", 8)
+        core.push_input(0, b"\x05")
+        statuses = [ConnectionStatus() for _ in range(65)]
+        with pytest.raises(RuntimeError, match="65 connect statuses exceed"):
+            core.emit_input(0xABCD, statuses, False)
+        # at the cap itself both cores still emit
+        ok = core.emit_input(0xABCD, statuses[:64], False)
+        assert ok is not None
