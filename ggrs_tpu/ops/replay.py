@@ -167,26 +167,32 @@ def build_replay_programs(
 
         loaded = ring.load(carry["ring"], frame - d)
 
-        def resim_step(scan_carry: Any, j: jax.Array) -> Tuple[Any, jax.Array]:
-            st, rng = scan_carry
+        def resim_step(st: Any, j: jax.Array) -> Tuple[Any, Tuple[Any, jax.Array]]:
             f_j = frame - d + j  # frame whose input we consume
             st = advance(st, _read_input(ring, inputs, f_j))
             cs = checksum(st)
-            rng = ring.save(rng, f_j + 1, st, cs)
-            return (st, rng), cs
+            return st, (st, cs)
 
-        (st, new_ring), resim_cs = jax.lax.scan(
+        # the scan emits the resim trajectory as stacked ys; the ring is
+        # updated ONCE per tick below (one scatter per buffer) instead of
+        # once per step — five dynamic-updates per resim step were ~35% of
+        # the flagship's step time (round-5 floor probe)
+        st, (resim_states, resim_cs) = jax.lax.scan(
             resim_step,
-            (loaded, carry["ring"]),
+            loaded,
             jnp.arange(d, dtype=jnp.int32),
             unroll=d if unroll_resim else 1,
+        )
+        saved_frames = frame - d + 1 + jnp.arange(d, dtype=jnp.int32)
+        new_ring = ring.save_many(
+            carry["ring"], saved_frames, resim_states, resim_cs
         )
         # resim_cs[j] digests frame F-d+1+j.  Every entry has a first-seen
         # digest in the history (frame F's was recorded by the previous
         # tick's live advance), so the whole window is compared — including
         # at check_distance=1, where the reference's scheme has nothing to
         # compare against.
-        resim_frames = frame - d + 1 + jnp.arange(d, dtype=jnp.int32)
+        resim_frames = saved_frames
         seen = jax.vmap(
             lambda f: jax.lax.dynamic_index_in_dim(
                 carry["hist"], ring.slot(f), axis=0, keepdims=False
@@ -257,7 +263,13 @@ def build_replay_programs(
             "ring": ring.init(init_state),
             "inputs": inputs,
             "hist": jnp.zeros((ring_length, CHECKSUM_LANES), jnp.uint32),
-            "live": jax.tree_util.tree_map(jnp.asarray, init_state),
+            # copy, never alias: on TPU the carry is DONATED every dispatch,
+            # and jnp.asarray would alias a caller's jax Arrays — their
+            # init_state buffers would be invalidated by the first tick
+            # (surfaces as INVALID_ARGUMENT at the next use)
+            "live": jax.tree_util.tree_map(
+                lambda l: jnp.array(l, copy=True), init_state
+            ),
             "frame": jnp.int32(0),
             "mismatches": jnp.int32(0),
             "first_bad": jnp.int32(_I32_MAX),

@@ -107,6 +107,38 @@ class DeviceStateRing:
             ),
         }
 
+    def save_many(
+        self,
+        ring: Any,
+        frames: jax.Array,
+        states: Any,
+        checksums: jax.Array,
+    ) -> Any:
+        """Write ``n`` consecutive saves in one scatter per leaf.
+
+        ``frames`` is a (n,) i32 vector whose slots must be DISTINCT
+        (n <= ring length guarantees it for consecutive frames); ``states``
+        leaves carry a leading (n,) axis (e.g. the stacked ys of a resim
+        scan); ``checksums`` is (n, 4).  Equivalent to folding ``save`` over
+        the n entries but costs one scatter per buffer instead of n — the
+        replay's steady tick uses this to take ring maintenance off the
+        per-resim-step critical path.
+        """
+        idx = self.slot(frames)
+        return {
+            "states": jax.tree_util.tree_map(
+                lambda buf, leaf: buf.at[idx].set(
+                    jnp.asarray(leaf, buf.dtype)
+                ),
+                ring["states"],
+                states,
+            ),
+            "checksums": ring["checksums"].at[idx].set(checksums),
+            "frames": ring["frames"].at[idx].set(
+                jnp.asarray(frames, jnp.int32)
+            ),
+        }
+
     def load(self, ring: Any, frame: jax.Array) -> Any:
         """Read the state stored in the slot for ``frame``."""
         i = self.slot(frame)

@@ -183,3 +183,43 @@ class TestReplayPrograms:
         carry = progs.run_steady(carry, jnp.ones((1, 1), jnp.int32))
         assert int(carry["mismatches"]) >= 1
         assert int(carry["first_bad"]) == frame
+
+
+class TestDigestPathEquivalence:
+    """checksum_device routes small states through one concatenated
+    reduction and large states through per-leaf offset sums; both must
+    produce identical lanes (lane_sums' chunk-additivity contract)."""
+
+    def test_concat_and_offset_sum_paths_agree(self):
+        from ggrs_tpu.ops import checksum as cs
+
+        rng = np.random.default_rng(3)
+        # total words straddle the fuse threshold from both sides
+        big = {
+            "a": jnp.asarray(rng.integers(0, 2**31, size=(3000,), dtype=np.int64)),
+            "b": jnp.asarray(rng.integers(0, 255, size=(2500,), dtype=np.uint8)),
+            "c": jnp.asarray(rng.random((700,)).astype(np.float32)),
+        }
+        small = {k: v[:50] for k, v in big.items()}
+        for state in (big, small):
+            words = [
+                cs._as_u32_words(jnp.asarray(l))
+                for l in jax.tree_util.tree_leaves(state)
+            ]
+            concat_lanes = cs.lane_sums(jnp.concatenate(words))
+            acc = jnp.zeros((4,), jnp.uint32)
+            off = 0
+            for w in words:
+                acc = acc + cs.lane_sums(w, off)
+                off += w.shape[0]
+            np.testing.assert_array_equal(np.asarray(concat_lanes), np.asarray(acc))
+            np.testing.assert_array_equal(
+                np.asarray(cs._digest_words(words)), np.asarray(concat_lanes)
+            )
+
+    def test_leaf_structure_still_distinguished(self):
+        # same concatenated words, different leaf boundaries -> the structure
+        # salt must keep the digests distinct
+        a = {"a": jnp.asarray([1, 2], jnp.uint32)}
+        b = {"a": jnp.asarray([1], jnp.uint32), "b": jnp.asarray([2], jnp.uint32)}
+        assert pytree_checksum(a) != pytree_checksum(b)
