@@ -75,6 +75,7 @@ CONFIGS = {
     "ecs": ("run_ecs", 1200),
     "chipvm256": ("run_chipvm256", 1200),
     "pallas_checksum": ("run_pallas_checksum", 900),
+    "spec_width": ("run_spec_width", 900),
     "pool_hosting": ("run_pool_hosting", 1500),
     "flagship": ("run_flagship", 1200),
 }
@@ -777,6 +778,99 @@ def _hosting_setup(n_matches: int, pooled: bool):
         jax.block_until_ready([ex.state for ex in executors])
 
     return tick, finalize
+
+
+def run_spec_width() -> None:
+    """The K-branch width ratio DESIGN §5 called unverifiable — measured.
+
+    The question: does advancing K vmapped branch hypotheses alongside the
+    live state cost ~the wall time of one advance (spare parallel width, the
+    TPU's proposition) or ~K× (serialized)?  Per-tick host dispatches can't
+    answer it through the tunnel (per-dispatch overhead ≫ device work), so
+    this scans T ticks of the branch-upkeep program — live advance + vmapped
+    K-branch advance + the window-ring write, the device body of
+    ``SpeculativeRollback.advance_and_extend`` — in ONE program per dispatch,
+    fenced once, against the identical scan of the plain advance.
+    ``spec_width_ratio_kK`` = t(K)/t(plain) per tick: 1.0 = branches ride
+    free, K = fully serialized."""
+    game = BoxGame(PLAYERS)
+    T = 4096 if _on_tpu() else 1024     # ticks per dispatch
+    dispatches, window = 4, 64
+    inps = jnp.asarray(_inputs(T, PLAYERS, seed=17))
+    st0 = jax.tree_util.tree_map(
+        lambda l: jnp.array(l, copy=True), game.init_state()
+    )
+
+    def plain_scan(st, xs):
+        return jax.lax.scan(lambda s, x: (game.advance(s, x), None), st, xs)[0]
+
+    def make_width_scan(K: int):
+        # K hypotheses: local player's real input, remote held at candidate k
+        cands = jnp.arange(K, dtype=jnp.uint8)
+
+        def body(carry, xs):
+            live, branches, ring = carry
+            inp, i = xs
+            live = game.advance(live, inp)
+            inp_k = jnp.stack(
+                [jnp.broadcast_to(inp[0], (K,)), cands], axis=1
+            ).astype(jnp.uint8)
+            branches = jax.vmap(game.advance)(branches, inp_k)
+            slot = jax.lax.rem(i, jnp.int32(window))
+            ring = jax.tree_util.tree_map(
+                lambda buf, leaf: jax.lax.dynamic_update_index_in_dim(
+                    buf, leaf, slot, axis=0
+                ),
+                ring,
+                branches,
+            )
+            return (live, branches, ring), None
+
+        def run(st, xs):
+            branches0 = jax.tree_util.tree_map(
+                lambda l: jnp.broadcast_to(l[None], (K,) + l.shape).copy(), st
+            )
+            ring0 = jax.tree_util.tree_map(
+                lambda l: jnp.zeros((window,) + l.shape, l.dtype), branches0
+            )
+            out, _ = jax.lax.scan(body, (st, branches0, ring0), xs)
+            # return the FULL carry: returning only the live state lets
+            # XLA's while-loop simplifier dead-code-eliminate the branch
+            # advances and ring writes entirely (verified via HLO cost
+            # analysis: 0 dynamic-update-slices and ~2.5x fewer flops with
+            # a live-only return), which would time plain against plain
+            return out
+
+        return run
+
+    ticks_i = jnp.arange(T, dtype=jnp.int32)
+    plain_j = jax.jit(plain_scan)
+    jax.block_until_ready(plain_j(st0, inps))
+    enter_honest_timing_mode()
+
+    def timed(fn, xs) -> float:
+        best = float("inf")
+        for _ in range(REPEATS):
+            t0 = time.perf_counter()
+            out = None
+            for _ in range(dispatches):
+                out = fn(st0, xs)
+            jax.block_until_ready(out)
+            best = min(best, time.perf_counter() - t0)
+        return best / (dispatches * T)  # seconds per tick
+
+    t_plain = timed(plain_j, inps)
+    emit("spec_width_plain_us_per_tick", t_plain * 1e6, "us/tick", 1.0)
+    for K in (1, 2, 4, 8):
+        wj = jax.jit(make_width_scan(K))
+        jax.block_until_ready(wj(st0, (inps, ticks_i)))
+        t_k = timed(wj, (inps, ticks_i))
+        emit(
+            f"spec_width_ratio_k{K}", t_k / t_plain,
+            f"x plain advance per tick ({t_k*1e6:.2f} us/tick; 1.0 = "
+            f"branches ride free, {K}.0 = serialized)",
+            t_plain / t_k,
+        )
 
 
 def run_pool_hosting() -> None:
