@@ -66,6 +66,10 @@ class PredictCustom(InputPredictor[I]):
         return self._fn(previous)
 
 
+def _default_eq(a: Any, b: Any) -> bool:
+    return a == b
+
+
 @dataclass(frozen=True)
 class Config:
     """Bundles the session's type behavior (reference: src/lib.rs:244-262).
@@ -83,8 +87,14 @@ class Config:
     input_default: Callable[[], Any]
     input_encode: Callable[[Any], bytes]
     input_decode: Callable[[bytes], Any]
-    input_eq: Callable[[Any, Any], bool] = field(default=lambda a, b: a == b)
+    input_eq: Callable[[Any, Any], bool] = field(default=_default_eq)
     predictor: InputPredictor = field(default_factory=PredictRepeatLast)
+    # Byte width of every encoded input, when the encoding is fixed-size and
+    # injective with an all-zero default (set by for_uint / for_struct).
+    # This is the gate for the native sync core: with it set, repeat-last
+    # prediction and equality over encoded bytes are exactly the Python
+    # semantics over values.  None = unknown shape, Python queues only.
+    native_input_size: Optional[int] = None
 
     def __post_init__(self) -> None:
         # A bare PredictDefault() needs the config's own notion of "default
@@ -113,6 +123,7 @@ class Config:
             input_encode=lambda v: struct.pack(fmt, v),
             input_decode=lambda b: struct.unpack(fmt, b)[0],
             predictor=predictor if predictor is not None else PredictRepeatLast(),
+            native_input_size=bits // 8,
         )
 
     @staticmethod
@@ -144,4 +155,16 @@ class Config:
             input_encode=_encode,
             input_decode=_decode,
             predictor=predictor if predictor is not None else PredictRepeatLast(),
+            # byte-wise equality must be EXACTLY value equality for the
+            # native sync core: floats break it (-0.0 == 0.0, NaN != NaN),
+            # and so do 's'/'p' (b'ab' == b'ab\x00\x00' after packing) and
+            # '?' (2 and True pack identically) — whitelist integer codes
+            # and pad bytes only
+            native_input_size=(
+                size
+                if all(
+                    ch in "bBhHiIlLqQnNx<>=!@0123456789 \t" for ch in fmt
+                )
+                else None
+            ),
         )

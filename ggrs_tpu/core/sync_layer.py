@@ -10,10 +10,12 @@ to the host.
 
 from __future__ import annotations
 
+import ctypes
+import os
 import threading
 from typing import Generic, List, Optional, Sequence, Tuple, TypeVar
 
-from .config import Config
+from .config import Config, PredictRepeatLast, _default_eq
 from .frame_info import GameState, PlayerInput
 from .input_queue import InputQueue
 from .types import (
@@ -118,11 +120,174 @@ class SavedStates(Generic[S]):
         return self.cells[frame % len(self.cells)]
 
 
+def _native_sync_semantics_ok(config: Config) -> bool:
+    """Byte-wise semantics are EXACTLY the Python value semantics: a
+    fixed-size injective encoding (for_uint / integer-only for_struct set
+    ``native_input_size``), repeat-last prediction, default equality."""
+    return (
+        config.native_input_size is not None
+        and type(config.predictor) is PredictRepeatLast
+        and config.input_eq is _default_eq
+    )
+
+
+def _native_sync_eligible(config: Config) -> bool:
+    """Default-on gate for the native sync core: semantics must hold and
+    the global kill switch must be off."""
+    return _native_sync_semantics_ok(config) and not os.environ.get(
+        "GGRS_TPU_NO_NATIVE"
+    )
+
+
+# native status codes (sync_core.cpp kStatus*) -> InputStatus
+_NATIVE_STATUS = (
+    InputStatus.CONFIRMED,
+    InputStatus.PREDICTED,
+    InputStatus.DISCONNECTED,
+)
+
+
+class _NativeSyncCore:
+    """ctypes facade over native/sync_core.cpp: the input-queue bank and
+    confirmed-frame watermark with ONE crossing per operation, storing
+    Config-encoded fixed-size input bytes.  Eligibility is decided by
+    ``SyncLayer`` (fixed-size injective encoding + repeat-last predictor +
+    default equality); the Python ``InputQueue`` bank remains the reference
+    implementation and the fallback, pinned equivalent by
+    tests/test_native_sync.py."""
+
+    def __init__(self, lib, config: Config, num_players: int) -> None:
+        self._lib = lib
+        self._config = config
+        self._size = config.native_input_size
+        self._players = num_players
+        self._ptr = lib.ggrs_sync_new(num_players, self._size)
+        if not self._ptr:
+            raise MemoryError("ggrs_sync_new failed")
+        self._in_buf = ctypes.create_string_buffer(self._size * num_players)
+        self._status = (ctypes.c_int32 * num_players)()
+        self._disc = ctypes.create_string_buffer(num_players)
+        self._lastf = (ctypes.c_int64 * num_players)()
+        self._out_frames = (ctypes.c_int64 * num_players)()
+
+    def __del__(self) -> None:  # pragma: no cover
+        try:
+            if self._ptr:
+                self._lib.ggrs_sync_free(self._ptr)
+                self._ptr = None
+        except Exception:
+            pass
+
+    def _pack_status(self, connect_status) -> None:
+        for i, st in enumerate(connect_status):
+            self._disc[i] = 1 if st.disconnected else 0
+            self._lastf[i] = st.last_frame
+
+    def add_input(self, player: int, frame: Frame, value) -> Frame:
+        rc = self._lib.ggrs_sync_add_input(
+            self._ptr, player, frame, self._config.input_encode(value)
+        )
+        if rc < NULL_FRAME:
+            raise AssertionError(f"native sync add_input failed: {rc}")
+        return rc
+
+    def synchronized_inputs(self, frame: Frame, connect_status):
+        self._pack_status(connect_status)
+        rc = self._lib.ggrs_sync_synchronized_inputs(
+            self._ptr, frame, self._disc, self._lastf,
+            self._in_buf, self._status,
+        )
+        if rc != 0:
+            raise AssertionError(f"native sync synchronized_inputs: {rc}")
+        decode, size = self._config.input_decode, self._size
+        raw = self._in_buf.raw
+        return [
+            (
+                decode(raw[p * size:(p + 1) * size]),
+                _NATIVE_STATUS[self._status[p]],
+            )
+            for p in range(self._players)
+        ]
+
+    def confirmed_inputs(self, frame: Frame, connect_status):
+        self._pack_status(connect_status)
+        rc = self._lib.ggrs_sync_confirmed_inputs(
+            self._ptr, frame, self._disc, self._lastf,
+            self._in_buf, self._out_frames,
+        )
+        if rc != 0:
+            raise AssertionError(
+                "There is no confirmed input for the requested frame "
+                f"{frame}"
+            )
+        decode, size = self._config.input_decode, self._size
+        raw = self._in_buf.raw
+        out = []
+        for p in range(self._players):
+            if self._out_frames[p] == NULL_FRAME:
+                out.append(
+                    PlayerInput.blank(NULL_FRAME, self._config.input_default)
+                )
+            else:
+                out.append(
+                    PlayerInput(frame, decode(raw[p * size:(p + 1) * size]))
+                )
+        return out
+
+    def confirmed_input(self, player: int, frame: Frame):
+        rc = self._lib.ggrs_sync_confirmed_input(
+            self._ptr, player, frame, self._in_buf
+        )
+        if rc != 0:
+            raise AssertionError(
+                "There is no confirmed input for the requested frame "
+                f"{frame}"
+            )
+        return PlayerInput(
+            frame, self._config.input_decode(self._in_buf.raw[: self._size])
+        )
+
+    def set_frame_delay(self, player: int, delay: int) -> None:
+        self._lib.ggrs_sync_set_frame_delay(self._ptr, player, delay)
+
+    def reset_prediction(self) -> None:
+        self._lib.ggrs_sync_reset_prediction(self._ptr)
+
+    def set_last_confirmed(self, frame: Frame) -> None:
+        rc = self._lib.ggrs_sync_set_last_confirmed(self._ptr, frame)
+        if rc != 0:
+            raise AssertionError(
+                "confirming past the first incorrect frame would discard "
+                "inputs still needed for the pending rollback"
+            )
+
+    def check_consistency(self, first_incorrect: Frame) -> Frame:
+        return self._lib.ggrs_sync_check_consistency(self._ptr, first_incorrect)
+
+    def first_incorrect(self, player: int) -> Frame:
+        return self._lib.ggrs_sync_first_incorrect(self._ptr, player)
+
+
 class SyncLayer(Generic[I, S]):
     """Owns the state ring and input queues; emits Save/Load requests and
-    merges per-player inputs (reference: sync_layer.rs:168-375)."""
+    merges per-player inputs (reference: sync_layer.rs:168-375).
 
-    def __init__(self, config: Config, num_players: int, max_prediction: int) -> None:
+    The input-queue/watermark MECHANISM runs on the native sync core
+    (native/sync_core.cpp, one ctypes crossing per operation) whenever the
+    config's encoding is fixed-size and injective with repeat-last
+    prediction and default equality — the profile of the pooled capacity
+    bench put ~90% of a hosting tick in this Python bookkeeping.  All other
+    configs (pluggable predictors, custom equality, variable-size inputs)
+    use the pure-Python ``InputQueue`` bank, which remains the reference
+    implementation; parity is pinned by tests/test_native_sync.py."""
+
+    def __init__(
+        self,
+        config: Config,
+        num_players: int,
+        max_prediction: int,
+        use_native: Optional[bool] = None,
+    ) -> None:
         self._config = config
         self.num_players = num_players
         self.max_prediction = max_prediction
@@ -130,9 +295,28 @@ class SyncLayer(Generic[I, S]):
         self._last_confirmed_frame: Frame = NULL_FRAME
         self._last_saved_frame: Frame = NULL_FRAME
         self._current_frame: Frame = 0
-        self.input_queues: List[InputQueue[I]] = [
-            InputQueue(config) for _ in range(num_players)
-        ]
+        self._native: Optional[_NativeSyncCore] = None
+        if use_native is None:
+            use_native = _native_sync_eligible(config)
+        elif use_native and not _native_sync_semantics_ok(config):
+            # forcing the native core with a config whose byte semantics
+            # diverge from value semantics would silently change prediction
+            # and equality behavior — refuse loudly
+            raise ValueError(
+                "use_native=True requires a fixed-size injective input "
+                "encoding with repeat-last prediction and default equality"
+            )
+        if use_native:
+            from ..net import _native as _native_mod
+
+            lib = _native_mod.sync_lib()
+            if lib is not None:
+                self._native = _NativeSyncCore(lib, config, num_players)
+        self.input_queues: List[InputQueue[I]] = (
+            []
+            if self._native is not None
+            else [InputQueue(config) for _ in range(num_players)]
+        )
 
     # ------------------------------------------------------------------
     # frame counters
@@ -191,9 +375,15 @@ class SyncLayer(Generic[I, S]):
 
     def set_frame_delay(self, player_handle: PlayerHandle, delay: int) -> None:
         assert player_handle < self.num_players
-        self.input_queues[player_handle].set_frame_delay(delay)
+        if self._native is not None:
+            self._native.set_frame_delay(player_handle, delay)
+        else:
+            self.input_queues[player_handle].set_frame_delay(delay)
 
     def reset_prediction(self) -> None:
+        if self._native is not None:
+            self._native.reset_prediction()
+            return
         for q in self.input_queues:
             q.reset_prediction()
 
@@ -201,11 +391,16 @@ class SyncLayer(Generic[I, S]):
         self, player_handle: PlayerHandle, input: PlayerInput[I]
     ) -> Frame:
         assert input.frame == self._current_frame
+        if self._native is not None:
+            return self._native.add_input(player_handle, input.frame, input.input)
         return self.input_queues[player_handle].add_input(input)
 
     def add_remote_input(
         self, player_handle: PlayerHandle, input: PlayerInput[I]
     ) -> None:
+        if self._native is not None:
+            self._native.add_input(player_handle, input.frame, input.input)
+            return
         self.input_queues[player_handle].add_input(input)
 
     def synchronized_inputs(
@@ -214,6 +409,10 @@ class SyncLayer(Generic[I, S]):
         """Inputs for all players at the current frame; predictions where
         confirmed input hasn't arrived; dummies for disconnected players
         (reference: sync_layer.rs:280-293)."""
+        if self._native is not None:
+            return self._native.synchronized_inputs(
+                self._current_frame, connect_status
+            )
         inputs: List[Tuple[I, InputStatus]] = []
         for i, status in enumerate(connect_status):
             if status.disconnected and status.last_frame < self._current_frame:
@@ -222,11 +421,22 @@ class SyncLayer(Generic[I, S]):
                 inputs.append(self.input_queues[i].input(self._current_frame))
         return inputs
 
+    def confirmed_input(
+        self, player_handle: PlayerHandle, frame: Frame
+    ) -> PlayerInput[I]:
+        """One player's confirmed input at ``frame``; raises if not stored
+        (core-dispatching accessor for tests/tools)."""
+        if self._native is not None:
+            return self._native.confirmed_input(player_handle, frame)
+        return self.input_queues[player_handle].confirmed_input(frame)
+
     def confirmed_inputs(
         self, frame: Frame, connect_status: Sequence
     ) -> List[PlayerInput[I]]:
         """Confirmed inputs for all players at ``frame``; blanks for
         disconnected players (reference: sync_layer.rs:296-310)."""
+        if self._native is not None:
+            return self._native.confirmed_inputs(frame, connect_status)
         inputs: List[PlayerInput[I]] = []
         for i, status in enumerate(connect_status):
             if status.disconnected and status.last_frame < frame:
@@ -241,11 +451,9 @@ class SyncLayer(Generic[I, S]):
 
     def set_last_confirmed_frame(self, frame: Frame, sparse_saving: bool) -> None:
         """Raise the confirmed-frame watermark and discard older inputs
-        (reference: sync_layer.rs:313-340)."""
-        first_incorrect: Frame = NULL_FRAME
-        for q in self.input_queues:
-            first_incorrect = max(first_incorrect, q.first_incorrect_frame)
-
+        (reference: sync_layer.rs:313-340).  POLICY (the sparse-saving and
+        current-frame minimums) stays here; the native core only verifies
+        the first-incorrect invariant, stores, and discards."""
         # With sparse saving, never confirm past the last save — otherwise the
         # rollback target would have been discarded.
         if sparse_saving:
@@ -253,6 +461,15 @@ class SyncLayer(Generic[I, S]):
 
         # never delete anything ahead of the current frame
         frame = min(frame, self._current_frame)
+
+        if self._native is not None:
+            self._native.set_last_confirmed(frame)
+            self._last_confirmed_frame = frame
+            return
+
+        first_incorrect: Frame = NULL_FRAME
+        for q in self.input_queues:
+            first_incorrect = max(first_incorrect, q.first_incorrect_frame)
 
         # Confirming past the first incorrect frame would discard inputs still
         # needed for the pending rollback.
@@ -266,6 +483,8 @@ class SyncLayer(Generic[I, S]):
     def check_simulation_consistency(self, first_incorrect: Frame) -> Frame:
         """Earliest incorrect frame across all input queues
         (reference: sync_layer.rs:343-353)."""
+        if self._native is not None:
+            return self._native.check_consistency(first_incorrect)
         for q in self.input_queues:
             incorrect = q.first_incorrect_frame
             if incorrect != NULL_FRAME and (

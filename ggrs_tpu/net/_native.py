@@ -101,7 +101,11 @@ def _native_dir() -> Path:
 
 
 def _sources() -> List[Path]:
-    return [_native_dir() / "codec.cpp", _native_dir() / "endpoint.cpp"]
+    return [
+        _native_dir() / "codec.cpp",
+        _native_dir() / "endpoint.cpp",
+        _native_dir() / "sync_core.cpp",
+    ]
 
 
 def _source_mtime() -> float:
@@ -287,6 +291,53 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.ggrs_ep_store_one.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_size_t,
         ]
+        if hasattr(lib, "ggrs_sync_new"):
+            lib.ggrs_sync_new.restype = ctypes.c_void_p
+            lib.ggrs_sync_new.argtypes = [ctypes.c_int, ctypes.c_int]
+            lib.ggrs_sync_free.restype = None
+            lib.ggrs_sync_free.argtypes = [ctypes.c_void_p]
+            lib.ggrs_sync_set_frame_delay.restype = None
+            lib.ggrs_sync_set_frame_delay.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+            ]
+            lib.ggrs_sync_reset_prediction.restype = None
+            lib.ggrs_sync_reset_prediction.argtypes = [ctypes.c_void_p]
+            lib.ggrs_sync_add_input.restype = ctypes.c_int64
+            lib.ggrs_sync_add_input.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_int64, ctypes.c_char_p,
+            ]
+            lib.ggrs_sync_synchronized_inputs.restype = ctypes.c_int
+            lib.ggrs_sync_synchronized_inputs.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_int32),
+            ]
+            lib.ggrs_sync_confirmed_inputs.restype = ctypes.c_int
+            lib.ggrs_sync_confirmed_inputs.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+            ]
+            lib.ggrs_sync_set_last_confirmed.restype = ctypes.c_int
+            lib.ggrs_sync_set_last_confirmed.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64,
+            ]
+            lib.ggrs_sync_last_confirmed.restype = ctypes.c_int64
+            lib.ggrs_sync_last_confirmed.argtypes = [ctypes.c_void_p]
+            lib.ggrs_sync_check_consistency.restype = ctypes.c_int64
+            lib.ggrs_sync_check_consistency.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64,
+            ]
+            lib.ggrs_sync_first_incorrect.restype = ctypes.c_int64
+            lib.ggrs_sync_first_incorrect.argtypes = [
+                ctypes.c_void_p, ctypes.c_int,
+            ]
+            lib.ggrs_sync_last_added.restype = ctypes.c_int64
+            lib.ggrs_sync_last_added.argtypes = [ctypes.c_void_p, ctypes.c_int]
+            lib.ggrs_sync_confirmed_input.restype = ctypes.c_int
+            lib.ggrs_sync_confirmed_input.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_int64, ctypes.c_char_p,
+            ]
         _lib = lib
         return _lib
 
@@ -297,6 +348,24 @@ EP_FALLBACK = -31
 EP_BAD_PENDING_HEAD = -32
 EP_ERR_BUFFER_TOO_SMALL = -11
 EP_ERR_TOO_MANY_INPUTS = -12  # kErrTooManyInputs: > _MAX_PLAYERS_ON_WIRE
+
+# sync-core return codes (mirror native/sync_core.cpp SyncRc)
+SYNC_OK = 0
+SYNC_ERR_PREDICTION_PENDING = -40
+SYNC_ERR_BEFORE_TAIL = -41
+SYNC_ERR_NO_CONFIRMED = -42
+SYNC_ERR_NON_SEQUENTIAL = -43
+SYNC_ERR_CONFIRM_PAST_INCORRECT = -44
+SYNC_ERR_BAD_ARGS = -45
+
+
+def sync_lib() -> Optional[ctypes.CDLL]:
+    """The loaded library for the native sync core, or None (use the Python
+    input queues).  Same load/fallback policy as the other fast paths."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "ggrs_sync_new"):
+        return None
+    return lib
 
 
 def available() -> bool:

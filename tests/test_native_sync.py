@@ -1,0 +1,217 @@
+"""Parity pin: the native sync core (native/sync_core.cpp) must be
+indistinguishable from the Python InputQueue/SyncLayer mechanism across the
+whole operation surface — landed frames, synchronized inputs + statuses,
+confirmed inputs, first-incorrect tracking, watermark discard behavior,
+delay grow/shrink, disconnects, and the error paths.
+
+Method: drive a native-core SyncLayer and a Python-core SyncLayer through
+identical randomized operation sequences and compare every observable after
+every operation.  Mirrors the role tests/test_native_endpoint.py plays for
+the endpoint datapath.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from ggrs_tpu.core.config import Config, PredictDefault
+from ggrs_tpu.core.frame_info import PlayerInput
+from ggrs_tpu.core.sync_layer import SyncLayer, _native_sync_eligible
+from ggrs_tpu.core.types import NULL_FRAME
+from ggrs_tpu.net import _native
+from ggrs_tpu.net.messages import ConnectionStatus
+
+pytestmark = pytest.mark.skipif(
+    _native.sync_lib() is None, reason="native sync core unavailable"
+)
+
+
+def make_pair(players=2, max_prediction=8, bits=16):
+    cfg = Config.for_uint(bits)
+    nat = SyncLayer(cfg, players, max_prediction, use_native=True)
+    py = SyncLayer(cfg, players, max_prediction, use_native=False)
+    assert nat._native is not None, "native core did not engage"
+    assert py._native is None
+    return nat, py
+
+
+class TestEligibility:
+    def test_for_uint_is_eligible(self):
+        assert _native_sync_eligible(Config.for_uint(8))
+
+    def test_custom_predictor_not_eligible(self):
+        assert not _native_sync_eligible(
+            Config.for_uint(8, predictor=PredictDefault())
+        )
+
+    def test_variable_size_not_eligible(self):
+        assert not _native_sync_eligible(Config.for_bytes())
+
+    def test_float_struct_not_eligible(self):
+        assert not _native_sync_eligible(Config.for_struct("<fI"))
+
+    def test_int_struct_eligible(self):
+        assert _native_sync_eligible(Config.for_struct("<hI"))
+
+    def test_env_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("GGRS_TPU_NO_NATIVE", "1")
+        assert not _native_sync_eligible(Config.for_uint(8))
+
+
+def assert_same_view(nat, py, status, frame_probe):
+    """Compare every observable the session layer reads."""
+    assert nat.check_simulation_consistency(NULL_FRAME) == \
+        py.check_simulation_consistency(NULL_FRAME)
+    for f in frame_probe:
+        nat_exc = py_exc = None
+        nat_val = py_val = None
+        try:
+            nat_val = nat.confirmed_input(0, f).input
+        except AssertionError:
+            nat_exc = True
+        try:
+            py_val = py.confirmed_input(0, f).input
+        except AssertionError:
+            py_exc = True
+        assert nat_exc == py_exc, f"confirmed_input({f}) availability differs"
+        assert nat_val == py_val
+
+
+class TestRandomizedParity:
+    @pytest.mark.parametrize("seed", [1, 7, 23, 99])
+    def test_lockfree_stream(self, seed):
+        """Remote inputs stream in while the local side runs ahead on
+        predictions; occasional mispredictions trigger reset_prediction (as
+        the session's rollback path would)."""
+        rng = random.Random(seed)
+        nat, py = make_pair(players=2, max_prediction=8)
+        status = [ConnectionStatus(), ConnectionStatus()]
+        remote_frame = -1
+        for step in range(400):
+            cur = nat.current_frame
+            assert cur == py.current_frame
+            # local input for current frame, always
+            v = rng.randrange(0, 1 << 16)
+            pi_n = PlayerInput(cur, v)
+            pi_p = PlayerInput(cur, v)
+            assert nat.add_local_input(0, pi_n) == py.add_local_input(0, pi_p)
+            status[0].last_frame = cur
+            # remote inputs arrive late and in bursts
+            while remote_frame < cur - rng.randrange(0, 6) and remote_frame < cur:
+                remote_frame += 1
+                rv = rng.randrange(0, 1 << 16)
+                nat.add_remote_input(1, PlayerInput(remote_frame, rv))
+                py.add_remote_input(1, PlayerInput(remote_frame, rv))
+                status[1].last_frame = remote_frame
+            # the session resolves mispredictions (rollback) AFTER polling
+            # remote inputs and BEFORE advancing — mirror that order
+            fi_n = nat.check_simulation_consistency(NULL_FRAME)
+            fi_p = py.check_simulation_consistency(NULL_FRAME)
+            assert fi_n == fi_p
+            if fi_n != NULL_FRAME:
+                nat.reset_prediction()
+                py.reset_prediction()
+            ni = nat.synchronized_inputs(status)
+            pi = py.synchronized_inputs(status)
+            assert ni == pi, f"step {step}: {ni} != {pi}"
+            nat.advance_frame()
+            py.advance_frame()
+            # raise the watermark like the session does
+            confirmed = min(status[0].last_frame, status[1].last_frame)
+            if confirmed > 0 and rng.random() < 0.5:
+                nat.set_last_confirmed_frame(confirmed, sparse_saving=False)
+                py.set_last_confirmed_frame(confirmed, sparse_saving=False)
+                assert nat.last_confirmed_frame == py.last_confirmed_frame
+            if step % 37 == 0:
+                probe = [max(0, cur - 3), cur]
+                assert_same_view(nat, py, status, probe)
+
+    @pytest.mark.parametrize("seed", [5, 13])
+    def test_delay_changes_and_disconnect(self, seed):
+        rng = random.Random(seed)
+        nat, py = make_pair(players=2, max_prediction=8)
+        status = [ConnectionStatus(), ConnectionStatus()]
+        for step in range(200):
+            cur = nat.current_frame
+            if step in (31, 90):  # grow, then shrink, player 0's delay
+                d = 3 if step == 31 else 1
+                nat.set_frame_delay(0, d)
+                py.set_frame_delay(0, d)
+            if step == 120:
+                status[1].disconnected = True
+            v = rng.randrange(0, 1 << 16)
+            assert nat.add_local_input(0, PlayerInput(cur, v)) == \
+                py.add_local_input(0, PlayerInput(cur, v))
+            if not status[1].disconnected:
+                rv = rng.randrange(0, 1 << 16)
+                nat.add_remote_input(1, PlayerInput(cur, rv))
+                py.add_remote_input(1, PlayerInput(cur, rv))
+                status[1].last_frame = cur
+            status[0].last_frame = cur
+            ni = nat.synchronized_inputs(status)
+            pi = py.synchronized_inputs(status)
+            assert ni == pi, f"step {step}: {ni} != {pi}"
+            nat.advance_frame()
+            py.advance_frame()
+            fi_n = nat.check_simulation_consistency(NULL_FRAME)
+            assert fi_n == py.check_simulation_consistency(NULL_FRAME)
+            if fi_n != NULL_FRAME:
+                nat.reset_prediction()
+                py.reset_prediction()
+
+    def test_confirm_past_incorrect_raises_identically(self):
+        nat, py = make_pair(players=1, max_prediction=8)
+        status = [ConnectionStatus()]
+        # go into prediction, then contradict it
+        nat.add_local_input(0, PlayerInput(0, 1))
+        py.add_local_input(0, PlayerInput(0, 1))
+        status[0].last_frame = 0
+        for layer in (nat, py):
+            layer.synchronized_inputs(status)
+            layer.advance_frame()
+            layer.synchronized_inputs(status)  # predicted for frame 1
+            layer.advance_frame()
+        # reality disagrees with the repeat-last prediction at frame 1
+        nat.add_remote_input(0, PlayerInput(1, 999))
+        py.add_remote_input(0, PlayerInput(1, 999))
+        assert nat.check_simulation_consistency(NULL_FRAME) == \
+            py.check_simulation_consistency(NULL_FRAME) == 1
+        with pytest.raises(AssertionError):
+            nat.set_last_confirmed_frame(2, sparse_saving=False)
+        with pytest.raises(AssertionError):
+            py.set_last_confirmed_frame(2, sparse_saving=False)
+
+    def test_input_during_pending_misprediction_raises_identically(self):
+        nat, py = make_pair(players=1, max_prediction=8)
+        status = [ConnectionStatus()]
+        for layer in (nat, py):
+            layer.synchronized_inputs(status)  # prediction from empty queue
+            layer.advance_frame()
+        nat.add_remote_input(0, PlayerInput(0, 7))
+        py.add_remote_input(0, PlayerInput(0, 7))
+        if nat.check_simulation_consistency(NULL_FRAME) != NULL_FRAME:
+            with pytest.raises(AssertionError):
+                nat.synchronized_inputs(status)
+            with pytest.raises(AssertionError):
+                py.synchronized_inputs(status)
+
+    def test_queue_capacity_guard_raises_identically(self):
+        """129 sequential inputs without a watermark raise in both cores
+        rather than silently wrapping the 128-slot ring."""
+        nat, py = make_pair(players=1, max_prediction=8)
+        for layer in (nat, py):
+            with pytest.raises(AssertionError):
+                for i in range(200):
+                    layer.add_remote_input(0, PlayerInput(i, i % 251))
+
+    def test_force_native_on_ineligible_config_refuses(self):
+        with pytest.raises(ValueError):
+            SyncLayer(Config.for_bytes(), 1, 8, use_native=True)
+
+    def test_string_struct_not_eligible(self):
+        # '4s' packs b'ab' and b'ab\x00\x00' identically: not injective
+        assert Config.for_struct("<4s").native_input_size is None
+        assert Config.for_struct("<?").native_input_size is None
+        assert Config.for_struct("<2hxx").native_input_size is not None

@@ -73,7 +73,7 @@ def test_set_last_confirmed_discards_inputs():
     sl.set_last_confirmed_frame(8, sparse_saving=False)
     assert sl.last_confirmed_frame == 8
     # frame 7 (= 8-1) and beyond must still be fetchable
-    assert sl.input_queues[0].confirmed_input(8).input == 8
+    assert sl.confirmed_input(0, 8).input == 8
 
 
 def test_disconnected_player_gets_default_input():
