@@ -22,3 +22,11 @@ if platform == "cpu":
 import jax  # noqa: E402  (import after XLA_FLAGS is set)
 
 jax.config.update("jax_platforms", platform)
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "soak: long-horizon (1e5-frame) endurance tests; deselect with "
+        '-m "not soak" when iterating',
+    )
