@@ -954,6 +954,17 @@ def p2p_soak(frames: int, periodic=None) -> dict:
             del digests[0][f]
 
     def rss_mb() -> float:
+        # CURRENT resident set, not ru_maxrss: the rusage value is a
+        # process-lifetime high-water mark, so a pytest run whose earlier
+        # device tests peaked higher would make the drift identically 0.0
+        # and the leak certification vacuous
+        try:
+            with open("/proc/self/status") as f:
+                for line in f:
+                    if line.startswith("VmRSS:"):
+                        return int(line.split()[1]) / 1024.0
+        except OSError:
+            pass
         return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0
 
     desyncs = 0
@@ -1482,6 +1493,36 @@ def orchestrate() -> None:
             )
         return ok
 
+    def write_artifact(results: dict, parsed_by_name: dict) -> list:
+        """Write bench_out/latest.json from what has completed SO FAR and
+        return the metric list.  Called after every config: the round-5
+        config list runs for tens of minutes, and a driver that kills the
+        orchestrator mid-run must still find every completed config's
+        metrics in the artifact."""
+        all_metrics = []
+        for name in names:  # print order, flagship last
+            if name in results:
+                all_metrics.extend(parsed_by_name[name][0])
+        if not all_metrics:
+            return all_metrics
+        artifact = {
+            "schema": "ggrs_tpu bench full stream v1",
+            "time_unix": int(time.time()),
+            "configs_run": [n for n in names if n in results],
+            "configs_pending": [n for n in names if n not in results],
+            "metrics": all_metrics,
+        }
+        out_dir = os.path.join(os.path.dirname(here), "bench_out")
+        try:
+            os.makedirs(out_dir, exist_ok=True)
+            tmp = os.path.join(out_dir, f".latest.{os.getpid()}.tmp")
+            with open(tmp, "w") as f:
+                json.dump(artifact, f, indent=1)
+            os.replace(tmp, os.path.join(out_dir, "latest.json"))
+        except OSError as e:  # the final print still carries the full list
+            sys.stderr.write(f"bench_out/latest.json not written: {e}\n")
+        return all_metrics
+
     any_metric = False
     flagship_result: Optional[Tuple[str, str, str]] = None
     results: dict = {}
@@ -1494,31 +1535,16 @@ def orchestrate() -> None:
             flagship_result = result  # printed last, below
         else:
             any_metric |= report(name, *result)
+        all_metrics = write_artifact(results, parsed_by_name)
 
     # Canonical self-contained artifact (VERDICT r4 item 7): the driver's
     # recorded BENCH file keeps only the tail of stdout, so earlier configs'
-    # metrics used to survive only in prose.  Write the COMPLETE metric list
-    # to bench_out/latest.json and also print it as one schema-shaped line
-    # (with the full list under "metrics") right before the flagship, so a
-    # tail capture of the last two lines is still the whole run.
-    all_metrics = []
-    for name in names:  # print order, flagship last
-        if name in results:
-            all_metrics.extend(parsed_by_name[name][0])
+    # metrics used to survive only in prose.  The artifact was refreshed
+    # after every config above (all_metrics holds the final refresh); print
+    # the complete list as one schema-shaped line right before the
+    # flagship, so a tail capture of the last two lines is still the whole
+    # run.
     if all_metrics:  # a total-failure run must not leave a valid metric line
-        artifact = {
-            "schema": "ggrs_tpu bench full stream v1",
-            "time_unix": int(time.time()),
-            "configs_run": [n for n in names if n in results],
-            "metrics": all_metrics,
-        }
-        out_dir = os.path.join(os.path.dirname(here), "bench_out")
-        try:
-            os.makedirs(out_dir, exist_ok=True)
-            with open(os.path.join(out_dir, "latest.json"), "w") as f:
-                json.dump(artifact, f, indent=1)
-        except OSError as e:  # the print below still carries the full list
-            sys.stderr.write(f"bench_out/latest.json not written: {e}\n")
         print(
             json.dumps(
                 {
