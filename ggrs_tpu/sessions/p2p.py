@@ -261,50 +261,53 @@ class P2PSession(ThreadOwned, Generic[I, S, A]):
 
         self._check_wait_recommendation()
 
+        # hot-path locals: this method runs once per session-tick for every
+        # hosted session, and the attribute chains below dominated its own
+        # profile time
+        sync = self._sync_layer
+        local_inputs = self._local_inputs
+        connect_status = self.local_connect_status
+
         # register local inputs and send them
+        all_landed = True
         for handle in self._local_handles:
-            player_input = self._local_inputs[handle]
-            actual_frame = self._sync_layer.add_local_input(handle, player_input)
+            player_input = local_inputs[handle]
+            actual_frame = sync.add_local_input(handle, player_input)
             player_input.frame = actual_frame
             if actual_frame != NULL_FRAME:
-                self.local_connect_status[handle].last_frame = actual_frame
+                connect_status[handle].last_frame = actual_frame
+            else:
+                all_landed = False
 
-        if not any(pi.frame == NULL_FRAME for pi in self._local_inputs.values()):
-            if self._remote_endpoints:
-                # every remote endpoint carries the same local inputs: join
-                # the per-player payload once, push it to each endpoint
-                frame, payload = encode_local_inputs(
-                    self._config, self._local_inputs
-                )
-                for endpoint in self._remote_endpoints:
-                    endpoint.send_encoded_input(
-                        frame, payload, self.local_connect_status
-                    )
-                    endpoint.send_all_messages(self._socket)
+        if all_landed and self._remote_endpoints:
+            # every remote endpoint carries the same local inputs: join
+            # the per-player payload once, push it to each endpoint
+            frame, payload = encode_local_inputs(self._config, local_inputs)
+            socket = self._socket
+            for endpoint in self._remote_endpoints:
+                endpoint.send_encoded_input(frame, payload, connect_status)
+                endpoint.send_all_messages(socket)
 
         # advance decision
+        current = sync.current_frame
+        last_confirmed = sync.last_confirmed_frame
         if lockstep:
-            can_advance = (
-                self._sync_layer.last_confirmed_frame == self._sync_layer.current_frame
-            )
+            can_advance = last_confirmed == current
         else:
-            if self._sync_layer.last_confirmed_frame == NULL_FRAME:
-                frames_ahead = self._sync_layer.current_frame
-            else:
-                frames_ahead = (
-                    self._sync_layer.current_frame - self._sync_layer.last_confirmed_frame
-                )
+            frames_ahead = (
+                current if last_confirmed == NULL_FRAME
+                else current - last_confirmed
+            )
             can_advance = frames_ahead < self._max_prediction
 
         if can_advance:
-            inputs = self._sync_layer.synchronized_inputs(self.local_connect_status)
-            self._sync_layer.advance_frame()
-            self._local_inputs.clear()
+            inputs = sync.synchronized_inputs(connect_status)
+            sync.advance_frame()
+            local_inputs.clear()
             requests.append(AdvanceFrame(inputs=inputs))
         else:
             logger.debug(
-                "Prediction threshold reached, skipping on frame %d",
-                self._sync_layer.current_frame,
+                "Prediction threshold reached, skipping on frame %d", current
             )
 
         return requests
@@ -341,16 +344,22 @@ class P2PSession(ThreadOwned, Generic[I, S, A]):
             if endpoint.is_running():
                 endpoint.update_local_frame_advantage(current_frame)
 
+        # stage events before handling: _handle_event may disconnect
+        # endpoints, which must not perturb the poll iteration
+        connect_status = self.local_connect_status
         events: List = []
+        append = events.append
         for endpoint in self._all_endpoints:
-            for event in endpoint.poll(self.local_connect_status):
-                events.append((event, endpoint.handles, endpoint.peer_addr))
+            for event in endpoint.poll(connect_status):
+                append((event, endpoint.handles, endpoint.peer_addr))
 
+        handle_event = self._handle_event
         for event, handles, addr in events:
-            self._handle_event(event, handles, addr)
+            handle_event(event, handles, addr)
 
+        socket = self._socket
         for endpoint in self._all_endpoints:
-            endpoint.send_all_messages(self._socket)
+            endpoint.send_all_messages(socket)
 
     def disconnect_player(self, player_handle: PlayerHandle) -> None:
         """Disconnect a remote player (and everyone sharing their address)
