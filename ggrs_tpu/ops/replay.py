@@ -94,14 +94,6 @@ def _store_input(ring: DeviceStateRing, inputs: Any, frame: jax.Array, inp: Any)
     )
 
 
-def _read_input(ring: DeviceStateRing, inputs: Any, frame: jax.Array) -> Any:
-    i = ring.slot(frame)
-    return jax.tree_util.tree_map(
-        lambda buf: jax.lax.dynamic_index_in_dim(buf, i, axis=0, keepdims=False),
-        inputs,
-    )
-
-
 def build_replay_programs(
     advance: AdvanceFn,
     ring_length: int,
@@ -167,9 +159,18 @@ def build_replay_programs(
 
         loaded = ring.load(carry["ring"], frame - d)
 
-        def resim_step(st: Any, j: jax.Array) -> Tuple[Any, Tuple[Any, jax.Array]]:
-            f_j = frame - d + j  # frame whose input we consume
-            st = advance(st, _read_input(ring, inputs, f_j))
+        # pre-gather the window's d inputs in ONE (traced-index) gather per
+        # leaf instead of a dynamic gather per resim step inside the scan —
+        # every op removed from the scan body is d ops off the tick's
+        # critical path
+        window_frames = frame - d + jnp.arange(d, dtype=jnp.int32)
+        window_slots = ring.slot(window_frames)
+        window_inputs = jax.tree_util.tree_map(
+            lambda buf: buf[window_slots], inputs
+        )
+
+        def resim_step(st: Any, inp_j: Any) -> Tuple[Any, Tuple[Any, jax.Array]]:
+            st = advance(st, inp_j)
             cs = checksum(st)
             return st, (st, cs)
 
@@ -180,7 +181,7 @@ def build_replay_programs(
         st, (resim_states, resim_cs) = jax.lax.scan(
             resim_step,
             loaded,
-            jnp.arange(d, dtype=jnp.int32),
+            window_inputs,
             unroll=d if unroll_resim else 1,
         )
         saved_frames = frame - d + 1 + jnp.arange(d, dtype=jnp.int32)
