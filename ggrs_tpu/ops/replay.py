@@ -194,11 +194,9 @@ def build_replay_programs(
         # at check_distance=1, where the reference's scheme has nothing to
         # compare against.
         resim_frames = saved_frames
-        seen = jax.vmap(
-            lambda f: jax.lax.dynamic_index_in_dim(
-                carry["hist"], ring.slot(f), axis=0, keepdims=False
-            )
-        )(resim_frames)
+        # one vectorized gather over the window's history slots (the vmapped
+        # per-frame dynamic_index form cost one gather per resim frame)
+        seen = carry["hist"][ring.slot(resim_frames)]
         bad = jnp.any(resim_cs != seen, axis=1)
         mismatches = carry["mismatches"] + jnp.sum(bad, dtype=jnp.int32)
         first_bad = jnp.minimum(
