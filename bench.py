@@ -76,8 +76,8 @@ CONFIGS = {
     # on a quiet link but blew a 1200s budget during a 5-10x slowdown
     "ecs": ("run_ecs", 1800),
     "chipvm256": ("run_chipvm256", 1800),
-    "pallas_checksum": ("run_pallas_checksum", 900),
-    "spec_width": ("run_spec_width", 900),
+    "pallas_checksum": ("run_pallas_checksum", 1200),
+    "spec_width": ("run_spec_width", 1200),
     "batch_sweep": ("run_batch_sweep", 1800),
     # the sweep's biggest B validated on the virtual 8-device CPU mesh
     "batch_sweep_mesh": (
