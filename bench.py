@@ -58,6 +58,11 @@ REPEATS = 3  # timed passes per config; best-of counters tunnel drift
 # final line as the headline, so the flagship prints last); EXECUTION order
 # puts the flagship first so slow configs can't starve the headline of wall
 # clock — see orchestrate().
+#
+# The DEFAULT invocation runs only the COMPACT subset below (VERDICT r5
+# item 1: round 5's 15-config suite, worst-case budgets ~5.5 h, no longer
+# fit the driver's capture window and BENCH_r05 recorded rc:124 with an
+# empty tail).  GGRS_BENCH_FULL=1 restores the full suite.
 CONFIGS = {
     "host_cd2": ("run_host_cd2", 600),
     "host_datapath": ("run_host_datapath", 600),
@@ -89,12 +94,32 @@ CONFIGS = {
     "pool_capacity": ("run_pool_capacity", 1800),
     "soak": ("run_soak", 1500),
     "pool_capacity_cpu": (
-        "run_pool_capacity", 1500,
+        "run_pool_capacity", 1200,
         {"GGRS_BENCH_PLATFORM": "cpu",
          "GGRS_BENCH_METRIC_PREFIX": "cpubackend_"},
     ),
-    "flagship": ("run_flagship", 1200),
+    # the native session bank (one C++ crossing per pool tick for ALL
+    # sessions' protocol+sync mechanism): 4-peer tick vs the 0.25 ms target
+    # and the pooled capacity ramp, on the CPU-backend proxy (the
+    # direct-attached host-bound regime the capacity headline lives in)
+    "host_bank": (
+        "run_host_bank", 900,
+        {"GGRS_BENCH_PLATFORM": "cpu"},
+    ),
+    "flagship": ("run_flagship", 900),
 }
+
+# The default subset: sized so the driver's capture window always sees the
+# flagship line (printed the moment its child completes) and the capacity /
+# host-bank headlines, even in degraded-tunnel weather.
+COMPACT_CONFIGS = (
+    "host_cd2",
+    "host_bank",
+    "ecs",
+    "chipvm256",
+    "pool_capacity_cpu",
+    "flagship",
+)
 
 
 def _inputs(n: int, players: int, seed: int) -> np.ndarray:
@@ -541,24 +566,20 @@ def run_host_cd2() -> None:
          "resim_frames/sec", 1.0)
 
 
-def run_host_datapath() -> None:
-    """Host-tick microbench (VERDICT r3 item 3): four live P2P peers over
-    the in-memory net with trivial (host, no-device) request fulfillment —
-    pure session + endpoint-datapath cost, the number that bounds massed
-    hosting.  ``vs_baseline`` is round 3's recorded 1.17 ms/tick over the
-    measured value (>1 = faster than round 3's host path)."""
+def _four_peer_population():
+    """THE single definition of the 4-peer host-tick scenario (names, rng
+    seeds; inputs come from ``_four_peer_input``): yields
+    ``(builder, socket)`` per peer.  ``host_datapath`` and ``host_bank``
+    both consume it, so their numbers stay comparable."""
     import random as _random
 
     from ggrs_tpu.core import Local, Remote
     from ggrs_tpu.net import InMemoryNetwork
     from ggrs_tpu.sessions import SessionBuilder
 
-    R3_US_PER_TICK = 1170.0  # docs/DESIGN.md §9, BENCH_r03 era measurement
-
     P = 4
     net = InMemoryNetwork()
     names = [f"N{h}" for h in range(P)]
-    sessions = []
     for h in range(P):
         b = (
             SessionBuilder(boxgame_config())
@@ -568,16 +589,32 @@ def run_host_datapath() -> None:
         )
         for o in range(P):
             b = b.add_player(Local() if o == h else Remote(names[o]), o)
-        sessions.append(b.start_p2p_session(net.socket(names[h])))
+        yield b, net.socket(names[h])
 
-    state = [0] * P
+
+def _four_peer_input(i: int, h: int) -> int:
+    return (i * 7 + h) % 16
+
+
+def run_host_datapath() -> None:
+    """Host-tick microbench (VERDICT r3 item 3): four live P2P peers over
+    the in-memory net with trivial (host, no-device) request fulfillment —
+    pure session + endpoint-datapath cost, the number that bounds massed
+    hosting.  ``vs_baseline`` is round 3's recorded 1.17 ms/tick over the
+    measured value (>1 = faster than round 3's host path)."""
+    R3_US_PER_TICK = 1170.0  # docs/DESIGN.md §9, BENCH_r03 era measurement
+
+    sessions = [
+        b.start_p2p_session(sock) for b, sock in _four_peer_population()
+    ]
+    state = [0] * len(sessions)
 
     def drive(ticks, base):
         for i in range(base, base + ticks):
             for s in sessions:
                 s.poll_remote_clients()
             for h, s in enumerate(sessions):
-                s.add_local_input(h, (i * 7 + h) % 16)
+                s.add_local_input(h, _four_peer_input(i, h))
                 for r in s.advance_frame():
                     k = type(r).__name__
                     if k == "SaveGameState":
@@ -796,10 +833,11 @@ def run_pallas_checksum() -> None:
              0.0)
 
 
-def _build_matches(n_matches: int):
-    """n_matches 2-peer BoxGame matches over one in-memory net — the ONE
-    definition of the hosting benches' match population (names, rng seeds,
-    input schedules); pooled and per-session variants must not drift."""
+def _match_population(n_matches: int):
+    """THE single definition of the hosting benches' match population:
+    yields ``(builder, socket, schedule)`` per session — names, rng seeds,
+    and input schedules that every hosting variant (per-session, pooled,
+    host-bank) must share so their numbers stay comparable."""
     import random
 
     from ggrs_tpu.core import Local, Remote
@@ -807,7 +845,6 @@ def _build_matches(n_matches: int):
     from ggrs_tpu.sessions import SessionBuilder
 
     net = InMemoryNetwork()
-    sessions, schedules = [], []
     for m in range(n_matches):
         names = (f"A{m}", f"B{m}")
         for me in (0, 1):
@@ -818,10 +855,19 @@ def _build_matches(n_matches: int):
                 .add_player(Local(), me)
                 .add_player(Remote(names[1 - me]), 1 - me)
             )
-            sessions.append(b.start_p2p_session(net.socket(names[me])))
-            schedules.append(
-                lambda i, m=m, me=me: ((i + 2 * m + me) // (2 + m % 3)) % 16
+            yield (
+                b,
+                net.socket(names[me]),
+                lambda i, m=m, me=me: ((i + 2 * m + me) // (2 + m % 3)) % 16,
             )
+
+
+def _build_matches(n_matches: int):
+    """The per-session form of ``_match_population``: started P2PSessions."""
+    sessions, schedules = [], []
+    for b, sock, sched in _match_population(n_matches):
+        sessions.append(b.start_p2p_session(sock))
+        schedules.append(sched)
     return sessions, schedules
 
 
@@ -1389,6 +1435,190 @@ def run_flagship() -> None:
     )
 
 
+def _bank_matches_setup(n_matches: int):
+    """The host-bank form of ``_match_population``: the SAME builders /
+    sockets / schedules driven through ``parallel.HostSessionPool`` instead
+    of per-session P2PSessions, fulfilled by the same
+    ``BatchedRequestExecutor``."""
+    from ggrs_tpu.parallel import BatchedRequestExecutor, HostSessionPool
+
+    game = BoxGame(2)
+
+    def to_arr(pairs):
+        return np.asarray([p[0] for p in pairs], np.uint8)
+
+    host = HostSessionPool()
+    schedules = []
+    for b, sock, sched in _match_population(n_matches):
+        host.add_session(b, sock)
+        schedules.append(sched)
+    pool = BatchedRequestExecutor(
+        game.advance, game.init_state(), to_arr,
+        batch_size=len(host), ring_length=10, max_burst=9,
+        with_checksums=False,
+    )
+    pool.warmup(np.zeros((2,), np.uint8))
+    return host, schedules, pool
+
+
+def run_host_bank() -> None:
+    """The tentpole metric (VERDICT r5 item 2): the native session bank —
+    every pooled session's protocol+sync mechanism in ONE C++ crossing per
+    pool tick.
+
+    Two measurements, both on the CPU-backend proxy (µs dispatch — the
+    host-bound regime the capacity headline lives in):
+
+    1. The 4-peer host tick vs the twice-missed ≤0.25 ms round-4 target
+       (``vs_baseline`` = 250 µs / measured; >1 = target met), with the
+       per-session Python path's tick in the unit string for attribution.
+    2. The pooled-capacity ramp: largest match count whose p99 strict-fence
+       tick fits the 16.7 ms frame budget, host fraction named per step.
+    """
+    from ggrs_tpu.parallel import HostSessionPool
+
+    # ---- 1. the 4-peer tick (host_datapath's EXACT scenario, via
+    # _four_peer_population, bank-driven vs per-session) ----
+    def four_peer_tick_us(use_bank: bool) -> float:
+        builders = list(_four_peer_population())
+        P = len(builders)
+        state = [0] * P
+        if use_bank:
+            host = HostSessionPool()
+            for b, s in builders:
+                host.add_session(b, s)
+            if not host.native_active:
+                # never present the Python fallback as the native-bank
+                # headline (e.g. GGRS_TPU_NO_NATIVE set): the caller skips
+                return None
+
+            def drive(ticks, base):
+                for i in range(base, base + ticks):
+                    for h in range(P):
+                        host.add_local_input(h, h, _four_peer_input(i, h))
+                    for h, reqs in enumerate(host.advance_all()):
+                        for r in reqs:
+                            k = type(r).__name__
+                            if k == "SaveGameState":
+                                r.cell.save(r.frame, state[h], None)
+                            elif k == "LoadGameState":
+                                state[h] = r.cell.data()
+        else:
+            sessions = [b.start_p2p_session(s) for b, s in builders]
+
+            def drive(ticks, base):
+                for i in range(base, base + ticks):
+                    for s in sessions:
+                        s.poll_remote_clients()
+                    for h, s in enumerate(sessions):
+                        s.add_local_input(h, _four_peer_input(i, h))
+                        for r in s.advance_frame():
+                            k = type(r).__name__
+                            if k == "SaveGameState":
+                                r.cell.save(r.frame, state[h], None)
+                            elif k == "LoadGameState":
+                                state[h] = r.cell.data()
+
+        drive(200, 0)
+        n, base = 2000, 200
+        best = float("inf")
+        for _ in range(REPEATS):
+            t0 = time.perf_counter()
+            drive(n, base)
+            best = min(best, (time.perf_counter() - t0) / n * 1e6)
+            base += n
+        return best
+
+    from ggrs_tpu.net import _native
+
+    # env check FIRST: bank_lib() would g++-build the library the user
+    # explicitly disabled, only to skip
+    if os.environ.get("GGRS_TPU_NO_NATIVE") or _native.bank_lib() is None:
+        print("# skip: host_bank needs the native toolchain", flush=True)
+        return
+
+    bank_us = four_peer_tick_us(use_bank=True)
+    if bank_us is None:  # the pool silently fell back: not a native number
+        print("# skip: host_bank pool did not engage the native bank",
+              flush=True)
+        return
+    py_us = four_peer_tick_us(use_bank=False)
+    emit(
+        "host_bank_p2p4_tick_us", bank_us,
+        f"us/tick (target 250; per-session python path {py_us:.0f} us, "
+        f"{py_us / bank_us:.1f}x)",
+        250.0 / bank_us if bank_us else 0.0,
+    )
+
+    # ---- 2. capacity ramp with one-crossing host + one-dispatch device ----
+    frame_budget_ms = 1000.0 / 60.0
+    T = 300
+    max_ok = 0
+    knee = None
+    for B in (64, 128, 256, 512):
+        host, schedules, pool = _bank_matches_setup(B)
+        n = len(host)
+        tick_counter = [0]
+
+        def tick():
+            i = tick_counter[0]
+            tick_counter[0] = i + 1
+            t0 = time.perf_counter()
+            for h in range(n):
+                host.add_local_input(h, h % 2, schedules[h](i))
+            reqs = host.advance_all()
+            t1 = time.perf_counter()
+            pool.run(reqs)
+            pool.block_until_ready()
+            t2 = time.perf_counter()
+            return (t1 - t0) * 1e3, (t2 - t1) * 1e3
+
+        for _ in range(16):
+            tick()
+        enter_honest_timing_mode()
+        best = None
+        for _ in range(REPEATS):
+            host_ms = np.empty(T)
+            dev_ms = np.empty(T)
+            for i in range(T):
+                host_ms[i], dev_ms[i] = tick()
+            total = host_ms + dev_ms
+            p50 = float(np.percentile(total, 50))
+            p99 = float(np.percentile(total, 99))
+            host_frac = float(np.median(host_ms / total))
+            if best is None or p99 < best[1]:
+                best = (p50, p99, host_frac)
+        p50, p99, host_frac = best
+        emit(
+            f"host_bank_capacity_b{B}_tick_ms_p99", p99,
+            f"ms/tick p99, strict fence, one host crossing + one dispatch "
+            f"(p50 {p50:.2f} ms, host fraction {host_frac:.2f}, native "
+            f"{'on' if host.native_active else 'OFF'})",
+            frame_budget_ms / p99,
+        )
+        if p99 <= frame_budget_ms:
+            max_ok = B
+        else:
+            knee = (B, host_frac)
+        del host, schedules, pool
+        if knee is not None:
+            break
+    regime = ""
+    if knee is not None:
+        b_knee, host_frac = knee
+        regime = (
+            f"; knee at B={b_knee}, limiting regime "
+            f"{'host bookkeeping' if host_frac > 0.5 else 'device fulfillment+fence'}"
+            f" ({host_frac:.0%} host)"
+        )
+    emit(
+        "host_bank_max_60hz_matches_per_chip", float(max_ok),
+        f"matches (2 sessions each) with p99 tick <= 16.7 ms, strict fence, "
+        f"native session bank{regime}",
+        1.0,
+    )
+
+
 # ---------------------------------------------------------------------------
 # orchestrator
 # ---------------------------------------------------------------------------
@@ -1422,26 +1652,31 @@ def _forward_child_lines(name: str, parsed: list, skipped: bool) -> bool:
 
 
 def orchestrate() -> None:
-    """Run every config in its own subprocess; the flagship's line prints
-    LAST (the driver reads the final line as the headline) but its child runs
-    FIRST — so a day of slow/degraded configs can't starve the headline
-    measurement of wall-clock budget.
+    """Run each selected config in its own subprocess.  The flagship child
+    runs FIRST and its metric lines are printed THE MOMENT it completes
+    (VERDICT r5 item 1: a driver capture window must never close on an
+    empty stream), then re-printed at the end so the final line stays the
+    headline.  The default selection is the COMPACT subset; GGRS_BENCH_FULL=1
+    restores the full suite.
     A child that dies or times out costs its own line only.  Exits nonzero
     if NO config produced a metric (total failure must not read as a clean
     run to a driver that records the exit status)."""
     here = os.path.abspath(__file__)
-    names = list(CONFIGS)
+    if os.environ.get("GGRS_BENCH_FULL"):
+        names = list(CONFIGS)
+    else:
+        names = [n for n in CONFIGS if n in COMPACT_CONFIGS]
     only = os.environ.get("GGRS_BENCH_ONLY")
     if only:  # comma-separated subset, e.g. GGRS_BENCH_ONLY=flagship,ecs
         sel = {s.strip() for s in only.split(",") if s.strip()}
-        unknown = sel - set(names)
+        unknown = sel - set(CONFIGS)  # any config selectable, not just compact
         if unknown or not sel:
             sys.stderr.write(
                 f"GGRS_BENCH_ONLY: unknown configs {unknown or only!r}; "
-                f"one of {names}\n"
+                f"one of {list(CONFIGS)}\n"
             )
             raise SystemExit(2)
-        names = [n for n in names if n in sel]
+        names = [n for n in CONFIGS if n in sel]
     run_order = (["flagship"] if "flagship" in names else []) + [
         n for n in names if n != "flagship"
     ]
@@ -1543,10 +1778,14 @@ def orchestrate() -> None:
         result = run_child(name)
         results[name] = result
         parsed_by_name[name] = _parse_child_lines(result[0])
+        # EVERY config (the flagship included) reports the moment its child
+        # completes: a driver that kills the orchestrator mid-run, or whose
+        # capture window closes early, still has the headline on stdout.
+        # The flagship's lines are re-printed at the very end so the final
+        # line keeps its headline semantics.
         if name == "flagship":
-            flagship_result = result  # printed last, below
-        else:
-            any_metric |= report(name, *result)
+            flagship_result = result
+        any_metric |= report(name, *result)
         all_metrics = write_artifact(results, parsed_by_name)
 
     # Canonical self-contained artifact (VERDICT r4 item 7): the driver's
@@ -1572,7 +1811,8 @@ def orchestrate() -> None:
         )
 
     if flagship_result is not None:
-        any_metric |= report("flagship", *flagship_result)
+        # re-print (no duplicate stderr note): the last line is the headline
+        _forward_child_lines("flagship", *parsed_by_name["flagship"])
     if not any_metric:
         raise SystemExit(1)
 

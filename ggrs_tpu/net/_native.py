@@ -17,6 +17,7 @@ import os
 import subprocess
 import sys
 import threading
+import time
 from pathlib import Path
 from typing import List, Optional, Sequence
 
@@ -105,6 +106,7 @@ def _sources() -> List[Path]:
         _native_dir() / "codec.cpp",
         _native_dir() / "endpoint.cpp",
         _native_dir() / "sync_core.cpp",
+        _native_dir() / "session_bank.cpp",
     ]
 
 
@@ -131,10 +133,18 @@ def _build(lib_path: Path) -> bool:
     if not all(s.exists() for s in srcs):
         return False
     # Sweep temps orphaned by hard-killed builds (different pid → never
-    # reused); safe under the module _lock plus pid-uniqueness.
+    # reused).  Age-gated to the 120 s build timeout: a fresh temp from a
+    # CONCURRENTLY-building process must survive — unlinking it mid-write
+    # would cost that process its native fast paths for its whole lifetime.
+    cutoff = time.time() - 120
     for stale in lib_path.parent.glob(f"{lib_path.name}.build.*"):
-        if stale.name != f"{lib_path.name}.build.{os.getpid()}":
-            stale.unlink(missing_ok=True)
+        if stale.name == f"{lib_path.name}.build.{os.getpid()}":
+            continue
+        try:
+            if stale.stat().st_mtime < cutoff:
+                stale.unlink(missing_ok=True)
+        except OSError:
+            pass  # raced with the owning process: leave it alone
     tmp = lib_path.with_name(f"{lib_path.name}.build.{os.getpid()}")
     cmd = [
         "g++",
@@ -338,6 +348,37 @@ def _load() -> Optional[ctypes.CDLL]:
             lib.ggrs_sync_confirmed_input.argtypes = [
                 ctypes.c_void_p, ctypes.c_int, ctypes.c_int64, ctypes.c_char_p,
             ]
+        # ---- session bank (native/session_bank.cpp) ----
+        if hasattr(lib, "ggrs_bank_new"):
+            lib.ggrs_bank_new.restype = ctypes.c_void_p
+            lib.ggrs_bank_new.argtypes = []
+            lib.ggrs_bank_free.restype = None
+            lib.ggrs_bank_free.argtypes = [ctypes.c_void_p]
+            lib.ggrs_bank_add_session.restype = ctypes.c_int64
+            lib.ggrs_bank_add_session.argtypes = [
+                ctypes.c_void_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.c_int, ctypes.c_int64, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int32), ctypes.c_int, ctypes.c_int,
+            ]
+            lib.ggrs_bank_add_endpoint.restype = ctypes.c_int64
+            lib.ggrs_bank_add_endpoint.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint16,
+                ctypes.POINTER(ctypes.c_int32), ctypes.c_int, ctypes.c_int64,
+            ]
+            lib.ggrs_bank_tick.restype = ctypes.c_int
+            lib.ggrs_bank_tick.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_char_p, ctypes.c_size_t,
+                ctypes.c_char_p, ctypes.c_size_t,
+                ctypes.POINTER(ctypes.c_size_t),
+            ]
+            lib.ggrs_bank_fetch_out.restype = ctypes.c_int
+            lib.ggrs_bank_fetch_out.argtypes = [
+                ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+                ctypes.POINTER(ctypes.c_size_t),
+            ]
+            lib.ggrs_bank_session_count.restype = ctypes.c_int64
+            lib.ggrs_bank_session_count.argtypes = [ctypes.c_void_p]
         _lib = lib
         return _lib
 
@@ -358,6 +399,18 @@ SYNC_ERR_NON_SEQUENTIAL = -43
 SYNC_ERR_CONFIRM_PAST_INCORRECT = -44
 SYNC_ERR_BAD_ARGS = -45
 
+# session-bank return codes (mirror native/session_bank.cpp; the buffer
+# code is wire_common.h's kErrBufferTooSmall, shared with the codec)
+BANK_ERR_BUFFER_TOO_SMALL = -11
+BANK_OK = 0
+BANK_ERR_CMD = -60
+BANK_ERR_LANDED_SPLIT = -70
+BANK_ERR_SYNC = -71
+BANK_ERR_SYNC_INPUTS = -72
+BANK_ERR_CONFIRM = -73
+BANK_ERR_NO_PLAYERS = -74
+BANK_ERR_SEQUENCE = -75
+
 
 def sync_lib() -> Optional[ctypes.CDLL]:
     """The loaded library for the native sync core, or None (use the Python
@@ -370,6 +423,16 @@ def sync_lib() -> Optional[ctypes.CDLL]:
 
 def available() -> bool:
     return _load() is not None
+
+
+def bank_lib() -> Optional[ctypes.CDLL]:
+    """The loaded library for the native session bank, or None (drive the
+    per-session Python sessions).  Same load/fallback policy as the other
+    fast paths; a prebuilt pre-bank library keeps its older fast paths."""
+    lib = _load()
+    if lib is None or not hasattr(lib, "ggrs_bank_new"):
+        return None
+    return lib
 
 
 def endpoint_lib() -> Optional[ctypes.CDLL]:

@@ -144,7 +144,11 @@ class InMemoryNetwork:
         q.append((deliver_at, from_addr, payload))
         if self._rng.random() < self.duplicate:
             q.append((deliver_at, from_addr, payload))
-        if len(q) >= 2 and self._rng.random() < self.reorder:
+        # the reorder random is drawn UNCONDITIONALLY so the rng stream is a
+        # pure function of the send sequence: whether a receiver has drained
+        # its queue yet (which varies between per-session and pooled drivers
+        # with identical sends) must not perturb the fault pattern
+        if self._rng.random() < self.reorder and len(q) >= 2:
             q[-1], q[-2] = q[-2], q[-1]
 
     @property

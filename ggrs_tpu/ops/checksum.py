@@ -172,7 +172,12 @@ def checksum_device(state: Any) -> jax.Array:
     dominate when leaves are a few words each).
     """
     leaves = [jnp.asarray(l) for l in jax.tree_util.tree_leaves(state)]
-    salt = jnp.asarray(_structure_salt(leaves) if leaves else _INIT_LANES)
+    # dtype must be explicit: _INIT_LANES holds ints above int32 max, and
+    # jnp.asarray's int32 default turns the empty-pytree path into an
+    # OverflowError (ADVICE r5)
+    salt = jnp.asarray(
+        _structure_salt(leaves) if leaves else _INIT_LANES, jnp.uint32
+    )
     if not leaves:
         return salt
     lanes = _digest_words([_as_u32_words(l) for l in leaves])

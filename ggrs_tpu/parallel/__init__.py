@@ -11,7 +11,9 @@ design, not ports:
   device mesh with ICI collectives for global health counters (``batch``),
   plus massed request fulfillment for LIVE heterogeneous sessions — B
   networked sessions' per-tick request lists executed as one predicated
-  device program (``session_pool``);
+  device program (``session_pool``), with the HOST half of the same tick —
+  protocol + sync mechanism for all B sessions — stepped in one native
+  crossing (``host_bank``; ``HostedPool`` pairs the two);
 - **player/entity** — vectorization inside one state pytree (the games do
   this by construction, e.g. BoxGame's (P, ...) arrays).
 """
@@ -26,10 +28,13 @@ from .batch import (
     make_mesh,
     make_mesh2d,
 )
-from .session_pool import BatchedRequestExecutor
+from .session_pool import BatchedRequestExecutor, HostedPool
+from .host_bank import HostSessionPool
 
 __all__ = [
     "BatchedRequestExecutor",
+    "HostSessionPool",
+    "HostedPool",
     "BatchedSessions",
     "HOST_AXIS",
     "SESSION_AXIS",

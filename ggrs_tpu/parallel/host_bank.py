@@ -1,0 +1,667 @@
+"""Host session pool: step B P2P sessions' per-tick protocol + sync
+mechanism in ONE ctypes crossing per pool tick.
+
+The round-5 capacity knee was ~90% host bookkeeping, and the per-operation
+native cores measured perf-neutral because ~200 ctypes crossings per
+session-tick hand back what the C++ saves (docs/ROUND5.md §4).  This module
+is the located fix: ``HostSessionPool`` drives every pooled session's tick —
+input enqueue, prediction/confirmation watermarks, endpoint timers, ack
+trim, outbound InputMessage assembly — through ``native/session_bank.cpp``
+off a single packed command buffer per tick.
+
+POLICY STAYS HERE, in Python: GgrsEvent emission, the disconnect consensus
+(:meth:`P2PSession._update_player_disconnects` semantics, applied as next
+tick's control ops), wait-recommendation pacing, and the construction of the
+``GgrsRequest`` lists the game fulfills.  The request grammar and the public
+per-session observables (``current_frame``, ``last_confirmed_frame``,
+``events``, landed frames) are unchanged from ``sessions/p2p.py``.
+
+FALLBACK: when the native library is unavailable (``GGRS_TPU_NO_NATIVE``,
+no toolchain) or any session's shape is outside the bank's mechanism
+(sparse saving, lockstep, spectators, desync detection, handshake,
+variable-size inputs), the pool transparently drives ordinary per-session
+``P2PSession`` objects — the untouched semantic reference.  Parity between
+the two paths is pinned by tests/test_session_bank.py: bit-identical wire
+bytes, frames, and events under seeded loss/dup/reorder traffic.
+
+Known one-tick-late behaviors on the native path (documented divergence,
+exercised only in disconnect scenarios; the fallback is exact): reactions
+to ``Disconnected`` protocol events and disconnect-consensus adjustments
+are computed from this tick's mirrors and applied as next tick's control
+ops.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import random
+import struct
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..core.errors import InvalidRequest
+from ..core.sync_layer import SavedStates
+from ..core.types import (
+    AdvanceFrame,
+    Disconnected,
+    Frame,
+    GgrsRequest,
+    InputStatus,
+    LoadGameState,
+    NetworkInterrupted,
+    NetworkResumed,
+    NULL_FRAME,
+    SaveGameState,
+    WaitRecommendation,
+)
+from ..net import _native
+from ..net.messages import RawMessage
+from ..net.protocol import MAX_CHECKSUM_HISTORY_SIZE
+from ..sessions.p2p import (
+    MAX_EVENT_QUEUE_SIZE,
+    MIN_RECOMMENDATION,
+    RECOMMENDATION_INTERVAL,
+)
+
+_STATUS = (
+    InputStatus.CONFIRMED,
+    InputStatus.PREDICTED,
+    InputStatus.DISCONNECTED,
+)
+
+# bank event kinds (session_bank.cpp EvKind)
+_EV_INTERRUPTED = 1
+_EV_RESUMED = 2
+_EV_DISCONNECTED = 3
+_EV_CHECKSUM = 4
+
+# receive staging caps shared with NativeEndpointCore: a session whose
+# worst-case input packet could overflow them must stay on the fallback
+# (the bank drops cap-exceeding packets instead of re-decoding in Python)
+_RECV_CAP_BYTES = 1 << 16
+_RECV_CAP_FRAMES = 512
+_WORST_CASE_FRAMES = 192  # 128-deep pending window with generous slack
+
+
+def _uvarint_len(v: int) -> int:
+    n = 1
+    while v >= 0x80:
+        v >>= 7
+        n += 1
+    return n
+
+
+def _bank_eligible(builder) -> bool:
+    """Can this builder's session run on the native bank mechanism?  The
+    checks mirror the bank's scope; anything outside it uses the Python
+    sessions (identical semantics, per-session cost)."""
+    cfg = builder._config
+    from ..core.sync_layer import _native_sync_semantics_ok
+    from ..core.types import Spectator
+
+    if not _native_sync_semantics_ok(cfg):
+        return False
+    if builder._sparse_saving or builder._max_prediction < 1:
+        return False  # sparse saving / lockstep: fallback policy paths
+    if builder._desync_detection.enabled or builder._sync_handshake:
+        return False
+    if builder._local_players < 1 or builder._num_players > 64:
+        return False
+    if any(
+        isinstance(t, Spectator) for t in builder._player_reg.handles.values()
+    ):
+        return False
+    # worst-case packet must fit the native staging caps
+    size = cfg.native_input_size
+    per_frame = builder._num_players * (size + _uvarint_len(size))
+    if _WORST_CASE_FRAMES * per_frame > _RECV_CAP_BYTES:
+        return False
+    if _WORST_CASE_FRAMES > _RECV_CAP_FRAMES:
+        return False
+    return True
+
+
+class _EndpointMirror:
+    """Python-side view of one bank endpoint: identity plus the state the
+    consensus / event policy reads."""
+
+    __slots__ = (
+        "addr", "handles", "magic", "running",
+        "peer_disc", "peer_last", "pending_checksums",
+    )
+
+    def __init__(self, addr, handles: List[int], magic: int, players: int):
+        self.addr = addr
+        self.handles = handles
+        self.magic = magic
+        self.running = True
+        self.peer_disc = [False] * players
+        self.peer_last = [NULL_FRAME] * players
+        self.pending_checksums: Dict[Frame, int] = {}
+
+
+class _SessionMirror:
+    """Python-side policy state for one bank session."""
+
+    __slots__ = (
+        "config", "socket", "num_players", "max_prediction", "input_size",
+        "local_handles", "local_handle_set", "endpoints", "addr_to_ep",
+        "saved_states", "current_frame", "last_confirmed", "frames_ahead",
+        "local_disc", "local_last", "event_queue", "next_recommended_sleep",
+        "staged_inputs", "pending_ctrl",
+    )
+
+    def __init__(self, config, socket, num_players, max_prediction,
+                 local_handles):
+        self.config = config
+        self.socket = socket
+        self.num_players = num_players
+        self.max_prediction = max_prediction
+        self.input_size = config.native_input_size
+        self.local_handles = local_handles
+        self.local_handle_set = set(local_handles)
+        self.endpoints: List[_EndpointMirror] = []
+        self.addr_to_ep: Dict[Any, int] = {}
+        self.saved_states = SavedStates(max_prediction)
+        self.current_frame: Frame = 0
+        self.last_confirmed: Frame = NULL_FRAME
+        self.frames_ahead = 0
+        self.local_disc = [False] * num_players
+        self.local_last = [NULL_FRAME] * num_players
+        self.event_queue: deque = deque()
+        self.next_recommended_sleep: Frame = 0
+        self.staged_inputs: Dict[int, bytes] = {}
+        self.pending_ctrl: List[Tuple[int, int, Frame]] = []
+
+    def push_event(self, event) -> None:
+        self.event_queue.append(event)
+        while len(self.event_queue) > MAX_EVENT_QUEUE_SIZE:
+            self.event_queue.popleft()
+
+
+class HostSessionPool:
+    """B pooled host sessions, one mechanism crossing per tick.
+
+    Usage (single-threaded, like every session object)::
+
+        pool = HostSessionPool()
+        for builder, socket in matches:
+            pool.add_session(builder, socket)
+        ...
+        pool.add_local_input(i, handle, value)     # per session, per tick
+        request_lists = pool.advance_all()          # ONE native crossing
+        events = pool.events(i)
+
+    ``request_lists[i]`` follows the exact ``GgrsRequest`` grammar of
+    ``P2PSession.advance_frame``; feed it to any executor, including
+    ``parallel.BatchedRequestExecutor`` (see ``parallel.HostedPool``).
+
+    On the native path all sessions' timers run off ONE clock read per tick
+    (builder 0's clock): pooled sessions must share a timebase.  Builders
+    whose clocks read visibly apart at finalize fall back to per-session
+    Python sessions, where each honors its own clock.
+    """
+
+    def __init__(self) -> None:
+        self._builders: List[Tuple[Any, Any]] = []
+        self._finalized = False
+        self._native_active = False
+        self._bank = None
+        self._lib = None
+        self._mirrors: List[_SessionMirror] = []
+        self._sessions: List[Any] = []  # fallback P2PSessions
+        self._clock = None
+        self._out_buf: Optional[ctypes.Array] = None
+        self._out_len = ctypes.c_size_t(0)
+        self._invalid: Optional[str] = None
+        self.crossings = 0  # ggrs_bank_tick invocations (the count test)
+
+    # ------------------------------------------------------------------
+    # construction
+    # ------------------------------------------------------------------
+
+    def add_session(self, builder, socket) -> int:
+        """Register one session described by a fully-populated
+        ``SessionBuilder`` plus its socket.  Returns the session index."""
+        if self._finalized:
+            raise InvalidRequest("pool already finalized; add sessions first")
+        self._builders.append((builder, socket))
+        return len(self._builders) - 1
+
+    def _finalize(self) -> None:
+        self._finalized = True
+        lib = None if os.environ.get("GGRS_TPU_NO_NATIVE") else (
+            _native.bank_lib()
+        )
+        # The bank runs every session's timers off ONE clock read per tick
+        # (builder 0's clock) — that is the pool's contract.  Builders whose
+        # clocks are visibly on a different timebase (a frozen test clock
+        # pooled with a real one reads hours apart) stay on the per-session
+        # fallback, where each session honors its own clock.  Distinct
+        # callables over the same timebase (per-builder lambdas reading one
+        # counter) read within the tolerance and pool fine.
+        def same_timebase() -> bool:
+            if not self._builders:
+                return False
+            first = self._builders[0][0]._clock
+            t0 = first()
+            for b, _ in self._builders:
+                if b._clock is first:
+                    continue
+                if abs(b._clock() - t0) > 100:
+                    return False
+            return True
+
+        eligible = lib is not None and same_timebase() and all(
+            _bank_eligible(b) and hasattr(s, "receive_all_datagrams")
+            for b, s in self._builders
+        )
+        if not eligible:
+            for builder, socket in self._builders:
+                self._sessions.append(builder.start_p2p_session(socket))
+            return
+
+        self._lib = lib
+        self._bank = lib.ggrs_bank_new()
+        if not self._bank:
+            raise MemoryError("ggrs_bank_new failed")
+        self._native_active = True
+        from ..core.types import Remote
+
+        for builder, socket in self._builders:
+            cfg = builder._config
+            # builder-level validation parity (start_p2p_session's checks)
+            for handle in range(builder._num_players):
+                if handle not in builder._player_reg.handles:
+                    raise InvalidRequest(
+                        "Not enough players have been added. Keep registering "
+                        "players up to the defined player number."
+                    )
+            local_handles = sorted(
+                h for h, t in builder._player_reg.handles.items()
+                if not isinstance(t, Remote)
+            )
+            arr = (ctypes.c_int32 * max(1, len(local_handles)))(*local_handles)
+            idx = lib.ggrs_bank_add_session(
+                self._bank, builder._num_players, cfg.native_input_size,
+                builder._max_prediction, builder._fps,
+                builder._disconnect_timeout_ms,
+                builder._disconnect_notify_start_ms,
+                arr, len(local_handles), builder._input_delay,
+            )
+            if idx < 0:
+                raise RuntimeError(f"ggrs_bank_add_session failed: {idx}")
+            mirror = _SessionMirror(
+                cfg, socket, builder._num_players, builder._max_prediction,
+                local_handles,
+            )
+            # endpoints: same address grouping, iteration order, and magic
+            # draws as start_p2p_session -> PeerProtocol.__init__, so the
+            # wire bytes (magic included) match the fallback bit-for-bit
+            remote_by_addr: Dict[Any, List[int]] = {}
+            for handle, ptype in builder._player_reg.handles.items():
+                if isinstance(ptype, Remote):
+                    remote_by_addr.setdefault(ptype.addr, []).append(handle)
+            now = builder._clock()
+            for addr, handles in remote_by_addr.items():
+                rng = builder._rng if builder._rng is not None else (
+                    random.Random()
+                )
+                magic = 0
+                while magic == 0:
+                    magic = rng.randrange(0, 1 << 16)
+                handles = sorted(handles)
+                harr = (ctypes.c_int32 * len(handles))(*handles)
+                ep_idx = lib.ggrs_bank_add_endpoint(
+                    self._bank, idx, magic, harr, len(handles), now
+                )
+                if ep_idx < 0:
+                    raise RuntimeError(
+                        f"ggrs_bank_add_endpoint failed: {ep_idx}"
+                    )
+                mirror.addr_to_ep[addr] = int(ep_idx)
+                mirror.endpoints.append(
+                    _EndpointMirror(addr, handles, magic,
+                                    builder._num_players)
+                )
+            self._mirrors.append(mirror)
+        self._clock = self._builders[0][0]._clock
+        # output buffer sized to the worst realistic tick (rollback resim
+        # descriptors + a full outbound volley per endpoint), grown never:
+        # a too-small buffer poisons the pool loudly instead
+        per_session = 0
+        for m in self._mirrors:
+            adv_bytes = m.num_players * (1 + m.input_size)
+            per_session = max(
+                per_session,
+                4096
+                + (m.max_prediction + 4) * (16 + adv_bytes)
+                + len(m.endpoints) * (2048 + 32 * m.num_players),
+            )
+        self._out_buf = ctypes.create_string_buffer(
+            max(1 << 16, per_session * len(self._mirrors))
+        )
+
+    # ------------------------------------------------------------------
+    # per-tick API
+    # ------------------------------------------------------------------
+
+    @property
+    def native_active(self) -> bool:
+        if not self._finalized:
+            self._finalize()
+        return self._native_active
+
+    def __len__(self) -> int:
+        return len(self._builders)
+
+    def add_local_input(self, index: int, handle: int, value) -> None:
+        if not self._finalized:
+            self._finalize()
+        if not self._native_active:
+            self._sessions[index].add_local_input(handle, value)
+            return
+        m = self._mirrors[index]
+        if handle not in m.local_handle_set:
+            raise InvalidRequest(
+                "The player handle you provided is not referring to a local "
+                "player."
+            )
+        m.staged_inputs[handle] = m.config.input_encode(value)
+
+    def advance_all(self) -> List[List[GgrsRequest]]:
+        """Run every session's tick (poll + advance); returns the B request
+        lists in session order.  Native path: exactly one ctypes crossing."""
+        if not self._finalized:
+            self._finalize()
+        if not self._native_active:
+            return [s.advance_frame() for s in self._sessions]
+        self._check_valid()
+
+        pack = struct.pack
+        # validate EVERY session's staged inputs before any destructive step
+        # (ctrl-op swap, socket drain): raising mid-build would silently lose
+        # pending disconnect ops and drained datagrams on a caller retry
+        for m in self._mirrors:
+            for handle in m.local_handles:
+                if handle not in m.staged_inputs:
+                    raise InvalidRequest(
+                        f"Missing local input for handle {handle} while "
+                        "calling advance_frame()."
+                    )
+        cmd_parts: List[bytes] = []
+        for m in self._mirrors:
+            cmd_parts.append(b"\x01")
+            cmd_parts.extend(m.staged_inputs[h] for h in m.local_handles)
+            ctrl = m.pending_ctrl
+            m.pending_ctrl = []
+            cmd_parts.append(pack("<H", len(ctrl)))
+            for op, ep_idx, frame in ctrl:
+                cmd_parts.append(pack("<BHq", op, ep_idx, frame))
+            datagrams = []
+            for from_addr, data in m.socket.receive_all_datagrams():
+                ep_idx = m.addr_to_ep.get(from_addr)
+                if ep_idx is not None:
+                    datagrams.append((ep_idx, data))
+            cmd_parts.append(pack("<H", len(datagrams)))
+            for ep_idx, data in datagrams:
+                cmd_parts.append(pack("<HI", ep_idx, len(data)))
+                cmd_parts.append(data)
+        cmd = b"".join(cmd_parts)
+
+        self.crossings += 1
+        rc = self._lib.ggrs_bank_tick(
+            self._bank, self._clock(), cmd, len(cmd),
+            self._out_buf, len(self._out_buf), ctypes.byref(self._out_len),
+        )
+        if rc == _native.BANK_ERR_BUFFER_TOO_SMALL:
+            # kErrBufferTooSmall: the tick RAN and its output is
+            # retained natively — grow and fetch (the one case that costs a
+            # second crossing, e.g. a stalled peer's whole-window volley)
+            self._out_buf = ctypes.create_string_buffer(
+                max(self._out_len.value, 2 * len(self._out_buf))
+            )
+            rc = self._lib.ggrs_bank_fetch_out(
+                self._bank, self._out_buf, len(self._out_buf),
+                ctypes.byref(self._out_len),
+            )
+        if rc != 0:
+            self._invalid = f"ggrs_bank_tick failed: {rc}"
+            if rc in (_native.BANK_ERR_SYNC, _native.BANK_ERR_CONFIRM,
+                      _native.BANK_ERR_SEQUENCE, _native.BANK_ERR_SYNC_INPUTS,
+                      _native.BANK_ERR_LANDED_SPLIT):
+                # the Python path fails these as AssertionErrors; match it
+                raise AssertionError(self._invalid)
+            raise RuntimeError(self._invalid)
+        return self._parse_output()
+
+    def _parse_output(self) -> List[List[GgrsRequest]]:
+        buf = memoryview(self._out_buf).cast("B")[: self._out_len.value]
+        unpack_from = struct.unpack_from
+        pos = 0
+        request_lists: List[List[GgrsRequest]] = []
+        for m in self._mirrors:
+            players, isize = m.num_players, m.input_size
+            landed, frames_ahead, current, confirmed, consensus, n_ops = (
+                unpack_from("<qiqqBH", buf, pos)
+            )
+            pos += 31
+            requests: List[GgrsRequest] = []
+            advanced = False
+            decode = m.config.input_decode
+            for _ in range(n_ops):
+                kind = buf[pos]
+                pos += 1
+                if kind == 2:
+                    statuses = bytes(buf[pos : pos + players])
+                    pos += players
+                    blob = bytes(buf[pos : pos + players * isize])
+                    pos += players * isize
+                    requests.append(AdvanceFrame(inputs=[
+                        (decode(blob[p * isize : (p + 1) * isize]),
+                         _STATUS[statuses[p]])
+                        for p in range(players)
+                    ]))
+                    advanced = True
+                else:
+                    (frame,) = unpack_from("<q", buf, pos)
+                    pos += 8
+                    cell = m.saved_states.get_cell(frame)
+                    if kind == 0:
+                        requests.append(SaveGameState(cell=cell, frame=frame))
+                        advanced = False
+                    else:
+                        assert cell.frame == frame, (
+                            f"rollback loads frame {frame} but its cell "
+                            f"holds {cell.frame} — was the save fulfilled?"
+                        )
+                        requests.append(LoadGameState(cell=cell, frame=frame))
+                        advanced = False
+            (n_out,) = unpack_from("<H", buf, pos)
+            pos += 2
+            socket = m.socket
+            for _ in range(n_out):
+                ep_idx, dlen = unpack_from("<HI", buf, pos)
+                pos += 6
+                data = bytes(buf[pos : pos + dlen])
+                pos += dlen
+                socket.send_to(RawMessage(data), m.endpoints[ep_idx].addr)
+            # stage event records; dispatch AFTER the status mirrors below
+            # are parsed — _on_protocol_disconnected reads m.local_last, and
+            # p2p.py's _handle_event sees the status as updated by this
+            # tick's EvInputs, not last tick's
+            (n_events,) = unpack_from("<H", buf, pos)
+            pos += 2
+            staged_events = []
+            for _ in range(n_events):
+                kind, ep_idx = unpack_from("<BH", buf, pos)
+                pos += 3
+                if kind == _EV_INTERRUPTED:
+                    (remaining,) = unpack_from("<q", buf, pos)
+                    pos += 8
+                    staged_events.append((kind, ep_idx, remaining))
+                elif kind == _EV_CHECKSUM:
+                    frame, lo, hi = unpack_from("<qQQ", buf, pos)
+                    pos += 24
+                    staged_events.append((kind, ep_idx, (frame, lo, hi)))
+                else:
+                    staged_events.append((kind, ep_idx, None))
+            (n_eps,) = unpack_from("<B", buf, pos)
+            pos += 1
+            for e in range(n_eps):
+                ep = m.endpoints[e]
+                ep.running = buf[pos] == 0
+                pos += 1
+                for h in range(players):
+                    disc, lf = unpack_from("<Bq", buf, pos)
+                    pos += 9
+                    ep.peer_disc[h] = bool(disc)
+                    ep.peer_last[h] = lf
+            for h in range(players):
+                disc, lf = unpack_from("<Bq", buf, pos)
+                pos += 9
+                m.local_disc[h] = bool(disc)
+                m.local_last[h] = lf
+
+            # ---- policy (Python): events, wait recommendation, consensus ----
+            for kind, ep_idx, payload in staged_events:
+                ep = m.endpoints[ep_idx]
+                if kind == _EV_INTERRUPTED:
+                    m.push_event(NetworkInterrupted(
+                        addr=ep.addr, disconnect_timeout=payload
+                    ))
+                elif kind == _EV_RESUMED:
+                    m.push_event(NetworkResumed(addr=ep.addr))
+                elif kind == _EV_DISCONNECTED:
+                    self._on_protocol_disconnected(m, ep_idx)
+                elif kind == _EV_CHECKSUM:
+                    frame, lo, hi = payload
+                    self._store_checksum(ep, frame, lo | (hi << 64))
+            pre_current = current - (1 if advanced else 0)
+            m.frames_ahead = frames_ahead
+            if (
+                pre_current > m.next_recommended_sleep
+                and frames_ahead >= MIN_RECOMMENDATION
+            ):
+                m.next_recommended_sleep = pre_current + RECOMMENDATION_INTERVAL
+                m.push_event(WaitRecommendation(skip_frames=frames_ahead))
+            m.current_frame = current
+            m.last_confirmed = confirmed
+            if advanced:
+                m.staged_inputs.clear()
+            if consensus:
+                self._run_consensus(m)
+            request_lists.append(requests)
+        return request_lists
+
+    # ------------------------------------------------------------------
+    # policy helpers (the Python halves of the split)
+    # ------------------------------------------------------------------
+
+    def _on_protocol_disconnected(self, m: _SessionMirror, ep_idx: int) -> None:
+        """EvDisconnected from an endpoint: mirror
+        ``P2PSession._handle_event`` — mark the endpoint's players
+        disconnected (via next tick's ctrl op) and surface the user event."""
+        ep = m.endpoints[ep_idx]
+        for handle in ep.handles:
+            m.pending_ctrl.append((1, ep_idx, m.local_last[handle]))
+            m.local_disc[handle] = True  # mirror eagerly for the policy reads
+        ep.running = False
+        m.push_event(Disconnected(addr=ep.addr))
+
+    def _run_consensus(self, m: _SessionMirror) -> None:
+        """``P2PSession._update_player_disconnects`` over the mirrors; the
+        resulting disconnects become next tick's ctrl ops."""
+        n = m.num_players
+        queue_connected = [True] * n
+        queue_min = [2**31 - 1] * n
+        for ep in m.endpoints:
+            if not ep.running:
+                continue
+            for h in range(n):
+                if ep.peer_disc[h]:
+                    queue_connected[h] = False
+                if ep.peer_last[h] < queue_min[h]:
+                    queue_min[h] = ep.peer_last[h]
+        handle_to_ep = {
+            h: i for i, ep in enumerate(m.endpoints) for h in ep.handles
+        }
+        for h in range(n):
+            local_connected = not m.local_disc[h]
+            local_min = m.local_last[h]
+            min_confirmed = queue_min[h]
+            if local_connected:
+                min_confirmed = min(min_confirmed, local_min)
+            if not queue_connected[h] and (
+                local_connected or local_min > min_confirmed
+            ):
+                ep_idx = handle_to_ep.get(h)
+                if ep_idx is not None:
+                    m.pending_ctrl.append((1, ep_idx, min_confirmed))
+                    for eh in m.endpoints[ep_idx].handles:
+                        m.local_disc[eh] = True
+                    m.endpoints[ep_idx].running = False
+
+    def _store_checksum(self, ep: _EndpointMirror, frame: Frame,
+                        checksum: int) -> None:
+        """``PeerProtocol._on_checksum_report`` with interval 1 (desync
+        detection is off for bank-eligible sessions)."""
+        if len(ep.pending_checksums) >= MAX_CHECKSUM_HISTORY_SIZE:
+            oldest = frame - (MAX_CHECKSUM_HISTORY_SIZE - 1)
+            ep.pending_checksums = {
+                f: c for f, c in ep.pending_checksums.items() if f >= oldest
+            }
+        ep.pending_checksums[frame] = checksum
+
+    # ------------------------------------------------------------------
+    # observables (API parity with P2PSession where the pool drivers and
+    # tests read it)
+    # ------------------------------------------------------------------
+
+    def events(self, index: int) -> List:
+        if not self.native_active:  # property finalizes lazily
+            return self._sessions[index].events()
+        m = self._mirrors[index]
+        out = list(m.event_queue)
+        m.event_queue.clear()
+        return out
+
+    def current_frame(self, index: int) -> Frame:
+        if not self.native_active:
+            return self._sessions[index].current_frame
+        return self._mirrors[index].current_frame
+
+    def last_confirmed_frame(self, index: int) -> Frame:
+        if not self.native_active:
+            return self._sessions[index]._sync_layer.last_confirmed_frame
+        return self._mirrors[index].last_confirmed
+
+    def frames_ahead(self, index: int) -> int:
+        if not self.native_active:
+            return self._sessions[index].frames_ahead()
+        return self._mirrors[index].frames_ahead
+
+    def session(self, index: int):
+        """The underlying P2PSession (fallback mode only — the native bank
+        has no per-session objects)."""
+        if self.native_active:
+            raise InvalidRequest(
+                "native bank active: per-session objects do not exist"
+            )
+        return self._sessions[index]
+
+    def _check_valid(self) -> None:
+        if self._invalid is not None:
+            raise RuntimeError(
+                f"pool was invalidated by an earlier failed tick "
+                f"({self._invalid}); rebuild it"
+            )
+
+    def __del__(self) -> None:  # pragma: no cover
+        try:
+            if self._bank and self._lib is not None:
+                self._lib.ggrs_bank_free(self._bank)
+                self._bank = None
+        except Exception:
+            pass

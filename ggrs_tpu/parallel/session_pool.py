@@ -535,3 +535,37 @@ class BatchedRequestExecutor:
 
     def block_until_ready(self) -> None:
         jax.block_until_ready(self._carry)
+
+
+class HostedPool:
+    """The full massed-hosting tick, both halves pooled: a
+    ``host_bank.HostSessionPool`` steps all B sessions' protocol + sync
+    mechanism in ONE ctypes crossing, and a ``BatchedRequestExecutor``
+    fulfills the B request lists in ONE device dispatch — two crossings of
+    any boundary per pool tick, total, regardless of B.
+
+    ``host_pool`` must hold the same sessions, in the same order, as the
+    executor's batch indices.  When the native bank is unavailable the host
+    half transparently degrades to per-session Python sessions (identical
+    request lists), so this wrapper needs no fallback of its own.
+    """
+
+    def __init__(self, host_pool, executor: BatchedRequestExecutor) -> None:
+        if len(host_pool) != executor.batch_size:
+            raise ValueError(
+                f"host pool has {len(host_pool)} sessions but the executor "
+                f"was built for batch_size={executor.batch_size}"
+            )
+        self.host = host_pool
+        self.executor = executor
+
+    def tick(self, local_inputs: Sequence[Tuple[int, int, Any]]) -> None:
+        """One pool tick: stage ``(session_index, handle, value)`` local
+        inputs, advance every session, fulfill every request list."""
+        add = self.host.add_local_input
+        for index, handle, value in local_inputs:
+            add(index, handle, value)
+        self.executor.run(self.host.advance_all())
+
+    def block_until_ready(self) -> None:
+        self.executor.block_until_ready()

@@ -1,0 +1,1048 @@
+// Native host-loop session bank: step EVERY pooled session's protocol +
+// sync mechanism in ONE ctypes crossing per pool tick.
+//
+// Round 5 made the per-operation mechanisms native (native/sync_core.cpp,
+// native/endpoint.cpp) and measured them perf-neutral: ~200 ctypes crossings
+// per session-tick hand back the ~13% the C++ saves (docs/ROUND5.md §4).
+// This module composes those SAME mechanisms — it calls their extern "C"
+// APIs, it does not reimplement them — into a bank of B sessions, and
+// ggrs_bank_tick() walks all of them off one packed command buffer:
+//
+//   per session: [ctrl ops] [inbound datagrams] [local input bytes]
+//     -> poll:    route datagrams (ack trim, delta-decode, ring commit,
+//                 remote-input enqueue), frame-advantage update, timers
+//                 (retry / quality / keep-alive / disconnect detector)
+//     -> advance: confirmed-frame watermark, consistency check + rollback
+//                 resim descriptor, local-input enqueue, outbound
+//                 InputMessage assembly, synchronized-input assembly
+//   per session: [request ops] [outbound datagrams] [events] [status mirrors]
+//
+// POLICY STAYS IN PYTHON (ggrs_tpu/parallel/host_bank.py): GgrsEvent
+// emission, the disconnect consensus, wait-recommendation pacing, and
+// GgrsRequest construction all happen above the seam, driven by the event
+// records and status mirrors this file returns.  The per-session Python
+// path (sessions/p2p.py over net/protocol.py) is the untouched semantic
+// reference; tests/test_session_bank.py pins the bank bit-identical to it
+// (wire bytes, frames, events) under seeded loss/dup/reorder traffic.
+//
+// Known, documented divergences (all unreachable from honest bank peers,
+// all covered exactly by the Python fallback path):
+//  - datagrams needing Python's unbounded-int decode (varints beyond u64)
+//    or exceeding the receive staging caps are dropped, not re-decoded;
+//  - disconnect consensus and EvDisconnected reactions apply one pool tick
+//    late (Python turns this tick's events into next tick's ctrl ops).
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <new>
+#include <vector>
+
+#include "wire_common.h"
+
+using namespace ggrs;
+
+// ---- the composed mechanisms (sync_core.cpp / endpoint.cpp, same .so) ----
+extern "C" {
+void* ggrs_ep_new(const uint8_t*, size_t, const uint8_t*, size_t, int64_t);
+void ggrs_ep_free(void*);
+int64_t ggrs_ep_pending_len(void*);
+int64_t ggrs_ep_last_recv_frame(void*);
+void ggrs_ep_ack(void*, int64_t);
+int64_t ggrs_ep_push(void*, int64_t, const uint8_t*, size_t);
+int ggrs_ep_emit_input(void*, uint16_t, const uint8_t*, const uint8_t*,
+                       int32_t, uint8_t, uint8_t*, size_t, size_t*);
+int ggrs_ep_handle_input_datagram(void*, const uint8_t*, size_t, uint16_t*,
+                                  uint8_t*, uint8_t*, int64_t*, int32_t*,
+                                  int64_t*, uint8_t*, size_t, size_t*, size_t,
+                                  size_t*, int64_t*, int64_t*);
+void ggrs_ep_commit(void*);
+
+void* ggrs_sync_new(int, int);
+void ggrs_sync_free(void*);
+void ggrs_sync_set_frame_delay(void*, int, int);
+void ggrs_sync_reset_prediction(void*);
+int64_t ggrs_sync_add_input(void*, int, int64_t, const uint8_t*);
+int ggrs_sync_synchronized_inputs(void*, int64_t, const uint8_t*,
+                                  const int64_t*, uint8_t*, int32_t*);
+int ggrs_sync_set_last_confirmed(void*, int64_t);
+int64_t ggrs_sync_check_consistency(void*, int64_t);
+}
+
+namespace {
+
+constexpr int64_t kNullFrame = -1;
+
+// protocol.py constants, mirrored exactly
+constexpr int64_t kShutdownTimerMs = 5000;
+constexpr int64_t kPendingOutputSize = 128;
+constexpr int64_t kRunningRetryMs = 200;
+constexpr int64_t kKeepAliveMs = 200;
+constexpr int64_t kQualityReportMs = 200;
+constexpr int kFrameWindow = 30;  // time_sync.py FRAME_WINDOW_SIZE
+
+// bank-level return codes (mirrored in _native.py as BANK_ERR_*)
+constexpr int kBankOk = 0;
+constexpr int kBankErrCmd = -60;         // malformed command stream
+constexpr int kBankErrLandedSplit = -70; // local inputs landed on != frames
+constexpr int kBankErrSync = -71;        // sync-core op failed (assert parity)
+constexpr int kBankErrSyncInputs = -72;  // synchronized_inputs failed
+constexpr int kBankErrConfirm = -73;     // set_last_confirmed invariant
+constexpr int kBankErrNoPlayers = -74;   // every player disconnected
+constexpr int kBankErrSequence = -75;    // remote input frame gap (assert)
+
+// endpoint core codes (endpoint.cpp)
+constexpr int kEpDrop = -30;
+constexpr int kEpFallback = -31;
+
+enum EpState : uint8_t { kRunning = 0, kDisconnected = 1, kShutdown = 2 };
+
+// event kinds on the output stream (host_bank.py mirrors)
+enum EvKind : uint8_t {
+  kEvInterrupted = 1,
+  kEvResumed = 2,
+  kEvDisconnected = 3,
+  kEvChecksum = 4,
+  kEvInput = 5,  // internal only: applied natively, never surfaced
+};
+
+struct EpEvent {
+  uint8_t kind;
+  int32_t handle = -1;   // kEvInput: session player handle
+  int64_t a = 0;         // frame / remaining ms
+  uint64_t lo = 0, hi = 0;  // checksum halves
+  uint32_t off = 0, len = 0;  // kEvInput: payload slice into evin_bytes
+};
+
+struct BankEndpoint {
+  void* ep = nullptr;
+  uint16_t magic = 0;
+  std::vector<int32_t> handles;  // sorted remote player handles
+  uint8_t state = kRunning;
+  // timers / liveness (protocol.py timestamps)
+  int64_t last_send = 0, last_recv = 0, last_input_recv = 0, last_quality = 0;
+  int64_t shutdown_at = 0;
+  bool notify_sent = false, disconnect_event_sent = false;
+  int64_t rtt = 0;
+  int64_t local_adv = 0, remote_adv = 0;
+  // time_sync.py sliding windows with running sums
+  int64_t ts_local[kFrameWindow] = {0}, ts_remote[kFrameWindow] = {0};
+  int64_t ts_local_sum = 0, ts_remote_sum = 0;
+  // what the peer last told us about every session player
+  std::vector<uint8_t> peer_disc;
+  std::vector<int64_t> peer_last;
+  int64_t packets_sent = 0, bytes_sent = 0;
+  // events persist across ticks (a post-drain event surfaces next tick,
+  // exactly like protocol.py's deque)
+  std::deque<EpEvent> events;
+  std::vector<uint8_t> evin_bytes;  // per-tick EvInput payload scratch
+  // per-tick outbound datagram streams, [u32 len][bytes]... each.  TWO
+  // phases because the Python session flushes every endpoint's queue at
+  // the end of poll_remote_clients and AGAIN per endpoint after
+  // send_encoded_input — so the per-socket global order is [all endpoints'
+  // poll messages][per-endpoint input messages], which multi-endpoint
+  // sessions observe (and the fault-injecting net's rng stream feels)
+  std::vector<uint8_t> out_poll, out_adv;
+  std::vector<uint8_t>* cur_out = nullptr;
+  uint32_t out_count = 0;
+
+  int64_t ts_average() const {
+    // int((remote_sum/30 - local_sum/30) / 2.0) — double ops term-for-term
+    // with time_sync.py so truncation matches bit-exactly
+    double local_avg = static_cast<double>(ts_local_sum) / kFrameWindow;
+    double remote_avg = static_cast<double>(ts_remote_sum) / kFrameWindow;
+    return static_cast<int64_t>((remote_avg - local_avg) / 2.0);
+  }
+};
+
+struct BankSession {
+  void* sync = nullptr;
+  int num_players = 0, input_size = 0, max_prediction = 8, fps = 60;
+  int64_t disconnect_timeout = 2000, notify_start = 500;
+  std::vector<int32_t> local_handles;  // sorted
+  std::vector<BankEndpoint> endpoints;
+  std::vector<uint8_t> local_disc;
+  std::vector<int64_t> local_last;
+  int64_t current_frame = 0;
+  int64_t last_confirmed = kNullFrame;
+  int64_t disconnect_frame = kNullFrame;
+  // scratch
+  std::vector<uint8_t> sync_buf;     // players * input_size
+  std::vector<int32_t> status_buf;   // players
+  std::vector<uint8_t> payload;      // joined local-input payload
+};
+
+struct Bank {
+  std::vector<BankSession*> sessions;
+  // endpoint-core receive staging (NativeEndpointCore's caps)
+  std::vector<uint8_t> recv_out = std::vector<uint8_t>(size_t{1} << 16);
+  std::vector<size_t> recv_sizes = std::vector<size_t>(512);
+  std::vector<uint8_t> emit_buf = std::vector<uint8_t>(size_t{1} << 12);
+  std::vector<uint8_t> out;  // tick output, memcpy'd to the caller
+};
+
+// ---- little-endian put/get over byte vectors -----------------------------
+
+void put_u8(std::vector<uint8_t>* b, uint8_t v) { b->push_back(v); }
+void put_u16(std::vector<uint8_t>* b, uint16_t v) {
+  b->push_back(v & 0xFF);
+  b->push_back(v >> 8);
+}
+void put_u32(std::vector<uint8_t>* b, uint32_t v) {
+  for (int i = 0; i < 4; ++i) b->push_back((v >> (8 * i)) & 0xFF);
+}
+void put_i64(std::vector<uint8_t>* b, int64_t v) {
+  uint64_t u = static_cast<uint64_t>(v);
+  for (int i = 0; i < 8; ++i) b->push_back((u >> (8 * i)) & 0xFF);
+}
+void put_u64(std::vector<uint8_t>* b, uint64_t u) {
+  for (int i = 0; i < 8; ++i) b->push_back((u >> (8 * i)) & 0xFF);
+}
+void put_raw(std::vector<uint8_t>* b, const uint8_t* p, size_t n) {
+  b->insert(b->end(), p, p + n);
+}
+
+struct CmdReader {
+  const uint8_t* p;
+  size_t len, pos = 0;
+  bool ok = true;
+  bool need(size_t n) {
+    if (pos + n > len) { ok = false; return false; }
+    return true;
+  }
+  uint8_t u8() { if (!need(1)) return 0; return p[pos++]; }
+  uint16_t u16() {
+    if (!need(2)) return 0;
+    uint16_t v = p[pos] | (p[pos + 1] << 8);
+    pos += 2;
+    return v;
+  }
+  uint32_t u32() {
+    if (!need(4)) return 0;
+    uint32_t v = 0;
+    for (int i = 0; i < 4; ++i) v |= static_cast<uint32_t>(p[pos + i]) << (8 * i);
+    pos += 4;
+    return v;
+  }
+  int64_t i64() {
+    if (!need(8)) return 0;
+    uint64_t v = 0;
+    for (int i = 0; i < 8; ++i) v |= static_cast<uint64_t>(p[pos + i]) << (8 * i);
+    pos += 8;
+    return static_cast<int64_t>(v);
+  }
+  const uint8_t* raw(size_t n) {
+    if (!need(n)) return nullptr;
+    const uint8_t* r = p + pos;
+    pos += n;
+    return r;
+  }
+};
+
+// ---- small-message assembly (byte-identical to messages.py encoders) -----
+
+void queue_bytes(BankEndpoint* ep, int64_t now, const uint8_t* p, size_t n) {
+  ep->packets_sent += 1;
+  ep->last_send = now;
+  ep->bytes_sent += static_cast<int64_t>(n);
+  put_u32(ep->cur_out, static_cast<uint32_t>(n));
+  put_raw(ep->cur_out, p, n);
+  ep->out_count += 1;
+}
+
+void queue_small(BankEndpoint* ep, int64_t now, const Writer& w) {
+  queue_bytes(ep, now, w.buf.data(), w.buf.size());
+}
+
+void msg_header(Writer* w, uint16_t magic, uint8_t tag) {
+  w->u8(magic & 0xFF);
+  w->u8(magic >> 8);
+  w->u8(tag);
+}
+
+void queue_input_ack(BankEndpoint* ep, int64_t now, int64_t ack_frame) {
+  Writer w;
+  msg_header(&w, ep->magic, kTagInputAck);
+  w.svarint(ack_frame);
+  queue_small(ep, now, w);
+}
+
+void queue_quality_report(BankEndpoint* ep, int64_t now) {
+  // protocol.py _send_quality_report: clamp to i16, ping = clock()
+  int64_t adv = ep->local_adv;
+  if (adv < -32768) adv = -32768;
+  if (adv > 32767) adv = 32767;
+  Writer w;
+  msg_header(&w, ep->magic, kTagQualityReport);
+  uint16_t a = static_cast<uint16_t>(static_cast<int16_t>(adv));
+  w.u8(a & 0xFF);
+  w.u8(a >> 8);
+  uint64_t ping = static_cast<uint64_t>(now);
+  for (int i = 0; i < 8; ++i) w.u8((ping >> (8 * i)) & 0xFF);
+  queue_small(ep, now, w);
+}
+
+void queue_quality_reply(BankEndpoint* ep, int64_t now, uint64_t pong) {
+  Writer w;
+  msg_header(&w, ep->magic, kTagQualityReply);
+  for (int i = 0; i < 8; ++i) w.u8((pong >> (8 * i)) & 0xFF);
+  queue_small(ep, now, w);
+}
+
+void queue_keep_alive(BankEndpoint* ep, int64_t now) {
+  Writer w;
+  msg_header(&w, ep->magic, kTagKeepAlive);
+  queue_small(ep, now, w);
+}
+
+void queue_sync_reply(BankEndpoint* ep, int64_t now, uint64_t nonce) {
+  Writer w;
+  msg_header(&w, ep->magic, kTagSyncReply);
+  w.uvarint(nonce);
+  queue_small(ep, now, w);
+}
+
+// protocol.py _mark_alive
+void mark_alive(BankEndpoint* ep, int64_t now) {
+  ep->last_recv = now;
+  if (ep->notify_sent && ep->state == kRunning) {
+    ep->notify_sent = false;
+    ep->events.push_back(EpEvent{kEvResumed});
+  }
+}
+
+// protocol.py _send_pending_output over the native emit
+void send_pending_output(Bank* bank, BankSession* s, BankEndpoint* ep,
+                         int64_t now) {
+  while (true) {
+    size_t out_len = 0;
+    int rc = ggrs_ep_emit_input(
+        ep->ep, ep->magic, s->local_disc.data(),
+        reinterpret_cast<const uint8_t*>(s->local_last.data()),
+        s->num_players, ep->state == kDisconnected ? 1 : 0,
+        bank->emit_buf.data(), bank->emit_buf.size(), &out_len);
+    if (rc == kErrBufferTooSmall) {
+      bank->emit_buf.resize(bank->emit_buf.size() * 4);
+      continue;
+    }
+    if (rc != kOk || out_len == 0) return;  // errors unreachable: bank
+    // sessions obey the wire player cap and the pending-head invariant
+    queue_bytes(ep, now, bank->emit_buf.data(), out_len);
+    return;
+  }
+}
+
+// Inner per-player framing of one received frame payload: exactly
+// len(handles) uvarint-prefixed blobs, each input_size bytes, nothing
+// trailing (protocol.py _decode_player_bytes + fixed-size input_decode).
+bool inner_framing_ok(const uint8_t* p, size_t n, size_t n_handles,
+                      size_t input_size) {
+  Reader r{p, n};
+  for (size_t i = 0; i < n_handles; ++i) {
+    const uint8_t* blob;
+    size_t blob_len;
+    if (r.byte_string(&blob, &blob_len) != kOk) return false;
+    if (blob_len != input_size) return false;
+  }
+  return r.remaining() == 0;
+}
+
+// One inbound datagram for one endpoint — the fused receive of
+// protocol.py handle_datagram, minus the Python-object escape hatches.
+void process_datagram(Bank* bank, BankSession* s, BankEndpoint* ep,
+                      int64_t now, const uint8_t* data, size_t len) {
+  if (ep->state == kShutdown) return;
+  if (len < 3) return;  // no tag byte: undecodable, drop
+  uint8_t tag = data[2];
+  Reader r{data, len};
+  const uint8_t* hdr;
+  r.take(3, &hdr);  // magic is carried but never verified (fork parity)
+
+  switch (tag) {
+    case kTagInput: {
+      uint16_t magic;
+      uint8_t dreq = 0;
+      uint8_t disc[kMaxPlayersOnWire];
+      int64_t frames[kMaxPlayersOnWire];
+      int32_t n_status = 0;
+      int64_t start_frame = 0;
+      size_t out_count = 0;
+      int64_t first_new = kNullFrame, new_last_recv = kNullFrame;
+      int rc = ggrs_ep_handle_input_datagram(
+          ep->ep, data, len, &magic, &dreq, disc, frames, &n_status,
+          &start_frame, bank->recv_out.data(), bank->recv_out.size(),
+          bank->recv_sizes.data(), bank->recv_sizes.size(), &out_count,
+          &first_new, &new_last_recv);
+      if (rc == kEpFallback) return;  // needs Python's unbounded decode:
+      // unreachable from an honest bank peer (fixed-size inputs, 128-deep
+      // window); dropping is the documented divergence
+      if (rc != kOk && rc != kEpDrop) return;  // malformed: drop whole
+      mark_alive(ep, now);
+      if (dreq) {
+        if (ep->state != kDisconnected && !ep->disconnect_event_sent) {
+          ep->events.push_back(EpEvent{kEvDisconnected});
+          ep->disconnect_event_sent = true;
+        }
+      } else {
+        if (n_status != s->num_players) return;  // malformed: drop
+        for (int32_t i = 0; i < n_status; ++i) {
+          if (disc[i]) ep->peer_disc[i] = 1;
+          if (frames[i] > ep->peer_last[i]) ep->peer_last[i] = frames[i];
+        }
+      }
+      if (rc == kEpDrop) return;  // gap / missing base: header-only packet
+      // _finish_input: validate ALL inner framing before committing
+      {
+        size_t pos = 0;
+        for (size_t i = 0; i < out_count; ++i) {
+          if (!inner_framing_ok(bank->recv_out.data() + pos,
+                                bank->recv_sizes[i], ep->handles.size(),
+                                static_cast<size_t>(s->input_size))) {
+            return;  // malformed inner frame: drop the packet whole
+          }
+          pos += bank->recv_sizes[i];
+        }
+      }
+      ggrs_ep_commit(ep->ep);
+      s->payload.clear();  // (reuse as nothing; commit clears staging)
+      ep->last_input_recv = now;
+      // stage EvInput per (frame, handle) with each handle's payload bytes
+      {
+        size_t pos = 0;
+        for (size_t i = 0; i < out_count; ++i) {
+          Reader fr{bank->recv_out.data() + pos, bank->recv_sizes[i]};
+          int64_t frame = first_new + static_cast<int64_t>(i);
+          for (size_t h = 0; h < ep->handles.size(); ++h) {
+            const uint8_t* blob;
+            size_t blob_len;
+            fr.byte_string(&blob, &blob_len);  // validated above
+            EpEvent ev{kEvInput};
+            ev.handle = ep->handles[h];
+            ev.a = frame;
+            ev.off = static_cast<uint32_t>(ep->evin_bytes.size());
+            ev.len = static_cast<uint32_t>(blob_len);
+            put_raw(&ep->evin_bytes, blob, blob_len);
+            ep->events.push_back(ev);
+          }
+          pos += bank->recv_sizes[i];
+        }
+      }
+      // ack what we have now (protocol.py acks with the mirror, which only
+      // moves when new frames landed)
+      int64_t ack = out_count ? new_last_recv : ggrs_ep_last_recv_frame(ep->ep);
+      queue_input_ack(ep, now, ack);
+      return;
+    }
+    case kTagInputAck: {
+      int64_t ack_frame;
+      if (r.svarint(&ack_frame) != kOk || r.remaining() != 0) return;
+      mark_alive(ep, now);
+      ggrs_ep_ack(ep->ep, ack_frame);
+      return;
+    }
+    case kTagQualityReport: {
+      const uint8_t* p;
+      if (r.take(10, &p) != kOk || r.remaining() != 0) return;
+      int16_t adv;
+      std::memcpy(&adv, p, 2);
+      uint64_t ping;
+      std::memcpy(&ping, p + 2, 8);
+      mark_alive(ep, now);
+      ep->remote_adv = adv;
+      queue_quality_reply(ep, now, ping);
+      return;
+    }
+    case kTagQualityReply: {
+      const uint8_t* p;
+      if (r.take(8, &p) != kOk || r.remaining() != 0) return;
+      uint64_t pong;
+      std::memcpy(&pong, p, 8);
+      mark_alive(ep, now);
+      if (static_cast<uint64_t>(now) >= pong) {
+        ep->rtt = now - static_cast<int64_t>(pong);
+      }
+      return;
+    }
+    case kTagChecksumReport: {
+      int64_t frame;
+      const uint8_t* p;
+      if (r.svarint(&frame) != kOk || r.take(16, &p) != kOk ||
+          r.remaining() != 0) {
+        return;
+      }
+      mark_alive(ep, now);
+      EpEvent ev{kEvChecksum};
+      ev.a = frame;
+      std::memcpy(&ev.lo, p, 8);
+      std::memcpy(&ev.hi, p + 8, 8);
+      ep->events.push_back(ev);
+      return;
+    }
+    case kTagKeepAlive: {
+      if (r.remaining() != 0) return;
+      mark_alive(ep, now);
+      return;
+    }
+    case kTagSyncRequest: {
+      uint64_t nonce;
+      if (r.uvarint(&nonce) != kOk || r.remaining() != 0) return;
+      mark_alive(ep, now);
+      queue_sync_reply(ep, now, nonce);  // always answered, any live state
+      return;
+    }
+    case kTagSyncReply: {
+      uint64_t nonce;
+      if (r.uvarint(&nonce) != kOk || r.remaining() != 0) return;
+      mark_alive(ep, now);  // running endpoints ignore late replies
+      return;
+    }
+    default:
+      return;  // unknown tag: drop
+  }
+}
+
+// protocol.py poll() timers, RUNNING/DISCONNECTED branches (the bank never
+// hosts SYNCHRONIZING endpoints — handshake sessions stay on the fallback)
+void poll_timers(Bank* bank, BankSession* s, BankEndpoint* ep, int64_t now) {
+  if (ep->state == kRunning) {
+    if (ep->last_input_recv + kRunningRetryMs < now) {
+      send_pending_output(bank, s, ep, now);
+      ep->last_input_recv = now;
+    }
+    if (ep->last_quality + kQualityReportMs < now) {
+      ep->last_quality = now;
+      queue_quality_report(ep, now);
+    }
+    if (ep->last_send + kKeepAliveMs < now) {
+      queue_keep_alive(ep, now);
+    }
+    if (!ep->notify_sent && ep->last_recv + s->notify_start < now) {
+      EpEvent ev{kEvInterrupted};
+      ev.a = s->disconnect_timeout - s->notify_start;
+      ep->events.push_back(ev);
+      ep->notify_sent = true;
+    }
+    if (!ep->disconnect_event_sent &&
+        ep->last_recv + s->disconnect_timeout < now) {
+      ep->events.push_back(EpEvent{kEvDisconnected});
+      ep->disconnect_event_sent = true;
+    }
+  } else if (ep->state == kDisconnected) {
+    if (ep->shutdown_at < now) ep->state = kShutdown;
+  }
+}
+
+// p2p.py _disconnect_player_at_frame for a remote endpoint, applied as a
+// ctrl op (Python policy decided it last tick)
+void disconnect_endpoint(BankSession* s, BankEndpoint* ep, int64_t now,
+                         int64_t last_frame) {
+  for (int32_t h : ep->handles) s->local_disc[h] = 1;
+  if (ep->state != kShutdown) {
+    ep->state = kDisconnected;
+    ep->shutdown_at = now + kShutdownTimerMs;
+  }
+  if (s->current_frame > last_frame) s->disconnect_frame = last_frame + 1;
+}
+
+// p2p.py _update_player_disconnects trigger condition — the DETECTION is
+// mechanism (a pure read); the action stays in Python via next tick's ctrl
+bool consensus_pending(const BankSession* s) {
+  for (int h = 0; h < s->num_players; ++h) {
+    bool queue_connected = true;
+    int64_t min_confirmed = INT64_MAX;
+    for (const BankEndpoint& ep : s->endpoints) {
+      if (ep.state != kRunning) continue;
+      if (ep.peer_disc[h]) queue_connected = false;
+      if (ep.peer_last[h] < min_confirmed) min_confirmed = ep.peer_last[h];
+    }
+    bool local_connected = !s->local_disc[h];
+    int64_t local_min = s->local_last[h];
+    if (local_connected && local_min < min_confirmed) min_confirmed = local_min;
+    if (!queue_connected && (local_connected || local_min > min_confirmed)) {
+      return true;
+    }
+  }
+  return false;
+}
+
+// p2p.py _max_frame_advantage: max time-sync average over endpoints with a
+// connected handle, 0 when none
+int64_t max_frame_advantage(const BankSession* s) {
+  int64_t frames_ahead = 0;
+  bool any = false;
+  for (const BankEndpoint& ep : s->endpoints) {
+    bool has_connected = false;
+    for (int32_t h : ep.handles) {
+      if (!s->local_disc[h]) has_connected = true;
+    }
+    if (!has_connected) continue;
+    int64_t adv = ep.ts_average();
+    if (!any || adv > frames_ahead) frames_ahead = adv;
+    any = true;
+  }
+  return frames_ahead;
+}
+
+int advance_session(Bank* bank, BankSession* s, int64_t now,
+                    const uint8_t* local_inputs, std::vector<uint8_t>* ops,
+                    uint16_t* n_ops, int64_t* landed_out,
+                    int64_t* frames_ahead_out) {
+  const int players = s->num_players;
+  const int isize = s->input_size;
+
+  // frame-0 initial save (p2p.py: save before anything else that tick)
+  if (s->current_frame == 0) {
+    put_u8(ops, 0);
+    put_i64(ops, 0);
+    ++*n_ops;
+  }
+
+  // confirmed frame: min last-received over connected players
+  int64_t confirmed = INT64_MAX;
+  for (int h = 0; h < players; ++h) {
+    if (!s->local_disc[h] && s->local_last[h] < confirmed) {
+      confirmed = s->local_last[h];
+    }
+  }
+  if (confirmed == INT64_MAX) return kBankErrNoPlayers;
+
+  // consistency check + rollback descriptor
+  int64_t first_incorrect =
+      ggrs_sync_check_consistency(s->sync, s->disconnect_frame);
+  if (first_incorrect != kNullFrame) {
+    if (first_incorrect < s->current_frame) {
+      // _adjust_gamestate, non-sparse: load first_incorrect, resim forward
+      int64_t frame_to_load = first_incorrect;
+      int64_t count = s->current_frame - frame_to_load;
+      put_u8(ops, 1);
+      put_i64(ops, frame_to_load);
+      ++*n_ops;
+      s->current_frame = frame_to_load;
+      ggrs_sync_reset_prediction(s->sync);
+      for (int64_t i = 0; i < count; ++i) {
+        if (i > 0) {
+          put_u8(ops, 0);
+          put_i64(ops, s->current_frame);
+          ++*n_ops;
+        }
+        int rc = ggrs_sync_synchronized_inputs(
+            s->sync, s->current_frame, s->local_disc.data(),
+            s->local_last.data(), s->sync_buf.data(), s->status_buf.data());
+        if (rc != kOk) return kBankErrSyncInputs;
+        s->current_frame += 1;
+        put_u8(ops, 2);
+        for (int p = 0; p < players; ++p) {
+          put_u8(ops, static_cast<uint8_t>(s->status_buf[p]));
+        }
+        put_raw(ops, s->sync_buf.data(),
+                static_cast<size_t>(players) * isize);
+        ++*n_ops;
+      }
+    }
+    s->disconnect_frame = kNullFrame;
+  }
+
+  // per-frame save of the current state (non-sparse mode)
+  put_u8(ops, 0);
+  put_i64(ops, s->current_frame);
+  ++*n_ops;
+
+  // confirmed-frame watermark (policy minimums applied: non-sparse, so only
+  // the never-past-current clamp)
+  int64_t watermark =
+      confirmed < s->current_frame ? confirmed : s->current_frame;
+  if (ggrs_sync_set_last_confirmed(s->sync, watermark) != kOk) {
+    return kBankErrConfirm;
+  }
+  s->last_confirmed = watermark;
+
+  // the wait-recommendation read happens HERE in p2p.py
+  // (_check_wait_recommendation), BEFORE send_encoded_input pushes this
+  // tick's sample into the time-sync windows — sampling after the push
+  // would let the recommendation see one tick into the future relative to
+  // the per-session Python path
+  *frames_ahead_out = max_frame_advantage(s);
+
+  // register local inputs and send them
+  bool all_landed = true;
+  int64_t landed = kNullFrame;
+  for (size_t i = 0; i < s->local_handles.size(); ++i) {
+    int64_t rc = ggrs_sync_add_input(s->sync, s->local_handles[i],
+                                     s->current_frame,
+                                     local_inputs + i * isize);
+    if (rc < kNullFrame) return kBankErrSync;
+    if (rc != kNullFrame) {
+      s->local_last[s->local_handles[i]] = rc;
+      if (landed != kNullFrame && rc != landed) return kBankErrLandedSplit;
+      landed = rc;
+    } else {
+      all_landed = false;
+    }
+  }
+  *landed_out = landed;
+
+  if (all_landed && !s->endpoints.empty() && !s->local_handles.empty()) {
+    // join the per-player payload once (encode_local_inputs)
+    s->payload.clear();
+    {
+      Writer w;
+      for (size_t i = 0; i < s->local_handles.size(); ++i) {
+        w.uvarint(static_cast<uint64_t>(isize));
+        w.raw(local_inputs + i * isize, static_cast<size_t>(isize));
+      }
+      s->payload.assign(w.buf.begin(), w.buf.end());
+    }
+    for (BankEndpoint& ep : s->endpoints) {
+      if (ep.state != kRunning) continue;  // send_encoded_input's gate
+      // time_sync.advance_frame(frame, local_adv, remote_adv)
+      int i = static_cast<int>(landed % kFrameWindow);
+      if (i < 0) i += kFrameWindow;
+      ep.ts_local_sum += ep.local_adv - ep.ts_local[i];
+      ep.ts_local[i] = ep.local_adv;
+      ep.ts_remote_sum += ep.remote_adv - ep.ts_remote[i];
+      ep.ts_remote[i] = ep.remote_adv;
+      int64_t pending = ggrs_ep_push(ep.ep, landed, s->payload.data(),
+                                     s->payload.size());
+      if (pending > kPendingOutputSize && !ep.disconnect_event_sent) {
+        // protocol.py queues EvDisconnected; it drains NEXT tick's poll.
+        // (The Python path does not set _disconnect_event_sent here; it
+        // relies on the session reacting — mirror the queue exactly.)
+        ep.events.push_back(EpEvent{kEvDisconnected});
+      }
+      send_pending_output(bank, s, &ep, now);
+    }
+  }
+
+  // advance decision
+  int64_t frames_ahead = s->last_confirmed == kNullFrame
+                             ? s->current_frame
+                             : s->current_frame - s->last_confirmed;
+  if (frames_ahead < s->max_prediction) {
+    int rc = ggrs_sync_synchronized_inputs(
+        s->sync, s->current_frame, s->local_disc.data(), s->local_last.data(),
+        s->sync_buf.data(), s->status_buf.data());
+    if (rc != kOk) return kBankErrSyncInputs;
+    s->current_frame += 1;
+    put_u8(ops, 2);
+    for (int p = 0; p < players; ++p) {
+      put_u8(ops, static_cast<uint8_t>(s->status_buf[p]));
+    }
+    put_raw(ops, s->sync_buf.data(), static_cast<size_t>(players) * isize);
+    ++*n_ops;
+  }
+  return kBankOk;
+}
+
+}  // namespace
+
+extern "C" {
+
+void* ggrs_bank_new(void) { return new (std::nothrow) Bank(); }
+
+void ggrs_bank_free(void* ptr) {
+  Bank* bank = static_cast<Bank*>(ptr);
+  if (!bank) return;
+  for (BankSession* s : bank->sessions) {
+    for (BankEndpoint& ep : s->endpoints) ggrs_ep_free(ep.ep);
+    ggrs_sync_free(s->sync);
+    delete s;
+  }
+  delete bank;
+}
+
+// Returns the new session's index, or a negative error.
+int64_t ggrs_bank_add_session(void* ptr, int num_players, int input_size,
+                              int max_prediction, int fps,
+                              int64_t disconnect_timeout_ms,
+                              int64_t disconnect_notify_start_ms,
+                              const int32_t* local_handles, int n_local,
+                              int input_delay) {
+  Bank* bank = static_cast<Bank*>(ptr);
+  if (num_players < 1 ||
+      static_cast<size_t>(num_players) > kMaxPlayersOnWire ||
+      input_size < 1 || input_size > 4096 || max_prediction < 1 ||
+      n_local < 0 || n_local > num_players) {
+    return kBankErrCmd;
+  }
+  void* sync = ggrs_sync_new(num_players, input_size);
+  if (!sync) return kBankErrCmd;
+  BankSession* s = new (std::nothrow) BankSession();
+  if (!s) {
+    ggrs_sync_free(sync);
+    return kBankErrCmd;
+  }
+  s->sync = sync;
+  s->num_players = num_players;
+  s->input_size = input_size;
+  s->max_prediction = max_prediction;
+  s->fps = fps;
+  s->disconnect_timeout = disconnect_timeout_ms;
+  s->notify_start = disconnect_notify_start_ms;
+  s->local_handles.assign(local_handles, local_handles + n_local);
+  s->local_disc.assign(num_players, 0);
+  s->local_last.assign(num_players, kNullFrame);
+  s->sync_buf.resize(static_cast<size_t>(num_players) * input_size);
+  s->status_buf.resize(num_players);
+  for (int32_t h : s->local_handles) {
+    ggrs_sync_set_frame_delay(s->sync, h, input_delay);
+  }
+  bank->sessions.push_back(s);
+  return static_cast<int64_t>(bank->sessions.size()) - 1;
+}
+
+// Returns the endpoint's index within the session, or a negative error.
+// now_ms seeds every liveness timestamp, as PeerProtocol.__init__ does.
+int64_t ggrs_bank_add_endpoint(void* ptr, int64_t session, uint16_t magic,
+                               const int32_t* handles, int n_handles,
+                               int64_t now_ms) {
+  Bank* bank = static_cast<Bank*>(ptr);
+  if (session < 0 ||
+      static_cast<size_t>(session) >= bank->sessions.size() ||
+      n_handles < 1) {
+    return kBankErrCmd;
+  }
+  BankSession* s = bank->sessions[static_cast<size_t>(session)];
+  // bases: the joined default payload, per side's player count
+  // (protocol.py: send over local players, receive over endpoint handles)
+  Writer send_base, recv_base;
+  std::vector<uint8_t> zeros(static_cast<size_t>(s->input_size), 0);
+  for (size_t i = 0; i < s->local_handles.size(); ++i) {
+    send_base.uvarint(static_cast<uint64_t>(s->input_size));
+    send_base.raw(zeros.data(), zeros.size());
+  }
+  for (int i = 0; i < n_handles; ++i) {
+    recv_base.uvarint(static_cast<uint64_t>(s->input_size));
+    recv_base.raw(zeros.data(), zeros.size());
+  }
+  void* ep = ggrs_ep_new(send_base.buf.data(), send_base.buf.size(),
+                         recv_base.buf.data(), recv_base.buf.size(),
+                         s->max_prediction);
+  if (!ep) return kBankErrCmd;
+  s->endpoints.emplace_back();
+  BankEndpoint& e = s->endpoints.back();
+  e.ep = ep;
+  e.magic = magic;
+  e.handles.assign(handles, handles + n_handles);
+  e.last_send = e.last_recv = e.last_input_recv = e.last_quality = now_ms;
+  e.peer_disc.assign(s->num_players, 0);
+  e.peer_last.assign(s->num_players, kNullFrame);
+  return static_cast<int64_t>(s->endpoints.size()) - 1;
+}
+
+// THE crossing.  Command stream, little-endian, per session in order:
+//   u8 flags (bit0 = local inputs present -> advance phase runs)
+//   [flags&1] n_local * input_size raw input bytes (sorted-handle order)
+//   u16 n_ctrl;  per ctrl: u8 op (1 = disconnect endpoint), u16 ep, i64 frame
+//   u16 n_datagrams;  per datagram: u16 ep, u32 len, bytes
+// Output stream, per session in order:
+//   i64 landed_frame
+//   i32 frames_ahead (max time-sync average over connected endpoints)
+//   i64 current_frame (post-tick), i64 last_confirmed
+//   u8 consensus_pending
+//   u16 n_ops;  per op: u8 kind (0 save / 1 load / 2 advance);
+//     save/load: i64 frame;  advance: players * u8 status,
+//     players * input_size input bytes
+//   u16 n_out;  per datagram: u16 ep, u32 len, bytes
+//   u16 n_events;  per event: u8 kind, u16 ep, kind-specific payload
+//   u8 n_endpoints;  per endpoint: u8 state, num_players * (u8 disc, i64 lf)
+//   num_players * (u8 disc, i64 last_frame)   [local status mirror]
+// Returns 0, kErrBufferTooSmall (retry with a bigger out), or a negative
+// bank/session error (the pool is poisoned; Python raises).
+int ggrs_bank_tick(void* ptr, int64_t now, const uint8_t* cmd, size_t cmd_len,
+                   uint8_t* out, size_t out_cap, size_t* out_len) {
+  Bank* bank = static_cast<Bank*>(ptr);
+  CmdReader r{cmd, cmd_len};
+  bank->out.clear();
+  std::vector<uint8_t> ops;
+  std::vector<EpEvent> staged_events;
+  std::vector<int32_t> staged_eps;
+
+  for (BankSession* s : bank->sessions) {
+    uint8_t flags = r.u8();
+    const uint8_t* local_inputs = nullptr;
+    if (flags & 1) {
+      local_inputs = r.raw(s->local_handles.size() *
+                           static_cast<size_t>(s->input_size));
+    }
+    uint16_t n_ctrl = r.u16();
+    if (!r.ok) return kBankErrCmd;
+    for (BankEndpoint& ep : s->endpoints) {
+      ep.out_poll.clear();
+      ep.out_adv.clear();
+      ep.cur_out = &ep.out_poll;
+      ep.out_count = 0;
+      ep.evin_bytes.clear();
+    }
+    for (uint16_t i = 0; i < n_ctrl; ++i) {
+      uint8_t op = r.u8();
+      uint16_t ep_idx = r.u16();
+      int64_t frame = r.i64();
+      if (!r.ok) return kBankErrCmd;
+      if (op == 1 && ep_idx < s->endpoints.size()) {
+        disconnect_endpoint(s, &s->endpoints[ep_idx], now, frame);
+      }
+    }
+
+    // ---- poll phase (p2p.py poll_remote_clients) ----
+    uint16_t n_datagrams = r.u16();
+    if (!r.ok) return kBankErrCmd;
+    for (uint16_t i = 0; i < n_datagrams; ++i) {
+      uint16_t ep_idx = r.u16();
+      uint32_t dlen = r.u32();
+      const uint8_t* data = r.raw(dlen);
+      if (!r.ok) return kBankErrCmd;
+      if (ep_idx < s->endpoints.size()) {
+        process_datagram(bank, s, &s->endpoints[ep_idx], now, data, dlen);
+      }
+    }
+    for (BankEndpoint& ep : s->endpoints) {
+      // update_local_frame_advantage (current_frame is never NULL)
+      if (ep.state == kRunning) {
+        int64_t last_recv_frame = ggrs_ep_last_recv_frame(ep.ep);
+        if (last_recv_frame != kNullFrame) {
+          int64_t ping = ep.rtt / 2;
+          int64_t remote_frame = last_recv_frame + (ping * s->fps) / 1000;
+          ep.local_adv = remote_frame - s->current_frame;
+        }
+      }
+    }
+    // stage events before handling (the poll loop), then apply in endpoint
+    // order — identical to p2p.py's two-pass event handling
+    staged_events.clear();
+    staged_eps.clear();
+    for (size_t e = 0; e < s->endpoints.size(); ++e) {
+      BankEndpoint& ep = s->endpoints[e];
+      poll_timers(bank, s, &ep, now);
+      while (!ep.events.empty()) {
+        staged_events.push_back(ep.events.front());
+        staged_eps.push_back(static_cast<int32_t>(e));
+        ep.events.pop_front();
+      }
+    }
+    std::vector<uint8_t> out_events;
+    uint16_t n_out_events = 0;
+    for (size_t i = 0; i < staged_events.size(); ++i) {
+      const EpEvent& ev = staged_events[i];
+      BankEndpoint& ep = s->endpoints[static_cast<size_t>(staged_eps[i])];
+      if (ev.kind == kEvInput) {
+        // p2p.py _handle_event EvInput: sequence invariant, status update,
+        // remote enqueue — skipped entirely for disconnected players
+        int32_t h = ev.handle;
+        if (!s->local_disc[h]) {
+          int64_t cur = s->local_last[h];
+          if (!(cur == kNullFrame || cur + 1 == ev.a)) return kBankErrSequence;
+          s->local_last[h] = ev.a;
+          int64_t rc = ggrs_sync_add_input(s->sync, h, ev.a,
+                                           ep.evin_bytes.data() + ev.off);
+          if (rc < kNullFrame) return kBankErrSync;
+        }
+      } else {
+        put_u8(&out_events, ev.kind);
+        put_u16(&out_events, static_cast<uint16_t>(staged_eps[i]));
+        if (ev.kind == kEvInterrupted) put_i64(&out_events, ev.a);
+        if (ev.kind == kEvChecksum) {
+          put_i64(&out_events, ev.a);
+          put_u64(&out_events, ev.lo);
+          put_u64(&out_events, ev.hi);
+        }
+        ++n_out_events;
+      }
+    }
+
+    // ---- advance phase (p2p.py advance_frame after its poll) ----
+    ops.clear();
+    uint16_t n_ops = 0;
+    int64_t landed = kNullFrame;
+    int64_t frames_ahead = 0;
+    bool pending_consensus = consensus_pending(s);
+    for (BankEndpoint& ep : s->endpoints) ep.cur_out = &ep.out_adv;
+    if (flags & 1) {
+      if (!local_inputs) return kBankErrCmd;
+      int rc = advance_session(bank, s, now, local_inputs, &ops, &n_ops,
+                               &landed, &frames_ahead);
+      if (rc != kBankOk) return rc;
+    } else {
+      frames_ahead = max_frame_advantage(s);
+    }
+
+    // ---- session output record ----
+    std::vector<uint8_t>* o = &bank->out;
+    put_i64(o, landed);
+    put_u32(o, static_cast<uint32_t>(static_cast<int32_t>(frames_ahead)));
+    put_i64(o, s->current_frame);
+    put_i64(o, s->last_confirmed);
+    put_u8(o, pending_consensus ? 1 : 0);
+    put_u16(o, n_ops);
+    put_raw(o, ops.data(), ops.size());
+    uint32_t n_out = 0;
+    for (BankEndpoint& ep : s->endpoints) n_out += ep.out_count;
+    put_u16(o, static_cast<uint16_t>(n_out));
+    // both phases, each in endpoint order — the Python session's observable
+    // per-socket send order (see the out_poll/out_adv comment above)
+    for (int phase = 0; phase < 2; ++phase) {
+      for (size_t e = 0; e < s->endpoints.size(); ++e) {
+        BankEndpoint& ep = s->endpoints[e];
+        const std::vector<uint8_t>& stream =
+            phase == 0 ? ep.out_poll : ep.out_adv;
+        size_t pos = 0;
+        while (pos < stream.size()) {
+          uint32_t dlen = 0;
+          for (int i = 0; i < 4; ++i) {
+            dlen |= static_cast<uint32_t>(stream[pos + i]) << (8 * i);
+          }
+          pos += 4;
+          put_u16(o, static_cast<uint16_t>(e));
+          put_u32(o, dlen);
+          put_raw(o, stream.data() + pos, dlen);
+          pos += dlen;
+        }
+      }
+    }
+    put_u16(o, n_out_events);
+    put_raw(o, out_events.data(), out_events.size());
+    put_u8(o, static_cast<uint8_t>(s->endpoints.size()));
+    for (BankEndpoint& ep : s->endpoints) {
+      put_u8(o, ep.state);
+      for (int h = 0; h < s->num_players; ++h) {
+        put_u8(o, ep.peer_disc[h]);
+        put_i64(o, ep.peer_last[h]);
+      }
+    }
+    for (int h = 0; h < s->num_players; ++h) {
+      put_u8(o, s->local_disc[h]);
+      put_i64(o, s->local_last[h]);
+    }
+  }
+
+  if (r.pos != r.len) return kBankErrCmd;  // trailing garbage: refuse
+  if (bank->out.size() > out_cap) {
+    // the tick already ran and its full output is retained in bank->out:
+    // report the needed size so the caller can grow its buffer and fetch
+    // via ggrs_bank_fetch_out — an extra crossing only on the rare growth
+    // tick (e.g. a stalled peer's whole-window retransmit volley), never a
+    // poisoned pool
+    *out_len = bank->out.size();
+    return kErrBufferTooSmall;
+  }
+  std::memcpy(out, bank->out.data(), bank->out.size());
+  *out_len = bank->out.size();
+  return kBankOk;
+}
+
+// Fetch the retained output of the last ggrs_bank_tick (the recovery path
+// for kErrBufferTooSmall; valid until the next tick).
+int ggrs_bank_fetch_out(void* ptr, uint8_t* out, size_t out_cap,
+                        size_t* out_len) {
+  Bank* bank = static_cast<Bank*>(ptr);
+  *out_len = bank->out.size();
+  if (bank->out.size() > out_cap) return kErrBufferTooSmall;
+  std::memcpy(out, bank->out.data(), bank->out.size());
+  return kBankOk;
+}
+
+int64_t ggrs_bank_session_count(void* ptr) {
+  return static_cast<int64_t>(static_cast<Bank*>(ptr)->sessions.size());
+}
+
+}  // extern "C"

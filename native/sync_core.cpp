@@ -292,7 +292,12 @@ int ggrs_sync_confirmed_inputs(void* h, int64_t frame, const uint8_t* disc,
       out_frames[p] = kNullFrame;
       continue;
     }
-    int offset = static_cast<int>(frame % kQueueLen);
+    // floored mod: C++ % on a negative frame is negative (out-of-bounds UB);
+    // Python's positive mod lands on a real slot, which for most negative
+    // frames fails the tag check loudly — and for frame -1 legitimately
+    // matches a still-blank slot (frames init to kNullFrame), so an early
+    // "frame < 0" rejection would NOT be parity
+    int offset = static_cast<int>(((frame % kQueueLen) + kQueueLen) % kQueueLen);
     if (q.frames[offset] != frame) return kSyncErrNoConfirmed;
     std::memcpy(dst, c->slot_bytes(q, offset), c->input_size);
     out_frames[p] = frame;
@@ -358,7 +363,10 @@ int ggrs_sync_confirmed_input(void* h, int player, int64_t frame,
   SyncCore* c = static_cast<SyncCore*>(h);
   if (player < 0 || player >= c->players) return kSyncErrBadArgs;
   Queue& q = c->queues[player];
-  int offset = static_cast<int>(frame % kQueueLen);
+  // floored mod, same reasoning as ggrs_sync_confirmed_inputs: negative %
+  // is out-of-bounds UB in C++, and Python-parity for frame -1 means
+  // matching the blank slot, not rejecting all negatives up front
+  int offset = static_cast<int>(((frame % kQueueLen) + kQueueLen) % kQueueLen);
   if (q.frames[offset] != frame) return kSyncErrNoConfirmed;
   std::memcpy(out, c->slot_bytes(q, offset), c->input_size);
   return kSyncOk;

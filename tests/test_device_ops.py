@@ -26,6 +26,15 @@ class TestChecksum:
         state = {"x": jnp.arange(100, dtype=jnp.int32), "y": jnp.float32(3.5)}
         assert pytree_checksum(state) == pytree_checksum(state)
 
+    def test_empty_pytree(self):
+        # regression (ADVICE r5): _INIT_LANES holds ints above int32 max and
+        # jnp.asarray's int32 default raised OverflowError on the leafless path
+        cs = checksum_device({})
+        assert cs.shape == (CHECKSUM_LANES,)
+        assert cs.dtype == jnp.uint32
+        assert pytree_checksum({}) == pytree_checksum({})
+        assert pytree_checksum({}) != pytree_checksum({"a": jnp.arange(2)})
+
     def test_sensitive_to_values(self):
         a = jnp.arange(16, dtype=jnp.int32)
         assert pytree_checksum(a) != pytree_checksum(a.at[3].add(1))

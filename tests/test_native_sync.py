@@ -215,3 +215,33 @@ class TestRandomizedParity:
         assert Config.for_struct("<4s").native_input_size is None
         assert Config.for_struct("<?").native_input_size is None
         assert Config.for_struct("<2hxx").native_input_size is not None
+
+    @pytest.mark.parametrize("frame", [-5, -128, -129, -1000])
+    def test_negative_frame_confirmed_input_raises_identically(self, frame):
+        """C++ % on a negative frame used to index out of bounds (UB); both
+        cores must refuse a negative frame the same loud way (ADVICE r5 /
+        ISSUE 1 satellite)."""
+        nat, py = make_pair(players=1, max_prediction=8)
+        for layer in (nat, py):
+            layer.add_remote_input(0, PlayerInput(0, 7))
+            with pytest.raises(AssertionError):
+                layer.confirmed_input(0, frame)
+
+    def test_frame_minus_one_matches_blank_slot_identically(self):
+        """The odd corner the naive guard would break: frame -1 lands (via
+        Python's positive mod) on a still-blank slot whose tag IS -1, so
+        both cores return the blank default instead of raising."""
+        nat, py = make_pair(players=1, max_prediction=8)
+        for layer in (nat, py):
+            layer.add_remote_input(0, PlayerInput(0, 7))
+        assert nat.confirmed_input(0, -1).input == \
+            py.confirmed_input(0, -1).input == 0
+
+    def test_negative_frame_confirmed_inputs_raises_identically(self):
+        nat, py = make_pair(players=2, max_prediction=8)
+        status = [ConnectionStatus(), ConnectionStatus()]
+        for layer in (nat, py):
+            layer.add_remote_input(0, PlayerInput(0, 1))
+            layer.add_remote_input(1, PlayerInput(0, 2))
+            with pytest.raises(AssertionError):
+                layer.confirmed_inputs(-3, status)
