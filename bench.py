@@ -68,7 +68,7 @@ CONFIGS = {
     "host_datapath": ("run_host_datapath", 600),
     "spec_p2p": ("run_spec_p2p", 1500),
     # same speculation measurement on the CPU backend: approximates a
-    # direct-attached accelerator's µs dispatch, the regime DESIGN §5/§9
+    # direct-attached accelerator's µs dispatch, the regime DESIGN §5/§10
     # predicts shrinks the speculation window-carry penalty
     # NOTE: JAX_PLATFORMS alone is clobbered by the container's
     # sitecustomize; main() honors GGRS_BENCH_PLATFORM via jax.config
@@ -104,6 +104,13 @@ CONFIGS = {
     # direct-attached host-bound regime the capacity headline lives in)
     "host_bank": (
         "run_host_bank", 900,
+        {"GGRS_BENCH_PLATFORM": "cpu"},
+    ),
+    # the supervised bank running DEGRADED: 1/8 of slots quarantined and
+    # evicted to per-session Python sessions (the fault-isolation layer's
+    # steady state after real faults) vs the all-native pool
+    "host_bank_degraded": (
+        "run_host_bank_degraded", 900,
         {"GGRS_BENCH_PLATFORM": "cpu"},
     ),
     "flagship": ("run_flagship", 900),
@@ -602,7 +609,7 @@ def run_host_datapath() -> None:
     pure session + endpoint-datapath cost, the number that bounds massed
     hosting.  ``vs_baseline`` is round 3's recorded 1.17 ms/tick over the
     measured value (>1 = faster than round 3's host path)."""
-    R3_US_PER_TICK = 1170.0  # docs/DESIGN.md §9, BENCH_r03 era measurement
+    R3_US_PER_TICK = 1170.0  # docs/DESIGN.md §10, BENCH_r03 era measurement
 
     sessions = [
         b.start_p2p_session(sock) for b, sock in _four_peer_population()
@@ -1461,6 +1468,48 @@ def _bank_matches_setup(n_matches: int):
     return host, schedules, pool
 
 
+def _bank_tick_fn(host, schedules, pool):
+    """One strict-fence pool tick (host crossing + device fulfillment),
+    returning (host_ms, device_ms) — the shared harness of the host_bank
+    capacity ramp and the degraded config."""
+    n = len(host)
+    counter = [0]
+
+    def tick():
+        i = counter[0]
+        counter[0] = i + 1
+        t0 = time.perf_counter()
+        for h in range(n):
+            host.add_local_input(h, h % 2, schedules[h](i))
+        reqs = host.advance_all()
+        t1 = time.perf_counter()
+        pool.run(reqs)
+        pool.block_until_ready()
+        t2 = time.perf_counter()
+        return (t1 - t0) * 1e3, (t2 - t1) * 1e3
+
+    return tick
+
+
+def _best_tick_percentiles(tick, T):
+    """(p50_ms, p99_ms, host_fraction) over T ticks, best-of-REPEATS by
+    p99, honest fence entered first."""
+    enter_honest_timing_mode()
+    best = None
+    for _ in range(REPEATS):
+        host_ms = np.empty(T)
+        dev_ms = np.empty(T)
+        for i in range(T):
+            host_ms[i], dev_ms[i] = tick()
+        total = host_ms + dev_ms
+        p50 = float(np.percentile(total, 50))
+        p99 = float(np.percentile(total, 99))
+        host_frac = float(np.median(host_ms / total))
+        if best is None or p99 < best[1]:
+            best = (p50, p99, host_frac)
+    return best
+
+
 def run_host_bank() -> None:
     """The tentpole metric (VERDICT r5 item 2): the native session bank —
     every pooled session's protocol+sync mechanism in ONE C++ crossing per
@@ -1557,38 +1606,10 @@ def run_host_bank() -> None:
     knee = None
     for B in (64, 128, 256, 512):
         host, schedules, pool = _bank_matches_setup(B)
-        n = len(host)
-        tick_counter = [0]
-
-        def tick():
-            i = tick_counter[0]
-            tick_counter[0] = i + 1
-            t0 = time.perf_counter()
-            for h in range(n):
-                host.add_local_input(h, h % 2, schedules[h](i))
-            reqs = host.advance_all()
-            t1 = time.perf_counter()
-            pool.run(reqs)
-            pool.block_until_ready()
-            t2 = time.perf_counter()
-            return (t1 - t0) * 1e3, (t2 - t1) * 1e3
-
+        tick = _bank_tick_fn(host, schedules, pool)
         for _ in range(16):
             tick()
-        enter_honest_timing_mode()
-        best = None
-        for _ in range(REPEATS):
-            host_ms = np.empty(T)
-            dev_ms = np.empty(T)
-            for i in range(T):
-                host_ms[i], dev_ms[i] = tick()
-            total = host_ms + dev_ms
-            p50 = float(np.percentile(total, 50))
-            p99 = float(np.percentile(total, 99))
-            host_frac = float(np.median(host_ms / total))
-            if best is None or p99 < best[1]:
-                best = (p50, p99, host_frac)
-        p50, p99, host_frac = best
+        p50, p99, host_frac = _best_tick_percentiles(tick, T)
         emit(
             f"host_bank_capacity_b{B}_tick_ms_p99", p99,
             f"ms/tick p99, strict fence, one host crossing + one dispatch "
@@ -1616,6 +1637,60 @@ def run_host_bank() -> None:
         f"matches (2 sessions each) with p99 tick <= 16.7 ms, strict fence, "
         f"native session bank{regime}",
         1.0,
+    )
+
+
+def run_host_bank_degraded() -> None:
+    """Pool throughput with 1/8 of slots quarantined+evicted (the
+    supervision layer's steady state after real faults): the evicted slots
+    tick per-session Python P2PSessions inside the same advance_all while
+    the survivors keep the one-crossing native path.  Reported against the
+    same pool fully native (``vs_baseline`` = healthy p99 / degraded p99;
+    1.0 = eviction is free, lower = the Python slots' cost)."""
+    from ggrs_tpu.net import _native
+
+    if os.environ.get("GGRS_TPU_NO_NATIVE") or _native.bank_lib() is None:
+        print("# skip: host_bank_degraded needs the native toolchain",
+              flush=True)
+        return
+
+    B = 64  # matches (2 sessions each)
+    T = 300
+
+    def measure(degrade: bool):
+        host, schedules, pool = _bank_matches_setup(B)
+        n = len(host)
+        if not host.native_active:
+            return None
+        tick = _bank_tick_fn(host, schedules, pool)
+        for _ in range(16):
+            tick()
+        if degrade:
+            for idx in range(0, n, 8):  # every 8th slot: 1/8 of the pool
+                host.inject_slot_error(idx)
+            for _ in range(16):  # let quarantine + eviction settle
+                tick()
+            evicted = sum(
+                1 for i in range(n) if host.slot_state(i) == "evicted"
+            )
+            if evicted == 0:
+                return None
+        best = _best_tick_percentiles(tick, T)
+        del host, schedules, pool
+        return best
+
+    healthy = measure(degrade=False)
+    degraded = measure(degrade=True)
+    if healthy is None or degraded is None:
+        print("# skip: host_bank_degraded pool did not engage/degrade",
+              flush=True)
+        return
+    emit(
+        f"host_bank_degraded_b{B}_tick_ms_p99", degraded[1],
+        f"ms/tick p99, strict fence, 1/8 slots evicted to Python "
+        f"(p50 {degraded[0]:.2f} ms, host fraction {degraded[2]:.2f}; "
+        f"all-native p99 {healthy[1]:.2f} ms)",
+        healthy[1] / degraded[1] if degraded[1] else 0.0,
     )
 
 

@@ -1,0 +1,207 @@
+"""Pool-scale chaos harness for the supervised session bank: the SHARED
+driver behind ``scripts/chaos.py`` and ``tests/test_bank_faults.py``
+(DESIGN.md §9).
+
+The topology under test: ``2 * n_matches`` in-bank slots — each 2-peer
+match on its OWN fault-isolated ``InMemoryNetwork``, so no fault-rng stream
+couples matches — plus one targeted slot whose peer is an EXTERNAL
+``P2PSession``.  Faults are driven through the pool's REAL tick path
+(``inject_datagram`` splices raw bytes into the slot's inbound routing,
+``inject_slot_error`` rides the ctrl-op channel, blackouts silence the
+external peer), and every observable needed for a bit-exact comparison
+against a fault-free control leg is recorded: per-slot wire bytes, request
+lists, and events.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Any, Callable, Dict, List, Optional
+
+from .core import Local, Remote
+from .core.config import Config
+from .net import InMemoryNetwork
+from .parallel.host_bank import HostSessionPool, SLOT_NATIVE
+from .sessions import SessionBuilder
+
+
+class RecordingSocket:
+    """Wraps a socket, recording every (addr, wire bytes) sent."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.sent = []
+
+    def send_to(self, msg, addr):
+        self.sent.append((addr, msg.encode()))
+        self.inner.send_to(msg, addr)
+
+    def receive_all_datagrams(self):
+        return self.inner.receive_all_datagrams()
+
+    def receive_all_messages(self):
+        return self.inner.receive_all_messages()
+
+
+def two_peer_builder(clock, rng_seed, me, other_name, other_handle=None):
+    """One side of a 2-peer uint16 match on a frozen list-clock."""
+    return (
+        SessionBuilder(Config.for_uint(16))
+        .with_clock(lambda: clock[0])
+        .with_rng(random.Random(rng_seed))
+        .add_player(Local(), me)
+        .add_player(
+            Remote(other_name),
+            other_handle if other_handle is not None else 1 - me,
+        )
+    )
+
+
+def fulfill(requests) -> None:
+    """Fulfill saves with the frame itself as state; validate loads."""
+    for r in requests:
+        k = type(r).__name__
+        if k == "SaveGameState":
+            r.cell.save(r.frame, r.frame, None)
+        elif k == "LoadGameState":
+            assert r.cell.data() is not None, (
+                f"load of unfulfilled cell at frame {r.frame}"
+            )
+
+
+def req_summary(requests) -> List:
+    """Comparable summary of a request list (kind + frame / inputs)."""
+    out = []
+    for r in requests:
+        k = type(r).__name__
+        if k == "AdvanceFrame":
+            out.append(("adv", tuple(r.inputs)))
+        else:
+            out.append((k, r.frame))
+    return out
+
+
+# Datagrams every path must drop at parse, before any state advance
+MALFORMED_BURST = [
+    b"",                          # empty
+    b"\x01",                      # shorter than a header
+    b"\xaa\xbb\xff",              # unknown tag 0xff
+    b"\xaa\xbb\x00\x01",          # input tag, truncated body
+    b"\xaa\xbb\x01\x02\x03\x04",  # input-ack with trailing garbage
+    b"\xaa\xbb\x02\x00",          # quality report, truncated
+    b"\xaa\xbb\x05\x00",          # keep-alive with trailing garbage
+    bytes(64),                    # zeros (input tag, malformed statuses)
+]
+
+
+def drive_chaos(
+    ticks: int,
+    n_matches: int = 4,
+    seed: int = 0,
+    inject: Optional[Callable[[int, Dict[str, Any]], Any]] = None,
+    ext_alive: Optional[Callable[[int], bool]] = None,
+    retire: bool = False,
+    fault_cfg: Optional[Dict[str, Any]] = None,
+) -> Dict[str, Any]:
+    """Build the chaos topology and drive ``ticks`` pool ticks.
+
+    ``inject(i, ctx)`` runs at the top of tick ``i`` (``ctx`` carries
+    ``pool``, ``ext``, ``target``, ``seed``); ``ext_alive(i)`` gates driving
+    the external peer (its blackout switch).  Identical arguments produce a
+    bit-identical run — the control/chaos comparison contract.
+    """
+    base = seed * 1000
+    clock = [0]
+    nets = []
+    pool = HostSessionPool(retire_dead_matches=retire)
+    socks = []
+    for m in range(n_matches):
+        cfg = dict(fault_cfg or {"latency_ticks": 1})
+        cfg.setdefault("seed", base + 100 + m)
+        net = InMemoryNetwork(**cfg)
+        nets.append(net)
+        names = (f"A{m}", f"B{m}")
+        for me in (0, 1):
+            s = RecordingSocket(net.socket(names[me]))
+            socks.append(s)
+            pool.add_session(
+                two_peer_builder(clock, base + 3 + 5 * m + me, me,
+                                 names[1 - me]),
+                s,
+            )
+    cfg = dict(fault_cfg or {"latency_ticks": 1})
+    cfg.setdefault("seed", base + 99)
+    net_t = InMemoryNetwork(**cfg)
+    nets.append(net_t)
+    target = len(socks)
+    ts = RecordingSocket(net_t.socket("T"))
+    socks.append(ts)
+    pool.add_session(two_peer_builder(clock, base + 71, 0, "X"), ts)
+    ext = two_peer_builder(clock, base + 72, 1, "T",
+                           other_handle=0).start_p2p_session(
+        net_t.socket("X")
+    )
+    if not pool.native_active:
+        raise RuntimeError("native session bank unavailable")
+
+    n = len(pool)
+    reqs_log: List[List] = [[] for _ in range(n)]
+    events_log: List[List] = [[] for _ in range(n)]
+
+    def sched(i, idx):
+        return ((i + 2 * idx) // (2 + idx % 3)) % 16
+
+    ctx: Dict[str, Any] = dict(
+        pool=pool, ext=ext, target=target, nets=nets, clock=clock, seed=seed,
+    )
+    for i in range(ticks):
+        clock[0] += 16
+        if inject is not None:
+            inject(i, ctx)
+        if ext_alive is None or ext_alive(i):
+            ext.add_local_input(1, (i * 5) % 16)
+            fulfill(ext.advance_frame())
+        for idx in range(n):
+            pool.add_local_input(idx, idx % 2, sched(i, idx))
+        for idx, reqs in enumerate(pool.advance_all()):
+            fulfill(reqs)
+            reqs_log[idx].append(req_summary(reqs))
+        for idx in range(n):
+            events_log[idx].extend(pool.events(idx))
+        for net in nets:
+            net.tick()
+    ctx.update(
+        wire=[s.sent for s in socks],
+        reqs=reqs_log,
+        events=events_log,
+        states=[pool.slot_state(i) for i in range(n)],
+        frames=[pool.current_frame(i) for i in range(n)],
+    )
+    return ctx
+
+
+def blast_radius_violations(
+    chaos: Dict[str, Any],
+    control: Dict[str, Any],
+    survivors: Optional[List[int]] = None,
+) -> List[str]:
+    """The acceptance check: every surviving slot must stay bank-resident
+    and bit-identical — wire bytes, request lists, events — to the control
+    leg, and the crossing count must stay one per pool tick.  Returns the
+    (hopefully empty) violation list so callers can assert or report."""
+    target = chaos["target"]
+    if survivors is None:
+        survivors = [i for i in range(len(chaos["states"])) if i != target]
+    out = []
+    for idx in survivors:
+        if chaos["states"][idx] != SLOT_NATIVE:
+            out.append(f"slot {idx} left native: {chaos['states'][idx]}")
+        for field in ("wire", "reqs", "events"):
+            if chaos[field][idx] != control[field][idx]:
+                out.append(f"slot {idx}: {field} diverged from control")
+    ticks = len(chaos["reqs"][0])
+    if chaos["pool"].crossings != ticks:
+        out.append(
+            f"crossing count {chaos['pool'].crossings} != {ticks} pool ticks"
+        )
+    return out

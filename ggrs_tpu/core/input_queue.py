@@ -196,6 +196,33 @@ class InputQueue(Generic[I]):
         return input_frame
 
     # ------------------------------------------------------------------
+    # adoption (fallback eviction)
+    # ------------------------------------------------------------------
+
+    def seed(self, start: Frame, inputs: List[I]) -> None:
+        """Populate an EMPTY queue with consecutive confirmed inputs for
+        frames ``[start, start + len(inputs))`` — the adoption path of
+        fallback eviction (mirror of native sync_core's ``ggrs_sync_seed``).
+        Slots land at ``frame % INPUT_QUEUE_LENGTH``, preserving the
+        addressing invariant normal sequential insertion from frame 0
+        establishes (``confirmed_input`` addresses by frame-mod while
+        ``input`` walks from the tail)."""
+        assert self.last_added_frame == NULL_FRAME and self.length == 0, (
+            "seed() requires a fresh queue"
+        )
+        assert start >= 0 and len(inputs) <= INPUT_QUEUE_LENGTH
+        if not inputs:
+            return
+        for i, value in enumerate(inputs):
+            frame = start + i
+            self._inputs[frame % INPUT_QUEUE_LENGTH] = PlayerInput(frame, value)
+        self.tail = start % INPUT_QUEUE_LENGTH
+        self.head = (start + len(inputs)) % INPUT_QUEUE_LENGTH
+        self.length = len(inputs)
+        self.first_frame = False
+        self.last_added_frame = start + len(inputs) - 1
+
+    # ------------------------------------------------------------------
     # discard
     # ------------------------------------------------------------------
 

@@ -24,6 +24,12 @@ from typing import List, Optional, Sequence
 from .wire import WireError
 
 _LIB_NAME = "_ggrs_codec.so"
+# GGRS_NATIVE_SANITIZE=1 (scripts/build_sanitized.sh) loads/builds a separate
+# ASan+UBSan-instrumented library so the parity and fault fuzzes can run
+# under sanitizers without touching the production .so
+_SANITIZE = bool(os.environ.get("GGRS_NATIVE_SANITIZE"))
+if _SANITIZE:
+    _LIB_NAME = "_ggrs_codec_san.so"
 # Resource caps for the fast path.  Real packets sit under the ~508-byte UDP
 # budget with at most the 128-input pending window; anything bigger (but
 # still legal for the Python codec, whose hard cap is 1<<22 bytes) falls back
@@ -146,9 +152,15 @@ def _build(lib_path: Path) -> bool:
         except OSError:
             pass  # raced with the owning process: leave it alone
     tmp = lib_path.with_name(f"{lib_path.name}.build.{os.getpid()}")
+    flags = (
+        ["-O1", "-g", "-fsanitize=address,undefined",
+         "-fno-sanitize-recover=all"]
+        if _SANITIZE
+        else ["-O2"]
+    )
     cmd = [
         "g++",
-        "-O2",
+        *flags,
         "-shared",
         "-fPIC",
         "-std=c++17",
@@ -301,6 +313,15 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.ggrs_ep_store_one.argtypes = [
             ctypes.c_void_p, ctypes.c_int64, ctypes.c_char_p, ctypes.c_size_t,
         ]
+        if hasattr(lib, "ggrs_ep_seed_send"):
+            # eviction-adoption seam; absent on a prebuilt older .so (such a
+            # library also lacks ggrs_bank_harvest, so bank_lib() keeps the
+            # pool on the Python fallback)
+            lib.ggrs_ep_seed_send.restype = None
+            lib.ggrs_ep_seed_send.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_char_p, ctypes.c_size_t,
+            ]
         if hasattr(lib, "ggrs_sync_new"):
             lib.ggrs_sync_new.restype = ctypes.c_void_p
             lib.ggrs_sync_new.argtypes = [ctypes.c_int, ctypes.c_int]
@@ -348,6 +369,16 @@ def _load() -> Optional[ctypes.CDLL]:
             lib.ggrs_sync_confirmed_input.argtypes = [
                 ctypes.c_void_p, ctypes.c_int, ctypes.c_int64, ctypes.c_char_p,
             ]
+            if hasattr(lib, "ggrs_sync_seed"):
+                lib.ggrs_sync_seed.restype = ctypes.c_int
+                lib.ggrs_sync_seed.argtypes = [
+                    ctypes.c_void_p, ctypes.c_int, ctypes.c_int64,
+                    ctypes.c_int32, ctypes.c_char_p,
+                ]
+                lib.ggrs_sync_tail_frame.restype = ctypes.c_int64
+                lib.ggrs_sync_tail_frame.argtypes = [
+                    ctypes.c_void_p, ctypes.c_int,
+                ]
         # ---- session bank (native/session_bank.cpp) ----
         if hasattr(lib, "ggrs_bank_new"):
             lib.ggrs_bank_new.restype = ctypes.c_void_p
@@ -379,6 +410,13 @@ def _load() -> Optional[ctypes.CDLL]:
             ]
             lib.ggrs_bank_session_count.restype = ctypes.c_int64
             lib.ggrs_bank_session_count.argtypes = [ctypes.c_void_p]
+            if hasattr(lib, "ggrs_bank_harvest"):
+                lib.ggrs_bank_harvest.restype = ctypes.c_int
+                lib.ggrs_bank_harvest.argtypes = [
+                    ctypes.c_void_p, ctypes.c_int64,
+                    ctypes.c_char_p, ctypes.c_size_t,
+                    ctypes.POINTER(ctypes.c_size_t),
+                ]
         _lib = lib
         return _lib
 
@@ -410,6 +448,18 @@ BANK_ERR_SYNC_INPUTS = -72
 BANK_ERR_CONFIRM = -73
 BANK_ERR_NO_PLAYERS = -74
 BANK_ERR_SEQUENCE = -75
+BANK_ERR_INJECTED = -76  # chaos-harness simulated slot fault (ctrl op 2)
+
+BANK_ERR_NAMES = {
+    BANK_ERR_CMD: "malformed command stream",
+    BANK_ERR_LANDED_SPLIT: "local inputs landed on different frames",
+    BANK_ERR_SYNC: "sync-core operation failed",
+    BANK_ERR_SYNC_INPUTS: "synchronized-input assembly failed",
+    BANK_ERR_CONFIRM: "confirmed-frame watermark invariant broken",
+    BANK_ERR_NO_PLAYERS: "every player disconnected",
+    BANK_ERR_SEQUENCE: "remote input frame out of sequence",
+    BANK_ERR_INJECTED: "injected fault (chaos harness)",
+}
 
 
 def sync_lib() -> Optional[ctypes.CDLL]:
@@ -428,9 +478,18 @@ def available() -> bool:
 def bank_lib() -> Optional[ctypes.CDLL]:
     """The loaded library for the native session bank, or None (drive the
     per-session Python sessions).  Same load/fallback policy as the other
-    fast paths; a prebuilt pre-bank library keeps its older fast paths."""
+    fast paths; a prebuilt pre-bank library keeps its older fast paths.
+    ``ggrs_bank_harvest`` is required alongside ``ggrs_bank_new``: the
+    supervision layer's eviction path needs it (and the seed symbols built
+    with it), so a pre-supervision prebuilt library must route pools to the
+    Python fallback rather than run a bank whose faults could never
+    evict."""
     lib = _load()
-    if lib is None or not hasattr(lib, "ggrs_bank_new"):
+    if (
+        lib is None
+        or not hasattr(lib, "ggrs_bank_new")
+        or not hasattr(lib, "ggrs_bank_harvest")
+    ):
         return None
     return lib
 

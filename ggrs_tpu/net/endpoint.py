@@ -191,6 +191,25 @@ class PyEndpointCore:
     def last_recv_frame(self) -> Frame:
         return self._last_recv
 
+    # ---- adoption (fallback eviction) ----
+
+    def seed_send(self, last_acked_frame: Frame, base: bytes) -> None:
+        """Adopt the send-side delta base: the resumed pending window
+        (re-fed via ``push_input``) compresses against — and must
+        sequentially follow — the exact base the peer last acked."""
+        self._last_acked_frame = last_acked_frame
+        self._last_acked = base
+
+    def seed_recv(
+        self, last_recv: Frame, entries: Sequence[Tuple[Frame, bytes]]
+    ) -> None:
+        """Adopt the receive-side ring: the frame payloads in-flight packets
+        will delta-decode against, plus the last-received watermark."""
+        for frame, payload in entries:
+            self._recv[frame] = payload
+        if last_recv > self._last_recv:
+            self._last_recv = last_recv
+
 
 class NativeEndpointCore:
     """C++-backed endpoint datapath (native/endpoint.cpp via ctypes)."""
@@ -435,6 +454,22 @@ class NativeEndpointCore:
 
     def last_recv_frame(self) -> Frame:
         return self._last_recv
+
+    # ---- adoption (fallback eviction) ----
+
+    def seed_send(self, last_acked_frame: Frame, base: bytes) -> None:
+        """``PyEndpointCore.seed_send`` over the native core."""
+        self._lib.ggrs_ep_seed_send(self._ptr, last_acked_frame, base, len(base))
+
+    def seed_recv(
+        self, last_recv: Frame, entries: Sequence[Tuple[Frame, bytes]]
+    ) -> None:
+        """``PyEndpointCore.seed_recv`` over the native core
+        (``ggrs_ep_store_one`` keeps the C++ last-recv watermark in step)."""
+        for frame, payload in entries:
+            self._lib.ggrs_ep_store_one(self._ptr, frame, payload, len(payload))
+        if last_recv > self._last_recv:
+            self._last_recv = last_recv
 
 
 def make_endpoint_core(

@@ -676,6 +676,51 @@ class PeerProtocol(Generic[I, A]):
         if staged is not None:
             self._finish_input(staged)
 
+    # ------------------------------------------------------------------
+    # adoption (fallback eviction)
+    # ------------------------------------------------------------------
+
+    def adopt_endpoint_state(
+        self,
+        *,
+        magic: int,
+        running: bool,
+        peer_connect_status: Sequence[Tuple[bool, Frame]],
+        last_recv_frame: Frame,
+        recv_entries: Sequence[Tuple[Frame, bytes]],
+        last_acked_frame: Frame,
+        send_base: bytes,
+        pending: Sequence[Tuple[Frame, bytes]],
+        pending_checksums: Optional[Dict[Frame, int]] = None,
+    ) -> None:
+        """Adopt a mid-stream endpoint's peer-visible state — the eviction
+        seam: a faulted native-bank slot resumes as a Python session and the
+        peer must see a retransmission hiccup, not a brand-new endpoint.
+
+        Adopted: the wire magic, the connect-status mirror, the un-acked
+        pending-output window with its delta base (the 200 ms retry timer
+        resends it, closing the peer's sequence gap), and the received-frame
+        ring in-flight packets delta-decode against.  NOT adopted: timers,
+        RTT, and the time-sync windows — liveness restarts from ``now`` and
+        the advantage estimate re-converges within one FRAME_WINDOW."""
+        self.magic = magic
+        for ours, (disc, lf) in zip(self.peer_connect_status, peer_connect_status):
+            ours.disconnected = bool(disc)
+            ours.last_frame = lf
+        self._core.seed_recv(last_recv_frame, recv_entries)
+        self._last_recv_frame = last_recv_frame
+        self._core.seed_send(last_acked_frame, send_base)
+        for frame, payload in pending:
+            self._core.push_input(frame, payload)
+        if pending_checksums:
+            self.pending_checksums = dict(pending_checksums)
+        if running:
+            # self-contained even for a sync_required endpoint: the adopted
+            # peer already proved itself live mid-match, so no re-handshake
+            self._state = _State.RUNNING
+        else:
+            self.disconnect()
+
     def _on_checksum_report(self, body: ChecksumReport) -> None:
         interval = self.desync_detection.interval if self.desync_detection.enabled else 1
         if len(self.pending_checksums) >= MAX_CHECKSUM_HISTORY_SIZE:

@@ -10,6 +10,7 @@ lacks but its trait design makes trivial.
 
 from __future__ import annotations
 
+import errno
 import logging
 import random
 import socket as _socket
@@ -17,9 +18,29 @@ from collections import deque
 from typing import Deque, Dict, Hashable, List, Optional, Protocol, Tuple, TypeVar
 
 from .messages import Message
+from .stats import NetworkStats
 from .wire import WireError
 
 logger = logging.getLogger(__name__)
+
+# Transient send failures a UDP socket can surface on Linux (often from a
+# previous datagram's ICMP error): the datagram counts as lost — which the
+# endpoint protocol's redundant sends already cover — instead of crashing
+# the session tick.  Anything else (EBADF after close, EACCES...) is a real
+# programming/configuration error and still raises.
+_TRANSIENT_SEND_ERRNOS = frozenset(
+    getattr(errno, name)
+    for name in (
+        "ENETUNREACH", "EHOSTUNREACH", "ECONNREFUSED", "ENETDOWN",
+        "EHOSTDOWN", "ENOBUFS", "EAGAIN", "EWOULDBLOCK",
+    )
+    if hasattr(errno, name)
+)
+# NOT in the set: EMSGSIZE (datagram exceeds the path/socket limit) and
+# EPERM (firewall/seccomp rejecting the destination) — deterministic local
+# faults that every retransmission would hit identically; swallowing them
+# would turn a configuration error into a silent stall instead of an
+# actionable raise on the first send.
 
 A = TypeVar("A", bound=Hashable)
 
@@ -44,6 +65,9 @@ class UdpNonBlockingSocket:
         self._sock = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
         self._sock.bind(("0.0.0.0", port))
         self._sock.setblocking(False)
+        # socket-level counters (send_errors is the live field here; the
+        # per-endpoint protocol stats carry their own copy of the rest)
+        self.stats = NetworkStats()
 
     @staticmethod
     def bind_to_port(port: int) -> "UdpNonBlockingSocket":
@@ -59,7 +83,15 @@ class UdpNonBlockingSocket:
                 len(buf),
                 IDEAL_MAX_UDP_PACKET_SIZE,
             )
-        self._sock.sendto(buf, addr)
+        try:
+            self._sock.sendto(buf, addr)
+        except OSError as e:
+            # mirror of the receive path's ConnectionResetError handling:
+            # transient OS errors count as packet loss, not session death
+            if e.errno not in _TRANSIENT_SEND_ERRNOS:
+                raise
+            self.stats.send_errors += 1
+            logger.debug("UDP send to %s failed transiently: %s", addr, e)
 
     def receive_all_messages(self) -> List[Tuple[Tuple[str, int], Message]]:
         received: List[Tuple[Tuple[str, int], Message]] = []

@@ -119,7 +119,7 @@ def build_replay_programs(
     faster than fully unrolled on the flagship config (the earlier
     unroll-everything choice was tuned against enqueue-rate fiction —
     smaller programs schedule better here), while moderate tick unroll (4)
-    stays best.  See docs/DESIGN.md §10.
+    stays best.  See docs/DESIGN.md §11.
     """
     assert check_distance >= 1, "device replay needs check_distance >= 1"
     assert ring_length > check_distance, "ring must cover the rollback window"

@@ -29,6 +29,24 @@ exercised only in disconnect scenarios; the fallback is exact): reactions
 to ``Disconnected`` protocol events and disconnect-consensus adjustments
 are computed from this tick's mirrors and applied as next tick's control
 ops.
+
+FAULT ISOLATION (the supervision layer): sharing one C++ bank across B
+sessions must not share one blast radius.  The native bank reports
+per-session error codes in its output records instead of failing the tick
+(session_bank.cpp); on a slot fault this pool QUARANTINES the slot (its
+command segment shrinks to a skip flag; the other B-1 sessions keep the
+one-crossing-per-tick path), harvests the slot's last committed state
+(``ggrs_bank_harvest`` — a one-off extra crossing), and EVICTS it to a
+freshly-built Python ``P2PSession`` that resumes the same match from the
+last committed frame via the adoption seam
+(``P2PSession.adopt_resume_state``).  Eviction retries with backoff a
+bounded number of times; an unrecoverable slot is marked DEAD and its
+request lists go empty.  The same per-slot containment wraps the Python
+fallback path (a session whose tick raises is marked dead; the rest keep
+ticking).  Chaos hooks (``inject_datagram``, ``inject_slot_error``) let
+tests and ``scripts/chaos.py`` drive faults through the real tick path;
+tests/test_bank_faults.py pins blast radius = 1 slot with the survivors
+bit-identical to a fault-free run.
 """
 
 from __future__ import annotations
@@ -40,7 +58,7 @@ import struct
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
-from ..core.errors import InvalidRequest
+from ..core.errors import GgrsError, InvalidRequest, NotSynchronized
 from ..core.sync_layer import SavedStates
 from ..core.types import (
     AdvanceFrame,
@@ -53,6 +71,7 @@ from ..core.types import (
     NetworkResumed,
     NULL_FRAME,
     SaveGameState,
+    SessionState,
     WaitRecommendation,
 )
 from ..net import _native
@@ -82,6 +101,31 @@ _EV_CHECKSUM = 4
 _RECV_CAP_BYTES = 1 << 16
 _RECV_CAP_FRAMES = 512
 _WORST_CASE_FRAMES = 192  # 128-deep pending window with generous slack
+
+# slot supervision states (the fault-isolation layer)
+SLOT_NATIVE = "native"          # stepped by the bank (or the py fallback)
+SLOT_QUARANTINED = "quarantined"  # faulted; eviction pending/backing off
+SLOT_EVICTED = "evicted"        # resumed on a per-session Python P2PSession
+SLOT_DEAD = "dead"              # unrecoverable; request lists stay empty
+
+# eviction retry policy: attempt n+1 waits n * backoff ticks; after the
+# bounded attempts the slot is marked dead
+EVICT_MAX_ATTEMPTS = 3
+EVICT_BACKOFF_TICKS = 8
+
+
+class SlotFault:
+    """One fault-log entry for a pool slot."""
+
+    __slots__ = ("tick", "code", "detail")
+
+    def __init__(self, tick: int, code: int, detail: str):
+        self.tick = tick
+        self.code = code
+        self.detail = detail
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"SlotFault(tick={self.tick}, code={self.code}, {self.detail!r})"
 
 
 def _uvarint_len(v: int) -> int:
@@ -203,7 +247,7 @@ class HostSessionPool:
     Python sessions, where each honors its own clock.
     """
 
-    def __init__(self) -> None:
+    def __init__(self, retire_dead_matches: bool = False) -> None:
         self._builders: List[Tuple[Any, Any]] = []
         self._finalized = False
         self._native_active = False
@@ -216,6 +260,22 @@ class HostSessionPool:
         self._out_len = ctypes.c_size_t(0)
         self._invalid: Optional[str] = None
         self.crossings = 0  # ggrs_bank_tick invocations (the count test)
+        self.harvests = 0   # eviction harvest crossings (one-off per fault)
+        # ---- supervision state (fault isolation) ----
+        # retire_dead_matches: when every remote endpoint of a slot has
+        # disconnected the match is over; True retires the slot (state dead,
+        # empty request lists) instead of letting it run free on dummy
+        # inputs forever.  Default False preserves P2PSession semantics.
+        self.retire_dead_matches = retire_dead_matches
+        self._tick_no = 0
+        self._slot_state: List[str] = []
+        self._fault_log: List[List[SlotFault]] = []
+        self._evicted: Dict[int, Any] = {}       # index -> P2PSession
+        self._pending_load: Dict[int, GgrsRequest] = {}
+        self._evict_attempts: Dict[int, int] = {}
+        self._evict_next_try: Dict[int, int] = {}
+        self._inject_dgrams: Dict[int, List[Tuple[int, bytes]]] = {}
+        self._inject_err: Dict[int, int] = {}
 
     # ------------------------------------------------------------------
     # construction
@@ -231,6 +291,8 @@ class HostSessionPool:
 
     def _finalize(self) -> None:
         self._finalized = True
+        self._slot_state = [SLOT_NATIVE] * len(self._builders)
+        self._fault_log = [[] for _ in self._builders]
         lib = None if os.environ.get("GGRS_TPU_NO_NATIVE") else (
             _native.bank_lib()
         )
@@ -359,8 +421,14 @@ class HostSessionPool:
     def add_local_input(self, index: int, handle: int, value) -> None:
         if not self._finalized:
             self._finalize()
+        state = self._slot_state[index]
+        if state == SLOT_DEAD:
+            return  # dead slots accept and drop (the match is over)
         if not self._native_active:
             self._sessions[index].add_local_input(handle, value)
+            return
+        if state == SLOT_EVICTED:
+            self._evicted[index].add_local_input(handle, value)
             return
         m = self._mirrors[index]
         if handle not in m.local_handle_set:
@@ -372,30 +440,52 @@ class HostSessionPool:
 
     def advance_all(self) -> List[List[GgrsRequest]]:
         """Run every session's tick (poll + advance); returns the B request
-        lists in session order.  Native path: exactly one ctypes crossing."""
+        lists in session order.  Native path: exactly one ctypes crossing
+        for every bank-resident slot; evicted slots tick their Python
+        session; quarantined/dead slots return empty lists."""
         if not self._finalized:
             self._finalize()
         if not self._native_active:
-            return [s.advance_frame() for s in self._sessions]
+            return self._advance_all_fallback()
         self._check_valid()
+        self._tick_no += 1
 
         pack = struct.pack
-        # validate EVERY session's staged inputs before any destructive step
-        # (ctrl-op swap, socket drain): raising mid-build would silently lose
-        # pending disconnect ops and drained datagrams on a caller retry
-        for m in self._mirrors:
+        # validate EVERY bank-resident session's staged inputs before any
+        # destructive step (ctrl-op swap, socket drain): raising mid-build
+        # would silently lose pending disconnect ops and drained datagrams
+        # on a caller retry.  (Evicted sessions enforce their own contract.)
+        for i, m in enumerate(self._mirrors):
+            state = self._slot_state[i]
+            if state == SLOT_EVICTED:
+                # same pre-crossing check for evicted sessions: their
+                # advance_frame raising AFTER the bank crossing would lose
+                # the healthy slots' request lists for this tick
+                self._evicted[i].validate_local_inputs()
+                continue
+            if state not in (SLOT_NATIVE, SLOT_QUARANTINED):
+                continue
             for handle in m.local_handles:
                 if handle not in m.staged_inputs:
                     raise InvalidRequest(
                         f"Missing local input for handle {handle} while "
                         "calling advance_frame()."
                     )
+        # snapshot which slots the bank steps this tick: the parse below
+        # must use the build-time view even if new faults land mid-parse
+        ticked = [s == SLOT_NATIVE for s in self._slot_state]
         cmd_parts: List[bytes] = []
-        for m in self._mirrors:
+        for i, m in enumerate(self._mirrors):
+            if not ticked[i]:
+                cmd_parts.append(b"\x02")  # kFlagSkip: no fields follow
+                continue
             cmd_parts.append(b"\x01")
             cmd_parts.extend(m.staged_inputs[h] for h in m.local_handles)
             ctrl = m.pending_ctrl
             m.pending_ctrl = []
+            inj = self._inject_err.pop(i, None)
+            if inj is not None:
+                ctrl = ctrl + [(2, 0, inj)]  # op 2: simulated slot fault
             cmd_parts.append(pack("<H", len(ctrl)))
             for op, ep_idx, frame in ctrl:
                 cmd_parts.append(pack("<BHq", op, ep_idx, frame))
@@ -404,6 +494,7 @@ class HostSessionPool:
                 ep_idx = m.addr_to_ep.get(from_addr)
                 if ep_idx is not None:
                     datagrams.append((ep_idx, data))
+            datagrams.extend(self._inject_dgrams.pop(i, ()))
             cmd_parts.append(pack("<H", len(datagrams)))
             for ep_idx, data in datagrams:
                 cmd_parts.append(pack("<HI", ep_idx, len(data)))
@@ -427,26 +518,31 @@ class HostSessionPool:
                 ctypes.byref(self._out_len),
             )
         if rc != 0:
+            # the only whole-bank failure left is a malformed command stream
+            # (a bug in THIS builder, no per-session blame possible)
             self._invalid = f"ggrs_bank_tick failed: {rc}"
-            if rc in (_native.BANK_ERR_SYNC, _native.BANK_ERR_CONFIRM,
-                      _native.BANK_ERR_SEQUENCE, _native.BANK_ERR_SYNC_INPUTS,
-                      _native.BANK_ERR_LANDED_SPLIT):
-                # the Python path fails these as AssertionErrors; match it
-                raise AssertionError(self._invalid)
             raise RuntimeError(self._invalid)
-        return self._parse_output()
+        request_lists = self._parse_output(ticked)
+        self._supervise(request_lists)
+        return request_lists
 
-    def _parse_output(self) -> List[List[GgrsRequest]]:
+    def _parse_output(self, ticked: List[bool]) -> List[List[GgrsRequest]]:
         buf = memoryview(self._out_buf).cast("B")[: self._out_len.value]
         unpack_from = struct.unpack_from
         pos = 0
         request_lists: List[List[GgrsRequest]] = []
-        for m in self._mirrors:
+        for idx, m in enumerate(self._mirrors):
             players, isize = m.num_players, m.input_size
-            landed, frames_ahead, current, confirmed, consensus, n_ops = (
-                unpack_from("<qiqqBH", buf, pos)
+            err, landed, frames_ahead, current, confirmed, consensus, n_ops = (
+                unpack_from("<iqiqqBH", buf, pos)
             )
-            pos += 31
+            pos += 35
+            # live: the bank actually stepped this slot and it didn't fault.
+            # A faulted slot's record is status-only (its ops/outbound/events
+            # were suppressed natively); parse positionally either way.
+            live = ticked[idx] and err == 0
+            if ticked[idx] and err != 0:
+                self._on_slot_fault(idx, err)
             requests: List[GgrsRequest] = []
             advanced = False
             decode = m.config.input_decode
@@ -481,12 +577,21 @@ class HostSessionPool:
             (n_out,) = unpack_from("<H", buf, pos)
             pos += 2
             socket = m.socket
+            send_failed: Optional[str] = None
             for _ in range(n_out):
                 ep_idx, dlen = unpack_from("<HI", buf, pos)
                 pos += 6
                 data = bytes(buf[pos : pos + dlen])
                 pos += dlen
-                socket.send_to(RawMessage(data), m.endpoints[ep_idx].addr)
+                if send_failed is not None:
+                    continue  # slot already faulted; keep consuming bytes
+                try:
+                    socket.send_to(RawMessage(data), m.endpoints[ep_idx].addr)
+                except Exception as e:  # a send fault is THIS slot's fault
+                    send_failed = f"socket send failed: {e!r}"
+            if send_failed is not None:
+                self._on_slot_fault(idx, 0, send_failed)
+                live = False
             # stage event records; dispatch AFTER the status mirrors below
             # are parsed — _on_protocol_disconnected reads m.local_last, and
             # p2p.py's _handle_event sees the status as updated by this
@@ -525,35 +630,402 @@ class HostSessionPool:
                 m.local_last[h] = lf
 
             # ---- policy (Python): events, wait recommendation, consensus ----
-            for kind, ep_idx, payload in staged_events:
-                ep = m.endpoints[ep_idx]
-                if kind == _EV_INTERRUPTED:
-                    m.push_event(NetworkInterrupted(
-                        addr=ep.addr, disconnect_timeout=payload
-                    ))
-                elif kind == _EV_RESUMED:
-                    m.push_event(NetworkResumed(addr=ep.addr))
-                elif kind == _EV_DISCONNECTED:
-                    self._on_protocol_disconnected(m, ep_idx)
-                elif kind == _EV_CHECKSUM:
-                    frame, lo, hi = payload
-                    self._store_checksum(ep, frame, lo | (hi << 64))
-            pre_current = current - (1 if advanced else 0)
-            m.frames_ahead = frames_ahead
-            if (
-                pre_current > m.next_recommended_sleep
-                and frames_ahead >= MIN_RECOMMENDATION
-            ):
-                m.next_recommended_sleep = pre_current + RECOMMENDATION_INTERVAL
-                m.push_event(WaitRecommendation(skip_frames=frames_ahead))
-            m.current_frame = current
-            m.last_confirmed = confirmed
-            if advanced:
-                m.staged_inputs.clear()
-            if consensus:
-                self._run_consensus(m)
+            # applied only for live slots; a faulted/skipped record carries
+            # no events and its policy state is frozen pending supervision
+            if live:
+                for kind, ep_idx, payload in staged_events:
+                    ep = m.endpoints[ep_idx]
+                    if kind == _EV_INTERRUPTED:
+                        m.push_event(NetworkInterrupted(
+                            addr=ep.addr, disconnect_timeout=payload
+                        ))
+                    elif kind == _EV_RESUMED:
+                        m.push_event(NetworkResumed(addr=ep.addr))
+                    elif kind == _EV_DISCONNECTED:
+                        self._on_protocol_disconnected(m, ep_idx)
+                    elif kind == _EV_CHECKSUM:
+                        frame, lo, hi = payload
+                        self._store_checksum(ep, frame, lo | (hi << 64))
+                pre_current = current - (1 if advanced else 0)
+                m.frames_ahead = frames_ahead
+                if (
+                    pre_current > m.next_recommended_sleep
+                    and frames_ahead >= MIN_RECOMMENDATION
+                ):
+                    m.next_recommended_sleep = (
+                        pre_current + RECOMMENDATION_INTERVAL
+                    )
+                    m.push_event(WaitRecommendation(skip_frames=frames_ahead))
+                if advanced:
+                    m.staged_inputs.clear()
+                if consensus:
+                    self._run_consensus(m)
+            if ticked[idx]:
+                m.current_frame = current
+                m.last_confirmed = confirmed
+            if not live:
+                requests = []
             request_lists.append(requests)
         return request_lists
+
+    # ------------------------------------------------------------------
+    # supervision: quarantine, eviction, retirement (fault isolation)
+    # ------------------------------------------------------------------
+
+    def _advance_all_fallback(self) -> List[List[GgrsRequest]]:
+        """Per-session Python path with the same per-slot containment: a
+        session whose tick raises is marked dead (no Python-to-Python
+        eviction exists — it IS the fallback) while the rest keep ticking.
+        Deliberate contract errors (``GgrsError``: missing inputs, not
+        synchronized) still propagate to the caller."""
+        self._tick_no += 1
+        # validate every live session's preconditions BEFORE any session
+        # advances: a contract raise mid-loop would discard earlier
+        # sessions' already-generated request lists (the native path makes
+        # the same check before its crossing).  Handshaking sessions are
+        # POLLED first — raising without polling would starve the handshake
+        # of its sync-request/reply datagrams (in-pool peers would never
+        # answer each other) and livelock the pool — then the pool raises
+        # once for all of them, losslessly: nothing has advanced yet.
+        synchronizing = False
+        for i, s in enumerate(self._sessions):
+            if self._slot_state[i] == SLOT_DEAD:
+                continue
+            if s.current_state() is SessionState.SYNCHRONIZING:
+                s.poll_remote_clients()
+                synchronizing |= (
+                    s.current_state() is SessionState.SYNCHRONIZING
+                )
+        if synchronizing:
+            raise NotSynchronized()
+        for i, s in enumerate(self._sessions):
+            if self._slot_state[i] != SLOT_DEAD:
+                s.validate_local_inputs()
+        out: List[List[GgrsRequest]] = []
+        for i, s in enumerate(self._sessions):
+            if self._slot_state[i] == SLOT_DEAD:
+                out.append([])
+                continue
+            try:
+                out.append(s.advance_frame())
+            except GgrsError:
+                raise
+            except Exception as e:
+                self._on_slot_fault(i, 0, f"{type(e).__name__}: {e}")
+                self._slot_state[i] = SLOT_DEAD
+                out.append([])
+                continue
+            if self.retire_dead_matches:
+                self._maybe_retire(i, s._remote_endpoints and all(
+                    not ep.is_running() for ep in s._remote_endpoints
+                ))
+        return out
+
+    def _maybe_retire(self, index: int, match_over) -> None:
+        """With ``retire_dead_matches``, a slot whose every remote endpoint
+        has disconnected is retired: the match is over, so empty request
+        lists beat running free on dummy inputs forever.  ``match_over``
+        must already be False for sessions with no remote endpoints."""
+        if self.retire_dead_matches and match_over:
+            self._fault_log[index].append(SlotFault(
+                self._tick_no, 0,
+                "match over: every remote endpoint disconnected",
+            ))
+            self._slot_state[index] = SLOT_DEAD
+
+    def _supervise(self, request_lists: List[List[GgrsRequest]]) -> None:
+        """Post-tick supervision pass: retire dead matches, drive pending
+        evictions, and tick evicted sessions — filling their slots of
+        ``request_lists`` in place."""
+        for i, state in enumerate(self._slot_state):
+            if state == SLOT_NATIVE:
+                if self.retire_dead_matches:
+                    m = self._mirrors[i]
+                    self._maybe_retire(i, m.endpoints and all(
+                        not ep.running for ep in m.endpoints
+                    ))
+                continue
+            if state == SLOT_QUARANTINED:
+                self._try_evict(i)
+                state = self._slot_state[i]
+            if state != SLOT_EVICTED:
+                continue
+            session = self._evicted[i]
+            try:
+                reqs = session.advance_frame()
+            except GgrsError:
+                raise
+            except Exception as e:
+                # the fallback faulted too (e.g. the same malicious peer):
+                # blast radius stays this one slot
+                self._on_slot_fault(i, 0, f"evicted tick: {type(e).__name__}: {e}")
+                self._slot_state[i] = SLOT_DEAD
+                request_lists[i] = []
+                continue
+            load = self._pending_load.pop(i, None)
+            if load is not None:
+                # the resume tick leads with restoring the game state saved
+                # at the slot's last committed frame
+                reqs = [load] + reqs
+            request_lists[i] = reqs
+            if self.retire_dead_matches:
+                self._maybe_retire(i, session._remote_endpoints and all(
+                    not ep.is_running() for ep in session._remote_endpoints
+                ))
+
+    def _on_slot_fault(self, index: int, code: int, detail: str = "") -> None:
+        """Record a fault and quarantine the slot: the bank stops stepping
+        it (skip flag) while eviction — resume on the Python fallback from
+        the last committed frame — is attempted with bounded backoff."""
+        self._fault_log[index].append(SlotFault(
+            self._tick_no, code,
+            detail or _native.BANK_ERR_NAMES.get(code, f"bank error {code}"),
+        ))
+        if self._slot_state[index] == SLOT_NATIVE:
+            self._slot_state[index] = SLOT_QUARANTINED
+            self._evict_attempts[index] = 0
+            self._evict_next_try[index] = self._tick_no  # try immediately
+
+    def _try_evict(self, index: int) -> None:
+        if self._tick_no < self._evict_next_try.get(index, 0):
+            return  # backing off
+        attempt = self._evict_attempts.get(index, 0) + 1
+        self._evict_attempts[index] = attempt
+        self._evict_next_try[index] = (
+            self._tick_no + EVICT_BACKOFF_TICKS * attempt
+        )
+        try:
+            session, load_req = self._evict(index)
+        except Exception as e:
+            self._fault_log[index].append(SlotFault(
+                self._tick_no, 0, f"eviction attempt {attempt} failed: {e}"
+            ))
+            if attempt >= EVICT_MAX_ATTEMPTS:
+                self._slot_state[index] = SLOT_DEAD
+            return
+        self._evicted[index] = session
+        self._pending_load[index] = load_req
+        self._slot_state[index] = SLOT_EVICTED
+        self._fault_log[index].append(SlotFault(
+            self._tick_no, 0,
+            f"evicted to Python fallback, resuming from frame "
+            f"{load_req.frame}",
+        ))
+
+    def _evict(self, index: int):
+        """Build a fresh ``P2PSession`` resuming from the slot's last
+        committed frame: harvest the native state (read-only, retry-safe),
+        adopt it through the adoption seam, feed this tick's staged inputs,
+        and hand back the session plus the leading ``LoadGameState``."""
+        m = self._mirrors[index]
+        builder, socket = self._builders[index]
+        h = self._harvest(index)
+        # Resume from the newest frame whose save the game actually
+        # fulfilled.  Normally that is the confirmed watermark, but a fault
+        # tick can raise the watermark and then have its own save op
+        # suppressed (native fault after set_last_confirmed, or a send
+        # failure dropping the parsed requests) — then the watermark-1 cell
+        # is the newest committed state, and the harvest keeps that frame's
+        # inputs precisely for this case.  Frames at or below the watermark
+        # can never hold misprediction state (the watermark cannot pass the
+        # first incorrect frame), so either cell is sound to resume from.
+        resume = None
+        cell = None
+        for r in (h["last_confirmed"], h["last_confirmed"] - 1):
+            if r < 0:
+                continue
+            c = m.saved_states.get_cell(r)
+            if c.frame != r:
+                continue
+            if any(
+                blobs and start > r for start, blobs in h["player_inputs"]
+            ):
+                continue  # harvested inputs do not reach back to r
+            resume, cell = r, c
+            break
+        if resume is None:
+            raise RuntimeError(
+                f"no committed resumable frame at or below "
+                f"{h['last_confirmed']} (unfulfilled saves?)"
+            )
+        session = builder.start_p2p_session(socket)
+        endpoint_states = {}
+        for e, ep in enumerate(m.endpoints):
+            he = h["endpoints"][e]
+            endpoint_states[ep.addr] = dict(
+                magic=ep.magic,
+                running=he["state"] == 0,
+                peer_connect_status=list(zip(ep.peer_disc, ep.peer_last)),
+                last_recv_frame=he["last_recv"],
+                recv_entries=he["recv_entries"],
+                last_acked_frame=he["last_acked_frame"],
+                send_base=he["send_base"],
+                pending=he["pending"],
+                pending_checksums=ep.pending_checksums,
+            )
+        session.adopt_resume_state(
+            frame=resume,
+            last_confirmed=resume,
+            saved_states=m.saved_states,
+            connect_status=list(zip(h["local_disc"], h["local_last"])),
+            player_inputs=h["player_inputs"],
+            endpoint_states=endpoint_states,
+            next_recommended_sleep=m.next_recommended_sleep,
+            pending_events=list(m.event_queue),
+        )
+        m.event_queue.clear()
+        decode = m.config.input_decode
+        for handle in m.local_handles:
+            blob = m.staged_inputs.get(handle)
+            if blob is not None:
+                session.add_local_input(handle, decode(blob))
+        m.staged_inputs.clear()
+        return session, LoadGameState(cell=cell, frame=resume)
+
+    def _harvest(self, index: int) -> Dict[str, Any]:
+        """One ``ggrs_bank_harvest`` crossing, parsed into the adoption
+        inputs (see session_bank.cpp for the layout)."""
+        self.harvests += 1
+        buf = ctypes.create_string_buffer(1 << 16)
+        out_len = ctypes.c_size_t(0)
+        while True:
+            rc = self._lib.ggrs_bank_harvest(
+                self._bank, index, buf, len(buf), ctypes.byref(out_len)
+            )
+            if rc == _native.BANK_ERR_BUFFER_TOO_SMALL:
+                buf = ctypes.create_string_buffer(
+                    max(out_len.value, 2 * len(buf))
+                )
+                continue
+            if rc != 0:
+                raise RuntimeError(f"ggrs_bank_harvest failed: {rc}")
+            break
+        b = bytes(buf.raw[: out_len.value])
+        unpack_from = struct.unpack_from
+        current, confirmed, disc_frame = unpack_from("<qqq", b, 0)
+        players, isize = unpack_from("<BI", b, 24)
+        pos = 29
+        local_disc: List[bool] = []
+        local_last: List[Frame] = []
+        player_inputs: List[Tuple[Frame, List[bytes]]] = []
+        for _ in range(players):
+            disc, last = unpack_from("<Bq", b, pos)
+            pos += 9
+            local_disc.append(bool(disc))
+            local_last.append(last)
+            start, count = unpack_from("<qI", b, pos)
+            pos += 12
+            blobs = [
+                b[pos + i * isize : pos + (i + 1) * isize]
+                for i in range(count)
+            ]
+            pos += count * isize
+            player_inputs.append((start, blobs))
+        (n_eps,) = unpack_from("<B", b, pos)
+        pos += 1
+        endpoints: List[Dict[str, Any]] = []
+        for _ in range(n_eps):
+            (state,) = unpack_from("<B", b, pos)
+            pos += 1
+            last_acked, base_len = unpack_from("<qI", b, pos)
+            pos += 12
+            send_base = b[pos : pos + base_len]
+            pos += base_len
+            (n_pending,) = unpack_from("<H", b, pos)
+            pos += 2
+            pending: List[Tuple[Frame, bytes]] = []
+            for _ in range(n_pending):
+                frame, dlen = unpack_from("<qI", b, pos)
+                pos += 12
+                pending.append((frame, b[pos : pos + dlen]))
+                pos += dlen
+            last_recv, n_recv = unpack_from("<qH", b, pos)
+            pos += 10
+            recv_entries: List[Tuple[Frame, bytes]] = []
+            for _ in range(n_recv):
+                frame, dlen = unpack_from("<qI", b, pos)
+                pos += 12
+                recv_entries.append((frame, b[pos : pos + dlen]))
+                pos += dlen
+            endpoints.append(dict(
+                state=state, last_acked_frame=last_acked,
+                send_base=send_base, pending=pending,
+                last_recv=last_recv, recv_entries=recv_entries,
+            ))
+        if pos != len(b):
+            raise RuntimeError("harvest buffer layout mismatch")
+        return dict(
+            current=current, last_confirmed=confirmed,
+            disconnect_frame=disc_frame, local_disc=local_disc,
+            local_last=local_last, player_inputs=player_inputs,
+            endpoints=endpoints,
+        )
+
+    # ------------------------------------------------------------------
+    # chaos hooks (tests + scripts/chaos.py)
+    # ------------------------------------------------------------------
+
+    def inject_datagram(self, index: int, from_addr, data: bytes) -> None:
+        """Chaos hook: deliver raw datagram bytes to session ``index`` as if
+        they arrived from ``from_addr``, without touching the network (other
+        slots' traffic and fault-rng streams are unperturbed).  Native slots
+        stage for the next tick's crossing; evicted slots process
+        immediately through the session's receive path."""
+        if not self._finalized:
+            self._finalize()
+        if not self._native_active:
+            raise InvalidRequest("inject_datagram requires the native bank")
+        state = self._slot_state[index]
+        if state == SLOT_EVICTED:
+            ep = self._evicted[index]._player_reg.remotes.get(from_addr)
+            if ep is None:
+                raise InvalidRequest(f"no endpoint for address {from_addr!r}")
+            ep.handle_datagram(data)
+            return
+        if state != SLOT_NATIVE:
+            # quarantined/dead slots process no traffic; dropping silently
+            # would let a chaos run report clean without exercising its fault
+            raise InvalidRequest(
+                f"slot {index} is {state}: it processes no datagrams"
+            )
+        m = self._mirrors[index]
+        ep_idx = m.addr_to_ep.get(from_addr)
+        if ep_idx is None:
+            raise InvalidRequest(f"no endpoint for address {from_addr!r}")
+        self._inject_dgrams.setdefault(index, []).append((ep_idx, bytes(data)))
+
+    def inject_slot_error(self, index: int, code: Optional[int] = None) -> None:
+        """Chaos hook: make session ``index`` fault with ``code`` (default
+        ``BANK_ERR_INJECTED``) on the next native tick — the stand-in for a
+        real mid-tick native fault, driven through the real ctrl-op path."""
+        if not self._finalized:
+            self._finalize()
+        if not self._native_active:
+            raise InvalidRequest("inject_slot_error requires the native bank")
+        if self._slot_state[index] != SLOT_NATIVE:
+            raise InvalidRequest(
+                f"slot {index} is {self._slot_state[index]}: only "
+                "bank-resident slots can take a simulated native fault"
+            )
+        self._inject_err[index] = int(
+            code if code is not None else _native.BANK_ERR_INJECTED
+        )
+
+    # ------------------------------------------------------------------
+    # supervision observables
+    # ------------------------------------------------------------------
+
+    def slot_state(self, index: int) -> str:
+        """``"native"`` (bank-resident, or the healthy fallback),
+        ``"quarantined"``, ``"evicted"``, or ``"dead"``."""
+        if not self._finalized:
+            self._finalize()
+        return self._slot_state[index]
+
+    def fault_log(self, index: int) -> List[SlotFault]:
+        if not self._finalized:
+            self._finalize()
+        return list(self._fault_log[index])
 
     # ------------------------------------------------------------------
     # policy helpers (the Python halves of the split)
@@ -622,6 +1094,8 @@ class HostSessionPool:
     def events(self, index: int) -> List:
         if not self.native_active:  # property finalizes lazily
             return self._sessions[index].events()
+        if index in self._evicted:  # evicted (or dead after eviction)
+            return self._evicted[index].events()
         m = self._mirrors[index]
         out = list(m.event_queue)
         m.event_queue.clear()
@@ -630,26 +1104,35 @@ class HostSessionPool:
     def current_frame(self, index: int) -> Frame:
         if not self.native_active:
             return self._sessions[index].current_frame
+        if index in self._evicted:
+            return self._evicted[index].current_frame
         return self._mirrors[index].current_frame
 
     def last_confirmed_frame(self, index: int) -> Frame:
         if not self.native_active:
             return self._sessions[index]._sync_layer.last_confirmed_frame
+        if index in self._evicted:
+            return self._evicted[index]._sync_layer.last_confirmed_frame
         return self._mirrors[index].last_confirmed
 
     def frames_ahead(self, index: int) -> int:
         if not self.native_active:
             return self._sessions[index].frames_ahead()
+        if index in self._evicted:
+            return self._evicted[index].frames_ahead()
         return self._mirrors[index].frames_ahead
 
     def session(self, index: int):
-        """The underlying P2PSession (fallback mode only — the native bank
+        """The underlying P2PSession: always present on the fallback path,
+        and present for EVICTED slots on the native path (the bank itself
         has no per-session objects)."""
-        if self.native_active:
-            raise InvalidRequest(
-                "native bank active: per-session objects do not exist"
-            )
-        return self._sessions[index]
+        if not self.native_active:
+            return self._sessions[index]
+        if index in self._evicted:
+            return self._evicted[index]
+        raise InvalidRequest(
+            "native bank active: this slot has no per-session object"
+        )
 
     def _check_valid(self) -> None:
         if self._invalid is not None:

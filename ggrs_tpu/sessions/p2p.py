@@ -188,6 +188,18 @@ class P2PSession(ThreadOwned, Generic[I, S, A]):
             return SessionState.SYNCHRONIZING
         return SessionState.RUNNING
 
+    def validate_local_inputs(self) -> None:
+        """Raise ``InvalidRequest`` unless every local player has staged an
+        input — ``advance_frame``'s precondition, exposed so pool drivers
+        can check it BEFORE any destructive step (socket drains, the native
+        bank crossing) instead of losing a tick's work to a late raise."""
+        for handle in self._local_handles:
+            if handle not in self._local_inputs:
+                raise InvalidRequest(
+                    f"Missing local input for handle {handle} while calling "
+                    "advance_frame()."
+                )
+
     def advance_frame(self) -> List[GgrsRequest]:
         """The main entry point; see the reference call stack
         (p2p_session.rs:265-426).  Returns the ordered request list."""
@@ -197,12 +209,7 @@ class P2PSession(ThreadOwned, Generic[I, S, A]):
         if self.current_state() is SessionState.SYNCHRONIZING:
             raise NotSynchronized()
 
-        for handle in self._local_handles:
-            if handle not in self._local_inputs:
-                raise InvalidRequest(
-                    f"Missing local input for handle {handle} while calling "
-                    "advance_frame()."
-                )
+        self.validate_local_inputs()
 
         # DESYNC DETECTION — must run before any frame can be newly marked
         # confirmed this tick: the comparison looks at the current confirmed
@@ -381,10 +388,20 @@ class P2PSession(ThreadOwned, Generic[I, S, A]):
     def network_stats(self, player_handle: PlayerHandle) -> NetworkStats:
         player_type = self._player_reg.handles.get(player_handle)
         if isinstance(player_type, Remote):
-            return self._player_reg.remotes[player_type.addr].network_stats()
-        if isinstance(player_type, Spectator):
-            return self._player_reg.spectators[player_type.addr].network_stats()
-        raise BadPlayerHandle()
+            stats = self._player_reg.remotes[player_type.addr].network_stats()
+        elif isinstance(player_type, Spectator):
+            stats = self._player_reg.spectators[
+                player_type.addr
+            ].network_stats()
+        else:
+            raise BadPlayerHandle()
+        # socket-level counter: transient OS send failures the socket
+        # swallowed as loss (UdpNonBlockingSocket.stats); sockets without
+        # the counter (fakes, user transports) report 0
+        sock_stats = getattr(self._socket, "stats", None)
+        if sock_stats is not None:
+            stats.send_errors = sock_stats.send_errors
+        return stats
 
     def confirmed_frame(self) -> Frame:
         """Minimum last-received frame over all connected players
@@ -438,6 +455,50 @@ class P2PSession(ThreadOwned, Generic[I, S, A]):
 
     def desync_detection(self) -> DesyncDetection:
         return self._desync_detection
+
+    # ------------------------------------------------------------------
+    # adoption (fallback eviction — the supervision seam)
+    # ------------------------------------------------------------------
+
+    def adopt_resume_state(
+        self,
+        *,
+        frame: Frame,
+        last_confirmed: Frame,
+        saved_states,
+        connect_status: List,
+        player_inputs: List,
+        endpoint_states: Dict,
+        next_recommended_sleep: Frame = 0,
+        pending_events: List = (),
+    ) -> None:
+        """Fast-forward a FRESH session to a mid-stream position: the
+        eviction path of the supervised session bank
+        (``parallel.host_bank``).  A faulted native slot's harvested state —
+        last committed frame, confirmed-input queues, connect statuses,
+        per-endpoint pending/received windows — is adopted so the session
+        resumes the SAME match from frame ``frame`` (the slot's last
+        committed frame) while its peers keep talking to the same address.
+
+        The caller is responsible for loading the game state saved at
+        ``frame`` before fulfilling this session's next request list (the
+        pool prepends the ``LoadGameState`` request).  Any speculative state
+        the faulted slot carried past ``frame`` is deliberately discarded —
+        predictions restart empty, so no disconnect-rollback descriptor is
+        adopted either."""
+        assert self._sync_layer.current_frame == 0, (
+            "adopt_resume_state() requires a freshly-built session"
+        )
+        self._sync_layer.adopt_resume_state(
+            frame, last_confirmed, saved_states, player_inputs
+        )
+        for handle, (disc, lf) in enumerate(connect_status):
+            self.local_connect_status[handle].disconnected = bool(disc)
+            self.local_connect_status[handle].last_frame = lf
+        for addr, state in endpoint_states.items():
+            self._player_reg.remotes[addr].adopt_endpoint_state(**state)
+        self._next_recommended_sleep = next_recommended_sleep
+        self._event_queue.extend(pending_events)
 
     # ------------------------------------------------------------------
     # internals

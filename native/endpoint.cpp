@@ -502,4 +502,102 @@ void ggrs_ep_store_one(void* ptr, int64_t frame, const uint8_t* payload,
   store_recv(static_cast<Endpoint*>(ptr), frame, payload, len);
 }
 
+// ---- eviction / supervision support --------------------------------------
+//
+// The supervised session bank (session_bank.cpp) evicts a faulted slot to
+// the per-session Python path, resuming from the slot's last committed
+// state.  The dump APIs let the bank's harvest read an endpoint's resumable
+// datapath state; the seed API lets a freshly-built core adopt the send side
+// (the receive side seeds through the existing ggrs_ep_store_one).  Framing
+// is fixed little-endian: [i64 frame][u32 len][bytes] per entry.
+
+namespace {
+
+void dump_i64(uint8_t* out, size_t* pos, int64_t v) {
+  std::memcpy(out + *pos, &v, 8);  // little-endian host (wire_common.h)
+  *pos += 8;
+}
+
+void dump_u32(uint8_t* out, size_t* pos, uint32_t v) {
+  std::memcpy(out + *pos, &v, 4);
+  *pos += 4;
+}
+
+void dump_u16(uint8_t* out, size_t* pos, uint16_t v) {
+  std::memcpy(out + *pos, &v, 2);
+  *pos += 2;
+}
+
+}  // namespace
+
+// Send-side dump:
+//   [i64 last_acked_frame][u32 base_len][base bytes]
+//   [u16 n_pending] then per entry [i64 frame][u32 len][bytes]
+// Returns kOk, or kErrBufferTooSmall with *out_len = needed size.
+int ggrs_ep_dump_send(void* ptr, uint8_t* out, size_t cap, size_t* out_len) {
+  Endpoint* ep = static_cast<Endpoint*>(ptr);
+  size_t need = 8 + 4 + ep->last_acked.size() + 2;
+  for (const FrameBytes& fb : ep->pending) need += 12 + fb.payload.size();
+  *out_len = need;
+  if (need > cap) return kErrBufferTooSmall;
+  size_t pos = 0;
+  dump_i64(out, &pos, ep->last_acked_frame);
+  dump_u32(out, &pos, static_cast<uint32_t>(ep->last_acked.size()));
+  std::memcpy(out + pos, ep->last_acked.data(), ep->last_acked.size());
+  pos += ep->last_acked.size();
+  dump_u16(out, &pos, static_cast<uint16_t>(ep->pending.size()));
+  for (const FrameBytes& fb : ep->pending) {
+    dump_i64(out, &pos, fb.frame);
+    dump_u32(out, &pos, static_cast<uint32_t>(fb.payload.size()));
+    std::memcpy(out + pos, fb.payload.data(), fb.payload.size());
+    pos += fb.payload.size();
+  }
+  return kOk;
+}
+
+// Receive-side dump: every ring entry still inside the GC window (these are
+// the delta-decode bases a resumed core needs so in-flight packets keep
+// decoding): [i64 last_recv_frame][u16 n] then per entry
+// [i64 frame][u32 len][bytes].  Entry order is ascending frame.
+int ggrs_ep_dump_recv(void* ptr, uint8_t* out, size_t cap, size_t* out_len) {
+  Endpoint* ep = static_cast<Endpoint*>(ptr);
+  int64_t lo = ep->last_recv_frame == kNullFrame
+                   ? 0
+                   : ep->last_recv_frame - 2 * ep->max_prediction;
+  if (lo < 0) lo = 0;  // frames on the ring are >= 0; NULL is the null base
+  size_t need = 8 + 2;
+  uint16_t n = 0;
+  for (int64_t f = lo; f <= ep->last_recv_frame; ++f) {
+    const std::vector<uint8_t>* p = lookup_base(*ep, f);
+    if (p != nullptr) {
+      need += 12 + p->size();
+      ++n;
+    }
+  }
+  *out_len = need;
+  if (need > cap) return kErrBufferTooSmall;
+  size_t pos = 0;
+  dump_i64(out, &pos, ep->last_recv_frame);
+  dump_u16(out, &pos, n);
+  for (int64_t f = lo; f <= ep->last_recv_frame; ++f) {
+    const std::vector<uint8_t>* p = lookup_base(*ep, f);
+    if (p == nullptr) continue;
+    dump_i64(out, &pos, f);
+    dump_u32(out, &pos, static_cast<uint32_t>(p->size()));
+    std::memcpy(out + pos, p->data(), p->size());
+    pos += p->size();
+  }
+  return kOk;
+}
+
+// Adopt the send-side delta base: the resumed pending window (re-fed via
+// ggrs_ep_push) compresses against — and must sequentially follow — the
+// exact base the peer last acked.
+void ggrs_ep_seed_send(void* ptr, int64_t last_acked_frame,
+                       const uint8_t* base, size_t len) {
+  Endpoint* ep = static_cast<Endpoint*>(ptr);
+  ep->last_acked_frame = last_acked_frame;
+  ep->last_acked.assign(base, base + len);
+}
+
 }  // extern "C"

@@ -31,6 +31,15 @@
 //    or exceeding the receive staging caps are dropped, not re-decoded;
 //  - disconnect consensus and EvDisconnected reactions apply one pool tick
 //    late (Python turns this tick's events into next tick's ctrl ops).
+//
+// FAULT ISOLATION (PR 2): a per-session mechanism error no longer fails the
+// tick.  Each session's output record leads with an i32 err code; a faulted
+// slot's ops/outbound/events are suppressed for that tick while the other
+// B-1 sessions step normally.  host_bank.py quarantines the slot, harvests
+// its last committed state (ggrs_bank_harvest), and evicts it to the
+// untouched per-session Python path or marks it dead.  The only remaining
+// whole-bank failure is a malformed command stream (kBankErrCmd), which can
+// only mean the Python command builder itself is broken.
 
 #include <cstddef>
 #include <cstdint>
@@ -68,6 +77,13 @@ int ggrs_sync_synchronized_inputs(void*, int64_t, const uint8_t*,
                                   const int64_t*, uint8_t*, int32_t*);
 int ggrs_sync_set_last_confirmed(void*, int64_t);
 int64_t ggrs_sync_check_consistency(void*, int64_t);
+int64_t ggrs_sync_last_added(void*, int);
+int64_t ggrs_sync_tail_frame(void*, int);
+int ggrs_sync_confirmed_input(void*, int, int64_t, uint8_t*);
+int ggrs_sync_queue_len(void);
+
+int ggrs_ep_dump_send(void*, uint8_t*, size_t, size_t*);
+int ggrs_ep_dump_recv(void*, uint8_t*, size_t, size_t*);
 }
 
 namespace {
@@ -82,15 +98,27 @@ constexpr int64_t kKeepAliveMs = 200;
 constexpr int64_t kQualityReportMs = 200;
 constexpr int kFrameWindow = 30;  // time_sync.py FRAME_WINDOW_SIZE
 
-// bank-level return codes (mirrored in _native.py as BANK_ERR_*)
+// bank-level return codes (mirrored in _native.py as BANK_ERR_*).
+// kBankErrCmd is the ONLY whole-bank failure left: a malformed command
+// stream means the Python builder itself is broken and no per-session
+// blame is possible.  Every other code is a PER-SLOT fault, reported in
+// that session's output record (err field) while the rest of the bank
+// ticks normally — the supervision layer in host_bank.py quarantines the
+// slot and evicts it to the Python fallback.
 constexpr int kBankOk = 0;
-constexpr int kBankErrCmd = -60;         // malformed command stream
+constexpr int kBankErrCmd = -60;         // malformed command stream (fatal)
 constexpr int kBankErrLandedSplit = -70; // local inputs landed on != frames
 constexpr int kBankErrSync = -71;        // sync-core op failed (assert parity)
 constexpr int kBankErrSyncInputs = -72;  // synchronized_inputs failed
 constexpr int kBankErrConfirm = -73;     // set_last_confirmed invariant
 constexpr int kBankErrNoPlayers = -74;   // every player disconnected
 constexpr int kBankErrSequence = -75;    // remote input frame gap (assert)
+constexpr int kBankErrInjected = -76;    // chaos-harness simulated fault
+
+// command flags (host_bank.py mirrors)
+constexpr uint8_t kFlagInputs = 1;  // local inputs present -> advance runs
+constexpr uint8_t kFlagSkip = 2;    // slot quarantined/evicted: no fields
+                                    // follow; emit a status-only record
 
 // endpoint core codes (endpoint.cpp)
 constexpr int kEpDrop = -30;
@@ -584,6 +612,24 @@ int64_t max_frame_advantage(const BankSession* s) {
   return frames_ahead;
 }
 
+// Status-mirror tail shared by the normal and skip record paths: a field
+// added to one but not the other would misalign Python's positional parse
+// exactly and only during fault handling.
+void emit_status_mirrors(std::vector<uint8_t>* o, const BankSession* s) {
+  put_u8(o, static_cast<uint8_t>(s->endpoints.size()));
+  for (const BankEndpoint& ep : s->endpoints) {
+    put_u8(o, ep.state);
+    for (int h = 0; h < s->num_players; ++h) {
+      put_u8(o, ep.peer_disc[h]);
+      put_i64(o, ep.peer_last[h]);
+    }
+  }
+  for (int h = 0; h < s->num_players; ++h) {
+    put_u8(o, s->local_disc[h]);
+    put_i64(o, s->local_last[h]);
+  }
+}
+
 int advance_session(Bank* bank, BankSession* s, int64_t now,
                     const uint8_t* local_inputs, std::vector<uint8_t>* ops,
                     uint16_t* n_ops, int64_t* landed_out,
@@ -831,11 +877,19 @@ int64_t ggrs_bank_add_endpoint(void* ptr, int64_t session, uint16_t magic,
 }
 
 // THE crossing.  Command stream, little-endian, per session in order:
-//   u8 flags (bit0 = local inputs present -> advance phase runs)
+//   u8 flags (bit0 = local inputs present -> advance phase runs;
+//             bit1 = skip: slot is quarantined/evicted, NO further fields
+//             follow for this session)
 //   [flags&1] n_local * input_size raw input bytes (sorted-handle order)
-//   u16 n_ctrl;  per ctrl: u8 op (1 = disconnect endpoint), u16 ep, i64 frame
+//   u16 n_ctrl;  per ctrl: u8 op, u16 ep, i64 frame
+//     op 1 = disconnect endpoint at `frame`
+//     op 2 = inject a simulated per-slot fault (`frame` carries the error
+//            code; the chaos harness's native-fault stand-in)
 //   u16 n_datagrams;  per datagram: u16 ep, u32 len, bytes
 // Output stream, per session in order:
+//   i32 err  (0 = ok; negative kBankErr* = THIS SLOT faulted this tick —
+//             its ops/outbound/events are suppressed, only the status
+//             mirrors below are live; the rest of the bank is unaffected)
 //   i64 landed_frame
 //   i32 frames_ahead (max time-sync average over connected endpoints)
 //   i64 current_frame (post-tick), i64 last_confirmed
@@ -847,8 +901,8 @@ int64_t ggrs_bank_add_endpoint(void* ptr, int64_t session, uint16_t magic,
 //   u16 n_events;  per event: u8 kind, u16 ep, kind-specific payload
 //   u8 n_endpoints;  per endpoint: u8 state, num_players * (u8 disc, i64 lf)
 //   num_players * (u8 disc, i64 last_frame)   [local status mirror]
-// Returns 0, kErrBufferTooSmall (retry with a bigger out), or a negative
-// bank/session error (the pool is poisoned; Python raises).
+// Returns 0, kErrBufferTooSmall (retry with a bigger out), or kBankErrCmd
+// (malformed command stream — the one remaining whole-bank failure).
 int ggrs_bank_tick(void* ptr, int64_t now, const uint8_t* cmd, size_t cmd_len,
                    uint8_t* out, size_t out_cap, size_t* out_len) {
   Bank* bank = static_cast<Bank*>(ptr);
@@ -860,8 +914,26 @@ int ggrs_bank_tick(void* ptr, int64_t now, const uint8_t* cmd, size_t cmd_len,
 
   for (BankSession* s : bank->sessions) {
     uint8_t flags = r.u8();
+    if (!r.ok) return kBankErrCmd;
+    std::vector<uint8_t>* o = &bank->out;
+    if (flags & kFlagSkip) {
+      // quarantined/evicted slot: nothing runs, emit a status-only record
+      // so the output stream stays positionally aligned
+      put_u32(o, 0);  // err = 0 (the fault was reported when it happened)
+      put_i64(o, kNullFrame);
+      put_u32(o, 0);
+      put_i64(o, s->current_frame);
+      put_i64(o, s->last_confirmed);
+      put_u8(o, 0);
+      put_u16(o, 0);  // n_ops
+      put_u16(o, 0);  // n_out
+      put_u16(o, 0);  // n_events
+      emit_status_mirrors(o, s);
+      continue;
+    }
+    int err = kBankOk;  // per-SLOT fault accumulator; never fails the tick
     const uint8_t* local_inputs = nullptr;
-    if (flags & 1) {
+    if (flags & kFlagInputs) {
       local_inputs = r.raw(s->local_handles.size() *
                            static_cast<size_t>(s->input_size));
     }
@@ -881,6 +953,10 @@ int ggrs_bank_tick(void* ptr, int64_t now, const uint8_t* cmd, size_t cmd_len,
       if (!r.ok) return kBankErrCmd;
       if (op == 1 && ep_idx < s->endpoints.size()) {
         disconnect_endpoint(s, &s->endpoints[ep_idx], now, frame);
+      } else if (op == 2) {
+        // simulated native slot fault: the whole slot tick is skipped, as
+        // a real mid-tick fault would leave it
+        err = frame < 0 ? static_cast<int>(frame) : kBankErrInjected;
       }
     }
 
@@ -891,83 +967,111 @@ int ggrs_bank_tick(void* ptr, int64_t now, const uint8_t* cmd, size_t cmd_len,
       uint16_t ep_idx = r.u16();
       uint32_t dlen = r.u32();
       const uint8_t* data = r.raw(dlen);
-      if (!r.ok) return kBankErrCmd;
-      if (ep_idx < s->endpoints.size()) {
+      if (!r.ok) return kBankErrCmd;  // parse ALL datagrams: stream alignment
+      if (err == kBankOk && ep_idx < s->endpoints.size()) {
         process_datagram(bank, s, &s->endpoints[ep_idx], now, data, dlen);
-      }
-    }
-    for (BankEndpoint& ep : s->endpoints) {
-      // update_local_frame_advantage (current_frame is never NULL)
-      if (ep.state == kRunning) {
-        int64_t last_recv_frame = ggrs_ep_last_recv_frame(ep.ep);
-        if (last_recv_frame != kNullFrame) {
-          int64_t ping = ep.rtt / 2;
-          int64_t remote_frame = last_recv_frame + (ping * s->fps) / 1000;
-          ep.local_adv = remote_frame - s->current_frame;
-        }
-      }
-    }
-    // stage events before handling (the poll loop), then apply in endpoint
-    // order — identical to p2p.py's two-pass event handling
-    staged_events.clear();
-    staged_eps.clear();
-    for (size_t e = 0; e < s->endpoints.size(); ++e) {
-      BankEndpoint& ep = s->endpoints[e];
-      poll_timers(bank, s, &ep, now);
-      while (!ep.events.empty()) {
-        staged_events.push_back(ep.events.front());
-        staged_eps.push_back(static_cast<int32_t>(e));
-        ep.events.pop_front();
       }
     }
     std::vector<uint8_t> out_events;
     uint16_t n_out_events = 0;
-    for (size_t i = 0; i < staged_events.size(); ++i) {
-      const EpEvent& ev = staged_events[i];
-      BankEndpoint& ep = s->endpoints[static_cast<size_t>(staged_eps[i])];
-      if (ev.kind == kEvInput) {
-        // p2p.py _handle_event EvInput: sequence invariant, status update,
-        // remote enqueue — skipped entirely for disconnected players
-        int32_t h = ev.handle;
-        if (!s->local_disc[h]) {
-          int64_t cur = s->local_last[h];
-          if (!(cur == kNullFrame || cur + 1 == ev.a)) return kBankErrSequence;
-          s->local_last[h] = ev.a;
-          int64_t rc = ggrs_sync_add_input(s->sync, h, ev.a,
-                                           ep.evin_bytes.data() + ev.off);
-          if (rc < kNullFrame) return kBankErrSync;
+    int64_t landed = kNullFrame;
+    int64_t frames_ahead = 0;
+    bool pending_consensus = false;
+    ops.clear();
+    uint16_t n_ops = 0;
+    if (err == kBankOk) {
+      for (BankEndpoint& ep : s->endpoints) {
+        // update_local_frame_advantage (current_frame is never NULL)
+        if (ep.state == kRunning) {
+          int64_t last_recv_frame = ggrs_ep_last_recv_frame(ep.ep);
+          if (last_recv_frame != kNullFrame) {
+            int64_t ping = ep.rtt / 2;
+            int64_t remote_frame = last_recv_frame + (ping * s->fps) / 1000;
+            ep.local_adv = remote_frame - s->current_frame;
+          }
         }
-      } else {
-        put_u8(&out_events, ev.kind);
-        put_u16(&out_events, static_cast<uint16_t>(staged_eps[i]));
-        if (ev.kind == kEvInterrupted) put_i64(&out_events, ev.a);
-        if (ev.kind == kEvChecksum) {
-          put_i64(&out_events, ev.a);
-          put_u64(&out_events, ev.lo);
-          put_u64(&out_events, ev.hi);
+      }
+      // stage events before handling (the poll loop), then apply in endpoint
+      // order — identical to p2p.py's two-pass event handling
+      staged_events.clear();
+      staged_eps.clear();
+      for (size_t e = 0; e < s->endpoints.size(); ++e) {
+        BankEndpoint& ep = s->endpoints[e];
+        poll_timers(bank, s, &ep, now);
+        while (!ep.events.empty()) {
+          staged_events.push_back(ep.events.front());
+          staged_eps.push_back(static_cast<int32_t>(e));
+          ep.events.pop_front();
         }
-        ++n_out_events;
+      }
+      for (size_t i = 0; err == kBankOk && i < staged_events.size(); ++i) {
+        const EpEvent& ev = staged_events[i];
+        BankEndpoint& ep = s->endpoints[static_cast<size_t>(staged_eps[i])];
+        if (ev.kind == kEvInput) {
+          // p2p.py _handle_event EvInput: sequence invariant, status update,
+          // remote enqueue — skipped entirely for disconnected players
+          int32_t h = ev.handle;
+          if (!s->local_disc[h]) {
+            int64_t cur = s->local_last[h];
+            if (!(cur == kNullFrame || cur + 1 == ev.a)) {
+              err = kBankErrSequence;  // slot fault, not a pool kill
+              break;
+            }
+            s->local_last[h] = ev.a;
+            int64_t rc = ggrs_sync_add_input(s->sync, h, ev.a,
+                                             ep.evin_bytes.data() + ev.off);
+            if (rc < kNullFrame) {
+              err = kBankErrSync;
+              break;
+            }
+          }
+        } else {
+          put_u8(&out_events, ev.kind);
+          put_u16(&out_events, static_cast<uint16_t>(staged_eps[i]));
+          if (ev.kind == kEvInterrupted) put_i64(&out_events, ev.a);
+          if (ev.kind == kEvChecksum) {
+            put_i64(&out_events, ev.a);
+            put_u64(&out_events, ev.lo);
+            put_u64(&out_events, ev.hi);
+          }
+          ++n_out_events;
+        }
       }
     }
 
     // ---- advance phase (p2p.py advance_frame after its poll) ----
-    ops.clear();
-    uint16_t n_ops = 0;
-    int64_t landed = kNullFrame;
-    int64_t frames_ahead = 0;
-    bool pending_consensus = consensus_pending(s);
-    for (BankEndpoint& ep : s->endpoints) ep.cur_out = &ep.out_adv;
-    if (flags & 1) {
-      if (!local_inputs) return kBankErrCmd;
-      int rc = advance_session(bank, s, now, local_inputs, &ops, &n_ops,
-                               &landed, &frames_ahead);
-      if (rc != kBankOk) return rc;
-    } else {
-      frames_ahead = max_frame_advantage(s);
+    if (err == kBankOk) {
+      pending_consensus = consensus_pending(s);
+      for (BankEndpoint& ep : s->endpoints) ep.cur_out = &ep.out_adv;
+      if (flags & kFlagInputs) {
+        if (!local_inputs) return kBankErrCmd;
+        int rc = advance_session(bank, s, now, local_inputs, &ops, &n_ops,
+                                 &landed, &frames_ahead);
+        if (rc != kBankOk) err = rc;
+      } else {
+        frames_ahead = max_frame_advantage(s);
+      }
+    }
+    if (err != kBankOk) {
+      // faulted slot: suppress everything this tick produced — partial ops
+      // would desync the game, partial sends would confuse the peer.  The
+      // status mirrors stay live (the harvest and eviction read them).
+      ops.clear();
+      n_ops = 0;
+      out_events.clear();
+      n_out_events = 0;
+      landed = kNullFrame;
+      frames_ahead = 0;
+      pending_consensus = false;
+      for (BankEndpoint& ep : s->endpoints) {
+        ep.out_poll.clear();
+        ep.out_adv.clear();
+        ep.out_count = 0;
+      }
     }
 
     // ---- session output record ----
-    std::vector<uint8_t>* o = &bank->out;
+    put_u32(o, static_cast<uint32_t>(err));
     put_i64(o, landed);
     put_u32(o, static_cast<uint32_t>(static_cast<int32_t>(frames_ahead)));
     put_i64(o, s->current_frame);
@@ -1001,18 +1105,7 @@ int ggrs_bank_tick(void* ptr, int64_t now, const uint8_t* cmd, size_t cmd_len,
     }
     put_u16(o, n_out_events);
     put_raw(o, out_events.data(), out_events.size());
-    put_u8(o, static_cast<uint8_t>(s->endpoints.size()));
-    for (BankEndpoint& ep : s->endpoints) {
-      put_u8(o, ep.state);
-      for (int h = 0; h < s->num_players; ++h) {
-        put_u8(o, ep.peer_disc[h]);
-        put_i64(o, ep.peer_last[h]);
-      }
-    }
-    for (int h = 0; h < s->num_players; ++h) {
-      put_u8(o, s->local_disc[h]);
-      put_i64(o, s->local_last[h]);
-    }
+    emit_status_mirrors(o, s);
   }
 
   if (r.pos != r.len) return kBankErrCmd;  // trailing garbage: refuse
@@ -1043,6 +1136,93 @@ int ggrs_bank_fetch_out(void* ptr, uint8_t* out, size_t out_cap,
 
 int64_t ggrs_bank_session_count(void* ptr) {
   return static_cast<int64_t>(static_cast<Bank*>(ptr)->sessions.size());
+}
+
+// Harvest one session's resumable state for Python-fallback eviction — the
+// read-only dump host_bank.py turns into a mid-stream P2PSession via the
+// adoption seam (P2PSession.adopt_resume_state).  Little-endian layout:
+//   i64 current_frame, i64 last_confirmed, i64 disconnect_frame
+//   u8 num_players, u32 input_size            [sanity echo]
+//   per player:
+//     u8 disc, i64 local_last
+//     i64 inputs_start (kNullFrame if none), u32 count,
+//     count * input_size input bytes          [frames start..start+count)
+//   u8 n_endpoints; per endpoint:
+//     u8 state
+//     send dump  (ggrs_ep_dump_send: last_acked_frame, base, pending window)
+//     recv dump  (ggrs_ep_dump_recv: last_recv_frame, ring window)
+// Returns 0, kErrBufferTooSmall (*out_len = needed), or kBankErrCmd for a
+// bad session index.  Read-only: safe to retry, never perturbs the bank.
+int ggrs_bank_harvest(void* ptr, int64_t session, uint8_t* out, size_t cap,
+                      size_t* out_len) {
+  Bank* bank = static_cast<Bank*>(ptr);
+  if (session < 0 || static_cast<size_t>(session) >= bank->sessions.size()) {
+    return kBankErrCmd;
+  }
+  BankSession* s = bank->sessions[static_cast<size_t>(session)];
+  std::vector<uint8_t> h;
+  put_i64(&h, s->current_frame);
+  put_i64(&h, s->last_confirmed);
+  put_i64(&h, s->disconnect_frame);
+  put_u8(&h, static_cast<uint8_t>(s->num_players));
+  put_u32(&h, static_cast<uint32_t>(s->input_size));
+  std::vector<uint8_t> input_buf(static_cast<size_t>(s->input_size));
+  for (int p = 0; p < s->num_players; ++p) {
+    put_u8(&h, s->local_disc[p]);
+    put_i64(&h, s->local_last[p]);
+    int64_t last_added = ggrs_sync_last_added(s->sync, p);
+    int64_t start = kNullFrame;
+    int64_t count = 0;
+    if (last_added != kNullFrame) {
+      // one frame DEEPER than the watermark: the watermark discard keeps
+      // last_confirmed-1, and eviction may resume there when the fault
+      // tick's own save of the watermark frame was suppressed
+      start = s->last_confirmed > 1 ? s->last_confirmed - 1 : 0;
+      int64_t tail = ggrs_sync_tail_frame(s->sync, p);
+      if (tail != kNullFrame && tail > start) start = tail;
+      if (start > last_added) start = last_added;
+      count = last_added - start + 1;
+      int64_t qlen = ggrs_sync_queue_len();  // the ring can never hold more
+      if (count > qlen) {
+        start = last_added - (qlen - 1);
+        count = qlen;
+      }
+    }
+    put_i64(&h, start);
+    put_u32(&h, static_cast<uint32_t>(count));
+    for (int64_t f = start; count > 0 && f <= last_added; ++f) {
+      if (ggrs_sync_confirmed_input(s->sync, p, f, input_buf.data()) != 0) {
+        return kBankErrCmd;  // hole in the queue: harvest contract broken
+      }
+      put_raw(&h, input_buf.data(), input_buf.size());
+    }
+  }
+  put_u8(&h, static_cast<uint8_t>(s->endpoints.size()));
+  std::vector<uint8_t> scratch(size_t{1} << 14);
+  for (BankEndpoint& ep : s->endpoints) {
+    put_u8(&h, ep.state);
+    for (int which = 0; which < 2; ++which) {
+      size_t need = 0;
+      while (true) {
+        int rc = which == 0
+                     ? ggrs_ep_dump_send(ep.ep, scratch.data(),
+                                         scratch.size(), &need)
+                     : ggrs_ep_dump_recv(ep.ep, scratch.data(),
+                                         scratch.size(), &need);
+        if (rc == kErrBufferTooSmall) {
+          scratch.resize(need);
+          continue;
+        }
+        if (rc != kOk) return kBankErrCmd;
+        break;
+      }
+      put_raw(&h, scratch.data(), need);
+    }
+  }
+  *out_len = h.size();
+  if (h.size() > cap) return kErrBufferTooSmall;
+  std::memcpy(out, h.data(), h.size());
+  return kBankOk;
 }
 
 }  // extern "C"

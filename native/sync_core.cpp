@@ -356,6 +356,49 @@ int64_t ggrs_sync_last_added(void* h, int player) {
   return c->queues[player].last_added;
 }
 
+// the per-player ring capacity (session_bank.cpp's harvest clamps against
+// this instead of duplicating the literal)
+int ggrs_sync_queue_len(void) { return kQueueLen; }
+
+// oldest frame still held by a player's queue (kNullFrame when empty) —
+// the lower bound of what a harvest can recover for fallback eviction
+int64_t ggrs_sync_tail_frame(void* h, int player) {
+  SyncCore* c = static_cast<SyncCore*>(h);
+  if (player < 0 || player >= c->players) return kSyncErrBadArgs;
+  Queue& q = c->queues[player];
+  return q.length > 0 ? q.frames[q.tail] : kNullFrame;
+}
+
+// Seed one player's EMPTY queue with `count` consecutive confirmed inputs
+// for frames [start, start+count) — the adoption path of fallback eviction.
+// Slots are placed at frame % kQueueLen, preserving the invariant normal
+// sequential insertion from frame 0 establishes (confirmed_input addresses
+// by frame-mod while queue_input walks from the tail).
+int ggrs_sync_seed(void* h, int player, int64_t start, int32_t count,
+                   const uint8_t* bytes) {
+  SyncCore* c = static_cast<SyncCore*>(h);
+  if (player < 0 || player >= c->players || start < 0 || count < 0 ||
+      count > kQueueLen) {
+    return kSyncErrBadArgs;
+  }
+  Queue& q = c->queues[player];
+  if (q.last_added != kNullFrame || q.length != 0) return kSyncErrBadArgs;
+  if (count == 0) return kSyncOk;
+  for (int32_t i = 0; i < count; ++i) {
+    i64 frame = start + i;
+    int slot = static_cast<int>(frame % kQueueLen);
+    q.frames[slot] = frame;
+    std::memcpy(c->slot_bytes(q, slot), bytes + static_cast<size_t>(i) * c->input_size,
+                c->input_size);
+  }
+  q.tail = static_cast<int>(start % kQueueLen);
+  q.head = static_cast<int>((start + count) % kQueueLen);
+  q.length = count;
+  q.first_frame = false;
+  q.last_added = start + count - 1;
+  return kSyncOk;
+}
+
 // confirmed_input for one player (input_queue.py confirmed_input): exact
 // slot match required
 int ggrs_sync_confirmed_input(void* h, int player, int64_t frame,

@@ -1,0 +1,49 @@
+#!/usr/bin/env bash
+# Build the native cores with AddressSanitizer + UBSan and run the session
+# bank's parity and fault fuzzes under them.
+#
+# The sanitized library lives beside the production one as
+# _ggrs_codec_san.so; GGRS_NATIVE_SANITIZE=1 makes ggrs_tpu.net._native load
+# (and, when stale, rebuild) that library with
+# -fsanitize=address,undefined -fno-sanitize-recover=all, so any native
+# heap/UB bug aborts the test run loudly instead of corrupting the bank.
+# ASan must be loaded before Python, hence the LD_PRELOAD.
+#
+# Usage: scripts/build_sanitized.sh [extra pytest args]
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+if ! command -v g++ >/dev/null; then
+    echo "skip: no g++ toolchain" >&2
+    exit 0
+fi
+asan_rt="$(g++ -print-file-name=libasan.so)"
+if [ ! -e "$asan_rt" ]; then
+    echo "skip: g++ has no libasan runtime" >&2
+    exit 0
+fi
+
+out=ggrs_tpu/net/_ggrs_codec_san.so
+echo "building sanitized native cores -> $out"
+g++ -O1 -g -shared -fPIC -std=c++17 \
+    -fsanitize=address,undefined -fno-sanitize-recover=all \
+    -o "$out" \
+    native/codec.cpp native/endpoint.cpp native/sync_core.cpp \
+    native/session_bank.cpp
+
+# detect_leaks=0: CPython itself "leaks" interned objects at exit, which is
+# noise here — the target is heap corruption / UB in the native cores while
+# the parity fuzz and the chaos tests drive them.
+#
+# The -k filter keeps the sanitized leg on the HOST-only tests: the
+# batched-executor integration tests JIT through XLA, whose own compiler
+# trips ASan's interceptors (an upstream finding, not ours) and aborts the
+# run before the bank code under test even executes.  The slow soak is
+# excluded by default; pass "-m" "slow" to run it sanitized too.
+LD_PRELOAD="$asan_rt" \
+ASAN_OPTIONS="detect_leaks=0:abort_on_error=1" \
+GGRS_NATIVE_SANITIZE=1 \
+JAX_PLATFORMS=cpu \
+python -m pytest tests/test_session_bank.py tests/test_bank_faults.py \
+    -q -p no:cacheprovider -m "not slow" \
+    -k "not batched_executor and not size_mismatch" "$@"

@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Pool-scale chaos CLI for the supervised session bank (DESIGN.md §9).
+
+Thin front-end over ``ggrs_tpu.chaos`` — the SAME driver the test suite
+uses (tests/test_bank_faults.py), so the script and the tests exercise one
+code path.  For each selected fault class it runs a fault-free CONTROL leg
+and a CHAOS leg, then verifies the blast radius: every non-targeted slot's
+wire bytes, request lists, and events must be bit-identical between the two
+legs, and the crossing count must stay one native crossing per pool tick.
+
+Fault classes (all driven through the pool's real tick path):
+  native-error  simulated native slot fault (ctrl-op channel)
+  desync        desync-class invariant fault (BANK_ERR_SYNC)
+  blackout      the target's peer goes permanently silent
+  malformed     burst of truncated/corrupted datagrams into the target
+  fuzz          seeded random junk datagrams into the target
+  all           every class, sequentially
+
+Usage:
+  JAX_PLATFORMS=cpu python scripts/chaos.py --matches 4 --ticks 400
+  python scripts/chaos.py --fault blackout --ticks 600 --seed 7
+
+Exit code 0 = blast radius contained in every leg; 1 = violation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import random
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from ggrs_tpu.chaos import (  # noqa: E402
+    MALFORMED_BURST,
+    blast_radius_violations,
+    drive_chaos,
+)
+from ggrs_tpu.net import _native  # noqa: E402
+
+
+def _fuzz_bytes(seed: int, i: int, k: int) -> bytes:
+    rng = random.Random(seed * 7919 + i * 31 + k)
+    return bytes(rng.randrange(256) for _ in range(rng.randrange(0, 40)))
+
+
+FAULTS = {
+    "native-error": dict(
+        inject=lambda i, ctx: (
+            ctx["pool"].inject_slot_error(ctx["target"]) if i == 60 else None
+        ),
+    ),
+    "desync": dict(
+        inject=lambda i, ctx: (
+            ctx["pool"].inject_slot_error(
+                ctx["target"], _native.BANK_ERR_SYNC
+            )
+            if i == 60
+            else None
+        ),
+    ),
+    "blackout": dict(ext_alive=lambda i: i < 80, retire=True),
+    "malformed": dict(
+        inject=lambda i, ctx: (
+            [
+                ctx["pool"].inject_datagram(ctx["target"], "X", junk)
+                for junk in MALFORMED_BURST
+            ]
+            if 50 <= i < 60
+            else None
+        ),
+    ),
+    "fuzz": dict(
+        inject=lambda i, ctx: (
+            [
+                ctx["pool"].inject_datagram(
+                    ctx["target"], "X", _fuzz_bytes(ctx["seed"], i, k)
+                )
+                for k in range(3)
+            ]
+            if 40 <= i < 140
+            else None
+        ),
+    ),
+}
+
+
+def verify_leg(name: str, matches: int, ticks: int, seed: int) -> bool:
+    spec = FAULTS[name]
+    retire = spec.get("retire", False)
+    control = drive_chaos(ticks, n_matches=matches, seed=seed, retire=retire)
+    chaos = drive_chaos(
+        ticks, n_matches=matches, seed=seed,
+        inject=spec.get("inject"),
+        ext_alive=spec.get("ext_alive"),
+        retire=retire,
+    )
+    target = chaos["target"]
+    violations = blast_radius_violations(chaos, control)
+    pool = chaos["pool"]
+    print(f"--- {name} ---")
+    print(f"  target slot {target}: state={chaos['states'][target]}, "
+          f"frame={chaos['frames'][target]}, ext peer frame="
+          f"{chaos['ext'].current_frame}")
+    for f in pool.fault_log(target):
+        print(f"    fault@tick {f.tick}: code={f.code} {f.detail}")
+    print(f"  crossings={pool.crossings} harvests={pool.harvests}")
+    if violations:
+        print("  BLAST RADIUS VIOLATED:")
+        for v in violations:
+            print(f"    {v}")
+        return False
+    print(f"  OK: {len(chaos['states']) - 1} surviving slots bit-identical "
+          "to control")
+    return True
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--matches", type=int, default=4,
+                    help="in-bank 2-peer matches (default 4 -> B=9 slots)")
+    ap.add_argument("--ticks", type=int, default=300)
+    ap.add_argument("--seed", type=int, default=3)
+    ap.add_argument("--fault", choices=[*FAULTS, "all"], default="all")
+    args = ap.parse_args()
+
+    names = list(FAULTS) if args.fault == "all" else [args.fault]
+    ok = True
+    for name in names:
+        ok &= verify_leg(name, args.matches, args.ticks, args.seed)
+    print("chaos verdict:", "PASS" if ok else "FAIL")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

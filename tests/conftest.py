@@ -30,3 +30,8 @@ def pytest_configure(config):
         "soak: long-horizon (1e5-frame) endurance tests; deselect with "
         '-m "not soak" when iterating',
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: multi-thousand-tick stress runs (e.g. the bank fault soak); "
+        "excluded from the tier-1 gate, run explicitly with -m slow",
+    )

@@ -1,0 +1,603 @@
+"""Fault isolation for the supervised session bank (DESIGN.md §9): one bad
+peer degrades one match, never the pool.
+
+The chaos scenarios drive faults through the pool's REAL tick path — raw
+datagrams spliced into a slot's inbound routing, simulated native slot
+errors on the ctrl-op channel, peer blackouts — and pin the headline:
+
+* blast radius = 1 slot (or 0 for malformed datagrams, which are dropped
+  before any state advance);
+* the surviving slots' wire bytes, request lists, and events stay
+  BIT-IDENTICAL to a fault-free control run;
+* the crossing count stays exactly one ``ggrs_bank_tick`` per pool tick
+  (plus a one-off harvest crossing per eviction);
+* an evicted slot resumes the same match on the Python fallback from its
+  last committed frame, bit-consistent with what its peer already holds.
+
+Each in-bank match lives on its OWN ``InMemoryNetwork`` so no fault-rng
+stream couples matches; the targeted slot's peer is an external
+``P2PSession`` so the survivors' traffic is provably independent of the
+fault.  The driver is ``ggrs_tpu.chaos.drive_chaos`` — the SAME harness
+``scripts/chaos.py`` fronts, so the CLI and this suite exercise one code
+path.
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from ggrs_tpu.chaos import (
+    MALFORMED_BURST,
+    blast_radius_violations,
+    drive_chaos,
+    fulfill,
+    two_peer_builder as builder,
+)
+from ggrs_tpu.core import Local, Remote
+from ggrs_tpu.core.config import Config
+from ggrs_tpu.net import InMemoryNetwork, _native
+from ggrs_tpu.parallel.host_bank import (
+    HostSessionPool,
+    SLOT_DEAD,
+    SLOT_EVICTED,
+    SLOT_NATIVE,
+)
+from ggrs_tpu.sessions import SessionBuilder
+
+needs_native = pytest.mark.skipif(
+    _native.bank_lib() is None, reason="native session bank unavailable"
+)
+
+
+def assert_survivors_identical(faulted, control, survivors):
+    """The acceptance pin: surviving slots stay bank-resident and
+    bit-identical to the fault-free control run — wire bytes, request
+    lists, and events — with one crossing per pool tick."""
+    violations = blast_radius_violations(faulted, control, survivors)
+    assert not violations, violations
+
+
+@needs_native
+class TestBlastRadius:
+    """B=9 banked sessions; each fault class touches at most the target."""
+
+    def test_simulated_native_slot_error_quarantines_one_slot(self):
+        control = drive_chaos(220)
+
+        def inject(i, ctx):
+            if i == 60:
+                ctx["pool"].inject_slot_error(ctx["target"])
+
+        run = drive_chaos(220, inject=inject)
+        target = run["target"]
+        survivors = [i for i in range(len(run["states"])) if i != target]
+        assert run["states"][target] == SLOT_EVICTED
+        assert all(run["states"][i] == SLOT_NATIVE for i in survivors)
+        assert_survivors_identical(run, control, survivors)
+        # the one-crossing invariant holds for the survivors; eviction cost
+        # exactly one extra harvest crossing, once
+        assert run["pool"].crossings == 220
+        assert run["pool"].harvests == 1
+        # the evicted slot resumed the SAME match: both sides kept advancing
+        assert run["pool"].current_frame(target) > 180
+        assert run["ext"].current_frame > 180
+        codes = [f.code for f in run["pool"].fault_log(target)]
+        assert _native.BANK_ERR_INJECTED in codes
+
+    def test_forced_desync_class_fault_quarantines_one_slot(self):
+        """A desync-class invariant violation (the errors the pre-supervision
+        bank raised as pool-wide AssertionErrors) now costs one slot."""
+        control = drive_chaos(220)
+
+        def inject(i, ctx):
+            if i == 60:
+                ctx["pool"].inject_slot_error(
+                    ctx["target"], _native.BANK_ERR_SYNC
+                )
+
+        run = drive_chaos(220, inject=inject)
+        target = run["target"]
+        survivors = [i for i in range(len(run["states"])) if i != target]
+        assert run["states"][target] == SLOT_EVICTED
+        assert all(run["states"][i] == SLOT_NATIVE for i in survivors)
+        assert_survivors_identical(run, control, survivors)
+        assert run["pool"].current_frame(target) > 180
+
+    def test_peer_blackout_retires_only_the_target(self):
+        """The target's peer goes silent for good: interrupt → disconnect →
+        (retire_dead_matches) the dead match is retired.  Everyone else is
+        bit-identical to the control run."""
+        control = drive_chaos(260, retire=True)
+        run = drive_chaos(260, retire=True, ext_alive=lambda i: i < 80)
+        target = run["target"]
+        survivors = [i for i in range(len(run["states"])) if i != target]
+        assert run["states"][target] == SLOT_DEAD
+        assert all(run["states"][i] == SLOT_NATIVE for i in survivors)
+        assert_survivors_identical(run, control, survivors)
+        kinds = [type(e).__name__ for e in run["events"][target]]
+        assert "NetworkInterrupted" in kinds
+        assert "Disconnected" in kinds
+        assert run["pool"].crossings == 260
+        # dead slot: request lists went (and stay) empty
+        assert run["reqs"][target][-1] == []
+
+    def test_malformed_datagram_burst_is_dropped_radius_zero(self):
+        """Truncated/corrupted datagrams are dropped at the native parse
+        before ANY state advance (the Python path's WireError handling):
+        blast radius 0 — even the targeted slot stays bit-identical, no
+        quarantine, and the bank is never invalidated."""
+        control = drive_chaos(200)
+
+        def inject(i, ctx):
+            if 50 <= i < 60:
+                for junk in MALFORMED_BURST:
+                    ctx["pool"].inject_datagram(ctx["target"], "X", junk)
+
+        run = drive_chaos(200, inject=inject)
+        all_slots = list(range(len(run["states"])))
+        assert all(run["states"][i] == SLOT_NATIVE for i in all_slots)
+        assert run["pool"].fault_log(run["target"]) == []
+        # radius zero: the TARGET too is bit-identical to control
+        assert_survivors_identical(run, control, all_slots)
+        # and the pool was never invalidated
+        assert run["pool"].current_frame(run["target"]) > 180
+
+    def test_malformed_fuzz_never_invalidates_the_bank(self):
+        """Seeded random junk through the bank's inbound routing: whatever
+        valid-looking packets it accidentally forms behave as the protocol
+        defines, but the bank must never be invalidated, never quarantine
+        the slot, and the OTHER slots must stay bit-identical."""
+        control = drive_chaos(200)
+        rng = random.Random(1234)
+        junk = [
+            bytes(rng.randrange(256) for _ in range(rng.randrange(0, 40)))
+            for _ in range(300)
+        ]
+
+        def inject(i, ctx):
+            if 40 <= i < 140:
+                for _ in range(3):
+                    ctx["pool"].inject_datagram(
+                        ctx["target"], "X", junk[(i * 3) % len(junk)]
+                    )
+
+        run = drive_chaos(200, inject=inject)
+        target = run["target"]
+        survivors = [i for i in range(len(run["states"])) if i != target]
+        assert all(run["states"][i] == SLOT_NATIVE for i in survivors)
+        assert run["states"][target] == SLOT_NATIVE  # junk is not a fault
+        assert_survivors_identical(run, control, survivors)
+        assert run["pool"].current_frame(target) > 180
+        assert run["ext"].current_frame > 180
+
+
+@needs_native
+class TestEviction:
+    def test_eviction_is_bit_consistent_with_the_peer(self):
+        """After eviction the peer's stored view of the evicted side's
+        inputs must equal the evicted session's own record — across input
+        delay and seeded loss/dup/reorder (the pending-window + delta-base
+        adoption working end to end)."""
+        for delay, faults in [
+            (0, None),
+            (2, None),
+            (0, dict(seed=5, loss=0.1, duplicate=0.05, reorder=0.05,
+                     latency_ticks=1)),
+        ]:
+            clock = [0]
+            net = InMemoryNetwork(**(faults or {"latency_ticks": 1}))
+            pool = HostSessionPool()
+            b = (
+                SessionBuilder(Config.for_uint(16))
+                .with_clock(lambda: clock[0])
+                .with_rng(random.Random(1))
+                .with_input_delay(delay)
+                .add_player(Local(), 0)
+                .add_player(Remote("R"), 1)
+            )
+            pool.add_session(b, net.socket("L"))
+            peer = (
+                SessionBuilder(Config.for_uint(16))
+                .with_clock(lambda: clock[0])
+                .with_rng(random.Random(2))
+                .with_input_delay(delay)
+                .add_player(Local(), 1)
+                .add_player(Remote("L"), 0)
+            ).start_p2p_session(net.socket("R"))
+            assert pool.native_active
+
+            def tick(i):
+                clock[0] += 16
+                peer.add_local_input(1, (i * 3) % 16)
+                fulfill(peer.advance_frame())
+                pool.add_local_input(0, 0, (i * 7) % 16)
+                for reqs in pool.advance_all():
+                    fulfill(reqs)
+                net.tick()
+
+            for i in range(50):
+                tick(i)
+            pool.inject_slot_error(0)
+            for i in range(50, 300):
+                tick(i)
+            assert pool.slot_state(0) == SLOT_EVICTED
+            sess = pool.session(0)
+            horizon = peer._sync_layer.last_confirmed_frame
+            checked = 0
+            for f in range(max(0, horizon - 60), horizon):
+                theirs = peer._sync_layer.confirmed_input(0, f).input
+                ours = sess._sync_layer.confirmed_input(0, f).input
+                assert theirs == ours, (delay, faults, f, theirs, ours)
+                checked += 1
+            assert checked >= 50
+            assert pool.current_frame(0) > 280 and peer.current_frame > 280
+
+    def test_in_bank_peer_survives_its_matchmates_eviction(self):
+        """Both sides of a match in the bank; one faults and evicts; the
+        match continues across the native/evicted seam."""
+        clock = [0]
+        net = InMemoryNetwork(latency_ticks=1)
+        pool = HostSessionPool()
+        for me, name, other in ((0, "L", "R"), (1, "R", "L")):
+            pool.add_session(builder(clock, 10 + me, me, other),
+                             net.socket(name))
+        assert pool.native_active
+
+        def tick(i):
+            clock[0] += 16
+            for idx in range(2):
+                pool.add_local_input(idx, idx, (i + idx) % 16)
+            for reqs in pool.advance_all():
+                fulfill(reqs)
+            net.tick()
+
+        for i in range(40):
+            tick(i)
+        pool.inject_slot_error(0)
+        for i in range(40, 240):
+            tick(i)
+        assert pool.slot_state(0) == SLOT_EVICTED
+        assert pool.slot_state(1) == SLOT_NATIVE
+        assert pool.current_frame(0) > 200
+        assert pool.current_frame(1) > 200
+        assert pool.crossings == 240
+
+    def test_missing_input_for_evicted_slot_raises_before_the_crossing(self):
+        """A missing staged input for an EVICTED session must raise in the
+        pre-crossing validation — raising after the native crossing would
+        lose the healthy slots' request lists for the tick."""
+        from ggrs_tpu.core.errors import InvalidRequest
+
+        clock = [0]
+        net = InMemoryNetwork(latency_ticks=1)
+        pool = HostSessionPool()
+        for me, name, other in ((0, "L", "R"), (1, "R", "L")):
+            pool.add_session(builder(clock, 10 + me, me, other),
+                             net.socket(name))
+        assert pool.native_active
+
+        def tick(i, include=(0, 1)):
+            clock[0] += 16
+            for idx in include:
+                pool.add_local_input(idx, idx, (i + idx) % 16)
+            for reqs in pool.advance_all():
+                fulfill(reqs)
+            net.tick()
+
+        for i in range(40):
+            tick(i)
+        pool.inject_slot_error(0)
+        for i in range(40, 60):
+            tick(i)
+        assert pool.slot_state(0) == SLOT_EVICTED
+        crossings = pool.crossings
+        clock[0] += 16
+        pool.add_local_input(1, 1, 3)  # slot 0's input deliberately missing
+        with pytest.raises(InvalidRequest):
+            pool.advance_all()
+        assert pool.crossings == crossings, (
+            "validation must fire BEFORE the native crossing"
+        )
+        # and the pool is not poisoned: stage properly and keep going
+        pool.add_local_input(0, 0, 3)
+        for reqs in pool.advance_all():
+            fulfill(reqs)
+        assert pool.slot_state(1) == SLOT_NATIVE
+
+    def test_eviction_falls_back_to_previous_committed_frame(self):
+        """The suppressed-save fault class: a fault tick can raise the
+        confirmed watermark and then have its own save op suppressed, so
+        the watermark cell was never fulfilled.  Eviction must resume from
+        watermark-1 (whose inputs the harvest keeps) instead of dying."""
+        clock = [0]
+        net = InMemoryNetwork(latency_ticks=1)
+        pool = HostSessionPool()
+        pool.add_session(builder(clock, 1, 0, "R"), net.socket("L"))
+        peer = builder(clock, 2, 1, "L").start_p2p_session(net.socket("R"))
+        assert pool.native_active
+
+        def tick(i):
+            clock[0] += 16
+            peer.add_local_input(1, (i * 3) % 16)
+            fulfill(peer.advance_frame())
+            pool.add_local_input(0, 0, (i * 7) % 16)
+            for reqs in pool.advance_all():
+                fulfill(reqs)
+            net.tick()
+
+        for i in range(60):
+            tick(i)
+        # simulate the unfulfilled watermark save, then fault the slot (the
+        # injection freezes the slot's tick, so the watermark cannot move
+        # between the clobber and the eviction's harvest)
+        w = pool._harvest(0)["last_confirmed"]
+        assert w > 1
+        pool._mirrors[0].saved_states.get_cell(w).save(w + 10 ** 6, None, None)
+        pool.inject_slot_error(0)
+        for i in range(60, 220):
+            tick(i)
+        assert pool.slot_state(0) == SLOT_EVICTED, pool.fault_log(0)
+        assert any(
+            f"resuming from frame {w - 1}" in f.detail
+            for f in pool.fault_log(0)
+        ), pool.fault_log(0)
+        assert pool.current_frame(0) > 180 and peer.current_frame > 180
+        # and the resumed stream stays bit-consistent with the peer
+        sess = pool.session(0)
+        horizon = peer._sync_layer.last_confirmed_frame
+        for f in range(max(0, horizon - 40), horizon):
+            assert (
+                peer._sync_layer.confirmed_input(0, f).input
+                == sess._sync_layer.confirmed_input(0, f).input
+            )
+
+    def test_unrecoverable_slot_goes_dead_after_bounded_retries(self):
+        """Fault before anything is committed (no confirmed frame): eviction
+        cannot resume, retries back off, the slot dies — and the pool keeps
+        serving the other slots."""
+        from ggrs_tpu.parallel.host_bank import EVICT_MAX_ATTEMPTS
+
+        clock = [0]
+        net = InMemoryNetwork()  # no latency: still nothing confirmed at t0
+        pool = HostSessionPool()
+        for me, name, other in ((0, "L", "R"), (1, "R", "L")):
+            pool.add_session(builder(clock, 20 + me, me, other),
+                             net.socket(name))
+        assert pool.native_active
+        pool.inject_slot_error(0)  # fires on the very first tick
+
+        def tick(i):
+            clock[0] += 16
+            for idx in range(2):
+                pool.add_local_input(idx, idx, i % 16)
+            for reqs in pool.advance_all():
+                fulfill(reqs)
+            net.tick()
+
+        tick(0)
+        assert pool.slot_state(0) == "quarantined"
+        # past the 2000 ms disconnect timeout so the healthy slot sheds its
+        # dead peer and runs free on dummy inputs
+        for i in range(1, 200):
+            tick(i)
+        assert pool.slot_state(0) == SLOT_DEAD
+        attempts = [
+            f for f in pool.fault_log(0) if "eviction attempt" in f.detail
+        ]
+        assert len(attempts) == EVICT_MAX_ATTEMPTS
+        assert pool.current_frame(1) > 60
+
+    def test_eviction_feeds_the_batched_executor(self):
+        """HostedPool end to end: the evicted slot's Load-leading request
+        list parses through BatchedRequestExecutor's grammar and its device
+        lane keeps advancing."""
+        import numpy as np
+
+        from ggrs_tpu.games import BoxGame, boxgame_config
+        from ggrs_tpu.parallel import BatchedRequestExecutor, HostedPool
+
+        game = BoxGame(2)
+        clock = [0]
+        net = InMemoryNetwork(latency_ticks=1)
+        host = HostSessionPool()
+        n_matches = 2
+        for m in range(n_matches):
+            names = (f"A{m}", f"B{m}")
+            for me in (0, 1):
+                b = (
+                    SessionBuilder(boxgame_config())
+                    .with_clock(lambda: clock[0])
+                    .with_rng(random.Random(7 * m + me))
+                    .add_player(Local(), me)
+                    .add_player(Remote(names[1 - me]), 1 - me)
+                )
+                host.add_session(b, net.socket(names[me]))
+        executor = BatchedRequestExecutor(
+            game.advance, game.init_state(),
+            lambda pairs: np.asarray([p[0] for p in pairs], np.uint8),
+            batch_size=len(host), ring_length=10, max_burst=9,
+            with_checksums=False,
+        )
+        executor.warmup(np.zeros((2,), np.uint8))
+        hosted = HostedPool(host, executor)
+
+        TICKS = 120
+        for i in range(TICKS):
+            clock[0] += 16
+            if i == 40:
+                host.inject_slot_error(1)
+            hosted.tick([
+                (idx, idx % 2, (i + idx) % 16) for idx in range(len(host))
+            ])
+            net.tick()
+        hosted.block_until_ready()
+        assert host.slot_state(1) == SLOT_EVICTED
+        for idx in range(len(host)):
+            assert host.current_frame(idx) >= TICKS - 24
+        st = executor.live_state(1)
+        assert set(st) == set(game.init_state_np())
+
+
+class TestFallbackIsolation:
+    def test_python_fallback_contains_slot_faults(self, monkeypatch):
+        """With the native bank unavailable, a session whose tick raises is
+        marked dead; the other sessions keep ticking."""
+        monkeypatch.setattr(_native, "bank_lib", lambda: None)
+        clock = [0]
+        net = InMemoryNetwork(latency_ticks=1)
+        pool = HostSessionPool()
+
+        class FaultySocket:
+            def __init__(self, inner):
+                self.inner = inner
+                self.explode = False
+
+            def send_to(self, msg, addr):
+                if self.explode:
+                    raise OSError("wire cut")
+                self.inner.send_to(msg, addr)
+
+            def receive_all_datagrams(self):
+                return self.inner.receive_all_datagrams()
+
+            def receive_all_messages(self):
+                return self.inner.receive_all_messages()
+
+        faulty = FaultySocket(net.socket("A0"))
+        pool.add_session(builder(clock, 1, 0, "B0"), faulty)
+        pool.add_session(builder(clock, 2, 1, "A0"), net.socket("B0"))
+        for m in range(1, 3):
+            names = (f"A{m}", f"B{m}")
+            for me in (0, 1):
+                pool.add_session(
+                    builder(clock, 3 + 2 * m + me, me, names[1 - me]),
+                    net.socket(names[me]),
+                )
+        assert not pool.native_active
+
+        def tick(i):
+            clock[0] += 16
+            for idx in range(len(pool)):
+                pool.add_local_input(idx, idx % 2, (i + idx) % 16)
+            for reqs in pool.advance_all():
+                fulfill(reqs)
+            net.tick()
+
+        for i in range(30):
+            tick(i)
+        faulty.explode = True
+        for i in range(30, 120):
+            tick(i)
+        assert pool.slot_state(0) == SLOT_DEAD
+        assert pool.fault_log(0)
+        for idx in range(2, len(pool)):
+            assert pool.slot_state(idx) == SLOT_NATIVE
+            assert pool.current_frame(idx) > 100
+
+    def test_handshake_pool_converges_on_fallback(self, monkeypatch):
+        """Handshake sessions (bank-ineligible, always fallback) must keep
+        polling while NotSynchronized is raised, or in-pool peers can never
+        answer each other's sync probes and advance_all livelocks."""
+        from ggrs_tpu.core.errors import NotSynchronized
+
+        monkeypatch.setattr(_native, "bank_lib", lambda: None)
+        clock = [0]
+        net = InMemoryNetwork()
+        pool = HostSessionPool()
+        for me, name, other in ((0, "L", "R"), (1, "R", "L")):
+            b = builder(clock, 30 + me, me, other).with_sync_handshake(True)
+            pool.add_session(b, net.socket(name))
+        assert not pool.native_active
+
+        synced_at = None
+        for i in range(100):
+            clock[0] += 16
+            for idx in range(2):
+                pool.add_local_input(idx, idx, i % 16)
+            try:
+                reqs = pool.advance_all()
+            except NotSynchronized:
+                continue
+            for r in reqs:
+                fulfill(r)
+            synced_at = i
+            break
+        assert synced_at is not None, "handshake never completed (livelock)"
+
+    def test_missing_input_still_raises_contract_error(self, monkeypatch):
+        """GgrsError is a caller bug, not a slot fault — both paths."""
+        from ggrs_tpu.core.errors import InvalidRequest
+
+        monkeypatch.setattr(_native, "bank_lib", lambda: None)
+        net = InMemoryNetwork()
+        pool = HostSessionPool()
+        clock = [0]
+        pool.add_session(builder(clock, 1, 0, "Y"), net.socket("X"))
+        pool.add_session(builder(clock, 2, 1, "X"), net.socket("Y"))
+        with pytest.raises(InvalidRequest):
+            pool.advance_all()
+        assert pool.slot_state(0) == SLOT_NATIVE
+
+
+@needs_native
+@pytest.mark.slow
+class TestSoak:
+    def test_bank_soak_under_combined_faults(self):
+        """≥5k ticks under loss+dup+reorder+latency plus a mid-run blackout
+        window: honest traffic must NEVER fault a slot (zero quarantines,
+        zero deaths) and every session converges.  The fault-free control
+        leg pins the same at zero-fault conditions."""
+        for faults, blackout in (
+            (dict(seed=9, loss=0.05, duplicate=0.03, reorder=0.03,
+                  latency_ticks=2), (2000, 2090)),
+            (dict(latency_ticks=1), None),  # fault-free control leg
+        ):
+            clock = [0]
+            nets = []
+            pool = HostSessionPool()
+            for m in range(2):
+                net = InMemoryNetwork(**faults)
+                nets.append(net)
+                names = (f"A{m}", f"B{m}")
+                for me in (0, 1):
+                    pool.add_session(
+                        builder(clock, 3 + 5 * m + me, me, names[1 - me]),
+                        net.socket(names[me]),
+                    )
+            assert pool.native_active
+
+            TICKS = 5200
+            for i in range(TICKS):
+                clock[0] += 16
+                if blackout is not None:
+                    if i == blackout[0]:
+                        for net in nets:
+                            net.loss = 1.0
+                    elif i == blackout[1]:
+                        for net in nets:
+                            net.loss = faults["loss"]
+                for idx in range(len(pool)):
+                    pool.add_local_input(idx, idx % 2, (i * 3 + idx) % 16)
+                for reqs in pool.advance_all():
+                    fulfill(reqs)
+                for idx in range(len(pool)):
+                    pool.events(idx)  # drain
+                for net in nets:
+                    net.tick()
+
+            for idx in range(len(pool)):
+                assert pool.slot_state(idx) == SLOT_NATIVE, (
+                    f"slot {idx} faulted under honest traffic: "
+                    f"{pool.fault_log(idx)}"
+                )
+                # frames advance at most 1/tick, so the blackout window is
+                # never regained — the bound is ticks minus the blackout
+                # plus prediction-stall slack
+                slack = (blackout[1] - blackout[0] if blackout else 0) + 64
+                assert pool.current_frame(idx) >= TICKS - slack, (
+                    f"slot {idx} failed to converge"
+                )
+            assert pool.crossings == TICKS
+            assert pool.harvests == 0
