@@ -139,19 +139,33 @@ def _inputs(n: int, players: int, seed: int) -> np.ndarray:
 _METRIC_PREFIX = os.environ.get("GGRS_BENCH_METRIC_PREFIX", "")
 
 
-def emit(metric: str, value: float, unit: str, vs_baseline: float) -> None:
-    print(
-        json.dumps(
-            {
-                "metric": _METRIC_PREFIX + metric,
-                # small values (roofline fractions, ratios) need the digits
-                "value": round(value, 1) if abs(value) >= 10 else round(value, 5),
-                "unit": unit,
-                "vs_baseline": round(vs_baseline, 2),
-            }
-        ),
-        flush=True,
-    )
+def emit(metric: str, value: float, unit: str, vs_baseline: float,
+         obs: Optional[dict] = None) -> None:
+    record = {
+        "metric": _METRIC_PREFIX + metric,
+        # small values (roofline fractions, ratios) need the digits
+        "value": round(value, 1) if abs(value) >= 10 else round(value, 5),
+        "unit": unit,
+        "vs_baseline": round(vs_baseline, 2),
+    }
+    if obs is not None:
+        # obs metrics snapshot (ggrs_tpu.obs.json_snapshot shape) — rides
+        # into bench_out/latest.json with the metric it annotates
+        record["obs"] = obs
+    print(json.dumps(record), flush=True)
+
+
+def _obs_counters_snapshot(registry) -> dict:
+    """The registry's counter/histogram families as a compact snapshot —
+    per-slot/per-endpoint scrape gauges are dropped (at B=64 matches they
+    are ~1k samples of point-in-time noise; the counters are the record)."""
+    from ggrs_tpu.obs import json_snapshot
+
+    return {
+        name: fam
+        for name, fam in json_snapshot(registry).items()
+        if not name.startswith(("ggrs_slot_", "ggrs_endpoint_"))
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -1442,11 +1456,12 @@ def run_flagship() -> None:
     )
 
 
-def _bank_matches_setup(n_matches: int):
+def _bank_matches_setup(n_matches: int, metrics=None):
     """The host-bank form of ``_match_population``: the SAME builders /
     sockets / schedules driven through ``parallel.HostSessionPool`` instead
     of per-session P2PSessions, fulfilled by the same
-    ``BatchedRequestExecutor``."""
+    ``BatchedRequestExecutor``.  ``metrics``: optional isolated
+    ``ggrs_tpu.obs.Registry`` for the obs-budget measurements."""
     from ggrs_tpu.parallel import BatchedRequestExecutor, HostSessionPool
 
     game = BoxGame(2)
@@ -1454,7 +1469,9 @@ def _bank_matches_setup(n_matches: int):
     def to_arr(pairs):
         return np.asarray([p[0] for p in pairs], np.uint8)
 
-    host = HostSessionPool()
+    host = HostSessionPool() if metrics is None else HostSessionPool(
+        metrics=metrics
+    )
     schedules = []
     for b, sock, sched in _match_population(n_matches):
         host.add_session(b, sock)
@@ -1468,10 +1485,12 @@ def _bank_matches_setup(n_matches: int):
     return host, schedules, pool
 
 
-def _bank_tick_fn(host, schedules, pool):
+def _bank_tick_fn(host, schedules, pool, scrape_each_tick=False):
     """One strict-fence pool tick (host crossing + device fulfillment),
     returning (host_ms, device_ms) — the shared harness of the host_bank
-    capacity ramp and the degraded config."""
+    capacity ramp and the degraded config.  ``scrape_each_tick`` adds the
+    obs stat harvest (one extra ctypes crossing) inside the host window —
+    the scrape-budget measurement of DESIGN.md §12."""
     n = len(host)
     counter = [0]
 
@@ -1482,6 +1501,8 @@ def _bank_tick_fn(host, schedules, pool):
         for h in range(n):
             host.add_local_input(h, h % 2, schedules[h](i))
         reqs = host.advance_all()
+        if scrape_each_tick:
+            host.scrape()
         t1 = time.perf_counter()
         pool.run(reqs)
         pool.block_until_ready()
@@ -1599,6 +1620,44 @@ def run_host_bank() -> None:
         250.0 / bank_us if bank_us else 0.0,
     )
 
+    # ---- 1b. the obs scrape budget (DESIGN.md §12): p99 with a metrics
+    # scrape every tick vs without, at the B=64 capacity point; the scrape
+    # run's counter snapshot is embedded in the bench record ----
+    from ggrs_tpu.obs import Registry
+
+    def scrape_leg(scrape: bool):
+        reg = Registry()
+        host, schedules, pool = _bank_matches_setup(64, metrics=reg)
+        if not host.native_active:
+            return None
+        tick = _bank_tick_fn(host, schedules, pool,
+                             scrape_each_tick=scrape)
+        for _ in range(16):
+            tick()
+        p = _best_tick_percentiles(tick, 200)
+        snap = _obs_counters_snapshot(reg)
+        crossings = (host.crossings, host.stat_crossings)
+        del host, schedules, pool
+        return p, snap, crossings
+
+    plain = scrape_leg(False)
+    scraped = scrape_leg(True)
+    if plain is not None and scraped is not None:
+        p99_plain, p99_scraped = plain[0][1], scraped[0][1]
+        overhead_pct = (
+            (p99_scraped - p99_plain) / p99_plain * 100.0 if p99_plain else 0.0
+        )
+        ticks, stat_crossings = scraped[2]
+        emit(
+            "host_bank_obs_scrape_overhead_pct", overhead_pct,
+            f"p99 delta with a per-tick metrics scrape, B=64 matches, strict "
+            f"fence (scraped {p99_scraped:.2f} ms vs plain {p99_plain:.2f} "
+            f"ms; {stat_crossings} stat crossings over {ticks} ticks = one "
+            f"per scrape; target <5%)",
+            5.0 / overhead_pct if overhead_pct > 0 else 99.0,
+            obs=scraped[1],
+        )
+
     # ---- 2. capacity ramp with one-crossing host + one-dispatch device ----
     frame_budget_ms = 1000.0 / 60.0
     T = 300
@@ -1658,7 +1717,10 @@ def run_host_bank_degraded() -> None:
     T = 300
 
     def measure(degrade: bool):
-        host, schedules, pool = _bank_matches_setup(B)
+        from ggrs_tpu.obs import Registry
+
+        reg = Registry()
+        host, schedules, pool = _bank_matches_setup(B, metrics=reg)
         n = len(host)
         if not host.native_active:
             return None
@@ -1676,8 +1738,9 @@ def run_host_bank_degraded() -> None:
             if evicted == 0:
                 return None
         best = _best_tick_percentiles(tick, T)
+        snap = _obs_counters_snapshot(reg)
         del host, schedules, pool
-        return best
+        return best, snap
 
     healthy = measure(degrade=False)
     degraded = measure(degrade=True)
@@ -1685,12 +1748,14 @@ def run_host_bank_degraded() -> None:
         print("# skip: host_bank_degraded pool did not engage/degrade",
               flush=True)
         return
+    (d50, d99, dfrac), dsnap = degraded
     emit(
-        f"host_bank_degraded_b{B}_tick_ms_p99", degraded[1],
+        f"host_bank_degraded_b{B}_tick_ms_p99", d99,
         f"ms/tick p99, strict fence, 1/8 slots evicted to Python "
-        f"(p50 {degraded[0]:.2f} ms, host fraction {degraded[2]:.2f}; "
-        f"all-native p99 {healthy[1]:.2f} ms)",
-        healthy[1] / degraded[1] if degraded[1] else 0.0,
+        f"(p50 {d50:.2f} ms, host fraction {dfrac:.2f}; "
+        f"all-native p99 {healthy[0][1]:.2f} ms)",
+        healthy[0][1] / d99 if d99 else 0.0,
+        obs=dsnap,  # the degraded run's fault/eviction/crossing counters
     )
 
 

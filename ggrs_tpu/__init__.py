@@ -10,6 +10,7 @@ stays on the host behind the same ordered Save/Load/Advance command-list
 boundary as the reference.
 """
 
+from . import obs  # noqa: F401  - metrics/flight-recorder/exporters (§12)
 from .core import *  # noqa: F401,F403
 from .core import __all__ as _core_all
 from .net import (
@@ -42,4 +43,5 @@ __all__ = list(_core_all) + [
     "SpectatorSession",
     "SyncTestSession",
     "UdpNonBlockingSocket",
+    "obs",
 ]

@@ -21,6 +21,7 @@ from typing import Any, Callable, Dict, List, Optional
 from .core import Local, Remote
 from .core.config import Config
 from .net import InMemoryNetwork
+from .obs.registry import Registry
 from .parallel.host_bank import HostSessionPool, SLOT_NATIVE
 from .sessions import SessionBuilder
 
@@ -102,18 +103,25 @@ def drive_chaos(
     ext_alive: Optional[Callable[[int], bool]] = None,
     retire: bool = False,
     fault_cfg: Optional[Dict[str, Any]] = None,
+    metrics: Optional[Registry] = None,
 ) -> Dict[str, Any]:
     """Build the chaos topology and drive ``ticks`` pool ticks.
 
     ``inject(i, ctx)`` runs at the top of tick ``i`` (``ctx`` carries
     ``pool``, ``ext``, ``target``, ``seed``); ``ext_alive(i)`` gates driving
     the external peer (its blackout switch).  Identical arguments produce a
-    bit-identical run — the control/chaos comparison contract.
+    bit-identical run — the control/chaos comparison contract; metrics
+    must never perturb it (``metrics=Registry(enabled=False)`` runs the
+    same pool with the obs layer compiled out, and tests pin the wire
+    bytes identical either way).  The run's registry and a final
+    ``pool.scrape()`` snapshot land in the returned ctx (``registry``,
+    ``scrape``).
     """
     base = seed * 1000
     clock = [0]
     nets = []
-    pool = HostSessionPool(retire_dead_matches=retire)
+    registry = metrics if metrics is not None else Registry()
+    pool = HostSessionPool(retire_dead_matches=retire, metrics=registry)
     socks = []
     for m in range(n_matches):
         cfg = dict(fault_cfg or {"latency_ticks": 1})
@@ -176,6 +184,8 @@ def drive_chaos(
         events=events_log,
         states=[pool.slot_state(i) for i in range(n)],
         frames=[pool.current_frame(i) for i in range(n)],
+        registry=registry,
+        scrape=pool.scrape(),
     )
     return ctx
 

@@ -322,6 +322,15 @@ def _load() -> Optional[ctypes.CDLL]:
                 ctypes.c_void_p, ctypes.c_int64,
                 ctypes.c_char_p, ctypes.c_size_t,
             ]
+        if hasattr(lib, "ggrs_ep_stats"):
+            # observability counters (obs stat harvest); absent on a
+            # prebuilt pre-obs .so — readers degrade to zeros
+            lib.ggrs_ep_stats.restype = None
+            lib.ggrs_ep_stats.argtypes = [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+            ]
+            lib.ggrs_ep_last_acked_frame.restype = ctypes.c_int64
+            lib.ggrs_ep_last_acked_frame.argtypes = [ctypes.c_void_p]
         if hasattr(lib, "ggrs_sync_new"):
             lib.ggrs_sync_new.restype = ctypes.c_void_p
             lib.ggrs_sync_new.argtypes = [ctypes.c_int, ctypes.c_int]
@@ -417,6 +426,14 @@ def _load() -> Optional[ctypes.CDLL]:
                     ctypes.c_char_p, ctypes.c_size_t,
                     ctypes.POINTER(ctypes.c_size_t),
                 ]
+            if hasattr(lib, "ggrs_bank_stats"):
+                # one-crossing stat harvest (obs); absent on a prebuilt
+                # pre-obs .so — HostSessionPool.scrape degrades gracefully
+                lib.ggrs_bank_stats.restype = ctypes.c_int
+                lib.ggrs_bank_stats.argtypes = [
+                    ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+                    ctypes.POINTER(ctypes.c_size_t),
+                ]
         _lib = lib
         return _lib
 
@@ -449,6 +466,13 @@ BANK_ERR_CONFIRM = -73
 BANK_ERR_NO_PLAYERS = -74
 BANK_ERR_SEQUENCE = -75
 BANK_ERR_INJECTED = -76  # chaos-harness simulated slot fault (ctrl op 2)
+
+# endpoint-core observability counter order (ggrs_ep_stats out7; also the
+# per-endpoint tail of each ggrs_bank_stats record)
+EP_STAT_FIELDS = (
+    "emits", "emit_bytes", "acks", "datagrams", "new_frames", "drops",
+    "fallbacks",
+)
 
 BANK_ERR_NAMES = {
     BANK_ERR_CMD: "malformed command stream",

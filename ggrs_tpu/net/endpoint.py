@@ -191,6 +191,10 @@ class PyEndpointCore:
     def last_recv_frame(self) -> Frame:
         return self._last_recv
 
+    def last_acked_frame(self) -> Frame:
+        """Newest frame the peer has acked (obs stat surface)."""
+        return self._last_acked_frame
+
     # ---- adoption (fallback eviction) ----
 
     def seed_send(self, last_acked_frame: Frame, base: bytes) -> None:
@@ -454,6 +458,13 @@ class NativeEndpointCore:
 
     def last_recv_frame(self) -> Frame:
         return self._last_recv
+
+    def last_acked_frame(self) -> Frame:
+        """Newest frame the peer has acked (obs stat surface); NULL when
+        the library predates the obs accessor."""
+        if hasattr(self._lib, "ggrs_ep_last_acked_frame"):
+            return self._lib.ggrs_ep_last_acked_frame(self._ptr)
+        return NULL_FRAME
 
     # ---- adoption (fallback eviction) ----
 

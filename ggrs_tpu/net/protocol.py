@@ -46,9 +46,23 @@ from .messages import RawMessage, encode_input_ack, parse_input_ack_frame
 from .sockets import NonBlockingSocket
 from .stats import NetworkStats
 from .wire import Reader, WireError, Writer
+from ..obs.registry import default_registry
 
 I = TypeVar("I")
 A = TypeVar("A", bound=Hashable)
+
+# obs (DESIGN.md §12): dropped-packet accounting by reason — process-wide
+# (endpoints are constructed below the pool/session seam); observational
+# only, the drop semantics themselves are unchanged
+_OBS_DROPPED = default_registry().counter(
+    "ggrs_protocol_dropped_packets_total",
+    "received datagrams dropped instead of applied, by reason",
+    labels=("reason",),
+)
+_DROP_UNDECODABLE = _OBS_DROPPED.labels(reason="undecodable")
+_DROP_MALFORMED = _OBS_DROPPED.labels(reason="malformed")
+_DROP_BAD_FRAME = _OBS_DROPPED.labels(reason="malformed_frame")
+_DROP_BAD_INPUT = _OBS_DROPPED.labels(reason="undecodable_input")
 
 UDP_HEADER_SIZE = 28  # IP + UDP header bytes, for bandwidth estimation
 UDP_SHUTDOWN_TIMER_MS = 5000
@@ -595,12 +609,14 @@ class PeerProtocol(Generic[I, A]):
         for frame_payload in payloads:
             per_player = _decode_player_bytes(frame_payload, n_handles)
             if per_player is None:
+                _DROP_BAD_FRAME.inc()
                 return  # malformed inner framing: drop the packet
             try:
                 decoded_inputs.append(
                     [self._config.input_decode(b) for b in per_player]
                 )
             except Exception:
+                _DROP_BAD_INPUT.inc()
                 return  # undecodable input payload: drop the packet
 
         self._core.commit()
@@ -626,6 +642,7 @@ class PeerProtocol(Generic[I, A]):
         try:
             msg = Message.decode(data)
         except WireError:
+            _DROP_UNDECODABLE.inc()
             return
         self.handle_message(msg)
 
@@ -652,6 +669,7 @@ class PeerProtocol(Generic[I, A]):
             self._decode_and_dispatch(data)
             return
         if res is None:
+            _DROP_MALFORMED.inc()
             return  # malformed: dropped whole, nothing applied
         self._mark_alive()
         disconnect_requested, (n_status, disc, frames), staged = res

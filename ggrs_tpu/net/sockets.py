@@ -20,8 +20,20 @@ from typing import Deque, Dict, Hashable, List, Optional, Protocol, Tuple, TypeV
 from .messages import Message
 from .stats import NetworkStats
 from .wire import WireError
+from ..obs.registry import default_registry
 
 logger = logging.getLogger(__name__)
+
+# obs (DESIGN.md §12): socket-level counters — process-wide, since sockets
+# are constructed below the pool/session seam.  Observational only.
+_OBS_SEND_ERRORS = default_registry().counter(
+    "ggrs_socket_send_errors_total",
+    "transient OS send failures swallowed as packet loss",
+)
+_OBS_OVERSIZED = default_registry().counter(
+    "ggrs_socket_oversized_packets_total",
+    "datagrams sent above the ideal fragmentation-safe UDP size",
+)
 
 # Transient send failures a UDP socket can surface on Linux (often from a
 # previous datagram's ICMP error): the datagram counts as lost — which the
@@ -78,6 +90,7 @@ class UdpNonBlockingSocket:
         if len(buf) > IDEAL_MAX_UDP_PACKET_SIZE:
             # Occasional large packets usually get through; persistent ones
             # mean the input struct is too big.  Warn, don't fail.
+            _OBS_OVERSIZED.inc()
             logger.warning(
                 "Sending UDP packet of size %d bytes, larger than ideal (%d)",
                 len(buf),
@@ -91,6 +104,7 @@ class UdpNonBlockingSocket:
             if e.errno not in _TRANSIENT_SEND_ERRNOS:
                 raise
             self.stats.send_errors += 1
+            _OBS_SEND_ERRORS.inc()
             logger.debug("UDP send to %s failed transiently: %s", addr, e)
 
     def receive_all_messages(self) -> List[Tuple[Tuple[str, int], Message]]:

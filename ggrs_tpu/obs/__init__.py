@@ -1,0 +1,63 @@
+"""``ggrs_tpu.obs`` — pool-scale observability (DESIGN.md §12).
+
+Three dependency-free pieces:
+
+- :mod:`registry` — counters, gauges, fixed-bucket histograms with label
+  sets; near-zero cost on the hot path and a shared null mode for
+  metrics-off runs.
+- :mod:`recorder` — the per-slot flight recorder: a bounded ring of
+  recent events (state changes, faults, rollback decisions, wire
+  digests) dumped on quarantine/eviction for post-mortems.
+- :mod:`exporters` — Prometheus text exposition, JSON snapshots, and a
+  stdlib HTTP scrape endpoint.
+
+The bank-side numbers behind these come from the native stat harvest:
+``HostSessionPool.scrape()`` dumps every slot's protocol/sync counters
+(ping, kbps, send-queue length, last-acked frame, rollback depth, frame
+advantage both ways) in ONE ctypes crossing per scrape
+(``ggrs_bank_stats`` in native/session_bank.cpp), preserving the
+one-crossing-per-tick invariant of DESIGN.md §8.
+
+Quickstart (see README "Observability")::
+
+    from ggrs_tpu.obs import Registry, start_http_server
+    from ggrs_tpu.parallel import HostSessionPool
+
+    reg = Registry()
+    pool = HostSessionPool(metrics=reg)
+    ...
+    server = start_http_server(reg, port=9464)
+    while running:
+        pool.advance_all()          # one crossing (the tick)
+        pool.scrape()               # one crossing (every slot's stats)
+"""
+
+from .registry import (
+    Counter,
+    DEFAULT,
+    Gauge,
+    Histogram,
+    Registry,
+    default_registry,
+)
+from .recorder import FlightRecorder
+from .exporters import (
+    MetricsServer,
+    json_snapshot,
+    prometheus_text,
+    start_http_server,
+)
+
+__all__ = [
+    "Counter",
+    "DEFAULT",
+    "FlightRecorder",
+    "Gauge",
+    "Histogram",
+    "MetricsServer",
+    "Registry",
+    "default_registry",
+    "json_snapshot",
+    "prometheus_text",
+    "start_http_server",
+]

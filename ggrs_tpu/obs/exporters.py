@@ -1,0 +1,154 @@
+"""Exporters for the metrics registry: Prometheus text exposition, JSON
+snapshots, and a stdlib HTTP endpoint (DESIGN.md §12).
+
+The exporters only *read* — they never drive the pool.  Bank-side gauges
+refresh when the driving thread calls ``HostSessionPool.scrape()`` (one
+ctypes crossing for the whole bank); the HTTP server then serves whatever
+the last scrape left in the registry.  Serving and scraping are split
+deliberately: sessions are single-threaded (the Send-not-Sync contract),
+so an HTTP thread must never reach into the bank itself.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Dict, Optional
+
+from .registry import Registry
+
+__all__ = ["prometheus_text", "json_snapshot", "start_http_server",
+           "MetricsServer"]
+
+
+def _escape_label(value: str) -> str:
+    return (
+        value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _fmt_value(v: float) -> str:
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _label_str(labels: Dict[str, str], extra: str = "") -> str:
+    parts = [f'{k}="{_escape_label(v)}"' for k, v in labels.items()]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def prometheus_text(registry: Registry) -> str:
+    """The registry in Prometheus text exposition format (version 0.0.4:
+    ``# HELP`` / ``# TYPE`` headers, one sample per line)."""
+    lines = []
+    for fam in registry.families():
+        if fam.help:
+            lines.append(f"# HELP {fam.name} {fam.help}")
+        lines.append(f"# TYPE {fam.name} {fam.kind}")
+        for labels, child in fam.samples():
+            if fam.kind == "histogram":
+                for upper, cum in child.cumulative():
+                    le = "+Inf" if upper == float("inf") else _fmt_value(upper)
+                    extra = 'le="%s"' % le
+                    lines.append(
+                        f"{fam.name}_bucket{_label_str(labels, extra)} {cum}"
+                    )
+                lines.append(
+                    f"{fam.name}_sum{_label_str(labels)} "
+                    f"{_fmt_value(child.sum)}"
+                )
+                lines.append(
+                    f"{fam.name}_count{_label_str(labels)} {child.count}"
+                )
+            else:
+                lines.append(
+                    f"{fam.name}{_label_str(labels)} "
+                    f"{_fmt_value(child.value)}"
+                )
+    return "\n".join(lines) + "\n"
+
+
+def json_snapshot(registry: Registry) -> Dict[str, Any]:
+    """The registry as a JSON-serializable dict — the shape bench.py
+    embeds in its ``bench_out`` records and chaos summaries print."""
+    out: Dict[str, Any] = {}
+    for fam in registry.families():
+        samples = []
+        for labels, child in fam.samples():
+            if fam.kind == "histogram":
+                samples.append({
+                    "labels": labels,
+                    "sum": child.sum,
+                    "count": child.count,
+                    "buckets": [
+                        {"le": upper if upper != float("inf") else "+Inf",
+                         "count": cum}
+                        for upper, cum in child.cumulative()
+                    ],
+                })
+            else:
+                samples.append({"labels": labels, "value": child.value})
+        out[fam.name] = {
+            "type": fam.kind,
+            "help": fam.help,
+            "samples": samples,
+        }
+    return out
+
+
+class MetricsServer:
+    """Minimal scrape endpoint over ``http.server``: ``/metrics`` serves
+    the Prometheus text format, ``/metrics.json`` the JSON snapshot.
+    Daemon-threaded; ``close()`` shuts it down.  Reads are GIL-safe
+    against concurrent increments (plain attribute reads), so no
+    coordination with the driving thread is needed."""
+
+    def __init__(self, registry: Registry, port: int = 0,
+                 addr: str = "127.0.0.1") -> None:
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(h) -> None:  # noqa: N805 - handler convention
+                if h.path.startswith("/metrics.json"):
+                    body = json.dumps(json_snapshot(registry)).encode()
+                    ctype = "application/json"
+                elif h.path.startswith("/metrics"):
+                    body = prometheus_text(registry).encode()
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                else:
+                    h.send_response(404)
+                    h.end_headers()
+                    return
+                h.send_response(200)
+                h.send_header("Content-Type", ctype)
+                h.send_header("Content-Length", str(len(body)))
+                h.end_headers()
+                h.wfile.write(body)
+
+            def log_message(h, *args) -> None:  # quiet by default
+                pass
+
+        self._httpd = ThreadingHTTPServer((addr, port), Handler)
+        self.addr = addr
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="ggrs-obs-http",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+def start_http_server(registry: Registry, port: int = 0,
+                      addr: str = "127.0.0.1") -> MetricsServer:
+    """Serve ``registry`` on ``http://addr:port/metrics`` (port 0 picks a
+    free one; read it back from the returned server's ``.port``)."""
+    return MetricsServer(registry, port=port, addr=addr)

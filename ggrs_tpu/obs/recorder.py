@@ -1,0 +1,81 @@
+"""Per-slot flight recorder: a bounded ring of recent events, dumped on
+quarantine/eviction for post-mortems (DESIGN.md §12).
+
+Rollback netcode faults are archaeology: by the time a slot quarantines,
+the packet or decision that doomed it is several ticks in the past.  The
+recorder keeps the last ``capacity`` events per slot — supervision state
+changes, faults, rollback decisions, and short digests of recent wire
+traffic — so the dump that accompanies a quarantine pinpoints what the
+slot was doing, without logging anything for healthy slots.
+
+Events are ``(tick, kind, detail)`` triples.  ``detail`` is usually a
+short pre-formatted string; hot-path events (the per-datagram wire
+digests) may instead pass a small tuple of scalars, which ``dump``
+formats lazily — recording must stay cheap enough to leave on for every
+healthy slot.  The recorder never holds references into live session
+state, so a dump is safe to stash long after the slot is gone.  Like the
+metrics registry, recording is observational only and must never perturb
+session behavior.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Any, Deque, List, Tuple
+
+__all__ = ["FlightRecorder", "EV_STATE", "EV_FAULT", "EV_ROLLBACK",
+           "EV_WIRE", "EV_EVICT"]
+
+# event kinds (free-form strings are allowed too; these are the ones the
+# pool emits and the chaos summaries group by)
+EV_STATE = "state"        # supervision transition (native -> quarantined...)
+EV_FAULT = "fault"        # a SlotFault landed
+EV_ROLLBACK = "rollback"  # the slot executed a rollback (load op)
+EV_WIRE = "wire"          # outbound datagram digest (crc32, length)
+EV_EVICT = "evict"        # eviction attempt / outcome
+
+
+class FlightRecorder:
+    """Bounded event ring for one pool slot."""
+
+    __slots__ = ("_ring", "recorded")
+
+    def __init__(self, capacity: int = 256) -> None:
+        self._ring: Deque[Tuple[int, str, Any]] = deque(maxlen=capacity)
+        self.recorded = 0  # total ever recorded (ring drops the oldest)
+
+    def record(self, tick: int, kind: str, detail: Any = "") -> None:
+        self._ring.append((tick, kind, detail))
+        self.recorded += 1
+
+    def events(self, last: int = 0) -> List[Tuple[int, str, Any]]:
+        """The retained events, oldest first; ``last`` > 0 keeps only the
+        newest ``last``."""
+        out = list(self._ring)
+        if last > 0:
+            out = out[-last:]
+        return out
+
+    def dump(self, last: int = 32) -> str:
+        """Human-readable dump of the newest ``last`` events — the
+        post-mortem attached to quarantine/eviction logs and chaos
+        summaries."""
+        events = self.events(last)
+        if not events:
+            return "  (no recorded events)"
+        dropped = self.recorded - len(self._ring)
+        lines = []
+        if len(events) < self.recorded:
+            lines.append(
+                f"  ... {self.recorded - len(events)} earlier events "
+                f"({dropped} beyond ring capacity)"
+            )
+        for tick, kind, detail in events:
+            if kind == EV_WIRE and isinstance(detail, tuple):
+                ep, length, crc = detail
+                detail = f"ep={ep} len={length}B crc={crc:08x}"
+            lines.append(f"  t{tick:06d} {kind:<9s} {detail}")
+        return "\n".join(lines)
+
+    def __len__(self) -> int:
+        return len(self._ring)

@@ -47,7 +47,28 @@ from ..core.types import (
     LoadGameState,
     SaveGameState,
 )
+from ..obs.registry import default_registry
 from ..ops.checksum import CHECKSUM_LANES, checksum_device, checksum_to_u128
+
+# obs (DESIGN.md §12): device-dispatch accounting for the pooled executor —
+# process-wide counters, observational only
+_OBS_DISPATCHES = default_registry().counter(
+    "ggrs_executor_dispatches_total",
+    "pooled tick programs dispatched to the device",
+)
+_OBS_EMPTY_TICKS = default_registry().counter(
+    "ggrs_executor_empty_ticks_total",
+    "run() calls where every session's request list was empty (no dispatch)",
+)
+_OBS_ROLLBACK_LOADS = default_registry().counter(
+    "ggrs_executor_rollback_loads_total",
+    "sessions that carried a LoadGameState (rollback) into a pooled tick",
+)
+_OBS_BURST_DEPTH = default_registry().histogram(
+    "ggrs_executor_burst_depth_frames",
+    "deepest per-session advance burst (replay depth) per dispatched tick",
+    buckets=(1, 2, 4, 8, 16, 32),
+)
 
 
 def _tree_where(pred: jax.Array, a: Any, b: Any) -> Any:
@@ -454,6 +475,7 @@ class BatchedRequestExecutor:
                 f"{self.batch_size} sessions"
             )
         if all(not reqs for reqs in request_lists):
+            _OBS_EMPTY_TICKS.inc()
             return
         desc = self._blank_desc()
         # parse fulfills cells eagerly (the ring-capacity guard needs this
@@ -466,6 +488,9 @@ class BatchedRequestExecutor:
             for b, reqs in enumerate(request_lists):
                 if reqs:
                     self._parse(b, reqs, desc)
+            _OBS_DISPATCHES.inc()
+            _OBS_ROLLBACK_LOADS.inc(int(desc["do_load"].sum()))
+            _OBS_BURST_DEPTH.observe(int(desc["n_adv"].max()))
             self._carry = self._tick(self._carry, desc)
         except BaseException as e:  # incl. KeyboardInterrupt mid-parse
             self._invalid = f"{type(e).__name__}: {e}"

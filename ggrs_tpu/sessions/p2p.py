@@ -60,6 +60,7 @@ from ..net.protocol import (
 )
 from ..net.sockets import NonBlockingSocket
 from ..net.stats import NetworkStats
+from ..obs.registry import default_registry
 from ..utils.ownership import ThreadOwned
 
 logger = logging.getLogger(__name__)
@@ -71,6 +72,18 @@ A = TypeVar("A", bound=Hashable)
 RECOMMENDATION_INTERVAL = 60  # frames between WaitRecommendation events
 MIN_RECOMMENDATION = 3  # minimum frames-ahead before recommending a wait
 MAX_EVENT_QUEUE_SIZE = 100
+
+# obs (DESIGN.md §12): process-wide rollback counters for the Python
+# session path — observational only, never consulted by the tick
+_OBS_ROLLBACKS = default_registry().counter(
+    "ggrs_session_rollbacks_total",
+    "rollbacks executed by Python-path sessions",
+)
+_OBS_ROLLBACK_DEPTH = default_registry().histogram(
+    "ggrs_session_rollback_depth_frames",
+    "frames resimulated per Python-path rollback",
+    buckets=(1, 2, 4, 8, 16, 32),
+)
 
 
 class PlayerRegistry(Generic[I, A]):
@@ -154,6 +167,13 @@ class P2PSession(ThreadOwned, Generic[I, S, A]):
         self._local_checksum_history: Dict[Frame, int] = {}
         self._last_sent_checksum_frame: Frame = NULL_FRAME
 
+        # obs: per-session counters (HostSessionPool._session_stats reads
+        # these for fallback/evicted slots; observational only)
+        self._stat_ticks = 0
+        self._stat_rollbacks = 0
+        self._stat_rollback_frames = 0
+        self._stat_max_rollback = 0
+
         # the registry is fixed once the session exists (players are added
         # through the builder only), so cache the per-tick iteration targets
         self._local_handles = players.local_player_handles()
@@ -210,6 +230,7 @@ class P2PSession(ThreadOwned, Generic[I, S, A]):
             raise NotSynchronized()
 
         self.validate_local_inputs()
+        self._stat_ticks += 1
 
         # DESYNC DETECTION — must run before any frame can be newly marked
         # confirmed this tick: the comparison looks at the current confirmed
@@ -539,6 +560,13 @@ class P2PSession(ThreadOwned, Generic[I, S, A]):
 
         assert frame_to_load <= first_incorrect
         count = current_frame - frame_to_load
+
+        self._stat_rollbacks += 1
+        self._stat_rollback_frames += count
+        if count > self._stat_max_rollback:
+            self._stat_max_rollback = count
+        _OBS_ROLLBACKS.inc()
+        _OBS_ROLLBACK_DEPTH.observe(count)
 
         requests.append(self._sync_layer.load_frame(frame_to_load))
         assert self._sync_layer.current_frame == frame_to_load

@@ -75,6 +75,17 @@ struct Endpoint {
   int64_t decoded_first = kNullFrame;
 
   std::vector<uint8_t> scratch;       // encode scratch
+
+  // ---- observability accumulators (ggrs_ep_stats) ----
+  // monotonic counters the stat harvest reads; the datapath never
+  // consults them, so they cannot perturb wire behavior
+  uint64_t stat_emits = 0;        // input datagrams built (emit_input)
+  uint64_t stat_emit_bytes = 0;   // their total wire bytes
+  uint64_t stat_acks = 0;         // acks applied (ggrs_ep_ack calls)
+  uint64_t stat_datagrams = 0;    // input payloads offered for decode
+  uint64_t stat_frames = 0;       // NEW frames staged by decodes
+  uint64_t stat_drops = 0;        // kEpDrop outcomes (gap/base/undecodable)
+  uint64_t stat_fallbacks = 0;    // kEpFallback outcomes (resource caps)
 };
 
 int64_t ring_slot(const Endpoint& ep, int64_t frame) {
@@ -141,6 +152,7 @@ int64_t ggrs_ep_last_recv_frame(void* ptr) {
 // payload as the delta base (protocol.py _pop_pending_output).
 void ggrs_ep_ack(void* ptr, int64_t ack_frame) {
   Endpoint* ep = static_cast<Endpoint*>(ptr);
+  ep->stat_acks += 1;
   while (!ep->pending.empty() && ep->pending.front().frame <= ack_frame) {
     ep->last_acked_frame = ep->pending.front().frame;
     ep->last_acked = std::move(ep->pending.front().payload);
@@ -235,6 +247,8 @@ int ggrs_ep_emit_input(void* ptr, uint16_t magic,
     if (w.buf.size() > cap) return kErrBufferTooSmall;
     std::memcpy(out, w.buf.data(), w.buf.size());
     *out_len = w.buf.size();
+    ep->stat_emits += 1;
+    ep->stat_emit_bytes += static_cast<uint64_t>(w.buf.size());
   }
   return kOk;
 }
@@ -249,12 +263,12 @@ int ggrs_ep_emit_input(void* ptr, uint16_t magic,
 // dropped (sequence gap / missing base / undecodable payload), kEpFallback
 // when legal-but-huge (caller uses the Python codec via ggrs_ep_fetch_base +
 // ggrs_ep_store_one).
-static int ep_on_input_impl(Endpoint* ep, int64_t start_frame,
-                            const uint8_t* comp, size_t comp_len,
-                            uint8_t* out, size_t out_cap, size_t* out_sizes,
-                            size_t max_frames, size_t* out_count,
-                            int64_t* first_new_frame,
-                            int64_t* new_last_recv) {
+static int ep_on_input_inner(Endpoint* ep, int64_t start_frame,
+                             const uint8_t* comp, size_t comp_len,
+                             uint8_t* out, size_t out_cap, size_t* out_sizes,
+                             size_t max_frames, size_t* out_count,
+                             int64_t* first_new_frame,
+                             int64_t* new_last_recv) {
   *out_count = 0;
   *first_new_frame = kNullFrame;
   *new_last_recv = ep->last_recv_frame;
@@ -384,6 +398,28 @@ static int ep_on_input_impl(Endpoint* ep, int64_t start_frame,
                        : ep->decoded_first +
                              static_cast<int64_t>(ep->decoded_sizes.size()) - 1;
   return kOk;
+}
+
+// stats wrapper around the decode: counts outcomes without touching the
+// decode's many early-return paths
+static int ep_on_input_impl(Endpoint* ep, int64_t start_frame,
+                            const uint8_t* comp, size_t comp_len,
+                            uint8_t* out, size_t out_cap, size_t* out_sizes,
+                            size_t max_frames, size_t* out_count,
+                            int64_t* first_new_frame,
+                            int64_t* new_last_recv) {
+  int rc = ep_on_input_inner(ep, start_frame, comp, comp_len, out, out_cap,
+                             out_sizes, max_frames, out_count,
+                             first_new_frame, new_last_recv);
+  ep->stat_datagrams += 1;
+  if (rc == kEpDrop) {
+    ep->stat_drops += 1;
+  } else if (rc == kEpFallback) {
+    ep->stat_fallbacks += 1;
+  } else if (rc == kOk) {
+    ep->stat_frames += static_cast<uint64_t>(*out_count);
+  }
+  return rc;
 }
 
 int ggrs_ep_on_input(void* ptr, int64_t start_frame, const uint8_t* comp,
@@ -598,6 +634,27 @@ void ggrs_ep_seed_send(void* ptr, int64_t last_acked_frame,
   Endpoint* ep = static_cast<Endpoint*>(ptr);
   ep->last_acked_frame = last_acked_frame;
   ep->last_acked.assign(base, base + len);
+}
+
+// ---- observability (the obs stat harvest) --------------------------------
+
+int64_t ggrs_ep_last_acked_frame(void* ptr) {
+  return static_cast<Endpoint*>(ptr)->last_acked_frame;
+}
+
+// Read the core's monotonic observability counters in one call.
+// out7 layout: emits, emit_bytes, acks, datagrams, new_frames, drops,
+// fallbacks (all u64; mirrored in ggrs_tpu/net/_native.py EP_STAT_FIELDS
+// and read per endpoint by ggrs_bank_stats).
+void ggrs_ep_stats(void* ptr, uint64_t* out7) {
+  Endpoint* ep = static_cast<Endpoint*>(ptr);
+  out7[0] = ep->stat_emits;
+  out7[1] = ep->stat_emit_bytes;
+  out7[2] = ep->stat_acks;
+  out7[3] = ep->stat_datagrams;
+  out7[4] = ep->stat_frames;
+  out7[5] = ep->stat_drops;
+  out7[6] = ep->stat_fallbacks;
 }
 
 }  // extern "C"

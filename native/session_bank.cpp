@@ -84,6 +84,9 @@ int ggrs_sync_queue_len(void);
 
 int ggrs_ep_dump_send(void*, uint8_t*, size_t, size_t*);
 int ggrs_ep_dump_recv(void*, uint8_t*, size_t, size_t*);
+
+int64_t ggrs_ep_last_acked_frame(void*);
+void ggrs_ep_stats(void*, uint64_t*);
 }
 
 namespace {
@@ -161,6 +164,7 @@ struct BankEndpoint {
   std::vector<uint8_t> peer_disc;
   std::vector<int64_t> peer_last;
   int64_t packets_sent = 0, bytes_sent = 0;
+  int64_t stats_start = 0;  // protocol.py _stats_start_time (kbps window)
   // events persist across ticks (a post-drain event surfaces next tick,
   // exactly like protocol.py's deque)
   std::deque<EpEvent> events;
@@ -195,6 +199,13 @@ struct BankSession {
   int64_t current_frame = 0;
   int64_t last_confirmed = kNullFrame;
   int64_t disconnect_frame = kNullFrame;
+  // ---- observability accumulators (ggrs_bank_stats) ----
+  // monotonic; read-only for the harvest, never consulted by the tick
+  uint64_t stat_ticks = 0;            // ticks this slot was actually stepped
+  uint64_t stat_rollbacks = 0;        // rollback decisions executed
+  uint64_t stat_rollback_frames = 0;  // total frames resimulated
+  uint64_t stat_max_rollback = 0;     // deepest single rollback
+  uint64_t stat_faults = 0;           // per-slot faults reported (err != 0)
   // scratch
   std::vector<uint8_t> sync_buf;     // players * input_size
   std::vector<int32_t> status_buf;   // players
@@ -661,6 +672,11 @@ int advance_session(Bank* bank, BankSession* s, int64_t now,
       // _adjust_gamestate, non-sparse: load first_incorrect, resim forward
       int64_t frame_to_load = first_incorrect;
       int64_t count = s->current_frame - frame_to_load;
+      s->stat_rollbacks += 1;
+      s->stat_rollback_frames += static_cast<uint64_t>(count);
+      if (static_cast<uint64_t>(count) > s->stat_max_rollback) {
+        s->stat_max_rollback = static_cast<uint64_t>(count);
+      }
       put_u8(ops, 1);
       put_i64(ops, frame_to_load);
       ++*n_ops;
@@ -871,6 +887,7 @@ int64_t ggrs_bank_add_endpoint(void* ptr, int64_t session, uint16_t magic,
   e.magic = magic;
   e.handles.assign(handles, handles + n_handles);
   e.last_send = e.last_recv = e.last_input_recv = e.last_quality = now_ms;
+  e.stats_start = now_ms;
   e.peer_disc.assign(s->num_players, 0);
   e.peer_last.assign(s->num_players, kNullFrame);
   return static_cast<int64_t>(s->endpoints.size()) - 1;
@@ -1052,7 +1069,9 @@ int ggrs_bank_tick(void* ptr, int64_t now, const uint8_t* cmd, size_t cmd_len,
         frames_ahead = max_frame_advantage(s);
       }
     }
+    s->stat_ticks += 1;
     if (err != kBankOk) {
+      s->stat_faults += 1;
       // faulted slot: suppress everything this tick produced — partial ops
       // would desync the game, partial sends would confuse the peer.  The
       // status mirrors stay live (the harvest and eviction read them).
@@ -1217,6 +1236,60 @@ int ggrs_bank_harvest(void* ptr, int64_t session, uint8_t* out, size_t cap,
         break;
       }
       put_raw(&h, scratch.data(), need);
+    }
+  }
+  *out_len = h.size();
+  if (h.size() > cap) return kErrBufferTooSmall;
+  std::memcpy(out, h.data(), h.size());
+  return kBankOk;
+}
+
+// THE stat harvest (DESIGN.md §12): dump every slot's protocol/sync
+// counters in ONE crossing per scrape — the observability sibling of
+// ggrs_bank_tick's one-crossing-per-tick invariant.  Read-only: safe to
+// call at any time between ticks, never perturbs the bank (quarantined
+// slots report their frozen state).  Little-endian layout, per session
+// in index order:
+//   i64 current_frame, i64 last_confirmed
+//   u64 ticks, u64 rollbacks, u64 rollback_frames, u64 max_rollback_depth
+//   u64 faults
+//   u8 n_endpoints; per endpoint:
+//     u8 state
+//     i64 rtt_ms, i64 send_queue_len, i64 last_acked_frame,
+//     i64 last_recv_frame
+//     i64 local_frame_advantage, i64 remote_frame_advantage,
+//     i64 frame_advantage_avg (the time-sync window average)
+//     i64 packets_sent, i64 bytes_sent, i64 stats_start_ms
+//     7 * u64 endpoint-core counters (ggrs_ep_stats order: emits,
+//       emit_bytes, acks, datagrams, new_frames, drops, fallbacks)
+// Returns kBankOk or kErrBufferTooSmall (*out_len = needed; retry).
+int ggrs_bank_stats(void* ptr, uint8_t* out, size_t cap, size_t* out_len) {
+  Bank* bank = static_cast<Bank*>(ptr);
+  std::vector<uint8_t> h;
+  uint64_t core[7];
+  for (BankSession* s : bank->sessions) {
+    put_i64(&h, s->current_frame);
+    put_i64(&h, s->last_confirmed);
+    put_u64(&h, s->stat_ticks);
+    put_u64(&h, s->stat_rollbacks);
+    put_u64(&h, s->stat_rollback_frames);
+    put_u64(&h, s->stat_max_rollback);
+    put_u64(&h, s->stat_faults);
+    put_u8(&h, static_cast<uint8_t>(s->endpoints.size()));
+    for (BankEndpoint& ep : s->endpoints) {
+      put_u8(&h, ep.state);
+      put_i64(&h, ep.rtt);
+      put_i64(&h, ggrs_ep_pending_len(ep.ep));
+      put_i64(&h, ggrs_ep_last_acked_frame(ep.ep));
+      put_i64(&h, ggrs_ep_last_recv_frame(ep.ep));
+      put_i64(&h, ep.local_adv);
+      put_i64(&h, ep.remote_adv);
+      put_i64(&h, ep.ts_average());
+      put_i64(&h, ep.packets_sent);
+      put_i64(&h, ep.bytes_sent);
+      put_i64(&h, ep.stats_start);
+      ggrs_ep_stats(ep.ep, core);
+      for (int i = 0; i < 7; ++i) put_u64(&h, core[i]);
     }
   }
   *out_len = h.size();

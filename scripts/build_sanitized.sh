@@ -45,5 +45,6 @@ ASAN_OPTIONS="detect_leaks=0:abort_on_error=1" \
 GGRS_NATIVE_SANITIZE=1 \
 JAX_PLATFORMS=cpu \
 python -m pytest tests/test_session_bank.py tests/test_bank_faults.py \
+    tests/test_obs.py \
     -q -p no:cacheprovider -m "not slow" \
     -k "not batched_executor and not size_mismatch" "$@"

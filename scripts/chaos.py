@@ -7,6 +7,9 @@ code path.  For each selected fault class it runs a fault-free CONTROL leg
 and a CHAOS leg, then verifies the blast radius: every non-targeted slot's
 wire bytes, request lists, and events must be bit-identical between the two
 legs, and the crossing count must stay one native crossing per pool tick.
+Each scenario ends with a metrics + flight-recorder summary (faults by
+code, evictions, survivor counters, the target slot's last 32 recorded
+events) instead of discarding that state — DESIGN.md §12.
 
 Fault classes (all driven through the pool's real tick path):
   native-error  simulated native slot fault (ctrl-op channel)
@@ -38,6 +41,53 @@ from ggrs_tpu.chaos import (  # noqa: E402
     drive_chaos,
 )
 from ggrs_tpu.net import _native  # noqa: E402
+
+
+def _metrics_summary(chaos) -> str:
+    """Per-scenario metrics digest (DESIGN.md §12): faults by code,
+    supervision flow, crossing budget, and survivor counters — the state
+    a plain pass/fail verdict used to discard."""
+    reg = chaos["registry"]
+    lines = []
+    fam = {f.name: f for f in reg.families()}
+    faults = fam.get("ggrs_pool_slot_faults_total")
+    if faults is not None and faults.children:
+        by_code = ", ".join(
+            f"code {labels['code']}: {int(child.value)}"
+            for labels, child in faults.samples()
+        )
+        lines.append(f"  metrics: faults by code: {by_code or 'none'}")
+    else:
+        lines.append("  metrics: faults by code: none")
+    lines.append(
+        "  metrics: evictions={} eviction_failures={} ticks={} "
+        "crossings(tick/harvest/stats)={}/{}/{}".format(
+            int(reg.value("ggrs_pool_evictions_total") or 0),
+            int(reg.value("ggrs_pool_eviction_failures_total") or 0),
+            int(reg.value("ggrs_pool_ticks_total") or 0),
+            int(reg.value("ggrs_pool_crossings_total", kind="tick") or 0),
+            int(reg.value("ggrs_pool_crossings_total", kind="harvest") or 0),
+            int(reg.value("ggrs_pool_crossings_total", kind="stats") or 0),
+        )
+    )
+    lines.append(
+        "  metrics: survivor counters: requests save/load/advance = "
+        "{}/{}/{}, rollbacks={}".format(
+            int(reg.value("ggrs_pool_requests_total", kind="save") or 0),
+            int(reg.value("ggrs_pool_requests_total", kind="load") or 0),
+            int(reg.value("ggrs_pool_requests_total", kind="advance") or 0),
+            int(reg.value("ggrs_pool_rollbacks_total") or 0),
+        )
+    )
+    states = fam.get("ggrs_pool_slot_state")
+    if states is not None:
+        occupancy = ", ".join(
+            f"{labels['state']}={int(child.value)}"
+            for labels, child in states.samples()
+            if child.value
+        )
+        lines.append(f"  metrics: slot states: {occupancy}")
+    return "\n".join(lines)
 
 
 def _fuzz_bytes(seed: int, i: int, k: int) -> bytes:
@@ -105,7 +155,12 @@ def verify_leg(name: str, matches: int, ticks: int, seed: int) -> bool:
           f"{chaos['ext'].current_frame}")
     for f in pool.fault_log(target):
         print(f"    fault@tick {f.tick}: code={f.code} {f.detail}")
-    print(f"  crossings={pool.crossings} harvests={pool.harvests}")
+    print(f"  crossings={pool.crossings} harvests={pool.harvests} "
+          f"stat_crossings={pool.stat_crossings}")
+    print(_metrics_summary(chaos))
+    dump = pool.flight_dump(target, last=32)
+    print(f"  flight recorder (target slot {target}, last 32 events):")
+    print("\n".join(f"  {line}" for line in dump.splitlines()))
     if violations:
         print("  BLAST RADIUS VIOLATED:")
         for v in violations:

@@ -282,6 +282,42 @@ class TestOneCrossingPerTick:
             net.tick()
         assert pool.crossings == TICKS
 
+    def test_scrapes_add_zero_tick_crossings(self):
+        """The obs budget (DESIGN.md §12): a metrics scrape per pool tick
+        costs exactly one SEPARATE ``ggrs_bank_stats`` crossing for the
+        whole bank — the tick crossing count is untouched, repeat scrapes
+        and ``network_stats`` reads within a tick hit the cache."""
+        from ggrs_tpu.core.errors import StatsUnavailable
+        from ggrs_tpu.obs import Registry
+
+        clock = [0]
+        net = InMemoryNetwork(latency_ticks=1)
+        pool = HostSessionPool(metrics=Registry())
+        for b, s in two_peer_builders(net, clock, n_matches=4):
+            pool.add_session(b, s)
+        assert pool.native_active
+        TICKS = 50
+        for i in range(TICKS):
+            clock[0] += 16
+            for idx in range(len(pool)):
+                pool.add_local_input(idx, idx % 2, (i + idx) % 16)
+            for reqs in pool.advance_all():
+                fulfill_saves(reqs)
+            pool.scrape()            # one stats crossing...
+            pool.scrape()            # ...and the repeat is cached
+            if i % 5 == 0:
+                try:
+                    pool.network_stats(0, 1)  # rides the same cache
+                except StatsUnavailable:
+                    pass  # under a second of elapsed clock (parity raise)
+            net.tick()
+        assert pool.crossings == TICKS, "scraping perturbed the tick path"
+        assert pool.stat_crossings == TICKS
+        assert pool.metrics.value("ggrs_pool_ticks_total") == TICKS
+        assert pool.metrics.value(
+            "ggrs_pool_crossings_total", kind="stats"
+        ) == TICKS
+
 
 class TestFallback:
     def test_fallback_behaves_like_plain_sessions(self, monkeypatch):
