@@ -7,6 +7,15 @@ this process as a spectator:
   python examples/ex_game_p2p.py --local-port 7777 --players local 127.0.0.1:8888 \
       --spectators 127.0.0.1:9999
   python examples/ex_game_spectator.py --local-port 9999 --host 127.0.0.1:7777
+
+This client is host-implementation agnostic: the host above is a single
+``P2PSession``, but a pool-scale host works identically — attach a
+``ggrs_tpu.broadcast.SpectatorHub`` to a ``HostSessionPool`` and the
+native bank fans the same wire-identical confirmed-input stream to this
+process from inside its one-crossing-per-tick loop (DESIGN.md §13;
+README "Spectating & replays").  Matches journaled there replay offline
+through ``ggrs_tpu.sessions.ReplaySession`` with the exact request
+stream this client fulfills live.
 """
 
 from __future__ import annotations

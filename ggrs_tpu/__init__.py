@@ -10,6 +10,7 @@ stays on the host behind the same ordered Save/Load/Advance command-list
 boundary as the reference.
 """
 
+from . import broadcast  # noqa: F401  - spectator fan-out + journals (§13)
 from . import obs  # noqa: F401  - metrics/flight-recorder/exporters (§12)
 from .core import *  # noqa: F401,F403
 from .core import __all__ as _core_all
@@ -24,6 +25,7 @@ from .net import (
 from .sessions import (
     DeviceSyncTestSession,
     P2PSession,
+    ReplaySession,
     SessionBuilder,
     SpectatorSession,
     SyncTestSession,
@@ -39,9 +41,11 @@ __all__ = list(_core_all) + [
     "NetworkStats",
     "NonBlockingSocket",
     "P2PSession",
+    "ReplaySession",
     "SessionBuilder",
     "SpectatorSession",
     "SyncTestSession",
     "UdpNonBlockingSocket",
+    "broadcast",
     "obs",
 ]

@@ -1,0 +1,33 @@
+"""Broadcast & replay: pool-scale spectator fan-out, durable match
+journals, and deterministic replay playback — three pillars over one data
+model, the per-match confirmed-input stream (DESIGN.md §13).
+
+- :class:`SpectatorHub` (``hub.py``): fan-out policy over the session
+  bank; with a hub attached, spectator matches are bank-eligible and the
+  bank relays confirmed inputs to every viewer inside the existing single
+  tick crossing.
+- :class:`MatchJournal` (``journal.py``): the stream on disk —
+  crc32-chained append-only records, periodic state checkpoints, an
+  in-memory tail window that doubles as the crash-recovery seam.
+- ``sessions.replay.ReplaySession``: deterministic playback of a journal
+  as the same GgrsRequest stream a spectator would fulfill, with
+  checkpoint-seek and fused device fast-forward.
+"""
+
+from .hub import SpectatorHub
+from .journal import (
+    JournalError,
+    JournalExhausted,
+    JournalTap,
+    MatchJournal,
+    read_journal,
+)
+
+__all__ = [
+    "JournalError",
+    "JournalExhausted",
+    "JournalTap",
+    "MatchJournal",
+    "SpectatorHub",
+    "read_journal",
+]

@@ -1,0 +1,448 @@
+"""Durable match journals: the per-match confirmed-input stream on disk.
+
+The confirmed-input stream is the canonical, deterministic artifact of a
+rollback match — the same record list a host relays to spectators
+(p2p_session.rs:717-744) fully determines every frame of the simulation.
+``MatchJournal`` appends it to one file per match, framed and
+crc32-chained, fed directly from the session bank's tick crossing
+(``HostSessionPool.set_confirmed_stream`` — zero extra ctypes crossings at
+steady state) or from a Python session through :class:`JournalTap`.
+
+One artifact, three consumers:
+
+- **Replay**: ``sessions.replay.ReplaySession`` re-emits the GgrsRequest
+  stream bit-identically, with checkpoint-seek and a fused device
+  fast-forward (``ops.replay.build_scrub_program``).
+- **Crash recovery**: :meth:`MatchJournal.recovery_harvest` synthesizes a
+  harvest-shaped resume dict from the in-memory tail window, so an evicted
+  bank slot whose native harvest is gone can still resume mid-match.
+- **Late joiners**: a new viewer replays the journal to the live tip
+  instead of needing pre-watermark inputs the host already discarded.
+
+File layout (all little-endian):
+
+  header   ``GGJL1\\n`` + u32 meta_len + meta JSON + u32 crc32(meta)
+  records  u8 kind, u32 payload_len, i64 frame, u32 crc, payload
+           crc = crc32(kind + payload_len + frame + payload, prev_crc) —
+           chained from the header crc and covering the record header, so
+           truncation or a flipped byte ANYWHERE invalidates every later
+           record and a reader recovers exactly the intact prefix.
+
+Record kinds: FRAME (payload = num_players blank flags + num_players *
+input_size raw input bytes), CHECKPOINT (payload = a self-contained npz
+blob from ``utils.checkpoint.dumps_pytree``; ``frame`` = the next frame to
+simulate from that state), GAP (a known hole — e.g. frames suppressed by a
+mid-fan-out slot fault; replays stop here), CLOSE (clean end of match).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import time
+import zlib
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..core.types import NULL_FRAME
+from ..net.wire import encode_uvarint
+from ..obs.registry import Registry, default_registry
+
+MAGIC = b"GGJL1\n"
+
+REC_FRAME = 1
+REC_CHECKPOINT = 2
+REC_GAP = 3
+REC_CLOSE = 4
+
+_HEADER_FMT = "<BIqI"  # kind, payload_len, frame, crc
+_HEADER_SIZE = struct.calcsize(_HEADER_FMT)
+
+# fsync latency lives in the sub-millisecond to tens-of-ms range
+_FSYNC_BUCKETS = (0.0005, 0.001, 0.002, 0.005, 0.01, 0.025, 0.05, 0.1, 0.5)
+
+
+class JournalError(Exception):
+    """Malformed or corrupt journal data."""
+
+
+class JournalExhausted(Exception):
+    """Replay reached the end of the journal (or a recorded gap)."""
+
+
+class MatchJournal:
+    """Append-only journal for one match.
+
+    ``append_frames(start_frame, records)`` is the sink contract the
+    session bank's confirmed-stream tap calls (records are ``(blank_flags,
+    joined_inputs)`` byte pairs, one per consecutive frame).  The journal
+    additionally keeps an in-memory tail window (``tail_window`` newest
+    frames) for crash recovery — :meth:`recovery_harvest` rebuilds an
+    evicted slot's resume state from it without touching disk.
+
+    ``fsync_every``: fsync after that many appended frames (0 = leave
+    durability to ``close()``/the OS).  Fsync latency lands in the
+    ``ggrs_journal_fsync_seconds`` histogram.
+    """
+
+    def __init__(
+        self,
+        path,
+        num_players: int,
+        input_size: int,
+        meta: Optional[Dict[str, Any]] = None,
+        fsync_every: int = 0,
+        tail_window: int = 128,
+        metrics: Optional[Registry] = None,
+    ) -> None:
+        self.path = os.fspath(path)
+        self.num_players = num_players
+        self.input_size = input_size
+        self.next_frame = 0  # next frame the journal expects to append
+        self._fsync_every = fsync_every
+        self._since_fsync = 0
+        self._closed = False
+        # crash-recovery tail: (frame, flags, blob), contiguous newest tail
+        self.tail: deque = deque(maxlen=tail_window)
+        # per-player connect tracking (recovery's local_disc/local_last)
+        self._disc = [False] * num_players
+        self._last = [NULL_FRAME] * num_players
+        m = metrics if metrics is not None else default_registry()
+        self._m_bytes = m.counter(
+            "ggrs_journal_bytes_total", "journal bytes appended")
+        self._m_frames = m.counter(
+            "ggrs_journal_frames_total", "confirmed frames journaled")
+        self._m_checkpoints = m.counter(
+            "ggrs_journal_checkpoints_total", "state checkpoints journaled")
+        self._m_gaps = m.counter(
+            "ggrs_journal_gaps_total", "gap records written (lost frames)")
+        self._m_fsync = m.histogram(
+            "ggrs_journal_fsync_seconds", "journal fsync latency",
+            buckets=_FSYNC_BUCKETS)
+
+        header_meta = dict(meta or {})
+        header_meta.setdefault("num_players", num_players)
+        header_meta.setdefault("input_size", input_size)
+        meta_blob = json.dumps(header_meta).encode()
+        self.meta = header_meta
+        # 'xb', never 'wb': the append-only contract holds across process
+        # restarts — silently truncating a prior match's journal would
+        # destroy exactly the crash-recovery/replay artifact this class
+        # exists to preserve (raises FileExistsError; pick a fresh path)
+        self._f = open(self.path, "xb")
+        self._f.write(MAGIC)
+        self._f.write(struct.pack("<I", len(meta_blob)))
+        self._f.write(meta_blob)
+        self._crc = zlib.crc32(meta_blob) & 0xFFFFFFFF
+        self._f.write(struct.pack("<I", self._crc))
+        self._m_bytes.inc(len(MAGIC) + 8 + len(meta_blob))
+
+    # ------------------------------------------------------------------
+    # writing
+    # ------------------------------------------------------------------
+
+    def _append(self, kind: int, frame: int, payload: bytes) -> None:
+        head = struct.pack("<BIq", kind, len(payload), frame)
+        self._crc = zlib.crc32(
+            payload, zlib.crc32(head, self._crc)
+        ) & 0xFFFFFFFF
+        self._f.write(head)
+        self._f.write(struct.pack("<I", self._crc))
+        self._f.write(payload)
+        self._m_bytes.inc(_HEADER_SIZE + len(payload))
+
+    def append_frames(
+        self, start_frame: int, records: Sequence[Tuple[bytes, bytes]]
+    ) -> None:
+        """The confirmed-stream sink (``HostSessionPool`` tick crossing /
+        ``JournalTap``): consecutive frames from ``start_frame``, each a
+        ``(blank_flags, joined_inputs)`` pair.  Frames the journal already
+        holds are skipped; a forward jump (frames lost to a mid-tick
+        fault) is recorded as an explicit GAP, never papered over."""
+        if self._closed:
+            return
+        for i, (flags, blob) in enumerate(records):
+            frame = start_frame + i
+            if frame < self.next_frame:
+                continue  # duplicate delivery: already journaled
+            if frame > self.next_frame:
+                self._append(REC_GAP, frame, b"")
+                self._m_gaps.inc()
+                self.tail.clear()  # the tail window must stay contiguous
+            self._append(REC_FRAME, frame, flags + blob)
+            self._m_frames.inc()
+            self.tail.append((frame, flags, blob))
+            for p in range(self.num_players):
+                if flags[p]:
+                    self._disc[p] = True
+                else:
+                    self._disc[p] = False
+                    self._last[p] = frame
+            self.next_frame = frame + 1
+            self._since_fsync += 1
+        if self._fsync_every and self._since_fsync >= self._fsync_every:
+            self.flush(fsync=True)
+
+    def append_checkpoint(
+        self, frame: int, state: Any, meta: Optional[Dict[str, Any]] = None
+    ) -> None:
+        """Embed a state checkpoint: ``state`` (a pytree) is the simulation
+        state from which ``frame`` is the NEXT frame to advance — i.e. the
+        state after applying frames ``0..frame-1``.  ``ReplaySession.seek``
+        lands on the newest checkpoint at or below its target."""
+        if self._closed:
+            return
+        from ..utils.checkpoint import dumps_pytree
+
+        blob = dumps_pytree(state, dict(meta or {}, frame=frame))
+        self._append(REC_CHECKPOINT, frame, blob)
+        self._m_checkpoints.inc()
+
+    def flush(self, fsync: bool = False) -> None:
+        self._f.flush()
+        if fsync:
+            t0 = time.perf_counter()
+            os.fsync(self._f.fileno())
+            self._m_fsync.observe(time.perf_counter() - t0)
+            self._since_fsync = 0
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._append(REC_CLOSE, self.next_frame, b"")
+        self.flush(fsync=True)
+        self._f.close()
+        self._closed = True
+
+    def __enter__(self) -> "MatchJournal":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # ------------------------------------------------------------------
+    # crash recovery (the journal adoption seam)
+    # ------------------------------------------------------------------
+
+    def recovery_harvest(self, pool, index: int) -> Dict[str, Any]:
+        """Synthesize a ``ggrs_bank_harvest``-shaped resume dict from the
+        in-memory tail window — the eviction path's stand-in when the
+        native harvest itself fails (crash recovery; registered through
+        ``HostSessionPool.set_confirmed_stream(recovery=...)``).
+
+        The window holds every player's confirmed inputs for the newest
+        ``tail_window`` frames, which is exactly what the harvest recovers:
+        sync-queue seeds, per-endpoint send windows (resent by the retry
+        timer, closing the peers' sequence gap — peers skip the overlap),
+        receive rings, and the spectator fan-out windows.  Liveness state
+        comes from the pool's Python-side mirrors."""
+        if not self.tail:
+            raise JournalError("journal tail is empty: nothing to resume")
+        m = pool._mirrors[index]
+        isize = self.input_size
+        window = list(self.tail)
+        frames = [f for f, _, _ in window]
+        w0, tip = frames[0], frames[-1]
+        blob_at = {f: blob for f, _, blob in window}
+
+        def join(handles: Sequence[int], frame: int) -> bytes:
+            blob = blob_at[frame]
+            return b"".join(
+                encode_uvarint(isize) + blob[h * isize : (h + 1) * isize]
+                for h in handles
+            )
+
+        def send_window(handles: Sequence[int]):
+            """(last_acked, base, pending) so the pending head follows the
+            base exactly (the emit-side invariant)."""
+            if w0 == 0:
+                zeros = bytes(isize)
+                base = b"".join(encode_uvarint(isize) + zeros for _ in handles)
+                return NULL_FRAME, base, [
+                    (f, join(handles, f)) for f in frames
+                ]
+            return w0, join(handles, w0), [
+                (f, join(handles, f)) for f in frames[1:]
+            ]
+
+        local_handles = m.local_handles
+        endpoints = []
+        for ep in m.endpoints:
+            acked, base, pending = send_window(local_handles)
+            endpoints.append(dict(
+                state=0 if ep.running else 1,
+                last_acked_frame=acked, send_base=base, pending=pending,
+                last_recv=tip,
+                recv_entries=[(f, join(ep.handles, f)) for f in frames],
+            ))
+        all_players = list(range(self.num_players))
+        spectators = []
+        for sp in m.spectators:
+            acked, base, pending = send_window(all_players)
+            spectators.append(dict(
+                state=0 if sp.running else 1,
+                last_acked_frame=acked, send_base=base, pending=pending,
+            ))
+        player_inputs = [
+            (w0, [blob_at[f][p * isize : (p + 1) * isize] for f in frames])
+            for p in all_players
+        ]
+        resume = min(tip, m.current_frame)
+        return dict(
+            current=m.current_frame,
+            last_confirmed=resume,
+            disconnect_frame=NULL_FRAME,
+            local_disc=list(self._disc),
+            local_last=list(self._last),
+            player_inputs=player_inputs,
+            endpoints=endpoints,
+            next_spectator_frame=tip + 1,
+            spectators=spectators,
+        )
+
+
+class JournalTap:
+    """A pseudo spectator endpoint that journals instead of sending — the
+    Python relay path's journal feed.  Grafted onto a ``P2PSession`` via
+    ``adopt_spectator_endpoint`` (evicted bank slots, fallback pools), it
+    receives the exact ``send_input`` calls a real spectator endpoint
+    would and appends them; every other endpoint-surface method is a
+    no-op, so the session's poll/flush loops pass through it unperturbed.
+    """
+
+    ADDR = ("__ggrs_journal_tap__", 0)  # never a real peer address
+
+    def __init__(self, journal: MatchJournal, config=None) -> None:
+        self._journal = journal
+        self._encode = config.input_encode if config is not None else None
+        self.handles: List[int] = []
+        self.peer_addr = self.ADDR
+
+    # --- the one live method ---
+    def send_input(self, inputs: Dict[int, Any], connect_status) -> None:
+        j = self._journal
+        flags = bytearray(j.num_players)
+        parts: List[bytes] = []
+        frame = NULL_FRAME
+        for handle in sorted(inputs):
+            pi = inputs[handle]
+            if pi.frame == NULL_FRAME:
+                flags[handle] = 1
+                parts.append(bytes(j.input_size))
+            else:
+                frame = pi.frame
+                blob = (
+                    self._encode(pi.input)
+                    if self._encode is not None else bytes(pi.input)
+                )
+                if len(blob) != j.input_size:
+                    # a config-less tap handed non-bytes inputs would
+                    # otherwise corrupt the journal silently
+                    raise JournalError(
+                        f"tap encoded a {len(blob)}-byte input; journal "
+                        f"holds {j.input_size}-byte inputs (pass the "
+                        "session Config to JournalTap)"
+                    )
+                parts.append(blob)
+        if frame == NULL_FRAME:
+            return  # every player disconnected below this frame
+        j.append_frames(frame, [(bytes(flags), b"".join(parts))])
+
+    # --- inert endpoint surface ---
+    def poll(self, connect_status) -> List:
+        return []
+
+    def send_all_messages(self, socket) -> None:
+        pass
+
+    def is_running(self) -> bool:
+        return True
+
+    def is_synchronizing(self) -> bool:
+        return False
+
+    def is_handling_message(self, addr) -> bool:
+        return False
+
+    def handle_datagram(self, data) -> None:
+        pass
+
+    def handle_message(self, msg) -> None:
+        pass
+
+    def disconnect(self) -> None:
+        pass
+
+
+def read_journal(path) -> Dict[str, Any]:
+    """Parse a journal file into ``{meta, frames, checkpoints, gaps,
+    closed, truncated}``.  The crc chain is verified record by record; a
+    mismatch (torn write, bit rot) truncates the parse at the last intact
+    record instead of raising — the recovered prefix is still a valid
+    replay (``truncated`` reports it)."""
+    with open(os.fspath(path), "rb") as f:
+        data = f.read()
+    if not data.startswith(MAGIC):
+        raise JournalError("not a ggrs journal (bad magic)")
+    pos = len(MAGIC)
+    if pos + 4 > len(data):
+        raise JournalError("truncated journal header")
+    (meta_len,) = struct.unpack_from("<I", data, pos)
+    pos += 4
+    if pos + meta_len + 4 > len(data):
+        raise JournalError("truncated journal header")
+    meta_blob = data[pos : pos + meta_len]
+    pos += meta_len
+    (header_crc,) = struct.unpack_from("<I", data, pos)
+    pos += 4
+    crc = zlib.crc32(meta_blob) & 0xFFFFFFFF
+    if crc != header_crc:
+        raise JournalError("journal header crc mismatch")
+    meta = json.loads(meta_blob.decode())
+    players = int(meta["num_players"])
+    isize = int(meta["input_size"])
+    frame_payload = players + players * isize
+
+    frames: List[Tuple[int, bytes, bytes]] = []
+    checkpoints: List[Tuple[int, bytes]] = []
+    gaps: List[int] = []
+    closed = False
+    truncated = False
+    while pos < len(data):
+        if pos + _HEADER_SIZE > len(data):
+            truncated = True
+            break
+        kind, plen, frame, rec_crc = struct.unpack_from(
+            _HEADER_FMT, data, pos
+        )
+        if pos + _HEADER_SIZE + plen > len(data):
+            truncated = True
+            break
+        payload = data[pos + _HEADER_SIZE : pos + _HEADER_SIZE + plen]
+        next_crc = zlib.crc32(
+            payload, zlib.crc32(data[pos : pos + 13], crc)
+        ) & 0xFFFFFFFF
+        if next_crc != rec_crc:
+            truncated = True
+            break
+        crc = next_crc
+        pos += _HEADER_SIZE + plen
+        if kind == REC_FRAME:
+            if plen != frame_payload:
+                raise JournalError(
+                    f"frame record is {plen} bytes, expected {frame_payload}"
+                )
+            frames.append((frame, payload[:players], payload[players:]))
+        elif kind == REC_CHECKPOINT:
+            checkpoints.append((frame, payload))
+        elif kind == REC_GAP:
+            gaps.append(frame)
+        elif kind == REC_CLOSE:
+            closed = True
+        else:
+            raise JournalError(f"unknown journal record kind {kind}")
+    return dict(
+        meta=meta, frames=frames, checkpoints=checkpoints, gaps=gaps,
+        closed=closed, truncated=truncated,
+    )

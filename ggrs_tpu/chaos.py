@@ -190,6 +190,183 @@ def drive_chaos(
     return ctx
 
 
+def drive_broadcast(
+    ticks: int,
+    use_hub: bool = True,
+    seed: int = 0,
+    n_spectators: int = 1,
+    n_side_matches: int = 0,
+    fault_cfg: Optional[Dict[str, Any]] = None,
+    journal_path=None,
+    journal_fsync: int = 0,
+    inject: Optional[Callable[[int, Dict[str, Any]], Any]] = None,
+    sabotage_harvest: bool = False,
+    metrics: Optional[Registry] = None,
+    scrape_every: int = 0,
+) -> Dict[str, Any]:
+    """Drive one broadcast world: a 2-peer match whose host declares
+    ``n_spectators`` spectator players, followed by that many real Python
+    ``SpectatorSession`` viewers, plus ``n_side_matches`` unrelated in-bank
+    matches (the blast-radius survivors), all on seeded fault networks.
+
+    ``use_hub=True`` hosts the match on a ``HostSessionPool`` +
+    ``SpectatorHub`` (native fan-out); ``use_hub=False`` hosts it on a
+    plain ``P2PSession`` — the per-session semantic reference the parity
+    fuzz compares against.  Identical arguments produce a bit-identical
+    run either way (that IS the fuzz contract).
+
+    ``journal_path`` attaches a ``MatchJournal`` (hub mode);
+    ``sabotage_harvest`` breaks the native harvest so an eviction must
+    recover from the journal tail; ``inject(i, ctx)`` runs at the top of
+    tick ``i`` (``ctx`` carries ``pool``/``hub``/``target``).  Returns the
+    per-viewer observed streams, the host's wire bytes, and the side
+    matches' observables for control/chaos comparison.
+    """
+    from .core.errors import (
+        NotSynchronized,
+        PredictionThreshold,
+        SpectatorTooFarBehind,
+    )
+    from .core.types import Spectator
+
+    base = seed * 1000
+    clock = [0]
+    cfg_kwargs = dict(fault_cfg or {"latency_ticks": 1})
+    cfg_kwargs.setdefault("seed", base + 1)
+    net = InMemoryNetwork(**cfg_kwargs)
+    config = Config.for_uint(16)
+
+    viewer_names = [f"V{k}" for k in range(n_spectators)]
+    hb = two_peer_builder(clock, base + 10, 0, "P")
+    for k, vname in enumerate(viewer_names):
+        hb = hb.add_player(Spectator(vname), 2 + k)
+    peer = two_peer_builder(clock, base + 20, 1, "H",
+                            other_handle=0).start_p2p_session(
+        net.socket("P")
+    )
+    viewers = []
+    for k, vname in enumerate(viewer_names):
+        vb = (
+            SessionBuilder(config)
+            .with_clock(lambda: clock[0])
+            .with_rng(random.Random(base + 30 + k))
+        )
+        viewers.append(vb.start_spectator_session("H", net.socket(vname)))
+
+    host_sock = RecordingSocket(net.socket("H"))
+    registry = metrics if metrics is not None else Registry()
+    pool = hub = journal = host = None
+    side_socks: List[RecordingSocket] = []
+    side_nets: List[InMemoryNetwork] = []
+    if use_hub:
+        from .broadcast import MatchJournal, SpectatorHub
+
+        pool = HostSessionPool(metrics=registry)
+        hub = SpectatorHub(pool, rng=random.Random(base + 40))
+        pool.add_session(hb, host_sock)
+        for m in range(n_side_matches):
+            s_cfg = dict(fault_cfg or {"latency_ticks": 1})
+            s_cfg.setdefault("seed", base + 100 + m)
+            s_net = InMemoryNetwork(**s_cfg)
+            side_nets.append(s_net)
+            names = (f"A{m}", f"B{m}")
+            for me in (0, 1):
+                s = RecordingSocket(s_net.socket(names[me]))
+                side_socks.append(s)
+                pool.add_session(
+                    two_peer_builder(clock, base + 50 + 5 * m + me, me,
+                                     names[1 - me]),
+                    s,
+                )
+        if not pool.native_active:
+            raise RuntimeError("native broadcast bank unavailable")
+        if journal_path is not None:
+            journal = MatchJournal(
+                journal_path, 2, config.native_input_size,
+                fsync_every=journal_fsync, metrics=registry,
+            )
+            hub.attach_journal(0, journal)
+        if sabotage_harvest:
+            def broken(index):
+                raise RuntimeError("simulated dead native state")
+
+            pool._harvest = broken
+    else:
+        host = hb.start_p2p_session(host_sock)
+
+    n_slots = 1 + 2 * n_side_matches
+    reqs_log: List[List] = [[] for _ in range(n_slots)]
+    events_log: List[List] = [[] for _ in range(n_slots)]
+    viewer_streams: List[List] = [[] for _ in viewers]
+    viewer_frames: List[List[int]] = [[] for _ in viewers]
+    hub_events: List = []
+
+    def sched(i, idx):
+        return ((i + 2 * idx) // (2 + idx % 3)) % 16
+
+    ctx: Dict[str, Any] = dict(
+        pool=pool, hub=hub, host=host, peer=peer, viewers=viewers,
+        target=0, clock=clock, seed=seed, journal=journal,
+    )
+    for i in range(ticks):
+        clock[0] += 16
+        if inject is not None:
+            inject(i, ctx)
+        peer.add_local_input(1, (i * 5) % 16)
+        fulfill(peer.advance_frame())
+        if use_hub:
+            for idx in range(n_slots):
+                pool.add_local_input(idx, (idx - 1) % 2 if idx else 0,
+                                     sched(i, idx))
+            for idx, reqs in enumerate(pool.advance_all()):
+                fulfill(reqs)
+                reqs_log[idx].append(req_summary(reqs))
+            for idx in range(n_slots):
+                events_log[idx].extend(pool.events(idx))
+            hub_events.extend(hub.events(0))
+            if scrape_every and i % scrape_every == 0:
+                pool.scrape()
+        else:
+            host.add_local_input(0, sched(i, 0))
+            reqs = host.advance_frame()
+            fulfill(reqs)
+            reqs_log[0].append(req_summary(reqs))
+            events_log[0].extend(host.events())
+        for k, viewer in enumerate(viewers):
+            try:
+                for r in viewer.advance_frame():
+                    viewer_streams[k].append(
+                        (viewer.current_frame, tuple(r.inputs))
+                    )
+            except (NotSynchronized, PredictionThreshold,
+                    SpectatorTooFarBehind):
+                pass
+            viewer_frames[k].append(viewer.current_frame)
+        net.tick()
+        for s_net in side_nets:
+            s_net.tick()
+    ctx.update(
+        host_wire=host_sock.sent,
+        side_wire=[s.sent for s in side_socks],
+        reqs=reqs_log,
+        events=events_log,
+        viewer_streams=viewer_streams,
+        viewer_frames=viewer_frames,
+        hub_events=hub_events,
+        registry=registry,
+        states=(
+            [pool.slot_state(i) for i in range(n_slots)] if use_hub
+            else ["native"] * n_slots
+        ),
+        frames=(
+            [pool.current_frame(i) for i in range(n_slots)] if use_hub
+            else [host.current_frame]
+        ),
+        peer_frame=peer.current_frame,
+    )
+    return ctx
+
+
 def blast_radius_violations(
     chaos: Dict[str, Any],
     control: Dict[str, Any],

@@ -434,6 +434,25 @@ def _load() -> Optional[ctypes.CDLL]:
                     ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
                     ctypes.POINTER(ctypes.c_size_t),
                 ]
+            if hasattr(lib, "ggrs_bank_attach_spectator"):
+                # broadcast subsystem (spectator fan-out + journal tap);
+                # absent on a prebuilt pre-broadcast .so — the pool then
+                # treats every hub as absent (spectator matches fall back
+                # to per-session Python relaying) and parses the
+                # pre-broadcast tick output layout
+                lib.ggrs_bank_attach_spectator.restype = ctypes.c_int64
+                lib.ggrs_bank_attach_spectator.argtypes = [
+                    ctypes.c_void_p, ctypes.c_int64, ctypes.c_uint16,
+                    ctypes.c_int64,
+                ]
+                lib.ggrs_bank_detach_spectator.restype = ctypes.c_int
+                lib.ggrs_bank_detach_spectator.argtypes = [
+                    ctypes.c_void_p, ctypes.c_int64, ctypes.c_int64,
+                ]
+                lib.ggrs_bank_set_confirmed_stream.restype = ctypes.c_int
+                lib.ggrs_bank_set_confirmed_stream.argtypes = [
+                    ctypes.c_void_p, ctypes.c_int64, ctypes.c_int,
+                ]
         _lib = lib
         return _lib
 
@@ -466,6 +485,7 @@ BANK_ERR_CONFIRM = -73
 BANK_ERR_NO_PLAYERS = -74
 BANK_ERR_SEQUENCE = -75
 BANK_ERR_INJECTED = -76  # chaos-harness simulated slot fault (ctrl op 2)
+BANK_ERR_SPEC_STREAM = -77  # confirmed-input fan-out / journal tap failed
 
 # endpoint-core observability counter order (ggrs_ep_stats out7; also the
 # per-endpoint tail of each ggrs_bank_stats record)
@@ -483,7 +503,19 @@ BANK_ERR_NAMES = {
     BANK_ERR_NO_PLAYERS: "every player disconnected",
     BANK_ERR_SEQUENCE: "remote input frame out of sequence",
     BANK_ERR_INJECTED: "injected fault (chaos harness)",
+    BANK_ERR_SPEC_STREAM: "confirmed-input fan-out failed",
 }
+
+
+def broadcast_lib() -> Optional[ctypes.CDLL]:
+    """The loaded library when it carries the broadcast entry points
+    (spectator fan-out + journal tap), or None.  A prebuilt pre-broadcast
+    library keeps the bank fast path but routes spectator matches to the
+    per-session Python relay."""
+    lib = bank_lib()
+    if lib is None or not hasattr(lib, "ggrs_bank_attach_spectator"):
+        return None
+    return lib
 
 
 def sync_lib() -> Optional[ctypes.CDLL]:

@@ -81,6 +81,17 @@ SYNC_RETRY_INTERVAL_MS = 200
 DEFAULT_SYNC_TIMEOUT_MS = 60_000
 
 
+def draw_magic(rng: random.Random) -> int:
+    """One endpoint wire-magic draw: a nonzero u16.  The SINGLE definition
+    — the pool's native endpoint/spectator construction and the broadcast
+    hub reproduce ``start_p2p_session``'s exact rng stream with it, which
+    the bit-identical-wire parity pins depend on."""
+    magic = 0
+    while magic == 0:
+        magic = rng.randrange(0, 1 << 16)
+    return magic
+
+
 def monotonic_ms() -> int:
     return int(time.monotonic() * 1000)
 
@@ -240,10 +251,7 @@ class PeerProtocol(Generic[I, A]):
         self._clock = clock
 
         rng = rng if rng is not None else random.Random()
-        magic = 0
-        while magic == 0:
-            magic = rng.randrange(0, 1 << 16)
-        self.magic = magic
+        self.magic = draw_magic(rng)
 
         self._send_queue: Deque[Tuple[Message, int]] = deque()  # (msg, encoded size)
         self._event_queue: Deque[ProtocolEvent] = deque()

@@ -20,6 +20,24 @@ class WireError(Exception):
     """Malformed or truncated wire data."""
 
 
+def encode_uvarint(v: int) -> bytes:
+    """One unsigned varint as bytes — the single definition behind
+    ``Writer.uvarint`` and the standalone payload builders (the pool's
+    spectator adoption, the journal's recovery windows)."""
+    if v < 0:
+        raise ValueError("uvarint requires non-negative value")
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            break
+    return bytes(out)
+
+
 class Writer:
     def __init__(self) -> None:
         self._parts: List[bytes] = []
@@ -54,18 +72,7 @@ class Writer:
         return self.u8(1 if v else 0)
 
     def uvarint(self, v: int) -> "Writer":
-        if v < 0:
-            raise ValueError("uvarint requires non-negative value")
-        out = bytearray()
-        while True:
-            b = v & 0x7F
-            v >>= 7
-            if v:
-                out.append(b | 0x80)
-            else:
-                out.append(b)
-                break
-        self._parts.append(bytes(out))
+        self._parts.append(encode_uvarint(v))
         return self
 
     def svarint(self, v: int) -> "Writer":

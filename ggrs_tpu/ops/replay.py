@@ -94,6 +94,36 @@ def _store_input(ring: DeviceStateRing, inputs: Any, frame: jax.Array, inp: Any)
     )
 
 
+def build_scrub_program(
+    advance: AdvanceFn,
+    donate: Optional[bool] = None,
+    unroll: int = 4,
+):
+    """Compile the confirmed-only playback program: advance N frames in ONE
+    fused dispatch — the fast-forward mode of journal replay
+    (``sessions.replay.ReplaySession``).
+
+    Replaying a journal never rolls back (every input is confirmed, like a
+    spectator's stream), so the 2d+2 request pattern the rollback programs
+    above fuse collapses to a bare advance scan: no ring, no checksum
+    history, no resimulation.  The returned callable is
+    ``scrub(state, stacked_inputs) -> state`` where ``stacked_inputs``
+    stacks the window's per-frame inputs on the leading axis; state and
+    inputs stay in HBM for the whole window, exactly like ``run_steady``.
+    """
+    if donate is None:
+        donate = jax.default_backend() == "tpu"
+
+    def scrub(state: Any, stacked_inputs: Any) -> Any:
+        def body(st: Any, inp: Any) -> Tuple[Any, None]:
+            return advance(st, inp), None
+
+        out, _ = jax.lax.scan(body, state, stacked_inputs, unroll=unroll)
+        return out
+
+    return jax.jit(scrub, donate_argnums=(0,) if donate else ())
+
+
 def build_replay_programs(
     advance: AdvanceFn,
     ring_length: int,

@@ -98,7 +98,12 @@ from ..core.types import (
 )
 from ..net import _native
 from ..net.messages import RawMessage
-from ..net.protocol import MAX_CHECKSUM_HISTORY_SIZE, UDP_HEADER_SIZE
+from ..net.wire import encode_uvarint
+from ..net.protocol import (
+    MAX_CHECKSUM_HISTORY_SIZE,
+    UDP_HEADER_SIZE,
+    draw_magic,
+)
 from ..net.stats import NetworkStats
 from ..obs.recorder import (
     EV_EVICT,
@@ -171,10 +176,18 @@ def _uvarint_len(v: int) -> int:
     return n
 
 
-def _bank_eligible(builder) -> bool:
+def _bank_eligible(builder, hub_active: bool = False) -> bool:
     """Can this builder's session run on the native bank mechanism?  The
     checks mirror the bank's scope; anything outside it uses the Python
-    sessions (identical semantics, per-session cost)."""
+    sessions (identical semantics, per-session cost).
+
+    ``hub_active``: a ``broadcast.SpectatorHub`` owns spectator relaying
+    for this pool AND the loaded library carries the broadcast entry
+    points.  A match with spectators is then bank-eligible — the bank fans
+    the confirmed-input stream out natively inside the tick crossing.
+    Hubless callers (and pre-broadcast libraries) keep the historical
+    behavior: spectator matches fall back to per-session Python sessions,
+    whose own relay path is the semantic reference."""
     cfg = builder._config
     from ..core.sync_layer import _native_sync_semantics_ok
     from ..core.types import Spectator
@@ -187,7 +200,7 @@ def _bank_eligible(builder) -> bool:
         return False
     if builder._local_players < 1 or builder._num_players > 64:
         return False
-    if any(
+    if not hub_active and any(
         isinstance(t, Spectator) for t in builder._player_reg.handles.values()
     ):
         return False
@@ -220,6 +233,24 @@ class _EndpointMirror:
         self.pending_checksums: Dict[Frame, int] = {}
 
 
+class _SpectatorMirror:
+    """Python-side view of one native fan-out (spectator) endpoint: the
+    identity plus the hub-facing state (attach handles, liveness, the ack
+    watermark the catchup-lag gauge reads, and the one-tick datagram
+    deferral that reproduces the Python session's flush order)."""
+
+    __slots__ = ("addr", "magic", "handles", "running", "last_acked",
+                 "deferred")
+
+    def __init__(self, addr, magic: int, handles: List[int]):
+        self.addr = addr
+        self.magic = magic
+        self.handles = handles  # builder spectator handles ([] = hub-joined)
+        self.running = True
+        self.last_acked: Frame = NULL_FRAME
+        self.deferred: List[bytes] = []  # fan-out datagrams, sent next tick
+
+
 class _SessionMirror:
     """Python-side policy state for one bank session."""
 
@@ -229,6 +260,7 @@ class _SessionMirror:
         "saved_states", "current_frame", "last_confirmed", "frames_ahead",
         "local_disc", "local_last", "event_queue", "next_recommended_sleep",
         "staged_inputs", "pending_ctrl",
+        "spectators", "addr_to_spec", "next_spec_frame",
     )
 
     def __init__(self, config, socket, num_players, max_prediction,
@@ -252,6 +284,12 @@ class _SessionMirror:
         self.next_recommended_sleep: Frame = 0
         self.staged_inputs: Dict[int, bytes] = {}
         self.pending_ctrl: List[Tuple[int, int, Frame]] = []
+        # broadcast fan-out (hub-owned): mirrors of the slot's native
+        # spectator endpoints, plus the next-frame cursor the attach policy
+        # reads (native truth, refreshed from every tick's broadcast tail)
+        self.spectators: List[_SpectatorMirror] = []
+        self.addr_to_spec: Dict[Any, int] = {}
+        self.next_spec_frame: Frame = 0
 
     def push_event(self, event) -> None:
         self.event_queue.append(event)
@@ -345,9 +383,27 @@ class HostSessionPool:
         self._m_rollbacks = m.counter(
             "ggrs_pool_rollbacks_total",
             "rollback decisions executed by pooled slots")
+        # ---- broadcast (DESIGN.md §13): fan-out + journal observability ----
+        self._m_fanout_dgrams = m.counter(
+            "ggrs_fanout_datagrams_total",
+            "confirmed-input datagrams fanned out to spectators",
+            labels=("slot",))
+        self._m_fanout_bytes = m.counter(
+            "ggrs_fanout_bytes_total",
+            "wire bytes fanned out to spectators", labels=("slot",))
+        self._m_spectators = m.gauge(
+            "ggrs_spectators_attached",
+            "spectator endpoints attached per slot", labels=("slot",))
+        self._m_spec_lag = m.gauge(
+            "ggrs_spectator_catchup_lag",
+            "frames broadcast but not yet acked by the viewer",
+            labels=("slot", "spectator"))
         self._quarantined_at: Dict[int, int] = {}  # index -> quarantine tick
         self._stats_cache: Optional[Tuple[int, List[Dict[str, Any]]]] = None
         self._setter_cache: Dict[int, Any] = {}  # slot -> prebound gauge sets
+        # slot -> prebound (datagrams.inc, bytes.inc): label resolution off
+        # the per-tick fan-out send loop, like _setter_cache for scrapes
+        self._fanout_counters: Dict[int, Tuple[Any, Any]] = {}
         self._scrape_buf: Optional[ctypes.Array] = None  # persistent (GC)
         self._bank_records: Optional[List[Dict[str, Any]]] = None
         # scrape-refreshed gauges (set by scrape(), one label set per slot /
@@ -395,6 +451,20 @@ class HostSessionPool:
         self._evict_next_try: Dict[int, int] = {}
         self._inject_dgrams: Dict[int, List[Tuple[int, bytes]]] = {}
         self._inject_err: Dict[int, int] = {}
+        # ---- broadcast subsystem seams (ggrs_tpu/broadcast) ----
+        # _spectator_hub: the SpectatorHub that owns relay policy for this
+        # pool (set by SpectatorHub.__init__, must precede finalization);
+        # _has_spec: the loaded library carries the broadcast entry points
+        # AND the hub is attached, so the tick crossing speaks the broadcast
+        # command/output layout; _journal_sinks: per-slot confirmed-stream
+        # consumers (MatchJournal.append_frames signature); _journal_recovery
+        # holds per-slot callables that synthesize a harvest-shaped resume
+        # dict from the journal tail when ggrs_bank_harvest itself fails
+        # (crash recovery — the chaos suite kills a slot's native state).
+        self._spectator_hub: Optional[Any] = None
+        self._has_spec = False
+        self._journal_sinks: Dict[int, Any] = {}
+        self._journal_recovery: Dict[int, Any] = {}
 
     # ------------------------------------------------------------------
     # construction
@@ -442,8 +512,14 @@ class HostSessionPool:
                     return False
             return True
 
+        hub_active = (
+            self._spectator_hub is not None
+            and lib is not None
+            and hasattr(lib, "ggrs_bank_attach_spectator")
+        )
         eligible = lib is not None and same_timebase() and all(
-            _bank_eligible(b) and hasattr(s, "receive_all_datagrams")
+            _bank_eligible(b, hub_active=hub_active)
+            and hasattr(s, "receive_all_datagrams")
             for b, s in self._builders
         )
         if not eligible:
@@ -456,7 +532,10 @@ class HostSessionPool:
         if not self._bank:
             raise MemoryError("ggrs_bank_new failed")
         self._native_active = True
-        from ..core.types import Remote
+        # the broadcast command/output layout is spoken whenever the
+        # library carries the entry points — spectator tables may be empty
+        self._has_spec = hasattr(lib, "ggrs_bank_attach_spectator")
+        from ..core.types import Remote, Spectator
 
         for builder, socket in self._builders:
             cfg = builder._config
@@ -469,7 +548,7 @@ class HostSessionPool:
                     )
             local_handles = sorted(
                 h for h, t in builder._player_reg.handles.items()
-                if not isinstance(t, Remote)
+                if not isinstance(t, (Remote, Spectator))
             )
             arr = (ctypes.c_int32 * max(1, len(local_handles)))(*local_handles)
             idx = lib.ggrs_bank_add_session(
@@ -497,9 +576,7 @@ class HostSessionPool:
                 rng = builder._rng if builder._rng is not None else (
                     random.Random()
                 )
-                magic = 0
-                while magic == 0:
-                    magic = rng.randrange(0, 1 << 16)
+                magic = draw_magic(rng)
                 handles = sorted(handles)
                 harr = (ctypes.c_int32 * len(handles))(*handles)
                 ep_idx = lib.ggrs_bank_add_endpoint(
@@ -514,6 +591,37 @@ class HostSessionPool:
                     _EndpointMirror(addr, handles, magic,
                                     builder._num_players)
                 )
+            # builder-declared spectators (hub-owned relay): native fan-out
+            # endpoints, created AFTER the remotes with the same rng draws
+            # start_p2p_session would make, so the remote endpoints' magic
+            # numbers — and hence the host's remote-facing wire bytes —
+            # are bit-identical to the per-session baseline
+            spectator_by_addr: Dict[Any, List[int]] = {}
+            for handle, ptype in builder._player_reg.handles.items():
+                if isinstance(ptype, Spectator):
+                    spectator_by_addr.setdefault(ptype.addr, []).append(
+                        handle
+                    )
+            for addr, handles in spectator_by_addr.items():
+                rng = builder._rng if builder._rng is not None else (
+                    random.Random()
+                )
+                magic = draw_magic(rng)
+                sp_idx = lib.ggrs_bank_attach_spectator(
+                    self._bank, idx, magic, now
+                )
+                if sp_idx < 0:
+                    raise RuntimeError(
+                        f"ggrs_bank_attach_spectator failed: {sp_idx}"
+                    )
+                mirror.addr_to_spec[addr] = int(sp_idx)
+                mirror.spectators.append(
+                    _SpectatorMirror(addr, magic, sorted(handles))
+                )
+            if mirror.spectators:
+                self._m_spectators.labels(slot=str(idx)).set(
+                    len(mirror.spectators)
+                )
             self._mirrors.append(mirror)
         self._clock = self._builders[0][0]._clock
         # output buffer sized to the worst realistic tick (rollback resim
@@ -526,7 +634,9 @@ class HostSessionPool:
                 per_session,
                 4096
                 + (m.max_prediction + 4) * (16 + adv_bytes)
-                + len(m.endpoints) * (2048 + 32 * m.num_players),
+                + len(m.endpoints) * (2048 + 32 * m.num_players)
+                + len(m.spectators) * 2048
+                + (m.max_prediction + 4) * (16 + adv_bytes),  # journal tap
             )
         self._out_buf = ctypes.create_string_buffer(
             max(1 << 16, per_session * len(self._mirrors))
@@ -618,15 +728,28 @@ class HostSessionPool:
             for op, ep_idx, frame in ctrl:
                 cmd_parts.append(pack("<BHq", op, ep_idx, frame))
             datagrams = []
+            spec_datagrams = []
+            addr_to_spec = m.addr_to_spec
             for from_addr, data in m.socket.receive_all_datagrams():
                 ep_idx = m.addr_to_ep.get(from_addr)
                 if ep_idx is not None:
                     datagrams.append((ep_idx, data))
+                elif addr_to_spec:
+                    sp_idx = addr_to_spec.get(from_addr)
+                    if sp_idx is not None:
+                        spec_datagrams.append((sp_idx, data))
             datagrams.extend(self._inject_dgrams.pop(i, ()))
             cmd_parts.append(pack("<H", len(datagrams)))
             for ep_idx, data in datagrams:
                 cmd_parts.append(pack("<HI", ep_idx, len(data)))
                 cmd_parts.append(data)
+            if self._has_spec:
+                # inbound viewer traffic (acks, quality, keep-alives, sync
+                # probes) rides the SAME crossing
+                cmd_parts.append(pack("<H", len(spec_datagrams)))
+                for sp_idx, data in spec_datagrams:
+                    cmd_parts.append(pack("<HI", sp_idx, len(data)))
+                    cmd_parts.append(data)
         cmd = b"".join(cmd_parts)
 
         self.crossings += 1
@@ -714,11 +837,20 @@ class HostSessionPool:
                                 f"load frame {frame} (was at "
                                 f"{m.current_frame})",
                             )
-            (n_out,) = unpack_from("<H", buf, pos)
-            pos += 2
+            # outbound.  Broadcast layout (has_spec): the poll-phase remote
+            # datagrams send immediately; the adv-phase (input) sends wait
+            # until the spectator queues — LAST tick's deferred fan-out plus
+            # this tick's spectator poll messages — have gone out, which is
+            # the Python session's exact per-socket order (poll's
+            # send_all_messages flushes remotes then spectators, then
+            # advance_frame sends the remote input messages inline; the
+            # fan-out messages it queues flush at the NEXT tick's poll).
+            has_spec = self._has_spec
             socket = m.socket
             send_failed: Optional[str] = None
-            for _ in range(n_out):
+            (n_out_poll,) = unpack_from("<H", buf, pos)
+            pos += 2
+            for _ in range(n_out_poll):
                 ep_idx, dlen = unpack_from("<HI", buf, pos)
                 pos += 6
                 data = bytes(buf[pos : pos + dlen])
@@ -734,9 +866,15 @@ class HostSessionPool:
                     socket.send_to(RawMessage(data), m.endpoints[ep_idx].addr)
                 except Exception as e:  # a send fault is THIS slot's fault
                     send_failed = f"socket send failed: {e!r}"
-            if send_failed is not None:
-                self._on_slot_fault(idx, 0, send_failed)
-                live = False
+            adv_out: List[Tuple[int, bytes]] = []
+            if has_spec:
+                (n_out_adv,) = unpack_from("<H", buf, pos)
+                pos += 2
+                for _ in range(n_out_adv):
+                    ep_idx, dlen = unpack_from("<HI", buf, pos)
+                    pos += 6
+                    adv_out.append((ep_idx, bytes(buf[pos : pos + dlen])))
+                    pos += dlen
             # stage event records; dispatch AFTER the status mirrors below
             # are parsed — _on_protocol_disconnected reads m.local_last, and
             # p2p.py's _handle_event sees the status as updated by this
@@ -773,6 +911,120 @@ class HostSessionPool:
                 pos += 9
                 m.local_disc[h] = bool(disc)
                 m.local_last[h] = lf
+
+            # ---- broadcast tail (DESIGN.md §13): spectator mirror, the
+            # phase-tagged fan-out streams, hub events, journal tap ----
+            if has_spec:
+                next_spec, n_specs = unpack_from("<qB", buf, pos)
+                pos += 9
+                m.next_spec_frame = next_spec
+                for e in range(n_specs):
+                    st, la = unpack_from("<Bq", buf, pos)
+                    pos += 9
+                    sp = m.spectators[e]
+                    sp.running = st == 0
+                    sp.last_acked = la
+                (n_spec_out,) = unpack_from("<H", buf, pos)
+                pos += 2
+                spec_poll: List[List[bytes]] = [[] for _ in range(n_specs)]
+                spec_adv: List[List[bytes]] = [[] for _ in range(n_specs)]
+                for _ in range(n_spec_out):
+                    sp_idx, phase, dlen = unpack_from("<HBI", buf, pos)
+                    pos += 7
+                    (spec_adv if phase else spec_poll)[sp_idx].append(
+                        bytes(buf[pos : pos + dlen])
+                    )
+                    pos += dlen
+                (n_spec_events,) = unpack_from("<H", buf, pos)
+                pos += 2
+                spec_events: List[Tuple[int, int, Any]] = []
+                for _ in range(n_spec_events):
+                    kind, sp_idx = unpack_from("<BH", buf, pos)
+                    pos += 3
+                    payload = None
+                    if kind == _EV_INTERRUPTED:
+                        (payload,) = unpack_from("<q", buf, pos)
+                        pos += 8
+                    spec_events.append((kind, sp_idx, payload))
+                (n_conf,) = unpack_from("<H", buf, pos)
+                pos += 2
+                conf_start: Frame = NULL_FRAME
+                conf_records: List[Tuple[bytes, bytes]] = []
+                if n_conf:
+                    (conf_start,) = unpack_from("<q", buf, pos)
+                    pos += 8
+                    blob_len = players * isize
+                    for _ in range(n_conf):
+                        flags = bytes(buf[pos : pos + players])
+                        pos += players
+                        conf_records.append((
+                            flags, bytes(buf[pos : pos + blob_len]),
+                        ))
+                        pos += blob_len
+                if live and m.spectators:
+                    # spectator sends: per viewer, last tick's deferred
+                    # fan-out datagrams then this tick's poll messages —
+                    # then the remote input messages, then stash this
+                    # tick's fan-out for the next (the Python flush order)
+                    fan = self._fanout_counters.get(idx)
+                    if fan is None:
+                        fan = (
+                            self._m_fanout_dgrams.labels(slot=str(idx)).inc,
+                            self._m_fanout_bytes.labels(slot=str(idx)).inc,
+                        )
+                        self._fanout_counters[idx] = fan
+                    fan_d, fan_b = fan
+                    for e, sp in enumerate(m.spectators):
+                        to_send = sp.deferred
+                        sp.deferred = []
+                        if e < n_specs:
+                            to_send = to_send + spec_poll[e]
+                        for data in to_send:
+                            if send_failed is not None:
+                                continue
+                            if rec is not None:
+                                rec.record(
+                                    self._tick_no, EV_WIRE,
+                                    (f"spec{e}", len(data),
+                                     zlib.crc32(data)),
+                                )
+                            try:
+                                socket.send_to(RawMessage(data), sp.addr)
+                                fan_d()
+                                fan_b(len(data))
+                            except Exception as exc:
+                                send_failed = f"socket send failed: {exc!r}"
+                elif not live:
+                    # a faulted/skipped slot's deferred stream is stale: the
+                    # fan-out window lives in the harvest's pending dumps
+                    # and is re-emitted by the evicted relay's retry timer
+                    for sp in m.spectators:
+                        sp.deferred = []
+            for ep_idx, data in adv_out:
+                if send_failed is not None:
+                    continue
+                if rec is not None:
+                    rec.record(self._tick_no, EV_WIRE,
+                               (ep_idx, len(data), zlib.crc32(data)))
+                try:
+                    socket.send_to(RawMessage(data), m.endpoints[ep_idx].addr)
+                except Exception as e:
+                    send_failed = f"socket send failed: {e!r}"
+            if has_spec and live and m.spectators:
+                for e, sp in enumerate(m.spectators):
+                    if e < n_specs:
+                        sp.deferred.extend(spec_adv[e])
+                hub = self._spectator_hub
+                if hub is not None and spec_events:
+                    for kind, sp_idx, payload in spec_events:
+                        hub._on_native_event(idx, sp_idx, kind, payload)
+            if has_spec and live and n_conf:
+                sink = self._journal_sinks.get(idx)
+                if sink is not None:
+                    sink.append_frames(conf_start, conf_records)
+            if send_failed is not None:
+                self._on_slot_fault(idx, 0, send_failed)
+                live = False
 
             # ---- policy (Python): events, wait recommendation, consensus ----
             # applied only for live slots; a faulted/skipped record carries
@@ -1016,7 +1268,23 @@ class HostSessionPool:
         and hand back the session plus the leading ``LoadGameState``."""
         m = self._mirrors[index]
         builder, socket = self._builders[index]
-        h = self._harvest(index)
+        try:
+            h = self._harvest(index)
+        except Exception:
+            # crash recovery (DESIGN.md §13): the native slot's resumable
+            # state is gone (corrupt harvest, dead bank memory).  A match
+            # journal, when attached, can stand in — its tail window holds
+            # the same confirmed inputs the harvest would have recovered,
+            # so the slot resumes from the journal instead of dying.
+            recover = self._journal_recovery.get(index)
+            if recover is None:
+                raise
+            h = recover()
+            self._fault_log[index].append(SlotFault(
+                self._tick_no, 0,
+                "harvest unavailable; resuming from journal tail "
+                f"(frame {h['last_confirmed']})",
+            ))
         # Resume from the newest frame whose save the game actually
         # fulfilled.  Normally that is the confirmed watermark, but a fault
         # tick can raise the watermark and then have its own save op
@@ -1069,8 +1337,25 @@ class HostSessionPool:
             endpoint_states=endpoint_states,
             next_recommended_sleep=m.next_recommended_sleep,
             pending_events=list(m.event_queue),
+            next_spectator_frame=h.get("next_spectator_frame", 0),
         )
         m.event_queue.clear()
+        # broadcast continuity: the relay falls back to the Python session
+        # (p2p.py's own spectator path), resuming each viewer's fan-out
+        # window mid-stream — builder-declared endpoints are adopted in
+        # place, hub-attached viewers are grafted through the adoption seam
+        if m.spectators:
+            self._adopt_spectators(session, builder, m, h)
+        sink = self._journal_sinks.get(index)
+        if sink is not None:
+            from ..broadcast.journal import JournalTap
+
+            # the tap needs the session config: it re-ENCODES the decoded
+            # inputs the relay hands it back into the journal's fixed-size
+            # wire blobs
+            session.adopt_spectator_endpoint(
+                JournalTap.ADDR, JournalTap(sink, m.config)
+            )
         decode = m.config.input_decode
         for handle in m.local_handles:
             blob = m.staged_inputs.get(handle)
@@ -1150,14 +1435,206 @@ class HostSessionPool:
                 send_base=send_base, pending=pending,
                 last_recv=last_recv, recv_entries=recv_entries,
             ))
+        next_spec: Frame = 0
+        spectators: List[Dict[str, Any]] = []
+        if self._has_spec:
+            next_spec, n_specs = unpack_from("<qB", b, pos)
+            pos += 9
+            for _ in range(n_specs):
+                (state,) = unpack_from("<B", b, pos)
+                pos += 1
+                last_acked, base_len = unpack_from("<qI", b, pos)
+                pos += 12
+                send_base = b[pos : pos + base_len]
+                pos += base_len
+                (n_pending,) = unpack_from("<H", b, pos)
+                pos += 2
+                pending = []
+                for _ in range(n_pending):
+                    frame, dlen = unpack_from("<qI", b, pos)
+                    pos += 12
+                    pending.append((frame, b[pos : pos + dlen]))
+                    pos += dlen
+                spectators.append(dict(
+                    state=state, last_acked_frame=last_acked,
+                    send_base=send_base, pending=pending,
+                ))
         if pos != len(b):
             raise RuntimeError("harvest buffer layout mismatch")
         return dict(
             current=current, last_confirmed=confirmed,
             disconnect_frame=disc_frame, local_disc=local_disc,
             local_last=local_last, player_inputs=player_inputs,
-            endpoints=endpoints,
+            endpoints=endpoints, next_spectator_frame=next_spec,
+            spectators=spectators,
         )
+
+    def _adopt_spectators(self, session, builder, m: _SessionMirror,
+                          h: Dict[str, Any]) -> None:
+        """Graft the slot's fan-out endpoints onto the evicted Python
+        session: builder-declared spectator endpoints are adopted in place,
+        hub-attached viewers get fresh ``PeerProtocol``s through
+        ``P2PSession.adopt_spectator_endpoint``.  Each resumes its harvested
+        send window (ack base + unacked pending), so the viewer sees a
+        retransmission hiccup, not a reset stream."""
+        players = m.num_players
+        default_blob = m.config.input_encode(m.config.input_default())
+        default_base = b"".join(
+            encode_uvarint(len(default_blob)) + default_blob
+            for _ in range(players)
+        )
+        spec_states = h.get("spectators") or []
+        for e, sp in enumerate(m.spectators):
+            hs = spec_states[e] if e < len(spec_states) else None
+            ep = session._player_reg.spectators.get(sp.addr)
+            if ep is None:
+                ep = builder._create_endpoint(
+                    list(sp.handles), sp.addr, builder._num_players
+                )
+                session.adopt_spectator_endpoint(sp.addr, ep)
+            base = hs["send_base"] if hs and hs["send_base"] else default_base
+            ep.adopt_endpoint_state(
+                magic=sp.magic,
+                running=(hs["state"] == 0) if hs else sp.running,
+                peer_connect_status=[(False, NULL_FRAME)] * players,
+                last_recv_frame=NULL_FRAME,
+                recv_entries=(),
+                last_acked_frame=(
+                    hs["last_acked_frame"] if hs else NULL_FRAME
+                ),
+                send_base=base,
+                pending=hs["pending"] if hs else (),
+            )
+            sp.deferred = []
+
+    # ------------------------------------------------------------------
+    # broadcast seams (driven by ggrs_tpu.broadcast.SpectatorHub)
+    # ------------------------------------------------------------------
+
+    def _attach_spectator(self, index: int, addr, magic: int,
+                          handles: Optional[List[int]] = None) -> int:
+        """Attach one fan-out endpoint to slot ``index`` (native path; the
+        hub owns the policy and calls this).  Must happen before the match
+        confirms its first frame — the native side refuses later joins."""
+        if not self._finalized:
+            self._finalize()
+        if not self._native_active or not self._has_spec:
+            raise InvalidRequest(
+                "native spectator fan-out unavailable on this pool"
+            )
+        m = self._mirrors[index]
+        if addr in m.addr_to_spec or addr in m.addr_to_ep:
+            raise InvalidRequest(f"address {addr!r} already attached")
+        sp_idx = self._lib.ggrs_bank_attach_spectator(
+            self._bank, index, magic, self._clock()
+        )
+        if sp_idx < 0:
+            raise InvalidRequest(
+                "spectator attach refused (match already past frame 0?): "
+                f"{_native.BANK_ERR_NAMES.get(sp_idx, sp_idx)}"
+            )
+        m.addr_to_spec[addr] = int(sp_idx)
+        m.spectators.append(_SpectatorMirror(addr, magic, handles or []))
+        self._m_spectators.labels(slot=str(index)).set(len(m.spectators))
+        return int(sp_idx)
+
+    def _detach_spectator(self, index: int, addr) -> None:
+        """Detach a viewer: the native endpoint shuts down immediately (no
+        disconnect linger) and stops receiving the stream."""
+        if not self._finalized:
+            self._finalize()
+        m = self._mirrors[index]
+        sp_idx = m.addr_to_spec.get(addr)
+        if sp_idx is None:
+            raise InvalidRequest(f"no spectator at address {addr!r}")
+        if self._native_active and self._slot_state[index] in (
+            SLOT_NATIVE, SLOT_QUARANTINED
+        ):
+            self._lib.ggrs_bank_detach_spectator(self._bank, index, sp_idx)
+        sp = m.spectators[sp_idx]
+        sp.running = False
+        sp.deferred = []
+        if index in self._evicted:
+            ep = self._evicted[index]._player_reg.spectators.get(addr)
+            if ep is not None:
+                ep.disconnect()
+
+    def _disconnect_spectator(self, index: int, sp_idx: int) -> None:
+        """Queue the hub's disconnect decision as next tick's ctrl op (the
+        same one-tick-late policy application as remote disconnects)."""
+        m = self._mirrors[index]
+        m.pending_ctrl.append((3, sp_idx, 0))
+        m.spectators[sp_idx].running = False
+
+    def set_confirmed_stream(self, index: int, sink,
+                             recovery=None) -> None:
+        """Attach a journal sink: the slot's newly-confirmed frames arrive
+        at ``sink.append_frames(start_frame, records)`` FROM THE TICK
+        CROSSING (zero extra crossings; records are ``(blank_flags,
+        joined_inputs)`` pairs).  ``recovery``, when given, is called if
+        eviction's native harvest fails and must return a harvest-shaped
+        dict built from the journal tail (crash recovery)."""
+        if not self._finalized:
+            self._finalize()
+        if sink is None:
+            self._journal_sinks.pop(index, None)
+            self._journal_recovery.pop(index, None)
+            if self._native_active and self._has_spec:
+                self._lib.ggrs_bank_set_confirmed_stream(
+                    self._bank, index, 0
+                )
+            return
+        if not self._native_active or not self._has_spec:
+            raise InvalidRequest(
+                "native confirmed-stream tap unavailable on this pool"
+            )
+        rc = self._lib.ggrs_bank_set_confirmed_stream(self._bank, index, 1)
+        if rc != 0:
+            raise InvalidRequest(
+                "journal tap refused (match already past frame 0?): "
+                f"{_native.BANK_ERR_NAMES.get(rc, rc)}"
+            )
+        self._journal_sinks[index] = sink
+        if recovery is not None:
+            self._journal_recovery[index] = recovery
+
+    def spectator_states(self, index: int) -> List[Dict[str, Any]]:
+        """Hub-facing mirror of one slot's fan-out endpoints: address,
+        liveness, the viewer's ack watermark, and the catchup lag
+        ((next_spectator_frame - 1) - last_acked).  On the Python-session
+        paths (fallback pool, evicted slot) the live endpoints answer."""
+        if not self._finalized:
+            self._finalize()
+        if not self._native_active or index in self._evicted:
+            session = (
+                self._evicted[index] if index in self._evicted
+                else self._sessions[index]
+            )
+            tip = getattr(session, "_next_spectator_frame", 0) - 1
+            out = []
+            for addr, sp in session._player_reg.spectators.items():
+                if not hasattr(sp, "_core"):
+                    continue  # journal taps have no wire state
+                la = getattr(sp._core, "last_acked_frame", None)
+                la = la() if la is not None else NULL_FRAME
+                out.append(dict(
+                    addr=addr, running=sp.is_running(), last_acked=la,
+                    catchup_lag=(
+                        max(0, tip - la) if sp.is_running() else 0
+                    ),
+                ))
+            return out
+        m = self._mirrors[index]
+        tip = m.next_spec_frame - 1
+        return [
+            dict(
+                addr=sp.addr, running=sp.running, last_acked=sp.last_acked,
+                catchup_lag=(
+                    max(0, tip - sp.last_acked) if sp.running else 0
+                ),
+            )
+            for sp in m.spectators
+        ]
 
     # ------------------------------------------------------------------
     # chaos hooks (tests + scripts/chaos.py)
@@ -1336,6 +1813,8 @@ class HostSessionPool:
                         }
                         for ep in m.endpoints
                     ],
+                    next_spectator_frame=0,
+                    spectators=[],
                 )
                 for i, m in enumerate(self._mirrors)
             ]
@@ -1364,6 +1843,26 @@ class HostSessionPool:
                 (core["emits"], core["emit_bytes"], core["acks"],
                  core["datagrams"], core["new_frames"], core["drops"],
                  core["fallbacks"]) = (c0, c1, c2, c3, c4, c5, c6)
+            if self._has_spec:
+                next_spec, n_specs = unpack_from("<qB", buf, pos)
+                pos += 9
+                rec["next_spectator_frame"] = next_spec
+                specs = rec["spectators"]
+                if len(specs) != n_specs:  # dynamic attach since last build
+                    del specs[:]
+                    specs.extend(
+                        dict(addr=sp.addr, state=0, last_acked_frame=0,
+                             pending_len=0, ping=0, packets_sent=0,
+                             bytes_sent=0, stats_start=0)
+                        for sp in self._mirrors[i].spectators[:n_specs]
+                    )
+                for ss in specs:
+                    (ss["state"], ss["last_acked_frame"],
+                     ss["pending_len"], ss["ping"], ss["packets_sent"],
+                     ss["bytes_sent"], ss["stats_start"]) = unpack_from(
+                        "<B6q", buf, pos
+                    )
+                    pos += 49
         if pos != n:
             raise RuntimeError("bank stats buffer layout mismatch")
         # a fresh list (the evicted overrides below must not clobber the
@@ -1379,6 +1878,8 @@ class HostSessionPool:
             current_frame=m.current_frame, last_confirmed=m.last_confirmed,
             ticks=0, rollbacks=0, rollback_frames=0, max_rollback_depth=0,
             faults=len(self._fault_log[index]),
+            next_spectator_frame=m.next_spec_frame,
+            spectators=[],
             endpoints=[
                 dict(addr=ep.addr, state=0 if ep.running else 1, ping=0,
                      send_queue_len=0, last_acked_frame=NULL_FRAME,
@@ -1428,6 +1929,24 @@ class HostSessionPool:
             max_rollback_depth=getattr(session, "_stat_max_rollback", 0),
             faults=len(self._fault_log[index]),
             endpoints=endpoints,
+            next_spectator_frame=getattr(
+                session, "_next_spectator_frame", 0
+            ),
+            spectators=[
+                dict(addr=addr, state=0 if sp.is_running() else 1,
+                     last_acked_frame=getattr(
+                         sp._core, "last_acked_frame", lambda: NULL_FRAME
+                     )(),
+                     pending_len=sp._core.pending_len(),
+                     ping=getattr(sp, "_round_trip_time", 0),
+                     packets_sent=getattr(sp, "_packets_sent", 0),
+                     bytes_sent=getattr(sp, "_bytes_sent", 0),
+                     stats_start=getattr(sp, "_stats_start_time", 0))
+                for addr, sp in getattr(
+                    session._player_reg, "spectators", {}
+                ).items()
+                if hasattr(sp, "_core")  # journal taps have no wire state
+            ],
         )
 
     def _gauge_setters(self, index: int, n_eps: int):
@@ -1486,6 +2005,20 @@ class HostSessionPool:
                 set_kbps(self._kbps(es, now))
                 set_local(es["local_frames_behind"])
                 set_remote(es["remote_frames_behind"])
+            specs = s.get("spectators")
+            if specs:
+                # broadcast gauges: how far each viewer's ack trails the
+                # broadcast tip (the stream stall detector)
+                tip = s.get("next_spectator_frame", 0) - 1
+                slot = str(s["index"])
+                for e, ss in enumerate(specs):
+                    lag = (
+                        max(0, tip - ss["last_acked_frame"])
+                        if ss["state"] == 0 else 0
+                    )
+                    self._m_spec_lag.labels(
+                        slot=slot, spectator=str(e)
+                    ).set(lag)
 
     def _now_ms(self) -> int:
         clock = self._clock

@@ -492,6 +492,7 @@ class P2PSession(ThreadOwned, Generic[I, S, A]):
         endpoint_states: Dict,
         next_recommended_sleep: Frame = 0,
         pending_events: List = (),
+        next_spectator_frame: Frame = 0,
     ) -> None:
         """Fast-forward a FRESH session to a mid-stream position: the
         eviction path of the supervised session bank
@@ -520,6 +521,22 @@ class P2PSession(ThreadOwned, Generic[I, S, A]):
             self._player_reg.remotes[addr].adopt_endpoint_state(**state)
         self._next_recommended_sleep = next_recommended_sleep
         self._event_queue.extend(pending_events)
+        # broadcast continuity: the relay must resume where the faulted
+        # slot's fan-out stopped — restarting at 0 would assert on inputs
+        # the watermark already discarded
+        self._next_spectator_frame = next_spectator_frame
+
+    def adopt_spectator_endpoint(self, addr: A, endpoint) -> None:
+        """Graft a spectator endpoint onto a LIVE session — the broadcast
+        subsystem's relay seam (ggrs_tpu/broadcast): an evicted bank slot's
+        hub-attached viewers, and the journal tap, keep receiving the
+        confirmed-input stream through this session's own spectator path.
+        The endpoint joins both the registry (inbound routing + fan-out)
+        and the cached poll list (timers + flushes)."""
+        if addr in self._player_reg.spectators:
+            raise InvalidRequest(f"spectator address {addr!r} already bound")
+        self._player_reg.spectators[addr] = endpoint
+        self._all_endpoints.append(endpoint)
 
     # ------------------------------------------------------------------
     # internals

@@ -16,6 +16,7 @@ fingerprint so a checkpoint can't silently resume under a different program
 
 from __future__ import annotations
 
+import io
 import json
 import os
 from typing import Any, Dict, Tuple
@@ -41,6 +42,47 @@ def save_pytree(path: str, tree: Any, meta: Dict[str, Any]) -> None:
     np.savez_compressed(
         _normalize(path), __meta__=np.asarray(json.dumps(meta)), **arrs
     )
+
+
+def dumps_pytree(tree: Any, meta: Dict[str, Any]) -> bytes:
+    """:func:`save_pytree` into bytes — the embeddable form the broadcast
+    journal's checkpoint records carry (one self-contained npz blob per
+    record, so a journal file stays a single append-only artifact)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    host = jax.device_get(leaves)
+    arrs = {f"leaf_{i}": np.asarray(l) for i, l in enumerate(host)}
+    buf = io.BytesIO()
+    np.savez_compressed(
+        buf, __meta__=np.asarray(json.dumps(meta)), **arrs
+    )
+    return buf.getvalue()
+
+
+def loads_pytree(data: bytes, template: Any) -> Tuple[Any, Dict[str, Any]]:
+    """Inverse of :func:`dumps_pytree`: rebuild the pytree into
+    ``template``'s structure with the same shape/dtype validation
+    :func:`load_pytree` applies."""
+    with np.load(io.BytesIO(data), allow_pickle=False) as npz:
+        meta = json.loads(str(npz["__meta__"][()]))
+        leaves, treedef = jax.tree_util.tree_flatten(template)
+        n_saved = sum(1 for k in npz.files if k.startswith("leaf_"))
+        if n_saved != len(leaves):
+            raise ValueError(
+                f"checkpoint holds {n_saved} leaves, template expects "
+                f"{len(leaves)} — wrong session config for this checkpoint?"
+            )
+        loaded = []
+        for i, ref in enumerate(leaves):
+            arr = npz[f"leaf_{i}"]
+            ref_shape = np.shape(ref)
+            ref_dtype = np.dtype(getattr(ref, "dtype", type(ref)))
+            if arr.shape != ref_shape or arr.dtype != ref_dtype:
+                raise ValueError(
+                    f"checkpoint leaf {i} is {arr.dtype}{arr.shape}, "
+                    f"template expects {ref_dtype}{ref_shape}"
+                )
+            loaded.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, loaded), meta
 
 
 def load_pytree(path: str, template: Any) -> Tuple[Any, Dict[str, Any]]:

@@ -75,6 +75,8 @@ void ggrs_sync_reset_prediction(void*);
 int64_t ggrs_sync_add_input(void*, int, int64_t, const uint8_t*);
 int ggrs_sync_synchronized_inputs(void*, int64_t, const uint8_t*,
                                   const int64_t*, uint8_t*, int32_t*);
+int ggrs_sync_confirmed_inputs(void*, int64_t, const uint8_t*,
+                               const int64_t*, uint8_t*, int64_t*);
 int ggrs_sync_set_last_confirmed(void*, int64_t);
 int64_t ggrs_sync_check_consistency(void*, int64_t);
 int64_t ggrs_sync_last_added(void*, int);
@@ -117,6 +119,7 @@ constexpr int kBankErrConfirm = -73;     // set_last_confirmed invariant
 constexpr int kBankErrNoPlayers = -74;   // every player disconnected
 constexpr int kBankErrSequence = -75;    // remote input frame gap (assert)
 constexpr int kBankErrInjected = -76;    // chaos-harness simulated fault
+constexpr int kBankErrSpecStream = -77;  // confirmed-input fan-out failed
 
 // command flags (host_bank.py mirrors)
 constexpr uint8_t kFlagInputs = 1;  // local inputs present -> advance runs
@@ -194,6 +197,20 @@ struct BankSession {
   int64_t disconnect_timeout = 2000, notify_start = 500;
   std::vector<int32_t> local_handles;  // sorted
   std::vector<BankEndpoint> endpoints;
+  // ---- broadcast fan-out (p2p.py's spectator relay, hub-owned policy) ----
+  // spectator endpoints reuse the SAME endpoint-core mechanism as remotes
+  // (pending window, delta base, InputMessage assembly) but carry the
+  // confirmed inputs of ALL players and never feed the sync layer; each has
+  // an independent ack/catchup window (its own core).  next_spectator_frame
+  // mirrors p2p.py _next_spectator_frame; stream_confirmed additionally
+  // stages the per-frame confirmed-input records into the tick OUTPUT (the
+  // journal tap — zero extra crossings).
+  std::vector<BankEndpoint> spectators;
+  int64_t next_spectator_frame = 0;
+  bool stream_confirmed = false;
+  std::vector<uint8_t> conf_stream;  // per-tick staged journal records
+  uint32_t conf_count = 0;
+  int64_t conf_start = kNullFrame;
   std::vector<uint8_t> local_disc;
   std::vector<int64_t> local_last;
   int64_t current_frame = 0;
@@ -209,7 +226,9 @@ struct BankSession {
   // scratch
   std::vector<uint8_t> sync_buf;     // players * input_size
   std::vector<int32_t> status_buf;   // players
+  std::vector<int64_t> frame_buf;    // players (confirmed_inputs out_frames)
   std::vector<uint8_t> payload;      // joined local-input payload
+  std::vector<uint8_t> spec_payload; // joined all-player fan-out payload
 };
 
 struct Bank {
@@ -623,9 +642,143 @@ int64_t max_frame_advantage(const BankSession* s) {
   return frames_ahead;
 }
 
+// p2p.py _send_confirmed_inputs_to_spectators: forward every newly
+// confirmed frame's inputs (for ALL players) to each running spectator
+// endpoint, and stage the same records for the journal tap.  Runs BEFORE
+// the watermark discard drops those inputs, with the UNCLAMPED confirmed
+// frame (the Python path sends with confirmed_frame before the
+// current-frame clamp — reachable with input delay).  One datagram per
+// newly confirmed frame per spectator, exactly like the Python loop.
+int fan_out_confirmed(Bank* bank, BankSession* s, int64_t now,
+                      int64_t confirmed) {
+  const int players = s->num_players;
+  const size_t isize = static_cast<size_t>(s->input_size);
+  while (s->next_spectator_frame <= confirmed) {
+    int64_t f = s->next_spectator_frame;
+    int rc = ggrs_sync_confirmed_inputs(
+        s->sync, f, s->local_disc.data(), s->local_last.data(),
+        s->sync_buf.data(), s->frame_buf.data());
+    if (rc != kOk) return kBankErrSpecStream;
+    if (!s->spectators.empty()) {
+      // joined payload over all players (encode_local_inputs: blanks for
+      // disconnected players encode as the zeroed default)
+      Writer w;
+      for (int p = 0; p < players; ++p) {
+        w.uvarint(static_cast<uint64_t>(isize));
+        w.raw(s->sync_buf.data() + static_cast<size_t>(p) * isize, isize);
+      }
+      s->spec_payload.assign(w.buf.begin(), w.buf.end());
+      for (BankEndpoint& ep : s->spectators) {
+        if (ep.state != kRunning) continue;  // send_input's RUNNING gate
+        int64_t pending = ggrs_ep_push(ep.ep, f, s->spec_payload.data(),
+                                       s->spec_payload.size());
+        if (pending > kPendingOutputSize && !ep.disconnect_event_sent) {
+          // a viewer that never acks 128 inputs is a stuck spectator
+          // (protocol.rs:441-445); the hub applies the disconnect next tick
+          ep.events.push_back(EpEvent{kEvDisconnected});
+        }
+        send_pending_output(bank, s, &ep, now);
+      }
+    }
+    if (s->stream_confirmed) {
+      if (s->conf_count == 0) s->conf_start = f;
+      for (int p = 0; p < players; ++p) {
+        put_u8(&s->conf_stream, s->frame_buf[p] == kNullFrame ? 1 : 0);
+      }
+      put_raw(&s->conf_stream, s->sync_buf.data(),
+              static_cast<size_t>(players) * isize);
+      s->conf_count += 1;
+    }
+    s->next_spectator_frame += 1;
+  }
+  return kBankOk;
+}
+
 // Status-mirror tail shared by the normal and skip record paths: a field
 // added to one but not the other would misalign Python's positional parse
 // exactly and only during fault handling.
+// Walk one phase's per-endpoint outbound streams and emit their datagram
+// records (u16 ep, [u8 phase when tagged], u32 len, bytes), in endpoint
+// order.  Shared by the remote sections and the spectator tail — the one
+// definition of the stream-to-record rewrite.
+void emit_out_records(std::vector<uint8_t>* o,
+                      std::vector<BankEndpoint>& endpoints, int phase,
+                      bool tag_phase, uint32_t* count) {
+  for (size_t e = 0; e < endpoints.size(); ++e) {
+    const std::vector<uint8_t>& stream =
+        phase == 0 ? endpoints[e].out_poll : endpoints[e].out_adv;
+    size_t pos = 0;
+    while (pos < stream.size()) {
+      uint32_t dlen = 0;
+      for (int i = 0; i < 4; ++i) {
+        dlen |= static_cast<uint32_t>(stream[pos + i]) << (8 * i);
+      }
+      pos += 4;
+      put_u16(o, static_cast<uint16_t>(e));
+      if (tag_phase) put_u8(o, static_cast<uint8_t>(phase));
+      put_u32(o, dlen);
+      put_raw(o, stream.data() + pos, dlen);
+      pos += dlen;
+      ++*count;
+    }
+  }
+}
+
+void patch_u16(std::vector<uint8_t>* o, size_t pos, uint32_t v) {
+  (*o)[pos] = v & 0xFF;
+  (*o)[pos + 1] = (v >> 8) & 0xFF;
+}
+
+// One outbound-datagram section (u16 count, then u16 ep / u32 len / bytes
+// per datagram) for one phase's streams, in endpoint order.
+void emit_out_section(std::vector<uint8_t>* o,
+                      std::vector<BankEndpoint>& endpoints, int phase) {
+  uint32_t count = 0;
+  size_t count_pos = o->size();
+  put_u16(o, 0);  // patched below
+  emit_out_records(o, endpoints, phase, false, &count);
+  patch_u16(o, count_pos, count);
+}
+
+// Broadcast tail of every session record (normal, faulted, and skip paths
+// all emit it so the positional parse never misaligns): the spectator
+// status mirror, the phase-tagged spectator outbound streams, the hub
+// event stream, and the journal tap's confirmed-input records.  A non-live
+// record (skip / fault) carries states only — its streams were suppressed.
+void emit_spectator_tail(std::vector<uint8_t>* o, BankSession* s, bool live,
+                         const std::vector<uint8_t>* spec_events = nullptr,
+                         uint16_t n_spec_events = 0) {
+  put_i64(o, s->next_spectator_frame);
+  put_u8(o, static_cast<uint8_t>(s->spectators.size()));
+  for (BankEndpoint& sp : s->spectators) {
+    put_u8(o, sp.state);
+    put_i64(o, ggrs_ep_last_acked_frame(sp.ep));
+  }
+  if (!live) {
+    put_u16(o, 0);  // n_spec_out
+    put_u16(o, 0);  // n_spec_events
+    put_u16(o, 0);  // n_conf
+    return;
+  }
+  uint32_t count = 0;
+  size_t count_pos = o->size();
+  put_u16(o, 0);  // n_spec_out, patched below
+  for (int phase = 0; phase < 2; ++phase) {
+    emit_out_records(o, s->spectators, phase, true, &count);
+  }
+  patch_u16(o, count_pos, count);
+  put_u16(o, n_spec_events);
+  if (spec_events != nullptr) {
+    put_raw(o, spec_events->data(), spec_events->size());
+  }
+  put_u16(o, static_cast<uint16_t>(s->conf_count));
+  if (s->conf_count > 0) {
+    put_i64(o, s->conf_start);
+    put_raw(o, s->conf_stream.data(), s->conf_stream.size());
+  }
+  return;
+}
+
 void emit_status_mirrors(std::vector<uint8_t>* o, const BankSession* s) {
   put_u8(o, static_cast<uint8_t>(s->endpoints.size()));
   for (const BankEndpoint& ep : s->endpoints) {
@@ -709,6 +862,13 @@ int advance_session(Bank* bank, BankSession* s, int64_t now,
   put_u8(ops, 0);
   put_i64(ops, s->current_frame);
   ++*n_ops;
+
+  // broadcast fan-out + journal tap: BEFORE set_last_confirmed discards the
+  // inputs it would need (p2p.py sends to spectators at exactly this point)
+  if (!s->spectators.empty() || s->stream_confirmed) {
+    int rc = fan_out_confirmed(bank, s, now, confirmed);
+    if (rc != kBankOk) return rc;
+  }
 
   // confirmed-frame watermark (policy minimums applied: non-sparse, so only
   // the never-past-current clamp)
@@ -807,6 +967,7 @@ void ggrs_bank_free(void* ptr) {
   if (!bank) return;
   for (BankSession* s : bank->sessions) {
     for (BankEndpoint& ep : s->endpoints) ggrs_ep_free(ep.ep);
+    for (BankEndpoint& ep : s->spectators) ggrs_ep_free(ep.ep);
     ggrs_sync_free(s->sync);
     delete s;
   }
@@ -846,6 +1007,7 @@ int64_t ggrs_bank_add_session(void* ptr, int num_players, int input_size,
   s->local_last.assign(num_players, kNullFrame);
   s->sync_buf.resize(static_cast<size_t>(num_players) * input_size);
   s->status_buf.resize(num_players);
+  s->frame_buf.resize(num_players);
   for (int32_t h : s->local_handles) {
     ggrs_sync_set_frame_delay(s->sync, h, input_delay);
   }
@@ -893,6 +1055,99 @@ int64_t ggrs_bank_add_endpoint(void* ptr, int64_t session, uint16_t magic,
   return static_cast<int64_t>(s->endpoints.size()) - 1;
 }
 
+// Attach a spectator fan-out endpoint to a session (broadcast subsystem —
+// ggrs_tpu/broadcast/hub.py owns registration policy and address routing).
+// The endpoint carries the confirmed inputs of ALL players (send base =
+// num_players default payloads, like start_p2p_session's spectator
+// endpoints); its ack/catchup window is independent of every other
+// spectator's.  Returns the spectator index within the session, or a
+// negative error.  now_ms seeds the liveness timers.  The hub must attach
+// before any frame is confirmed (next_spectator_frame > 0 is refused: the
+// pre-watermark inputs a late joiner would need are already discarded —
+// the journal is the late-join/catch-up story).
+int64_t ggrs_bank_attach_spectator(void* ptr, int64_t session, uint16_t magic,
+                                   int64_t now_ms) {
+  Bank* bank = static_cast<Bank*>(ptr);
+  if (session < 0 ||
+      static_cast<size_t>(session) >= bank->sessions.size()) {
+    return kBankErrCmd;
+  }
+  BankSession* s = bank->sessions[static_cast<size_t>(session)];
+  // refuse late joins: the cursor must still be able to start at frame 0.
+  // next_spectator_frame alone is not enough — a slot that never had a
+  // spectator or journal keeps it at 0 while the watermark discard (and
+  // the input ring's wraparound) eat the early frames; admitting such an
+  // attach would fault the whole slot on its next tick.
+  if (s->next_spectator_frame > 0 || s->current_frame > 0 ||
+      s->last_confirmed > 0) {
+    return kBankErrSpecStream;
+  }
+  // the spectator count crosses the tick/harvest/stats layouts as a u8;
+  // the 256th attach would silently misalign every parse
+  if (s->spectators.size() >= 255) return kBankErrSpecStream;
+  Writer send_base, recv_base;
+  std::vector<uint8_t> zeros(static_cast<size_t>(s->input_size), 0);
+  for (int i = 0; i < s->num_players; ++i) {
+    send_base.uvarint(static_cast<uint64_t>(s->input_size));
+    send_base.raw(zeros.data(), zeros.size());
+  }
+  // viewers never send inputs; a single default entry keeps the recv side
+  // well-formed and any stray InputMessage from a viewer drops harmlessly
+  recv_base.uvarint(static_cast<uint64_t>(s->input_size));
+  recv_base.raw(zeros.data(), zeros.size());
+  void* ep = ggrs_ep_new(send_base.buf.data(), send_base.buf.size(),
+                         recv_base.buf.data(), recv_base.buf.size(),
+                         s->max_prediction);
+  if (!ep) return kBankErrCmd;
+  s->spectators.emplace_back();
+  BankEndpoint& e = s->spectators.back();
+  e.ep = ep;
+  e.magic = magic;
+  e.last_send = e.last_recv = e.last_input_recv = e.last_quality = now_ms;
+  e.stats_start = now_ms;
+  e.peer_disc.assign(s->num_players, 0);
+  e.peer_last.assign(s->num_players, kNullFrame);
+  return static_cast<int64_t>(s->spectators.size()) - 1;
+}
+
+// Detach: immediate shutdown (no 5 s linger — the hub already decided).
+// The slot stays in the table so other spectator indices remain stable.
+int ggrs_bank_detach_spectator(void* ptr, int64_t session, int64_t spec) {
+  Bank* bank = static_cast<Bank*>(ptr);
+  if (session < 0 ||
+      static_cast<size_t>(session) >= bank->sessions.size()) {
+    return kBankErrCmd;
+  }
+  BankSession* s = bank->sessions[static_cast<size_t>(session)];
+  if (spec < 0 || static_cast<size_t>(spec) >= s->spectators.size()) {
+    return kBankErrCmd;
+  }
+  s->spectators[static_cast<size_t>(spec)].state = kShutdown;
+  return kBankOk;
+}
+
+// Journal tap: when enabled, every newly-confirmed frame's inputs are
+// staged into the session's tick-output record (the n_conf section) from
+// the SAME crossing that fans them out — journaling costs zero extra
+// crossings at steady state.
+int ggrs_bank_set_confirmed_stream(void* ptr, int64_t session, int enabled) {
+  Bank* bank = static_cast<Bank*>(ptr);
+  if (session < 0 ||
+      static_cast<size_t>(session) >= bank->sessions.size()) {
+    return kBankErrCmd;
+  }
+  BankSession* s = bank->sessions[static_cast<size_t>(session)];
+  if (enabled && s->next_spectator_frame == 0 &&
+      (s->current_frame > 0 || s->last_confirmed > 0)) {
+    // same late-join rule as attach: a journal must start at frame 0 (or
+    // ride an already-running fan-out cursor); frames below the watermark
+    // are gone and the tap would fault the slot
+    return kBankErrSpecStream;
+  }
+  s->stream_confirmed = enabled != 0;
+  return kBankOk;
+}
+
 // THE crossing.  Command stream, little-endian, per session in order:
 //   u8 flags (bit0 = local inputs present -> advance phase runs;
 //             bit1 = skip: slot is quarantined/evicted, NO further fields
@@ -902,7 +1157,9 @@ int64_t ggrs_bank_add_endpoint(void* ptr, int64_t session, uint16_t magic,
 //     op 1 = disconnect endpoint at `frame`
 //     op 2 = inject a simulated per-slot fault (`frame` carries the error
 //            code; the chaos harness's native-fault stand-in)
+//     op 3 = disconnect spectator `ep` (hub policy, applied next tick)
 //   u16 n_datagrams;  per datagram: u16 ep, u32 len, bytes
+//   u16 n_spec_datagrams;  per datagram: u16 spectator, u32 len, bytes
 // Output stream, per session in order:
 //   i32 err  (0 = ok; negative kBankErr* = THIS SLOT faulted this tick —
 //             its ops/outbound/events are suppressed, only the status
@@ -914,10 +1171,20 @@ int64_t ggrs_bank_add_endpoint(void* ptr, int64_t session, uint16_t magic,
 //   u16 n_ops;  per op: u8 kind (0 save / 1 load / 2 advance);
 //     save/load: i64 frame;  advance: players * u8 status,
 //     players * input_size input bytes
-//   u16 n_out;  per datagram: u16 ep, u32 len, bytes
+//   u16 n_out_poll;  per datagram: u16 ep, u32 len, bytes  [poll phase]
+//   u16 n_out_adv;   per datagram: u16 ep, u32 len, bytes  [input sends]
 //   u16 n_events;  per event: u8 kind, u16 ep, kind-specific payload
 //   u8 n_endpoints;  per endpoint: u8 state, num_players * (u8 disc, i64 lf)
 //   num_players * (u8 disc, i64 last_frame)   [local status mirror]
+//   --- broadcast tail (emit_spectator_tail) ---
+//   i64 next_spectator_frame
+//   u8 n_spectators;  per: u8 state, i64 last_acked_frame
+//   u16 n_spec_out;  per: u16 spectator, u8 phase (0 poll / 1 fan-out),
+//     u32 len, bytes  — phase-1 datagrams are sent by the pool one tick
+//     later, reproducing the Python session's flush order exactly
+//   u16 n_spec_events;  per: u8 kind, u16 spectator [+ i64 for interrupted]
+//   u16 n_conf;  [if > 0] i64 conf_start; per frame:
+//     players * u8 blank_flag, players * input_size bytes  [journal tap]
 // Returns 0, kErrBufferTooSmall (retry with a bigger out), or kBankErrCmd
 // (malformed command stream — the one remaining whole-bank failure).
 int ggrs_bank_tick(void* ptr, int64_t now, const uint8_t* cmd, size_t cmd_len,
@@ -943,9 +1210,11 @@ int ggrs_bank_tick(void* ptr, int64_t now, const uint8_t* cmd, size_t cmd_len,
       put_i64(o, s->last_confirmed);
       put_u8(o, 0);
       put_u16(o, 0);  // n_ops
-      put_u16(o, 0);  // n_out
+      put_u16(o, 0);  // n_out_poll
+      put_u16(o, 0);  // n_out_adv
       put_u16(o, 0);  // n_events
       emit_status_mirrors(o, s);
+      emit_spectator_tail(o, s, false);
       continue;
     }
     int err = kBankOk;  // per-SLOT fault accumulator; never fails the tick
@@ -963,6 +1232,16 @@ int ggrs_bank_tick(void* ptr, int64_t now, const uint8_t* cmd, size_t cmd_len,
       ep.out_count = 0;
       ep.evin_bytes.clear();
     }
+    for (BankEndpoint& ep : s->spectators) {
+      ep.out_poll.clear();
+      ep.out_adv.clear();
+      ep.cur_out = &ep.out_poll;
+      ep.out_count = 0;
+      ep.evin_bytes.clear();
+    }
+    s->conf_stream.clear();
+    s->conf_count = 0;
+    s->conf_start = kNullFrame;
     for (uint16_t i = 0; i < n_ctrl; ++i) {
       uint8_t op = r.u8();
       uint16_t ep_idx = r.u16();
@@ -974,6 +1253,15 @@ int ggrs_bank_tick(void* ptr, int64_t now, const uint8_t* cmd, size_t cmd_len,
         // simulated native slot fault: the whole slot tick is skipped, as
         // a real mid-tick fault would leave it
         err = frame < 0 ? static_cast<int>(frame) : kBankErrInjected;
+      } else if (op == 3 && ep_idx < s->spectators.size()) {
+        // disconnect spectator (hub policy, one tick after its event —
+        // p2p.py's spectator branch of _disconnect_player_at_frame: no
+        // local-status or rollback side effects, just the endpoint)
+        BankEndpoint& sp = s->spectators[ep_idx];
+        if (sp.state != kShutdown) {
+          sp.state = kDisconnected;
+          sp.shutdown_at = now + kShutdownTimerMs;
+        }
       }
     }
 
@@ -989,8 +1277,23 @@ int ggrs_bank_tick(void* ptr, int64_t now, const uint8_t* cmd, size_t cmd_len,
         process_datagram(bank, s, &s->endpoints[ep_idx], now, data, dlen);
       }
     }
+    // inbound spectator traffic (acks, quality reports, keep-alives, sync
+    // requests) — routed by the hub's address table, same crossing
+    uint16_t n_spec_dgrams = r.u16();
+    if (!r.ok) return kBankErrCmd;
+    for (uint16_t i = 0; i < n_spec_dgrams; ++i) {
+      uint16_t sp_idx = r.u16();
+      uint32_t dlen = r.u32();
+      const uint8_t* data = r.raw(dlen);
+      if (!r.ok) return kBankErrCmd;
+      if (err == kBankOk && sp_idx < s->spectators.size()) {
+        process_datagram(bank, s, &s->spectators[sp_idx], now, data, dlen);
+      }
+    }
     std::vector<uint8_t> out_events;
     uint16_t n_out_events = 0;
+    std::vector<uint8_t> spec_events;
+    uint16_t n_spec_events = 0;
     int64_t landed = kNullFrame;
     int64_t frames_ahead = 0;
     bool pending_consensus = false;
@@ -1019,6 +1322,25 @@ int ggrs_bank_tick(void* ptr, int64_t now, const uint8_t* cmd, size_t cmd_len,
           staged_events.push_back(ep.events.front());
           staged_eps.push_back(static_cast<int32_t>(e));
           ep.events.pop_front();
+        }
+      }
+      // spectator timers run after the remotes' (p2p.py polls
+      // _all_endpoints in remotes-then-spectators order); their events go
+      // to the HUB stream — never into the session's input/event path (a
+      // viewer's lifecycle is hub policy, and a malicious viewer's
+      // InputMessage must not reach the sync layer)
+      for (size_t e = 0; e < s->spectators.size(); ++e) {
+        BankEndpoint& sp = s->spectators[e];
+        poll_timers(bank, s, &sp, now);
+        while (!sp.events.empty()) {
+          const EpEvent& ev = sp.events.front();
+          if (ev.kind != kEvInput) {
+            put_u8(&spec_events, ev.kind);
+            put_u16(&spec_events, static_cast<uint16_t>(e));
+            if (ev.kind == kEvInterrupted) put_i64(&spec_events, ev.a);
+            ++n_spec_events;
+          }
+          sp.events.pop_front();
         }
       }
       for (size_t i = 0; err == kBankOk && i < staged_events.size(); ++i) {
@@ -1060,6 +1382,7 @@ int ggrs_bank_tick(void* ptr, int64_t now, const uint8_t* cmd, size_t cmd_len,
     if (err == kBankOk) {
       pending_consensus = consensus_pending(s);
       for (BankEndpoint& ep : s->endpoints) ep.cur_out = &ep.out_adv;
+      for (BankEndpoint& ep : s->spectators) ep.cur_out = &ep.out_adv;
       if (flags & kFlagInputs) {
         if (!local_inputs) return kBankErrCmd;
         int rc = advance_session(bank, s, now, local_inputs, &ops, &n_ops,
@@ -1079,6 +1402,8 @@ int ggrs_bank_tick(void* ptr, int64_t now, const uint8_t* cmd, size_t cmd_len,
       n_ops = 0;
       out_events.clear();
       n_out_events = 0;
+      spec_events.clear();
+      n_spec_events = 0;
       landed = kNullFrame;
       frames_ahead = 0;
       pending_consensus = false;
@@ -1087,6 +1412,14 @@ int ggrs_bank_tick(void* ptr, int64_t now, const uint8_t* cmd, size_t cmd_len,
         ep.out_adv.clear();
         ep.out_count = 0;
       }
+      for (BankEndpoint& ep : s->spectators) {
+        ep.out_poll.clear();
+        ep.out_adv.clear();
+        ep.out_count = 0;
+      }
+      s->conf_stream.clear();
+      s->conf_count = 0;
+      s->conf_start = kNullFrame;
     }
 
     // ---- session output record ----
@@ -1098,33 +1431,17 @@ int ggrs_bank_tick(void* ptr, int64_t now, const uint8_t* cmd, size_t cmd_len,
     put_u8(o, pending_consensus ? 1 : 0);
     put_u16(o, n_ops);
     put_raw(o, ops.data(), ops.size());
-    uint32_t n_out = 0;
-    for (BankEndpoint& ep : s->endpoints) n_out += ep.out_count;
-    put_u16(o, static_cast<uint16_t>(n_out));
-    // both phases, each in endpoint order — the Python session's observable
-    // per-socket send order (see the out_poll/out_adv comment above)
-    for (int phase = 0; phase < 2; ++phase) {
-      for (size_t e = 0; e < s->endpoints.size(); ++e) {
-        BankEndpoint& ep = s->endpoints[e];
-        const std::vector<uint8_t>& stream =
-            phase == 0 ? ep.out_poll : ep.out_adv;
-        size_t pos = 0;
-        while (pos < stream.size()) {
-          uint32_t dlen = 0;
-          for (int i = 0; i < 4; ++i) {
-            dlen |= static_cast<uint32_t>(stream[pos + i]) << (8 * i);
-          }
-          pos += 4;
-          put_u16(o, static_cast<uint16_t>(e));
-          put_u32(o, dlen);
-          put_raw(o, stream.data() + pos, dlen);
-          pos += dlen;
-        }
-      }
-    }
+    // the two phases are SEPARATE sections (each in endpoint order): the
+    // Python session's per-socket send order interleaves the spectator
+    // queues between them (poll's send_all_messages flushes remotes then
+    // spectators, then advance sends the remote input messages), so the
+    // pool needs the phase boundary to reproduce that order exactly
+    emit_out_section(o, s->endpoints, 0);
+    emit_out_section(o, s->endpoints, 1);
     put_u16(o, n_out_events);
     put_raw(o, out_events.data(), out_events.size());
     emit_status_mirrors(o, s);
+    emit_spectator_tail(o, s, true, &spec_events, n_spec_events);
   }
 
   if (r.pos != r.len) return kBankErrCmd;  // trailing garbage: refuse
@@ -1170,6 +1487,11 @@ int64_t ggrs_bank_session_count(void* ptr) {
 //     u8 state
 //     send dump  (ggrs_ep_dump_send: last_acked_frame, base, pending window)
 //     recv dump  (ggrs_ep_dump_recv: last_recv_frame, ring window)
+//   i64 next_spectator_frame
+//   u8 n_spectators; per spectator:
+//     u8 state
+//     send dump  (the fan-out window a relaying eviction must resume with;
+//     viewers have no recv state worth harvesting)
 // Returns 0, kErrBufferTooSmall (*out_len = needed), or kBankErrCmd for a
 // bad session index.  Read-only: safe to retry, never perturbs the bank.
 int ggrs_bank_harvest(void* ptr, int64_t session, uint8_t* out, size_t cap,
@@ -1238,6 +1560,23 @@ int ggrs_bank_harvest(void* ptr, int64_t session, uint8_t* out, size_t cap,
       put_raw(&h, scratch.data(), need);
     }
   }
+  put_i64(&h, s->next_spectator_frame);
+  put_u8(&h, static_cast<uint8_t>(s->spectators.size()));
+  for (BankEndpoint& sp : s->spectators) {
+    put_u8(&h, sp.state);
+    size_t need = 0;
+    while (true) {
+      int rc = ggrs_ep_dump_send(sp.ep, scratch.data(), scratch.size(),
+                                 &need);
+      if (rc == kErrBufferTooSmall) {
+        scratch.resize(need);
+        continue;
+      }
+      if (rc != kOk) return kBankErrCmd;
+      break;
+    }
+    put_raw(&h, scratch.data(), need);
+  }
   *out_len = h.size();
   if (h.size() > cap) return kErrBufferTooSmall;
   std::memcpy(out, h.data(), h.size());
@@ -1262,6 +1601,12 @@ int ggrs_bank_harvest(void* ptr, int64_t session, uint8_t* out, size_t cap,
 //     i64 packets_sent, i64 bytes_sent, i64 stats_start_ms
 //     7 * u64 endpoint-core counters (ggrs_ep_stats order: emits,
 //       emit_bytes, acks, datagrams, new_frames, drops, fallbacks)
+//   i64 next_spectator_frame
+//   u8 n_spectators; per spectator:
+//     u8 state, i64 last_acked_frame, i64 pending_len, i64 rtt_ms,
+//     i64 packets_sent, i64 bytes_sent, i64 stats_start_ms
+//   (the catchup-lag gauge is (next_spectator_frame-1) - last_acked_frame;
+//   harvested in the SAME crossing as everything else)
 // Returns kBankOk or kErrBufferTooSmall (*out_len = needed; retry).
 int ggrs_bank_stats(void* ptr, uint8_t* out, size_t cap, size_t* out_len) {
   Bank* bank = static_cast<Bank*>(ptr);
@@ -1290,6 +1635,17 @@ int ggrs_bank_stats(void* ptr, uint8_t* out, size_t cap, size_t* out_len) {
       put_i64(&h, ep.stats_start);
       ggrs_ep_stats(ep.ep, core);
       for (int i = 0; i < 7; ++i) put_u64(&h, core[i]);
+    }
+    put_i64(&h, s->next_spectator_frame);
+    put_u8(&h, static_cast<uint8_t>(s->spectators.size()));
+    for (BankEndpoint& sp : s->spectators) {
+      put_u8(&h, sp.state);
+      put_i64(&h, ggrs_ep_last_acked_frame(sp.ep));
+      put_i64(&h, ggrs_ep_pending_len(sp.ep));
+      put_i64(&h, sp.rtt);
+      put_i64(&h, sp.packets_sent);
+      put_i64(&h, sp.bytes_sent);
+      put_i64(&h, sp.stats_start);
     }
   }
   *out_len = h.size();

@@ -38,13 +38,14 @@ g++ -O1 -g -shared -fPIC -std=c++17 \
 # The -k filter keeps the sanitized leg on the HOST-only tests: the
 # batched-executor integration tests JIT through XLA, whose own compiler
 # trips ASan's interceptors (an upstream finding, not ours) and aborts the
-# run before the bank code under test even executes.  The slow soak is
-# excluded by default; pass "-m" "slow" to run it sanitized too.
+# run before the bank code under test even executes; the fused-scrub
+# replay test JITs too.  The slow soak is excluded by default; pass
+# "-m" "slow" to run it sanitized too.
 LD_PRELOAD="$asan_rt" \
 ASAN_OPTIONS="detect_leaks=0:abort_on_error=1" \
 GGRS_NATIVE_SANITIZE=1 \
 JAX_PLATFORMS=cpu \
 python -m pytest tests/test_session_bank.py tests/test_bank_faults.py \
-    tests/test_obs.py \
+    tests/test_obs.py tests/test_broadcast.py tests/test_replay_journal.py \
     -q -p no:cacheprovider -m "not slow" \
-    -k "not batched_executor and not size_mismatch" "$@"
+    -k "not batched_executor and not size_mismatch and not fused_scrub and not scrub_matches" "$@"

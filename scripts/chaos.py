@@ -17,6 +17,12 @@ Fault classes (all driven through the pool's real tick path):
   blackout      the target's peer goes permanently silent
   malformed     burst of truncated/corrupted datagrams into the target
   fuzz          seeded random junk datagrams into the target
+  spectator     broadcast leg: a hub-fanned match with live viewers and a
+                journal is chaos-killed with its native harvest DEAD; the
+                slot must recover from the journal tail, the viewers must
+                keep following, and the in-bank side matches must stay
+                bit-identical to control (ends with the hub's metrics
+                digest — DESIGN.md §13)
   all           every class, sequentially
 
 Usage:
@@ -38,6 +44,7 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 from ggrs_tpu.chaos import (  # noqa: E402
     MALFORMED_BURST,
     blast_radius_violations,
+    drive_broadcast,
     drive_chaos,
 )
 from ggrs_tpu.net import _native  # noqa: E402
@@ -171,19 +178,95 @@ def verify_leg(name: str, matches: int, ticks: int, seed: int) -> bool:
     return True
 
 
+def verify_broadcast_leg(matches: int, ticks: int, seed: int) -> bool:
+    """The broadcast scenario: chaos-kill a hub-fanned, journaled match
+    whose native harvest is dead; verify journal recovery, viewer
+    continuity, and survivor bit-identity — then print the hub's metrics
+    digest (DESIGN.md §13) instead of discarding it."""
+    import tempfile
+
+    from ggrs_tpu.parallel.host_bank import SLOT_EVICTED, SLOT_NATIVE
+
+    # clamp inside the run: the kill must actually fire and leave room to
+    # observe the recovery, whatever --ticks was passed
+    kill_at = min(max(40, ticks // 3), max(1, ticks - 20))
+
+    def inject(i, ctx):
+        if i == kill_at:
+            ctx["pool"].inject_slot_error(ctx["target"])
+
+    with tempfile.TemporaryDirectory() as tmp:
+        control = drive_broadcast(
+            ticks, use_hub=True, seed=seed, n_spectators=2,
+            n_side_matches=matches,
+            journal_path=f"{tmp}/control.ggjl",
+        )
+        chaos = drive_broadcast(
+            ticks, use_hub=True, seed=seed, n_spectators=2,
+            n_side_matches=matches,
+            journal_path=f"{tmp}/chaos.ggjl",
+            inject=inject, sabotage_harvest=True, scrape_every=8,
+        )
+    pool = chaos["pool"]
+    print("--- spectator ---")
+    print(f"  target slot 0: state={chaos['states'][0]}, "
+          f"frame={chaos['frames'][0]}, ext peer frame="
+          f"{chaos['peer_frame']}, viewers at "
+          f"{[f[-1] for f in chaos['viewer_frames']]}")
+    for f in pool.fault_log(0):
+        print(f"    fault@tick {f.tick}: code={f.code} {f.detail}")
+    violations = []
+    if chaos["states"][0] != SLOT_EVICTED:
+        violations.append(
+            f"target never recovered: state {chaos['states'][0]}"
+        )
+    if not any("journal tail" in f.detail for f in pool.fault_log(0)):
+        violations.append("recovery did not come from the journal")
+    for vf in chaos["viewer_frames"]:
+        if vf[-1] < vf[kill_at] + (ticks - kill_at) // 2:
+            violations.append("a viewer stalled after the kill")
+    for idx in range(1, 1 + 2 * matches):
+        if chaos["states"][idx] != SLOT_NATIVE:
+            violations.append(f"slot {idx} left native")
+        for field in ("reqs", "events"):
+            if chaos[field][idx] != control[field][idx]:
+                violations.append(f"slot {idx}: {field} diverged")
+    for k in range(2 * matches):
+        if chaos["side_wire"][k] != control["side_wire"][k]:
+            violations.append(f"side socket {k}: wire diverged")
+    print("  hub metrics digest:")
+    print(chaos["hub"].metrics_digest())
+    if violations:
+        print("  BROADCAST SCENARIO VIOLATED:")
+        for v in violations:
+            print(f"    {v}")
+        return False
+    print(f"  OK: journal recovery + {2 * matches} surviving slots "
+          "bit-identical to control")
+    return True
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--matches", type=int, default=4,
                     help="in-bank 2-peer matches (default 4 -> B=9 slots)")
     ap.add_argument("--ticks", type=int, default=300)
     ap.add_argument("--seed", type=int, default=3)
-    ap.add_argument("--fault", choices=[*FAULTS, "all"], default="all")
+    ap.add_argument("--fault", choices=[*FAULTS, "spectator", "all"],
+                    default="all")
     args = ap.parse_args()
 
-    names = list(FAULTS) if args.fault == "all" else [args.fault]
+    names = (
+        [*FAULTS, "spectator"] if args.fault == "all" else [args.fault]
+    )
     ok = True
     for name in names:
-        ok &= verify_leg(name, args.matches, args.ticks, args.seed)
+        if name == "spectator":
+            ok &= verify_broadcast_leg(
+                min(args.matches, 2), args.ticks, args.seed
+            )
+        else:
+            ok &= verify_leg(name, args.matches, args.ticks, args.seed)
     print("chaos verdict:", "PASS" if ok else "FAIL")
     return 0 if ok else 1
 

@@ -1,0 +1,454 @@
+"""Broadcast subsystem pins (DESIGN.md §13): native spectator fan-out
+parity against the per-session Python relay, hub-aware bank admission,
+the zero-extra-crossings budget for fan-out + journaling, dynamic viewer
+lifecycle, and supervision interplay (eviction keeps viewers fed; a
+chaos-killed slot recovers from the journal with survivors untouched).
+"""
+
+from __future__ import annotations
+
+import random
+
+import pytest
+
+from ggrs_tpu.chaos import blast_radius_violations, drive_broadcast
+from ggrs_tpu.core import Local, Remote
+from ggrs_tpu.core.config import Config
+from ggrs_tpu.core.types import Disconnected, Spectator
+from ggrs_tpu.net import InMemoryNetwork, _native
+from ggrs_tpu.parallel.host_bank import (
+    HostSessionPool,
+    SLOT_EVICTED,
+    SLOT_NATIVE,
+    _bank_eligible,
+)
+from ggrs_tpu.sessions import SessionBuilder
+
+needs_broadcast = pytest.mark.skipif(
+    _native.broadcast_lib() is None,
+    reason="native broadcast bank unavailable",
+)
+
+FAULTS = dict(loss=0.05, duplicate=0.03, reorder=0.03, latency_ticks=1)
+
+
+@needs_broadcast
+class TestFanOutParityFuzz:
+    @pytest.mark.parametrize("seed", [1, 7, 23])
+    def test_hub_spectator_stream_bit_identical(self, seed):
+        """The headline pin: a hub-fanned spectator's observed
+        frame/input stream — and the host's entire wire byte sequence —
+        is bit-identical to the Python ``P2PSession`` +
+        ``SpectatorSession`` baseline under seeded loss/dup/reorder."""
+        base = drive_broadcast(
+            250, use_hub=False, seed=seed, fault_cfg=dict(FAULTS, seed=seed)
+        )
+        hubd = drive_broadcast(
+            250, use_hub=True, seed=seed, fault_cfg=dict(FAULTS, seed=seed)
+        )
+        assert hubd["host_wire"] == base["host_wire"], (
+            "host wire bytes diverged from the per-session baseline"
+        )
+        assert hubd["viewer_streams"] == base["viewer_streams"]
+        assert hubd["viewer_frames"] == base["viewer_frames"]
+        assert hubd["reqs"][0] == base["reqs"][0]
+        assert hubd["viewer_frames"][0][-1] > 200, "viewer stalled"
+
+    def test_multi_viewer_fan_out(self):
+        """8 viewers on one match, each with an independent ack window:
+        every stream matches the single-viewer reference content."""
+        ctx = drive_broadcast(150, use_hub=True, seed=3, n_spectators=8,
+                              fault_cfg=dict(FAULTS, seed=3))
+        streams = ctx["viewer_streams"]
+        assert len(streams) == 8
+        # all viewers see the same (frame -> inputs) mapping
+        maps = [dict(s) for s in streams]
+        reference = maps[0]
+        assert reference, "no viewer received anything"
+        for k, m in enumerate(maps[1:], 1):
+            shared = set(reference) & set(m)
+            assert shared, f"viewer {k} received nothing in common"
+            for f in shared:
+                assert m[f] == reference[f], f"viewer {k} diverged at {f}"
+        assert all(ctx["viewer_frames"][k][-1] > 100 for k in range(8))
+
+
+@needs_broadcast
+class TestAdmission:
+    def _spectator_builder(self, clock, rng_seed):
+        return (
+            SessionBuilder(Config.for_uint(16))
+            .with_clock(lambda: clock[0])
+            .with_rng(random.Random(rng_seed))
+            .add_player(Local(), 0)
+            .add_player(Remote("P"), 1)
+            .add_player(Spectator("V"), 2)
+        )
+
+    def test_bank_eligible_is_hub_aware(self):
+        clock = [0]
+        b = self._spectator_builder(clock, 1)
+        assert not _bank_eligible(b)                      # hubless: refuse
+        assert _bank_eligible(b, hub_active=True)         # hub: admit
+
+    def test_hubless_spectator_match_falls_back_and_runs(self):
+        """The pre-broadcast behavior is preserved verbatim for hubless
+        callers: the match lands on the Python session (which relays to
+        its spectators itself) and still runs."""
+        clock = [0]
+        net = InMemoryNetwork()
+        pool = HostSessionPool()
+        pool.add_session(self._spectator_builder(clock, 1), net.socket("H"))
+        assert not pool.native_active
+        peer = (
+            SessionBuilder(Config.for_uint(16))
+            .with_clock(lambda: clock[0])
+            .with_rng(random.Random(2))
+            .add_player(Local(), 1)
+            .add_player(Remote("H"), 0)
+        ).start_p2p_session(net.socket("P"))
+        for i in range(30):
+            clock[0] += 16
+            peer.add_local_input(1, i % 16)
+            for r in peer.advance_frame():
+                if type(r).__name__ == "SaveGameState":
+                    r.cell.save(r.frame, None, None)
+            pool.add_local_input(0, 0, i % 16)
+            for reqs in pool.advance_all():
+                for r in reqs:
+                    if type(r).__name__ == "SaveGameState":
+                        r.cell.save(r.frame, None, None)
+        assert pool.current_frame(0) > 20
+
+    def test_hub_makes_spectator_match_native(self):
+        from ggrs_tpu.broadcast import SpectatorHub
+
+        clock = [0]
+        net = InMemoryNetwork()
+        pool = HostSessionPool()
+        SpectatorHub(pool, rng=random.Random(9))
+        pool.add_session(self._spectator_builder(clock, 1), net.socket("H"))
+        assert pool.native_active
+
+
+@needs_broadcast
+class TestCrossingBudget:
+    def test_fanout_and_journal_add_zero_crossings(self, tmp_path):
+        """THE acceptance pin: a bank-hosted match with 8 native-fanned
+        spectators plus an attached journal still runs in the PR 1 + PR 3
+        crossing budget — one bank crossing per pool tick plus one stats
+        crossing per scrape, nothing more."""
+        ctx = drive_broadcast(
+            120, use_hub=True, seed=5, n_spectators=8,
+            journal_path=tmp_path / "match.ggjl", scrape_every=1,
+        )
+        pool = ctx["pool"]
+        assert pool.crossings == 120, "fan-out perturbed the tick budget"
+        assert pool.stat_crossings == 120
+        assert pool.harvests == 0
+        assert ctx["journal"].next_frame > 100, "journal received no frames"
+        # the fan-out actually happened (counters, not just silence)
+        reg = ctx["registry"]
+        total = sum(
+            child.value
+            for fam in reg.families() if fam.name == "ggrs_fanout_datagrams_total"
+            for _, child in fam.samples()
+        )
+        assert total > 100 * 8, "native fan-out sent almost nothing"
+
+
+@needs_broadcast
+class TestViewerLifecycle:
+    def test_dynamic_attach_before_frame0_and_detach(self):
+        from ggrs_tpu.broadcast import SpectatorHub
+        from ggrs_tpu.core.errors import (
+            InvalidRequest,
+            NotSynchronized,
+            PredictionThreshold,
+        )
+
+        clock = [0]
+        net = InMemoryNetwork(latency_ticks=1)
+        cfg = Config.for_uint(16)
+        hb = (
+            SessionBuilder(cfg)
+            .with_clock(lambda: clock[0])
+            .with_rng(random.Random(1))
+            .add_player(Local(), 0)
+            .add_player(Remote("P"), 1)
+        )
+        peer = (
+            SessionBuilder(cfg)
+            .with_clock(lambda: clock[0])
+            .with_rng(random.Random(2))
+            .add_player(Local(), 1)
+            .add_player(Remote("H"), 0)
+        ).start_p2p_session(net.socket("P"))
+        viewer = (
+            SessionBuilder(cfg)
+            .with_clock(lambda: clock[0])
+            .with_rng(random.Random(3))
+        ).start_spectator_session("H", net.socket("V"))
+        pool = HostSessionPool()
+        hub = SpectatorHub(pool, rng=random.Random(4))
+        pool.add_session(hb, net.socket("H"))
+        assert pool.native_active
+        hub.attach(0, "V")  # dynamic join before frame 0
+        assert len(hub.spectators(0)) == 1
+
+        def tick(i):
+            clock[0] += 16
+            peer.add_local_input(1, i % 16)
+            for r in peer.advance_frame():
+                if type(r).__name__ == "SaveGameState":
+                    r.cell.save(r.frame, None, None)
+            pool.add_local_input(0, 0, i % 16)
+            for reqs in pool.advance_all():
+                for r in reqs:
+                    if type(r).__name__ == "SaveGameState":
+                        r.cell.save(r.frame, None, None)
+            try:
+                viewer.advance_frame()
+            except (NotSynchronized, PredictionThreshold):
+                pass
+            net.tick()
+
+        for i in range(40):
+            tick(i)
+        assert viewer.current_frame > 20, "dynamic viewer never followed"
+        # late joins are refused (the journal is the catch-up story)
+        with pytest.raises(InvalidRequest):
+            hub.attach(0, "LATE")
+        frozen = viewer.current_frame
+        hub.detach(0, "V")
+        for i in range(40, 90):
+            tick(i)
+        assert pool.current_frame(0) > 70, "detach perturbed the match"
+        assert viewer.current_frame <= frozen + 12, (
+            "detached viewer kept receiving the stream"
+        )
+
+    def test_late_attach_refused_on_virgin_slot(self, tmp_path):
+        """A slot that never had a spectator or journal keeps its fan-out
+        cursor at 0 while the watermark discard eats the early inputs —
+        a mid-match attach (viewer OR journal tap) must be refused, not
+        admitted and then fault the whole slot."""
+        from ggrs_tpu.broadcast import MatchJournal, SpectatorHub
+        from ggrs_tpu.core.errors import InvalidRequest
+
+        clock = [0]
+        net = InMemoryNetwork(latency_ticks=1)
+        cfg = Config.for_uint(16)
+        hb = (
+            SessionBuilder(cfg)
+            .with_clock(lambda: clock[0])
+            .with_rng(random.Random(1))
+            .add_player(Local(), 0)
+            .add_player(Remote("P"), 1)
+        )
+        peer = (
+            SessionBuilder(cfg)
+            .with_clock(lambda: clock[0])
+            .with_rng(random.Random(2))
+            .add_player(Local(), 1)
+            .add_player(Remote("H"), 0)
+        ).start_p2p_session(net.socket("P"))
+        pool = HostSessionPool()
+        hub = SpectatorHub(pool, rng=random.Random(3))
+        pool.add_session(hb, net.socket("H"))
+        assert pool.native_active
+        for i in range(60):
+            clock[0] += 16
+            peer.add_local_input(1, i % 16)
+            for r in peer.advance_frame():
+                if type(r).__name__ == "SaveGameState":
+                    r.cell.save(r.frame, None, None)
+            pool.add_local_input(0, 0, i % 16)
+            for reqs in pool.advance_all():
+                for r in reqs:
+                    if type(r).__name__ == "SaveGameState":
+                        r.cell.save(r.frame, None, None)
+            net.tick()
+        with pytest.raises(InvalidRequest):
+            hub.attach(0, "LATE")
+        with pytest.raises(InvalidRequest):
+            hub.attach_journal(0, MatchJournal(
+                tmp_path / "late.ggjl", 2, cfg.native_input_size
+            ))
+        # the refusals left the slot untouched
+        for i in range(60, 80):
+            clock[0] += 16
+            peer.add_local_input(1, i % 16)
+            for r in peer.advance_frame():
+                if type(r).__name__ == "SaveGameState":
+                    r.cell.save(r.frame, None, None)
+            pool.add_local_input(0, 0, i % 16)
+            for reqs in pool.advance_all():
+                for r in reqs:
+                    if type(r).__name__ == "SaveGameState":
+                        r.cell.save(r.frame, None, None)
+            net.tick()
+        assert pool.slot_state(0) == SLOT_NATIVE
+        assert pool.current_frame(0) > 60
+
+    def test_stuck_viewer_disconnects_match_unharmed(self):
+        """A viewer that never acks: the 128-unacked rule fires natively,
+        the hub surfaces Disconnected and detaches the viewer via ctrl
+        op, and the match itself never misses a frame."""
+        from ggrs_tpu.broadcast import SpectatorHub
+
+        clock = [0]
+        net = InMemoryNetwork(latency_ticks=1)
+        cfg = Config.for_uint(16)
+        hb = (
+            SessionBuilder(cfg)
+            .with_clock(lambda: clock[0])
+            .with_rng(random.Random(1))
+            .add_player(Local(), 0)
+            .add_player(Remote("P"), 1)
+            .add_player(Spectator("MUTE"), 2)
+        )
+        peer = (
+            SessionBuilder(cfg)
+            .with_clock(lambda: clock[0])
+            .with_rng(random.Random(2))
+            .add_player(Local(), 1)
+            .add_player(Remote("H"), 0)
+        ).start_p2p_session(net.socket("P"))
+        # "MUTE" never drains its socket: it acks nothing, ever
+        pool = HostSessionPool()
+        hub = SpectatorHub(pool, rng=random.Random(4))
+        pool.add_session(hb, net.socket("H"))
+        assert pool.native_active
+        for i in range(180):
+            clock[0] += 16
+            peer.add_local_input(1, i % 16)
+            for r in peer.advance_frame():
+                if type(r).__name__ == "SaveGameState":
+                    r.cell.save(r.frame, None, None)
+            pool.add_local_input(0, 0, i % 16)
+            for reqs in pool.advance_all():
+                for r in reqs:
+                    if type(r).__name__ == "SaveGameState":
+                        r.cell.save(r.frame, None, None)
+            net.tick()
+        events = hub.events(0)
+        assert any(isinstance(e, Disconnected) for e in events), (
+            "stuck viewer never surfaced Disconnected"
+        )
+        assert not hub.spectators(0)[0]["running"]
+        assert pool.slot_state(0) == SLOT_NATIVE
+        assert pool.current_frame(0) > 150, "stuck viewer stalled the match"
+
+
+@needs_broadcast
+class TestSupervisionInterplay:
+    def test_eviction_keeps_viewer_fed(self):
+        """A native fault mid-match: the slot evicts to the Python relay
+        and the viewer KEEPS receiving the stream across the transition
+        (the fan-out window rides the harvest's pending dumps)."""
+        def inject(i, ctx):
+            if i == 80:
+                ctx["pool"].inject_slot_error(0)
+
+        ctx = drive_broadcast(240, use_hub=True, seed=11, inject=inject)
+        assert ctx["states"][0] == SLOT_EVICTED
+        frames = ctx["viewer_frames"][0]
+        assert frames[-1] > frames[80] + 100, (
+            "viewer stalled after the host slot evicted"
+        )
+
+    def test_chaos_kill_recovers_from_journal_survivors_untouched(
+        self, tmp_path
+    ):
+        """The acceptance scenario: kill a NATIVE slot mid-match with its
+        harvest unavailable (dead native state) — the slot recovers from
+        the journal tail, the match and its viewer continue, and the
+        unrelated in-bank matches are bit-identical to a fault-free
+        control leg."""
+        def inject(i, ctx):
+            if i == 100:
+                ctx["pool"].inject_slot_error(0)
+
+        control = drive_broadcast(
+            300, use_hub=True, seed=17, n_side_matches=2,
+            journal_path=tmp_path / "control.ggjl",
+        )
+        chaos = drive_broadcast(
+            300, use_hub=True, seed=17, n_side_matches=2,
+            journal_path=tmp_path / "chaos.ggjl",
+            inject=inject, sabotage_harvest=True,
+        )
+        assert chaos["states"][0] == SLOT_EVICTED
+        assert any(
+            "journal tail" in f.detail
+            for f in chaos["pool"].fault_log(0)
+        ), "recovery did not come from the journal"
+        # the journal stays a VALID artifact across the eviction: the
+        # evicted relay's tap re-encodes with the session config, so the
+        # post-eviction frames parse and extend well past the kill tick
+        from ggrs_tpu.broadcast import read_journal
+
+        chaos["journal"].close()
+        parsed = read_journal(tmp_path / "chaos.ggjl")
+        assert not parsed["truncated"]
+        assert parsed["frames"][-1][0] > 200
+        # the recovered match keeps pace with its external peer
+        assert chaos["frames"][0] > chaos["peer_frame"] - 20
+        assert chaos["viewer_frames"][0][-1] > 250
+        # survivors: bit-identical wire/requests/events vs control
+        violations = []
+        for idx in range(1, 5):
+            if chaos["states"][idx] != SLOT_NATIVE:
+                violations.append(f"slot {idx} left native")
+            for field in ("reqs", "events"):
+                if chaos[field][idx] != control[field][idx]:
+                    violations.append(f"slot {idx}: {field} diverged")
+        for k in range(4):
+            if chaos["side_wire"][k] != control["side_wire"][k]:
+                violations.append(f"side socket {k}: wire diverged")
+        assert not violations, violations
+
+
+@needs_broadcast
+@pytest.mark.slow
+class TestBroadcastSoak:
+    def test_long_fanout_soak_under_faults(self, tmp_path):
+        """Slow soak (run with ``-m slow``): 2.5k ticks of hub fan-out to
+        8 viewers under loss/dup/reorder with a journal attached — no
+        quarantine, no viewer left behind, journal contiguous."""
+        ctx = drive_broadcast(
+            2500, use_hub=True, seed=29, n_spectators=8,
+            fault_cfg=dict(seed=29, loss=0.03, duplicate=0.02,
+                           reorder=0.02, latency_ticks=1),
+            journal_path=tmp_path / "soak.ggjl", journal_fsync=256,
+            scrape_every=16,
+        )
+        assert ctx["states"][0] == SLOT_NATIVE, "soak quarantined the slot"
+        assert ctx["pool"].crossings == 2500
+        assert all(f[-1] > 2300 for f in ctx["viewer_frames"])
+        journal = ctx["journal"]
+        journal.close()
+        from ggrs_tpu.broadcast import read_journal
+
+        parsed = read_journal(tmp_path / "soak.ggjl")
+        assert not parsed["gaps"] and parsed["closed"]
+        assert len(parsed["frames"]) > 2300
+
+
+@needs_broadcast
+class TestMetricsObservability:
+    def test_spectator_gauges_and_digest(self, tmp_path):
+        ctx = drive_broadcast(
+            100, use_hub=True, seed=2, n_spectators=2,
+            journal_path=tmp_path / "m.ggjl", scrape_every=5,
+        )
+        reg = ctx["registry"]
+        assert reg.value("ggrs_spectators_attached", slot="0") == 2
+        assert (reg.value("ggrs_journal_frames_total") or 0) > 80
+        assert (reg.value("ggrs_fanout_bytes_total", slot="0") or 0) > 0
+        lag0 = reg.value("ggrs_spectator_catchup_lag", slot="0",
+                         spectator="0")
+        assert lag0 is not None and lag0 < 30
+        digest = ctx["hub"].metrics_digest()
+        assert "viewers live" in digest and "journal:" in digest
