@@ -1474,12 +1474,13 @@ def run_flagship() -> None:
     )
 
 
-def _bank_matches_setup(n_matches: int, metrics=None):
+def _bank_matches_setup(n_matches: int, metrics=None, tracer=None):
     """The host-bank form of ``_match_population``: the SAME builders /
     sockets / schedules driven through ``parallel.HostSessionPool`` instead
     of per-session P2PSessions, fulfilled by the same
     ``BatchedRequestExecutor``.  ``metrics``: optional isolated
-    ``ggrs_tpu.obs.Registry`` for the obs-budget measurements."""
+    ``ggrs_tpu.obs.Registry`` for the obs-budget measurements; ``tracer``:
+    optional ``ggrs_tpu.obs.Tracer`` for the trace-overhead pricing."""
     from ggrs_tpu.parallel import BatchedRequestExecutor, HostSessionPool
 
     game = BoxGame(2)
@@ -1487,9 +1488,12 @@ def _bank_matches_setup(n_matches: int, metrics=None):
     def to_arr(pairs):
         return np.asarray([p[0] for p in pairs], np.uint8)
 
-    host = HostSessionPool() if metrics is None else HostSessionPool(
-        metrics=metrics
-    )
+    kwargs = {}
+    if metrics is not None:
+        kwargs["metrics"] = metrics
+    if tracer is not None:
+        kwargs["tracer"] = tracer
+    host = HostSessionPool(**kwargs)
     schedules = []
     for b, sock, sched in _match_population(n_matches):
         host.add_session(b, sock)
@@ -1674,6 +1678,44 @@ def run_host_bank() -> None:
             f"per scrape; target <5%)",
             5.0 / overhead_pct if overhead_pct > 0 else 99.0,
             obs=scraped[1],
+        )
+
+    # ---- 1c. the trace budget (DESIGN.md §14): p99 with a live Tracer
+    # (python tick/crossing/slot spans + the native in-crossing phase
+    # timers, armed) vs the shared NULL_TRACER, at the B=64 capacity
+    # point — priced exactly like the scrape overhead above ----
+    from ggrs_tpu.obs import Tracer
+
+    def trace_leg(trace: bool):
+        reg = Registry()
+        tracer = Tracer(capacity=1 << 14) if trace else None
+        host, schedules, pool = _bank_matches_setup(
+            64, metrics=reg, tracer=tracer
+        )
+        if not host.native_active:
+            return None
+        armed = host._trace_native
+        tick = _bank_tick_fn(host, schedules, pool)
+        for _ in range(16):
+            tick()
+        p = _best_tick_percentiles(tick, 200)
+        del host, schedules, pool
+        return p, armed
+
+    t_plain = trace_leg(False)
+    t_traced = trace_leg(True)
+    if t_plain is not None and t_traced is not None:
+        p99_plain, p99_traced = t_plain[0][1], t_traced[0][1]
+        overhead_pct = (
+            (p99_traced - p99_plain) / p99_plain * 100.0 if p99_plain else 0.0
+        )
+        emit(
+            "host_bank_trace_overhead_pct", overhead_pct,
+            f"p99 delta with tracing on (python spans + native phase timers "
+            f"{'armed' if t_traced[1] else 'UNAVAILABLE'}), B=64 matches, "
+            f"strict fence (traced {p99_traced:.2f} ms vs plain "
+            f"{p99_plain:.2f} ms; zero extra crossings; target <5%)",
+            5.0 / overhead_pct if overhead_pct > 0 else 99.0,
         )
 
     # ---- 2. capacity ramp with one-crossing host + one-dispatch device ----

@@ -154,6 +154,12 @@ class SpectatorHub:
         the journal's crash-recovery seam; fallback pools graft a
         :class:`JournalTap` onto the Python session."""
         pool = self.pool
+        # one timeline per pool: the journal's fsync spans join the pool
+        # trace, and the journal tail feeds the slot's DesyncReports
+        tracer = getattr(pool, "tracer", None)
+        if tracer is not None and tracer.enabled:
+            if not journal._tracer.enabled:
+                journal._tracer = tracer
         if pool.native_active:
             self._check_slot_attachable(index)
         if pool.native_active and pool.slot_state(index) == "native":
@@ -216,6 +222,12 @@ class SpectatorHub:
         """Live view of match ``index``'s viewers: address, liveness, ack
         watermark, catchup lag (frames broadcast but unacked)."""
         return self.pool.spectator_states(index)
+
+    def desync_report(self, index: int):
+        """The pool's forensic report for match ``index`` (built when a
+        desync-class fault quarantined the slot; its journal-tail section
+        comes from this hub's attached journal), or None."""
+        return self.pool.desync_report(index)
 
     def metrics_digest(self) -> str:
         """One-paragraph summary for chaos scenarios and operators: per-

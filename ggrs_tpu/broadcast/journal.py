@@ -95,6 +95,7 @@ class MatchJournal:
         fsync_every: int = 0,
         tail_window: int = 128,
         metrics: Optional[Registry] = None,
+        tracer=None,
     ) -> None:
         self.path = os.fspath(path)
         self.num_players = num_players
@@ -103,6 +104,11 @@ class MatchJournal:
         self._fsync_every = fsync_every
         self._since_fsync = 0
         self._closed = False
+        # tracing (DESIGN.md §14): fsync stalls show up as journal.fsync
+        # spans on the pool timeline — the classic hidden tick-p99 spike
+        from ..obs.trace import NULL_TRACER
+
+        self._tracer = tracer if tracer is not None else NULL_TRACER
         # crash-recovery tail: (frame, flags, blob), contiguous newest tail
         self.tail: deque = deque(maxlen=tail_window)
         # per-player connect tracking (recovery's local_disc/local_last)
@@ -202,9 +208,10 @@ class MatchJournal:
     def flush(self, fsync: bool = False) -> None:
         self._f.flush()
         if fsync:
-            t0 = time.perf_counter()
-            os.fsync(self._f.fileno())
-            self._m_fsync.observe(time.perf_counter() - t0)
+            with self._tracer.span("journal.fsync", cat="io"):
+                t0 = time.perf_counter()
+                os.fsync(self._f.fileno())
+                self._m_fsync.observe(time.perf_counter() - t0)
             self._since_fsync = 0
 
     def close(self) -> None:

@@ -20,8 +20,11 @@ from typing import Any, Callable, Dict, List, Optional
 
 from .core import Local, Remote
 from .core.config import Config
+from .core.types import DesyncDetected, DesyncDetection
 from .net import InMemoryNetwork
+from .obs.recorder import FlightRecorder
 from .obs.registry import Registry
+from .obs.trace import Tracer
 from .parallel.host_bank import HostSessionPool, SLOT_NATIVE
 from .sessions import SessionBuilder
 
@@ -104,6 +107,7 @@ def drive_chaos(
     retire: bool = False,
     fault_cfg: Optional[Dict[str, Any]] = None,
     metrics: Optional[Registry] = None,
+    tracer: Optional[Tracer] = None,
 ) -> Dict[str, Any]:
     """Build the chaos topology and drive ``ticks`` pool ticks.
 
@@ -113,7 +117,10 @@ def drive_chaos(
     bit-identical run — the control/chaos comparison contract; metrics
     must never perturb it (``metrics=Registry(enabled=False)`` runs the
     same pool with the obs layer compiled out, and tests pin the wire
-    bytes identical either way).  The run's registry and a final
+    bytes identical either way).  ``tracer`` rides the same contract: a
+    live ``Tracer`` arms the native in-crossing phase timers, and the
+    trace suite pins wire bytes bit-identical tracer on vs off with zero
+    extra tick crossings.  The run's registry and a final
     ``pool.scrape()`` snapshot land in the returned ctx (``registry``,
     ``scrape``).
     """
@@ -121,7 +128,8 @@ def drive_chaos(
     clock = [0]
     nets = []
     registry = metrics if metrics is not None else Registry()
-    pool = HostSessionPool(retire_dead_matches=retire, metrics=registry)
+    pool = HostSessionPool(retire_dead_matches=retire, metrics=registry,
+                           tracer=tracer)
     socks = []
     for m in range(n_matches):
         cfg = dict(fault_cfg or {"latency_ticks": 1})
@@ -186,8 +194,84 @@ def drive_chaos(
         frames=[pool.current_frame(i) for i in range(n)],
         registry=registry,
         scrape=pool.scrape(),
+        tracer=tracer,
     )
     return ctx
+
+
+def drive_desync_forensics(
+    ticks: int,
+    fault_frame: int,
+    seed: int = 0,
+    interval: int = 1,
+    fault_cfg: Optional[Dict[str, Any]] = None,
+    tracer: Optional[Tracer] = None,
+) -> Dict[str, Any]:
+    """The reference desync-detection path under a seeded state fault: two
+    Python ``P2PSession`` peers with ``DesyncDetection.on(interval)``,
+    where peer B's simulation silently diverges from frame ``fault_frame``
+    on (its saves carry perturbed checksums from that frame forward — the
+    classic nondeterminism bug).  The checksum interval traffic then turns
+    the divergence into ``DesyncDetected`` events on both ends, and the
+    forensics layer (DESIGN.md §14) synthesizes ``DesyncReport``s whose
+    first-divergent-frame bisection should land exactly on ``fault_frame``
+    when ``interval == 1``.
+
+    Flight recorders and the optional ``tracer`` are attached to both
+    sessions; the returned ctx carries both sessions (``a``, ``b``), their
+    drained events, and both report lists (``reports_a``, ``reports_b``).
+    """
+    base = seed * 1000
+    clock = [0]
+    cfg = dict(fault_cfg or {"latency_ticks": 1})
+    cfg.setdefault("seed", base + 1)
+    net = InMemoryNetwork(**cfg)
+    sessions = []
+    recorders = []
+    names = ("A", "B")
+    for me in (0, 1):
+        builder = two_peer_builder(
+            clock, base + 7 + me, me, names[1 - me]
+        ).with_desync_detection_mode(DesyncDetection.on(interval))
+        s = builder.start_p2p_session(net.socket(names[me]))
+        rec = FlightRecorder()
+        s.attach_forensics(recorder=rec, tracer=tracer)
+        sessions.append(s)
+        recorders.append(rec)
+
+    def checksum_for(me: int, frame: int) -> int:
+        # deterministic "state digest": both peers agree until B's
+        # simulation diverges at fault_frame
+        if me == 1 and frame >= fault_frame:
+            return (frame * 2654435761 + 1) & 0xFFFFFFFF
+        return (frame * 2654435761) & 0xFFFFFFFF
+
+    events: List[List[Any]] = [[], []]
+    for i in range(ticks):
+        clock[0] += 16
+        for me, s in enumerate(sessions):
+            s.add_local_input(me, (i * (me + 3)) % 16)
+            for r in s.advance_frame():
+                k = type(r).__name__
+                if k == "SaveGameState":
+                    r.cell.save(r.frame, r.frame,
+                                checksum_for(me, r.frame))
+                elif k == "LoadGameState":
+                    assert r.cell.data() is not None
+            events[me].extend(s.events())
+        net.tick()
+    desyncs = [
+        [e for e in evs if isinstance(e, DesyncDetected)] for evs in events
+    ]
+    return dict(
+        a=sessions[0], b=sessions[1],
+        recorders=recorders,
+        events=events, desyncs=desyncs,
+        reports_a=sessions[0].desync_reports,
+        reports_b=sessions[1].desync_reports,
+        fault_frame=fault_frame,
+        tracer=tracer,
+    )
 
 
 def drive_broadcast(

@@ -453,6 +453,14 @@ def _load() -> Optional[ctypes.CDLL]:
                 lib.ggrs_bank_set_confirmed_stream.argtypes = [
                     ctypes.c_void_p, ctypes.c_int64, ctypes.c_int,
                 ]
+            if hasattr(lib, "ggrs_bank_set_timing"):
+                # in-crossing phase timers (tracing, DESIGN.md §14);
+                # absent on a prebuilt pre-trace .so — the pool then runs
+                # Python-side spans only, with no native timing tail
+                lib.ggrs_bank_set_timing.restype = ctypes.c_int
+                lib.ggrs_bank_set_timing.argtypes = [
+                    ctypes.c_void_p, ctypes.c_int,
+                ]
         _lib = lib
         return _lib
 
@@ -492,6 +500,14 @@ BANK_ERR_SPEC_STREAM = -77  # confirmed-input fan-out / journal tap failed
 EP_STAT_FIELDS = (
     "emits", "emit_bytes", "acks", "datagrams", "new_frames", "drops",
     "fallbacks",
+)
+
+# in-crossing phase order (session_bank.cpp BankPhase; the timing tails on
+# the tick and stats outputs carry one u64 of nanoseconds per entry, in
+# this order, with the count byte last)
+BANK_PHASES = (
+    "inbound", "timers", "commit", "rollback", "outbound", "fanout",
+    "emit", "other",
 )
 
 BANK_ERR_NAMES = {

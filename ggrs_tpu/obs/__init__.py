@@ -9,7 +9,12 @@ Three dependency-free pieces:
   recent events (state changes, faults, rollback decisions, wire
   digests) dumped on quarantine/eviction for post-mortems.
 - :mod:`exporters` — Prometheus text exposition, JSON snapshots, and a
-  stdlib HTTP scrape endpoint.
+  stdlib HTTP scrape endpoint (``/metrics``, ``/healthz``, ``/trace``).
+- :mod:`trace` — the span tracer (DESIGN.md §14): tick → crossing → slot
+  spans in a bounded ring with Chrome/Perfetto trace-event export;
+  ``Tracer(enabled=False)`` compiles the layer out.
+- :mod:`forensics` — desync post-mortems: first-divergent-frame bisection
+  over shared checksum histories and the :class:`DesyncReport` artifact.
 
 The bank-side numbers behind these come from the native stat harvest:
 ``HostSessionPool.scrape()`` dumps every slot's protocol/sync counters
@@ -40,8 +45,15 @@ from .registry import (
     Registry,
     default_registry,
 )
-from .recorder import FlightRecorder
+from .recorder import ChecksumHistory, FlightRecorder
+from .trace import NULL_TRACER, Tracer
+from .forensics import (
+    DesyncReport,
+    build_desync_report,
+    first_divergent_frame,
+)
 from .exporters import (
+    MetricsHTTPServer,
     MetricsServer,
     json_snapshot,
     prometheus_text,
@@ -49,14 +61,21 @@ from .exporters import (
 )
 
 __all__ = [
+    "ChecksumHistory",
     "Counter",
     "DEFAULT",
+    "DesyncReport",
     "FlightRecorder",
     "Gauge",
     "Histogram",
+    "MetricsHTTPServer",
     "MetricsServer",
+    "NULL_TRACER",
     "Registry",
+    "Tracer",
+    "build_desync_report",
     "default_registry",
+    "first_divergent_frame",
     "json_snapshot",
     "prometheus_text",
     "start_http_server",

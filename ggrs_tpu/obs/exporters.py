@@ -1,5 +1,5 @@
 """Exporters for the metrics registry: Prometheus text exposition, JSON
-snapshots, and a stdlib HTTP endpoint (DESIGN.md §12).
+snapshots, and a stdlib HTTP endpoint (DESIGN.md §12, §14).
 
 The exporters only *read* — they never drive the pool.  Bank-side gauges
 refresh when the driving thread calls ``HostSessionPool.scrape()`` (one
@@ -7,18 +7,28 @@ ctypes crossing for the whole bank); the HTTP server then serves whatever
 the last scrape left in the registry.  Serving and scraping are split
 deliberately: sessions are single-threaded (the Send-not-Sync contract),
 so an HTTP thread must never reach into the bank itself.
+
+Endpoints:
+
+- ``/metrics`` — Prometheus text, ``/metrics.json`` — the JSON snapshot;
+- ``/healthz`` — liveness plus last-tick age (a ``health`` callable
+  returning the driving loop's last-tick ``time.monotonic()`` stamp, e.g.
+  ``lambda: pool.last_tick_at``; 503 when the loop has gone stale);
+- ``/trace`` — the attached :class:`~ggrs_tpu.obs.trace.Tracer`'s current
+  window as Chrome trace-event JSON (save it, open in chrome://tracing).
 """
 
 from __future__ import annotations
 
 import json
 import threading
+import time
 from typing import Any, Dict, Optional
 
 from .registry import Registry
 
 __all__ = ["prometheus_text", "json_snapshot", "start_http_server",
-           "MetricsServer"]
+           "MetricsServer", "MetricsHTTPServer"]
 
 
 def _escape_label(value: str) -> str:
@@ -102,28 +112,59 @@ def json_snapshot(registry: Registry) -> Dict[str, Any]:
 
 class MetricsServer:
     """Minimal scrape endpoint over ``http.server``: ``/metrics`` serves
-    the Prometheus text format, ``/metrics.json`` the JSON snapshot.
+    the Prometheus text format, ``/metrics.json`` the JSON snapshot,
+    ``/healthz`` liveness + last-tick age, ``/trace`` the tracer window.
     Daemon-threaded; ``close()`` shuts it down.  Reads are GIL-safe
     against concurrent increments (plain attribute reads), so no
-    coordination with the driving thread is needed."""
+    coordination with the driving thread is needed.
+
+    ``health``: optional callable returning the driving loop's last-tick
+    timestamp on the ``time.monotonic()`` clock (or None before the first
+    tick).  ``/healthz`` reports 200 with the age while it stays under
+    ``stale_after`` seconds, 503 once the loop has gone quiet — the
+    pageable "pool wedged" signal.  ``tracer``: optional
+    :class:`~ggrs_tpu.obs.trace.Tracer` served on ``/trace``.
+    """
 
     def __init__(self, registry: Registry, port: int = 0,
-                 addr: str = "127.0.0.1") -> None:
+                 addr: str = "127.0.0.1", tracer: Any = None,
+                 health: Any = None, stale_after: float = 5.0) -> None:
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        def healthz_body() -> tuple:
+            age = None
+            if health is not None:
+                last = health()
+                if last is not None:
+                    age = max(0.0, time.monotonic() - last)
+            ok = age is None or age <= stale_after
+            body = json.dumps({
+                "ok": ok,
+                "last_tick_age_s": age,
+                "stale_after_s": stale_after if health is not None else None,
+            }).encode()
+            return (200 if ok else 503), body
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(h) -> None:  # noqa: N805 - handler convention
+                status = 200
                 if h.path.startswith("/metrics.json"):
                     body = json.dumps(json_snapshot(registry)).encode()
                     ctype = "application/json"
                 elif h.path.startswith("/metrics"):
                     body = prometheus_text(registry).encode()
                     ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif h.path.startswith("/healthz"):
+                    status, body = healthz_body()
+                    ctype = "application/json"
+                elif h.path.startswith("/trace") and tracer is not None:
+                    body = json.dumps(tracer.chrome_trace()).encode()
+                    ctype = "application/json"
                 else:
                     h.send_response(404)
                     h.end_headers()
                     return
-                h.send_response(200)
+                h.send_response(status)
                 h.send_header("Content-Type", ctype)
                 h.send_header("Content-Length", str(len(body)))
                 h.end_headers()
@@ -147,8 +188,17 @@ class MetricsServer:
         self._thread.join(timeout=5)
 
 
+# the name the quickstarts use; MetricsServer predates the /healthz and
+# /trace endpoints and stays as an alias
+MetricsHTTPServer = MetricsServer
+
+
 def start_http_server(registry: Registry, port: int = 0,
-                      addr: str = "127.0.0.1") -> MetricsServer:
+                      addr: str = "127.0.0.1", tracer: Any = None,
+                      health: Any = None,
+                      stale_after: float = 5.0) -> MetricsServer:
     """Serve ``registry`` on ``http://addr:port/metrics`` (port 0 picks a
-    free one; read it back from the returned server's ``.port``)."""
-    return MetricsServer(registry, port=port, addr=addr)
+    free one; read it back from the returned server's ``.port``).  Pass
+    ``tracer=`` / ``health=`` to light up ``/trace`` and ``/healthz``."""
+    return MetricsServer(registry, port=port, addr=addr, tracer=tracer,
+                         health=health, stale_after=stale_after)

@@ -21,10 +21,10 @@ session behavior.
 from __future__ import annotations
 
 from collections import deque
-from typing import Any, Deque, List, Tuple
+from typing import Any, Deque, Dict, List, Tuple
 
-__all__ = ["FlightRecorder", "EV_STATE", "EV_FAULT", "EV_ROLLBACK",
-           "EV_WIRE", "EV_EVICT"]
+__all__ = ["ChecksumHistory", "FlightRecorder", "EV_STATE", "EV_FAULT",
+           "EV_ROLLBACK", "EV_WIRE", "EV_EVICT", "EV_DESYNC"]
 
 # event kinds (free-form strings are allowed too; these are the ones the
 # pool emits and the chaos summaries group by)
@@ -33,16 +33,76 @@ EV_FAULT = "fault"        # a SlotFault landed
 EV_ROLLBACK = "rollback"  # the slot executed a rollback (load op)
 EV_WIRE = "wire"          # outbound datagram digest (crc32, length)
 EV_EVICT = "evict"        # eviction attempt / outcome
+EV_DESYNC = "desync"      # a checksum mismatch / desync-class fault landed
+
+
+class ChecksumHistory:
+    """Bounded per-frame checksum window (desync forensics, DESIGN.md §14).
+
+    The reference's desync detection compares one frame at a time and
+    forgets; the first-divergent-frame bisection needs a *window* of
+    recent (frame, checksum) pairs from both ends.  This is that window:
+    a dict bounded to the newest ``capacity`` distinct frames.
+    """
+
+    __slots__ = ("_map", "_order", "capacity")
+
+    def __init__(self, capacity: int = 256) -> None:
+        self.capacity = capacity
+        self._map: Dict[int, int] = {}
+        self._order: Deque[int] = deque()
+
+    def record(self, frame: int, checksum: int) -> None:
+        if frame not in self._map:
+            self._order.append(frame)
+            while len(self._order) > self.capacity:
+                self._map.pop(self._order.popleft(), None)
+        self._map[frame] = checksum
+
+    def get(self, frame: int):
+        return self._map.get(frame)
+
+    def items(self) -> Dict[int, int]:
+        """A snapshot copy, safe to keep after the session is gone."""
+        return dict(self._map)
+
+    def frames(self) -> List[int]:
+        return sorted(self._map)
+
+    def __len__(self) -> int:
+        return len(self._map)
+
+    def __contains__(self, frame: int) -> bool:
+        return frame in self._map
 
 
 class FlightRecorder:
     """Bounded event ring for one pool slot."""
 
-    __slots__ = ("_ring", "recorded")
+    __slots__ = ("_ring", "recorded", "checksums", "remote_checksums")
 
-    def __init__(self, capacity: int = 256) -> None:
+    def __init__(self, capacity: int = 256,
+                 checksum_window: int = 256) -> None:
         self._ring: Deque[Tuple[int, str, Any]] = deque(maxlen=capacity)
         self.recorded = 0  # total ever recorded (ring drops the oldest)
+        # desync forensics (DESIGN.md §14): the local per-frame checksum
+        # window plus one window per remote peer, fed from the desync
+        # detection interval traffic — the bisection inputs
+        self.checksums = ChecksumHistory(checksum_window)
+        self.remote_checksums: Dict[Any, ChecksumHistory] = {}
+
+    def record_checksum(self, frame: int, checksum: int,
+                        addr: Any = None) -> None:
+        """Record one per-frame checksum: local when ``addr`` is None,
+        else into the peer's window."""
+        if addr is None:
+            self.checksums.record(frame, checksum)
+        else:
+            hist = self.remote_checksums.get(addr)
+            if hist is None:
+                hist = ChecksumHistory(self.checksums.capacity)
+                self.remote_checksums[addr] = hist
+            hist.record(frame, checksum)
 
     def record(self, tick: int, kind: str, detail: Any = "") -> None:
         self._ring.append((tick, kind, detail))

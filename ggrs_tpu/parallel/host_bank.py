@@ -70,6 +70,7 @@ import ctypes
 import os
 import random
 import struct
+import time
 import zlib
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
@@ -106,6 +107,7 @@ from ..net.protocol import (
 )
 from ..net.stats import NetworkStats
 from ..obs.recorder import (
+    EV_DESYNC,
     EV_EVICT,
     EV_FAULT,
     EV_ROLLBACK,
@@ -114,6 +116,8 @@ from ..obs.recorder import (
     FlightRecorder,
 )
 from ..obs.registry import Registry, default_registry
+from ..obs.trace import NULL_TRACER, Tracer
+from ..obs.forensics import DesyncReport, build_desync_report
 from ..utils.tracing import get_logger, trace_span
 from ..sessions.p2p import (
     MAX_EVENT_QUEUE_SIZE,
@@ -174,6 +178,16 @@ def _uvarint_len(v: int) -> int:
         v >>= 7
         n += 1
     return n
+
+
+def _phase_names(n_ph: int):
+    """The ``n_ph`` phase names for a timing tail: ``_native.BANK_PHASES``
+    padded with generic names when the loaded library is newer than this
+    driver (shared by the tick-tail and stats-tail parsers)."""
+    names = _native.BANK_PHASES
+    if n_ph <= len(names):
+        return names[:n_ph]
+    return names + tuple(f"phase{i}" for i in range(len(names), n_ph))
 
 
 def _bank_eligible(builder, hub_active: bool = False) -> bool:
@@ -322,7 +336,8 @@ class HostSessionPool:
 
     def __init__(self, retire_dead_matches: bool = False,
                  metrics: Optional[Registry] = None,
-                 flight_recorder_size: int = 256) -> None:
+                 flight_recorder_size: int = 256,
+                 tracer: Optional[Tracer] = None) -> None:
         self._builders: List[Tuple[Any, Any]] = []
         self._finalized = False
         self._native_active = False
@@ -346,6 +361,21 @@ class HostSessionPool:
         self._obs_on = m.enabled
         self._flight_capacity = flight_recorder_size
         self._recorders: List[Optional[FlightRecorder]] = []
+        # ---- tracing (DESIGN.md §14) ----
+        # tracer: tick -> crossing -> slot spans on the Python side; when
+        # the library carries ggrs_bank_set_timing, the native per-phase
+        # timings ride the tick output's timing tail (zero extra crossings)
+        # and are re-emitted as child spans of the crossing.  The shared
+        # NULL_TRACER default keeps the hot path at one no-op call per tick.
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        self._trace_native = False  # timing tail armed on the loaded bank
+        self._phase_totals: Optional[Tuple[int, Dict[str, int]]] = None
+        self._last_phase_ns: Optional[Dict[str, int]] = None
+        # /healthz source: last completed pool tick on time.monotonic()
+        self.last_tick_at: Optional[float] = None
+        # desync forensics: slot -> the report built when a desync-class
+        # fault quarantined it (DesyncReport; scripts/chaos.py artifacts)
+        self._desync_reports: Dict[int, DesyncReport] = {}
         self._m_ticks = m.counter(
             "ggrs_pool_ticks_total", "pool ticks driven (advance_all calls)")
         _cross = m.counter(
@@ -535,6 +565,12 @@ class HostSessionPool:
         # the broadcast command/output layout is spoken whenever the
         # library carries the entry points — spectator tables may be empty
         self._has_spec = hasattr(lib, "ggrs_bank_attach_spectator")
+        # arm the in-crossing phase timers only when someone is tracing:
+        # disarmed, the tick performs zero clock reads and emits the exact
+        # pre-timing output layout (the on/off wire pin rides on this)
+        if self.tracer.enabled and hasattr(lib, "ggrs_bank_set_timing"):
+            lib.ggrs_bank_set_timing(self._bank, 1)
+            self._trace_native = True
         from ..core.types import Remote, Spectator
 
         for builder, socket in self._builders:
@@ -687,6 +723,9 @@ class HostSessionPool:
         self._check_valid()
         self._tick_no += 1
         self._m_ticks.inc()
+        tracer = self.tracer
+        tracing = tracer.enabled
+        t_tick = tracer.now_ns() if tracing else 0
 
         pack = struct.pack
         # validate EVERY bank-resident session's staged inputs before any
@@ -754,6 +793,7 @@ class HostSessionPool:
 
         self.crossings += 1
         self._m_cross_tick.inc()
+        t_cross = tracer.now_ns() if tracing else 0
         rc = self._lib.ggrs_bank_tick(
             self._bank, self._clock(), cmd, len(cmd),
             self._out_buf, len(self._out_buf), ctypes.byref(self._out_len),
@@ -769,6 +809,24 @@ class HostSessionPool:
                 self._bank, self._out_buf, len(self._out_buf),
                 ctypes.byref(self._out_len),
             )
+        if tracing:
+            # the crossing span, then the native per-phase timings laid
+            # end-to-end inside it (they were measured inside this very
+            # window, so they nest under it and sum to the in-crossing
+            # time; the gap to the crossing span is pure ctypes overhead)
+            dur = tracer.now_ns() - t_cross
+            tracer.add_complete("bank.crossing", t_cross, dur, cat="native",
+                                args={"tick": self._tick_no})
+            if self._trace_native and rc == 0:
+                off = t_cross
+                phases = self._parse_timing_tail()
+                for name, ns in phases:
+                    if ns:
+                        tracer.add_complete(
+                            f"bank.{name}", off, ns, cat="native"
+                        )
+                    off += ns
+                self._last_phase_ns = dict(phases)
         if rc != 0:
             # the only whole-bank failure left is a malformed command stream
             # (a bug in THIS builder, no per-session blame possible)
@@ -776,14 +834,32 @@ class HostSessionPool:
             raise RuntimeError(self._invalid)
         request_lists = self._parse_output(ticked)
         self._supervise(request_lists)
+        if tracing:
+            tracer.add_complete("pool.tick", t_tick,
+                                tracer.now_ns() - t_tick, cat="py")
+        self.last_tick_at = time.monotonic()
         return request_lists
+
+    def _parse_timing_tail(self) -> List[Tuple[str, int]]:
+        """The tick output's timing tail: ``(phase, ns)`` pairs in bank
+        order.  The count byte sits LAST so the tail parses from the end
+        of the buffer, independent of the session records before it."""
+        end = self._out_len.value
+        n_ph = self._out_buf[end - 1][0]
+        vals = struct.unpack_from(
+            f"<{n_ph}Q", self._out_buf, end - 1 - 8 * n_ph
+        )
+        return list(zip(_phase_names(n_ph), vals))
 
     def _parse_output(self, ticked: List[bool]) -> List[List[GgrsRequest]]:
         buf = memoryview(self._out_buf).cast("B")[: self._out_len.value]
         unpack_from = struct.unpack_from
         pos = 0
         request_lists: List[List[GgrsRequest]] = []
+        tracer = self.tracer
+        tracing = tracer.enabled
         for idx, m in enumerate(self._mirrors):
+            t_slot = tracer.now_ns() if tracing else 0
             players, isize = m.num_players, m.input_size
             err, landed, frames_ahead, current, confirmed, consensus, n_ops = (
                 unpack_from("<iqiqqBH", buf, pos)
@@ -1063,6 +1139,14 @@ class HostSessionPool:
             if not live:
                 requests = []
             request_lists.append(requests)
+            if tracing:
+                # the Python half of this slot's tick: record parse, sends,
+                # event/consensus policy (nests under pool.tick, after the
+                # crossing span)
+                tracer.add_complete(
+                    "pool.slot", t_slot, tracer.now_ns() - t_slot, cat="py",
+                    args={"slot": idx, "frame": current},
+                )
         return request_lists
 
     # ------------------------------------------------------------------
@@ -1077,6 +1161,8 @@ class HostSessionPool:
         synchronized) still propagate to the caller."""
         self._tick_no += 1
         self._m_ticks.inc()
+        tracer = self.tracer
+        t_tick = tracer.now_ns() if tracer.enabled else 0
         # validate every live session's preconditions BEFORE any session
         # advances: a contract raise mid-loop would discard earlier
         # sessions' already-generated request lists (the native path makes
@@ -1117,6 +1203,10 @@ class HostSessionPool:
                 self._maybe_retire(i, s._remote_endpoints and all(
                     not ep.is_running() for ep in s._remote_endpoints
                 ))
+        if tracer.enabled:
+            tracer.add_complete("pool.tick", t_tick,
+                                tracer.now_ns() - t_tick, cat="py")
+        self.last_tick_at = time.monotonic()
         return out
 
     def _maybe_retire(self, index: int, match_over) -> None:
@@ -1203,6 +1293,11 @@ class HostSessionPool:
             self._quarantined_at[index] = self._tick_no
             self._evict_attempts[index] = 0
             self._evict_next_try[index] = self._tick_no  # try immediately
+            if code == _native.BANK_ERR_SYNC:
+                # desync-class fault: synthesize the forensic artifact NOW,
+                # while the mirrors, journal tail, and trace window still
+                # hold the state around the fault (DESIGN.md §14)
+                self._build_native_desync_report(index, code, named)
             # the post-mortem: the slot's recent history, logged the moment
             # it leaves the bank (DESIGN.md §12 flight-recorder contract)
             if rec is not None:
@@ -1211,6 +1306,51 @@ class HostSessionPool:
                     "recorder (last 32 events):\n%s",
                     index, self._tick_no, code, named, rec.dump(32),
                 )
+
+    def _build_native_desync_report(self, index: int, code: int,
+                                    named: str) -> None:
+        """DesyncReport for a desync-class native fault: no local checksum
+        history exists on the bank path (desync detection is a fallback
+        feature), so the report carries the evidence that IS available —
+        the peers' reported checksums, the flight recorder, the journal
+        tail, and the active trace window."""
+        m = self._mirrors[index]
+        rec = self._recorders[index] if self._recorders else None
+        # per-peer attribution: same-frame reports from different peers
+        # must not overwrite each other — a multi-endpoint window is keyed
+        # by peer address (the disagreeing peer is the forensic lead)
+        peer_windows = {
+            ep.addr: dict(ep.pending_checksums) for ep in m.endpoints
+        }
+        single = m.endpoints[0].addr if len(m.endpoints) == 1 else None
+        report = build_desync_report(
+            kind="native-fault",
+            detected_frame=m.current_frame,
+            addr=single,
+            remote_history=peer_windows[single] if single is not None else {},
+            recorder=rec,
+            journal=self._journal_sinks.get(index),
+            tracer=self.tracer,
+            detail=f"slot {index} quarantined by desync-class fault "
+                   f"code={code} ({named}) at pool tick {self._tick_no}",
+        )
+        if single is None:
+            report.checksum_window = {
+                f"remote[{addr!r}]": window
+                for addr, window in peer_windows.items() if window
+            }
+        self._desync_reports[index] = report
+        if rec is not None:
+            rec.record(self._tick_no, EV_DESYNC,
+                       f"code={code} report built (frame {m.current_frame})")
+        self.tracer.add_instant("pool.desync", cat="py", slot=index,
+                                frame=m.current_frame, code=code)
+
+    def desync_report(self, index: int) -> Optional[DesyncReport]:
+        """The forensic report built when slot ``index`` quarantined on a
+        desync-class fault, or None.  (The checksum-compare detection path
+        lives on Python sessions — see ``P2PSession.desync_reports``.)"""
+        return self._desync_reports.get(index)
 
     def _try_evict(self, index: int) -> None:
         if self._tick_no < self._evict_next_try.get(index, 0):
@@ -1222,7 +1362,8 @@ class HostSessionPool:
         )
         rec = self._recorders[index] if self._recorders else None
         try:
-            session, load_req = self._evict(index)
+            with self.tracer.span("pool.evict", slot=index):
+                session, load_req = self._evict(index)
         except Exception as e:
             self._fault_log[index].append(SlotFault(
                 self._tick_no, 0, f"eviction attempt {attempt} failed: {e}"
@@ -1362,6 +1503,14 @@ class HostSessionPool:
             if blob is not None:
                 session.add_local_input(handle, decode(blob))
         m.staged_inputs.clear()
+        # forensic continuity: the evicted session keeps tracing into the
+        # pool's ring, recording into the slot's flight recorder, and
+        # citing the slot's journal tail in any future DesyncReport
+        session.attach_forensics(
+            recorder=self._recorders[index] if self._recorders else None,
+            tracer=self.tracer if self.tracer.enabled else None,
+            journal=self._journal_sinks.get(index),
+        )
         return session, LoadGameState(cell=cell, frame=resume)
 
     def _harvest(self, index: int) -> Dict[str, Any]:
@@ -1733,7 +1882,7 @@ class HostSessionPool:
         steady-state allocation) — copy what you need to keep."""
         if not self._finalized:
             self._finalize()
-        with trace_span("ggrs.obs.scrape"):
+        with trace_span("ggrs.obs.scrape"), self.tracer.span("pool.scrape"):
             if self._native_active:
                 stats = self._bank_stats()
             else:
@@ -1743,6 +1892,18 @@ class HostSessionPool:
                 ]
             self._update_scrape_gauges(stats)
         return stats
+
+    def native_phase_totals(self) -> Optional[Tuple[int, Dict[str, int]]]:
+        """``(timed_ticks, {phase: total_ns})`` accumulated by the native
+        phase timers since the bank was built — the cumulative view of the
+        per-tick timing tail, refreshed by ``scrape()`` (it rides the
+        stats crossing).  None until tracing is armed and a scrape ran."""
+        return self._phase_totals
+
+    def last_tick_phases(self) -> Optional[Dict[str, int]]:
+        """The most recent tick's in-crossing phase ns (the same numbers
+        re-emitted as ``bank.*`` trace spans), or None."""
+        return self._last_phase_ns
 
     def _bank_stats(self) -> List[Dict[str, Any]]:
         if (
@@ -1820,6 +1981,18 @@ class HostSessionPool:
             ]
         unpack_from = struct.unpack_from
         buf = self._scrape_buf
+        end = n
+        if self._trace_native:
+            # cumulative timing tail (count byte last): u64 timed_ticks,
+            # n_ph * u64 totals, u8 n_ph — parsed from the end, like the
+            # tick output's tail
+            (n_ph,) = unpack_from("<B", buf, n - 1)
+            tail = 8 + 8 * n_ph + 1
+            vals = unpack_from(f"<{n_ph + 1}Q", buf, n - tail)
+            self._phase_totals = (
+                vals[0], dict(zip(_phase_names(n_ph), vals[1:]))
+            )
+            end = n - tail
         pos = 0
         for i, rec in enumerate(self._bank_records):
             (rec["current_frame"], rec["last_confirmed"], rec["ticks"],
@@ -1863,7 +2036,7 @@ class HostSessionPool:
                         "<B6q", buf, pos
                     )
                     pos += 49
-        if pos != n:
+        if pos != end:
             raise RuntimeError("bank stats buffer layout mismatch")
         # a fresh list (the evicted overrides below must not clobber the
         # master records); the dicts themselves are shared live views

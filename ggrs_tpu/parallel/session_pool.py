@@ -48,6 +48,7 @@ from ..core.types import (
     SaveGameState,
 )
 from ..obs.registry import default_registry
+from ..obs.trace import NULL_TRACER
 from ..ops.checksum import CHECKSUM_LANES, checksum_device, checksum_to_u128
 
 # obs (DESIGN.md §12): device-dispatch accounting for the pooled executor —
@@ -194,6 +195,9 @@ class BatchedRequestExecutor:
             )
         self._input_dtype: Optional[np.dtype] = None
         self._input_shape: Optional[Tuple[int, ...]] = None
+        # tracing (DESIGN.md §14): device dispatch + fence spans; assign a
+        # live Tracer (or let HostedPool share the host pool's) to light up
+        self.tracer = NULL_TRACER
         # set on a failed run(): once a tick aborts mid-parse, fulfilled
         # cells reference slots that were never written — every later use
         # must fail loudly instead of serving stale state
@@ -485,13 +489,14 @@ class BatchedRequestExecutor:
         # tick never wrote, so the pool is unusable: poison it loudly rather
         # than let a caller that caught the error keep running on stale loads
         try:
-            for b, reqs in enumerate(request_lists):
-                if reqs:
-                    self._parse(b, reqs, desc)
-            _OBS_DISPATCHES.inc()
-            _OBS_ROLLBACK_LOADS.inc(int(desc["do_load"].sum()))
-            _OBS_BURST_DEPTH.observe(int(desc["n_adv"].max()))
-            self._carry = self._tick(self._carry, desc)
+            with self.tracer.span("device.dispatch"):
+                for b, reqs in enumerate(request_lists):
+                    if reqs:
+                        self._parse(b, reqs, desc)
+                _OBS_DISPATCHES.inc()
+                _OBS_ROLLBACK_LOADS.inc(int(desc["do_load"].sum()))
+                _OBS_BURST_DEPTH.observe(int(desc["n_adv"].max()))
+                self._carry = self._tick(self._carry, desc)
         except BaseException as e:  # incl. KeyboardInterrupt mid-parse
             self._invalid = f"{type(e).__name__}: {e}"
             raise
@@ -559,7 +564,8 @@ class BatchedRequestExecutor:
         return checksum_to_u128(lanes)
 
     def block_until_ready(self) -> None:
-        jax.block_until_ready(self._carry)
+        with self.tracer.span("device.fence"):
+            jax.block_until_ready(self._carry)
 
 
 class HostedPool:
@@ -583,6 +589,14 @@ class HostedPool:
             )
         self.host = host_pool
         self.executor = executor
+        # one trace per hosted pool: the device dispatch/fence spans join
+        # the host pool's tick -> crossing -> slot timeline
+        host_tracer = getattr(host_pool, "tracer", None)
+        if (
+            host_tracer is not None and host_tracer.enabled
+            and not executor.tracer.enabled
+        ):
+            executor.tracer = host_tracer
 
     def tick(self, local_inputs: Sequence[Tuple[int, int, Any]]) -> None:
         """One pool tick: stage ``(session_index, handle, value)`` local

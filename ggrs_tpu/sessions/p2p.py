@@ -60,7 +60,10 @@ from ..net.protocol import (
 )
 from ..net.sockets import NonBlockingSocket
 from ..net.stats import NetworkStats
+from ..obs.forensics import MAX_REPORTS, DesyncReport, build_desync_report
+from ..obs.recorder import ChecksumHistory, EV_DESYNC, FlightRecorder
 from ..obs.registry import default_registry
+from ..obs.trace import NULL_TRACER
 from ..utils.ownership import ThreadOwned
 
 logger = logging.getLogger(__name__)
@@ -167,6 +170,20 @@ class P2PSession(ThreadOwned, Generic[I, S, A]):
         self._local_checksum_history: Dict[Frame, int] = {}
         self._last_sent_checksum_frame: Frame = NULL_FRAME
 
+        # forensics & tracing (DESIGN.md §14) — observational only.  The
+        # per-peer checksum window accumulates the desync-interval reports
+        # (``pending_checksums`` entries are consumed by the compare); on a
+        # mismatch a DesyncReport is synthesized from both windows via
+        # first-divergent-frame bisection and kept alongside the event.
+        # The window lives on the attached flight recorder when there is
+        # one; ``_remote_checksum_history`` is the recorder-less fallback
+        # store (see ``_remote_hist`` — one store, never both).
+        self.tracer = NULL_TRACER
+        self.recorder: Optional[FlightRecorder] = None
+        self.desync_reports: List[DesyncReport] = []
+        self._forensics_journal = None
+        self._remote_checksum_history: Dict[A, ChecksumHistory] = {}
+
         # obs: per-session counters (HostSessionPool._session_stats reads
         # these for fallback/evicted slots; observational only)
         self._stat_ticks = 0
@@ -223,6 +240,10 @@ class P2PSession(ThreadOwned, Generic[I, S, A]):
     def advance_frame(self) -> List[GgrsRequest]:
         """The main entry point; see the reference call stack
         (p2p_session.rs:265-426).  Returns the ordered request list."""
+        with self.tracer.span("session.tick"):
+            return self._advance_frame_impl()
+
+    def _advance_frame_impl(self) -> List[GgrsRequest]:
         self._check_owner()
         self.poll_remote_clients()
 
@@ -343,6 +364,10 @@ class P2PSession(ThreadOwned, Generic[I, S, A]):
     def poll_remote_clients(self) -> None:
         """Drain the socket, route messages to endpoints, run timers, handle
         events, and flush outgoing packets (reference: p2p_session.rs:430-478)."""
+        with self.tracer.span("session.poll"):
+            self._poll_remote_clients_impl()
+
+    def _poll_remote_clients_impl(self) -> None:
         self._check_owner()
         remotes = self._player_reg.remotes
         spectators = self._player_reg.spectators
@@ -476,6 +501,21 @@ class P2PSession(ThreadOwned, Generic[I, S, A]):
 
     def desync_detection(self) -> DesyncDetection:
         return self._desync_detection
+
+    def attach_forensics(self, recorder: Optional[FlightRecorder] = None,
+                         tracer=None, journal=None) -> None:
+        """Attach observability sinks (DESIGN.md §14; every argument
+        optional, everything observational only): a ``FlightRecorder``
+        that receives checksum history and desync events, a ``Tracer``
+        whose window rides DesyncReports (and that times this session's
+        ticks), and a ``MatchJournal`` whose in-memory tail provides the
+        frames around a divergence."""
+        if recorder is not None:
+            self.recorder = recorder
+        if tracer is not None:
+            self.tracer = tracer
+        if journal is not None:
+            self._forensics_journal = journal
 
     # ------------------------------------------------------------------
     # adoption (fallback eviction — the supervision seam)
@@ -747,15 +787,35 @@ class P2PSession(ThreadOwned, Generic[I, S, A]):
     # desync detection (reference: p2p_session.rs:904-975)
     # ------------------------------------------------------------------
 
+    def _remote_hist(self, addr: A) -> ChecksumHistory:
+        """The per-peer checksum window for ``addr`` — held by the attached
+        flight recorder when there is one (the ISSUE'd forensic surface),
+        by the session otherwise; one store, never both."""
+        store = (
+            self.recorder.remote_checksums if self.recorder is not None
+            else self._remote_checksum_history
+        )
+        hist = store.get(addr)
+        if hist is None:
+            hist = ChecksumHistory()
+            store[addr] = hist
+        return hist
+
     def _compare_local_checksums_against_peers(self) -> None:
         for remote in self._player_reg.remotes.values():
             checked = []
+            hist: Optional[ChecksumHistory] = None
             for remote_frame, remote_checksum in remote.pending_checksums.items():
                 if remote_frame >= self._sync_layer.last_confirmed_frame:
                     continue  # still waiting for inputs for this frame
                 local_checksum = self._local_checksum_history.get(remote_frame)
                 if local_checksum is None:
                     continue
+                # forensics: the compare consumes pending_checksums, so the
+                # bisection window must accumulate them here, match or not
+                if hist is None:
+                    hist = self._remote_hist(remote.peer_addr)
+                hist.record(remote_frame, remote_checksum)
                 if local_checksum != remote_checksum:
                     self._push_event(
                         DesyncDetected(
@@ -765,9 +825,54 @@ class P2PSession(ThreadOwned, Generic[I, S, A]):
                             addr=remote.peer_addr,
                         )
                     )
+                    self._record_desync(
+                        remote.peer_addr, remote_frame, local_checksum,
+                        remote_checksum, hist,
+                    )
                 checked.append(remote_frame)
             for frame in checked:
                 del remote.pending_checksums[frame]
+
+    def _record_desync(self, addr: A, frame: Frame, local_checksum: int,
+                       remote_checksum: int,
+                       remote_history: ChecksumHistory) -> None:
+        """Forensics for one ``DesyncDetected`` (DESIGN.md §14): bisect the
+        shared checksum history for the first divergent frame and keep a
+        :class:`DesyncReport` next to the event.  Bounded: a real desync
+        re-fires every interval until the match is torn down, and the first
+        few reports say everything."""
+        if len(self.desync_reports) >= MAX_REPORTS:
+            return
+        # the recorder's local window (256 frames) out-reaches the
+        # protocol-pruned history (MAX_CHECKSUM_HISTORY_SIZE): bisect over
+        # the deepest window available
+        local_history = (
+            self.recorder.checksums if self.recorder is not None
+            and len(self.recorder.checksums)
+            else self._local_checksum_history
+        )
+        report = build_desync_report(
+            detected_frame=frame,
+            addr=addr,
+            local_checksum=local_checksum,
+            remote_checksum=remote_checksum,
+            local_history=local_history,
+            remote_history=remote_history,
+            recorder=self.recorder,
+            journal=self._forensics_journal,
+            tracer=self.tracer,
+            detail="checksum compare at the desync-detection interval "
+                   f"(interval={self._desync_detection.interval})",
+        )
+        self.desync_reports.append(report)
+        if self.recorder is not None:
+            self.recorder.record(
+                self._stat_ticks, EV_DESYNC,
+                f"frame {frame}: local {local_checksum:#x} != "
+                f"remote {remote_checksum:#x} (first divergent "
+                f"{report.first_divergent_frame})",
+            )
+        self.tracer.add_instant("session.desync", frame=frame)
 
     def _check_checksum_send_interval(self) -> None:
         interval = self._desync_detection.interval
@@ -789,6 +894,8 @@ class P2PSession(ThreadOwned, Generic[I, S, A]):
                     remote.send_checksum_report(frame_to_send, checksum)
                 self._last_sent_checksum_frame = frame_to_send
                 self._local_checksum_history[frame_to_send] = checksum
+                if self.recorder is not None:
+                    self.recorder.record_checksum(frame_to_send, checksum)
 
             if len(self._local_checksum_history) > MAX_CHECKSUM_HISTORY_SIZE:
                 oldest_to_keep = (

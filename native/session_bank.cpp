@@ -41,6 +41,7 @@
 // whole-bank failure is a malformed command stream (kBankErrCmd), which can
 // only mean the Python command builder itself is broken.
 
+#include <chrono>
 #include <cstddef>
 #include <cstdint>
 #include <cstring>
@@ -125,6 +126,50 @@ constexpr int kBankErrSpecStream = -77;  // confirmed-input fan-out failed
 constexpr uint8_t kFlagInputs = 1;  // local inputs present -> advance runs
 constexpr uint8_t kFlagSkip = 2;    // slot quarantined/evicted: no fields
                                     // follow; emit a status-only record
+
+// ---- in-crossing phase timers (tracing, DESIGN.md §14) ----------------
+// When ggrs_bank_set_timing(1) is armed, the tick accumulates per-phase
+// wall time (steady_clock, never the session clock) and appends a timing
+// tail to the EXISTING tick output — tracing costs zero extra ctypes
+// crossings and, when off, zero clock reads.  Phase order is mirrored by
+// _native.BANK_PHASES; "other" is the remainder (cmd parse, skip records,
+// memcpy) so the phases always sum to the measured in-crossing time.
+enum BankPhase : int {
+  kPhInbound = 0,   // datagram routing / ack / ring commit
+  kPhTimers = 1,    // frame advantage, retry/quality/keep-alive/disconnect
+  kPhCommit = 2,    // staged EvInput apply: remote-input enqueue into sync
+  kPhRollback = 3,  // consistency check + rollback-resim descriptor build
+  kPhOutbound = 4,  // local-input enqueue + outbound InputMessage assembly
+  kPhFanout = 5,    // spectator fan-out + journal-tap staging
+  kPhEmit = 6,      // output-record assembly (ops, sections, mirrors)
+  kPhOther = 7,     // total - sum(above): parse, skip slots, bookkeeping
+  kNumPhases = 8,
+};
+
+inline uint64_t mono_ns() {
+  return static_cast<uint64_t>(
+      std::chrono::duration_cast<std::chrono::nanoseconds>(
+          std::chrono::steady_clock::now().time_since_epoch())
+          .count());
+}
+
+struct PhaseTimer {
+  bool on = false;
+  uint64_t t = 0;
+  uint64_t ns[kNumPhases] = {0};
+  // re-base without attributing the gap (it lands in kPhOther)
+  void skip() {
+    if (on) t = mono_ns();
+  }
+  // attribute time since the last skip()/lap() to `ph`
+  void lap(int ph) {
+    if (on) {
+      uint64_t n = mono_ns();
+      ns[ph] += n - t;
+      t = n;
+    }
+  }
+};
 
 // endpoint core codes (endpoint.cpp)
 constexpr int kEpDrop = -30;
@@ -238,6 +283,12 @@ struct Bank {
   std::vector<size_t> recv_sizes = std::vector<size_t>(512);
   std::vector<uint8_t> emit_buf = std::vector<uint8_t>(size_t{1} << 12);
   std::vector<uint8_t> out;  // tick output, memcpy'd to the caller
+  // tracing (DESIGN.md §14): armed by ggrs_bank_set_timing; per-tick
+  // phase ns ride the tick output, the cumulative totals ride the stats
+  // output — neither adds a crossing
+  bool timing = false;
+  uint64_t timed_ticks = 0;
+  uint64_t phase_total[kNumPhases] = {0};
 };
 
 // ---- little-endian put/get over byte vectors -----------------------------
@@ -797,9 +848,10 @@ void emit_status_mirrors(std::vector<uint8_t>* o, const BankSession* s) {
 int advance_session(Bank* bank, BankSession* s, int64_t now,
                     const uint8_t* local_inputs, std::vector<uint8_t>* ops,
                     uint16_t* n_ops, int64_t* landed_out,
-                    int64_t* frames_ahead_out) {
+                    int64_t* frames_ahead_out, PhaseTimer* pt) {
   const int players = s->num_players;
   const int isize = s->input_size;
+  pt->skip();
 
   // frame-0 initial save (p2p.py: save before anything else that tick)
   if (s->current_frame == 0) {
@@ -862,6 +914,7 @@ int advance_session(Bank* bank, BankSession* s, int64_t now,
   put_u8(ops, 0);
   put_i64(ops, s->current_frame);
   ++*n_ops;
+  pt->lap(kPhRollback);
 
   // broadcast fan-out + journal tap: BEFORE set_last_confirmed discards the
   // inputs it would need (p2p.py sends to spectators at exactly this point)
@@ -869,6 +922,7 @@ int advance_session(Bank* bank, BankSession* s, int64_t now,
     int rc = fan_out_confirmed(bank, s, now, confirmed);
     if (rc != kBankOk) return rc;
   }
+  pt->lap(kPhFanout);
 
   // confirmed-frame watermark (policy minimums applied: non-sparse, so only
   // the never-past-current clamp)
@@ -953,6 +1007,7 @@ int advance_session(Bank* bank, BankSession* s, int64_t now,
     put_raw(ops, s->sync_buf.data(), static_cast<size_t>(players) * isize);
     ++*n_ops;
   }
+  pt->lap(kPhOutbound);
   return kBankOk;
 }
 
@@ -1148,6 +1203,16 @@ int ggrs_bank_set_confirmed_stream(void* ptr, int64_t session, int enabled) {
   return kBankOk;
 }
 
+// Arm/disarm the in-crossing phase timers (DESIGN.md §14).  When armed,
+// every ggrs_bank_tick appends a per-tick timing tail to its output and
+// ggrs_bank_stats appends the cumulative totals — tracing rides the
+// existing crossings.  When disarmed (the default) the tick performs zero
+// clock reads and emits byte-identical output to a pre-timing build.
+int ggrs_bank_set_timing(void* ptr, int enabled) {
+  static_cast<Bank*>(ptr)->timing = enabled != 0;
+  return kBankOk;
+}
+
 // THE crossing.  Command stream, little-endian, per session in order:
 //   u8 flags (bit0 = local inputs present -> advance phase runs;
 //             bit1 = skip: slot is quarantined/evicted, NO further fields
@@ -1185,6 +1250,10 @@ int ggrs_bank_set_confirmed_stream(void* ptr, int64_t session, int enabled) {
 //   u16 n_spec_events;  per: u8 kind, u16 spectator [+ i64 for interrupted]
 //   u16 n_conf;  [if > 0] i64 conf_start; per frame:
 //     players * u8 blank_flag, players * input_size bytes  [journal tap]
+// After the last session record, ONLY when ggrs_bank_set_timing armed the
+// phase timers (DESIGN.md §14):
+//   kNumPhases * u64 phase_ns, u8 n_phases   [timing tail; count byte
+//     last so the caller parses it from the END of the buffer]
 // Returns 0, kErrBufferTooSmall (retry with a bigger out), or kBankErrCmd
 // (malformed command stream — the one remaining whole-bank failure).
 int ggrs_bank_tick(void* ptr, int64_t now, const uint8_t* cmd, size_t cmd_len,
@@ -1195,6 +1264,9 @@ int ggrs_bank_tick(void* ptr, int64_t now, const uint8_t* cmd, size_t cmd_len,
   std::vector<uint8_t> ops;
   std::vector<EpEvent> staged_events;
   std::vector<int32_t> staged_eps;
+  PhaseTimer pt;
+  pt.on = bank->timing;
+  const uint64_t tick_t0 = pt.on ? mono_ns() : 0;
 
   for (BankSession* s : bank->sessions) {
     uint8_t flags = r.u8();
@@ -1266,6 +1338,7 @@ int ggrs_bank_tick(void* ptr, int64_t now, const uint8_t* cmd, size_t cmd_len,
     }
 
     // ---- poll phase (p2p.py poll_remote_clients) ----
+    pt.skip();
     uint16_t n_datagrams = r.u16();
     if (!r.ok) return kBankErrCmd;
     for (uint16_t i = 0; i < n_datagrams; ++i) {
@@ -1290,6 +1363,7 @@ int ggrs_bank_tick(void* ptr, int64_t now, const uint8_t* cmd, size_t cmd_len,
         process_datagram(bank, s, &s->spectators[sp_idx], now, data, dlen);
       }
     }
+    pt.lap(kPhInbound);
     std::vector<uint8_t> out_events;
     uint16_t n_out_events = 0;
     std::vector<uint8_t> spec_events;
@@ -1343,6 +1417,7 @@ int ggrs_bank_tick(void* ptr, int64_t now, const uint8_t* cmd, size_t cmd_len,
           sp.events.pop_front();
         }
       }
+      pt.lap(kPhTimers);
       for (size_t i = 0; err == kBankOk && i < staged_events.size(); ++i) {
         const EpEvent& ev = staged_events[i];
         BankEndpoint& ep = s->endpoints[static_cast<size_t>(staged_eps[i])];
@@ -1376,6 +1451,7 @@ int ggrs_bank_tick(void* ptr, int64_t now, const uint8_t* cmd, size_t cmd_len,
           ++n_out_events;
         }
       }
+      pt.lap(kPhCommit);
     }
 
     // ---- advance phase (p2p.py advance_frame after its poll) ----
@@ -1386,7 +1462,7 @@ int ggrs_bank_tick(void* ptr, int64_t now, const uint8_t* cmd, size_t cmd_len,
       if (flags & kFlagInputs) {
         if (!local_inputs) return kBankErrCmd;
         int rc = advance_session(bank, s, now, local_inputs, &ops, &n_ops,
-                                 &landed, &frames_ahead);
+                                 &landed, &frames_ahead, &pt);
         if (rc != kBankOk) err = rc;
       } else {
         frames_ahead = max_frame_advantage(s);
@@ -1423,6 +1499,7 @@ int ggrs_bank_tick(void* ptr, int64_t now, const uint8_t* cmd, size_t cmd_len,
     }
 
     // ---- session output record ----
+    pt.skip();
     put_u32(o, static_cast<uint32_t>(err));
     put_i64(o, landed);
     put_u32(o, static_cast<uint32_t>(static_cast<int32_t>(frames_ahead)));
@@ -1442,9 +1519,26 @@ int ggrs_bank_tick(void* ptr, int64_t now, const uint8_t* cmd, size_t cmd_len,
     put_raw(o, out_events.data(), out_events.size());
     emit_status_mirrors(o, s);
     emit_spectator_tail(o, s, true, &spec_events, n_spec_events);
+    pt.lap(kPhEmit);
   }
 
   if (r.pos != r.len) return kBankErrCmd;  // trailing garbage: refuse
+  if (pt.on) {
+    // timing tail (count byte LAST so Python can parse from the end
+    // without knowing the phase count up front): kNumPhases u64 ns then
+    // u8 kNumPhases.  "other" closes the books: phases sum exactly to the
+    // measured in-crossing time.
+    uint64_t total = mono_ns() - tick_t0;
+    uint64_t sum = 0;
+    for (int i = 0; i < kPhOther; ++i) sum += pt.ns[i];
+    pt.ns[kPhOther] = total > sum ? total - sum : 0;
+    bank->timed_ticks += 1;
+    for (int i = 0; i < kNumPhases; ++i) {
+      bank->phase_total[i] += pt.ns[i];
+      put_u64(&bank->out, pt.ns[i]);
+    }
+    put_u8(&bank->out, static_cast<uint8_t>(kNumPhases));
+  }
   if (bank->out.size() > out_cap) {
     // the tick already ran and its full output is retained in bank->out:
     // report the needed size so the caller can grow its buffer and fetch
@@ -1607,6 +1701,9 @@ int ggrs_bank_harvest(void* ptr, int64_t session, uint8_t* out, size_t cap,
 //     i64 packets_sent, i64 bytes_sent, i64 stats_start_ms
 //   (the catchup-lag gauge is (next_spectator_frame-1) - last_acked_frame;
 //   harvested in the SAME crossing as everything else)
+// When the phase timers are armed (ggrs_bank_set_timing), a cumulative
+// timing tail follows the last session:
+//   u64 timed_ticks, kNumPhases * u64 total_phase_ns, u8 n_phases
 // Returns kBankOk or kErrBufferTooSmall (*out_len = needed; retry).
 int ggrs_bank_stats(void* ptr, uint8_t* out, size_t cap, size_t* out_len) {
   Bank* bank = static_cast<Bank*>(ptr);
@@ -1647,6 +1744,11 @@ int ggrs_bank_stats(void* ptr, uint8_t* out, size_t cap, size_t* out_len) {
       put_i64(&h, sp.bytes_sent);
       put_i64(&h, sp.stats_start);
     }
+  }
+  if (bank->timing) {
+    put_u64(&h, bank->timed_ticks);
+    for (int i = 0; i < kNumPhases; ++i) put_u64(&h, bank->phase_total[i]);
+    put_u8(&h, static_cast<uint8_t>(kNumPhases));
   }
   *out_len = h.size();
   if (h.size() > cap) return kErrBufferTooSmall;

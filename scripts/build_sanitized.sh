@@ -47,5 +47,6 @@ GGRS_NATIVE_SANITIZE=1 \
 JAX_PLATFORMS=cpu \
 python -m pytest tests/test_session_bank.py tests/test_bank_faults.py \
     tests/test_obs.py tests/test_broadcast.py tests/test_replay_journal.py \
+    tests/test_trace.py tests/test_desync_detection.py \
     -q -p no:cacheprovider -m "not slow" \
     -k "not batched_executor and not size_mismatch and not fused_scrub and not scrub_matches" "$@"

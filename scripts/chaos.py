@@ -11,9 +11,17 @@ Each scenario ends with a metrics + flight-recorder summary (faults by
 code, evictions, survivor counters, the target slot's last 32 recorded
 events) instead of discarding that state — DESIGN.md §12.
 
+With ``--artifact-dir``, every scenario additionally writes a
+machine-readable JSON artifact (digest + verdict + any DesyncReport
+path) for CI consumption — DESIGN.md §14.
+
 Fault classes (all driven through the pool's real tick path):
   native-error  simulated native slot fault (ctrl-op channel)
-  desync        desync-class invariant fault (BANK_ERR_SYNC)
+  desync        desync-class invariant fault (BANK_ERR_SYNC) on the bank —
+                the quarantine now yields a DesyncReport artifact — plus a
+                forensic leg on the reference detection path: a state
+                fault seeded at a known frame must bisect to EXACTLY that
+                first divergent frame in both peers' reports
   blackout      the target's peer goes permanently silent
   malformed     burst of truncated/corrupted datagrams into the target
   fuzz          seeded random junk datagrams into the target
@@ -35,6 +43,7 @@ Exit code 0 = blast radius contained in every leg; 1 = violation.
 from __future__ import annotations
 
 import argparse
+import json
 import random
 import sys
 from pathlib import Path
@@ -46,8 +55,25 @@ from ggrs_tpu.chaos import (  # noqa: E402
     blast_radius_violations,
     drive_broadcast,
     drive_chaos,
+    drive_desync_forensics,
 )
 from ggrs_tpu.net import _native  # noqa: E402
+from ggrs_tpu.obs import json_snapshot  # noqa: E402
+
+
+def _write_artifact(artifact_dir, name: str, payload: dict):
+    """One machine-readable JSON artifact per scenario (CI consumption):
+    digest + verdict + any DesyncReport path, alongside the stdout
+    digest.  Returns the path, or None when no --artifact-dir was given."""
+    if artifact_dir is None:
+        return None
+    out = Path(artifact_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    path = out / f"{name}.json"
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=2, default=str)
+    print(f"  artifact: {path}")
+    return path
 
 
 def _metrics_summary(chaos) -> str:
@@ -143,7 +169,8 @@ FAULTS = {
 }
 
 
-def verify_leg(name: str, matches: int, ticks: int, seed: int) -> bool:
+def verify_leg(name: str, matches: int, ticks: int, seed: int,
+               artifact_dir=None) -> bool:
     spec = FAULTS[name]
     retire = spec.get("retire", False)
     control = drive_chaos(ticks, n_matches=matches, seed=seed, retire=retire)
@@ -168,6 +195,35 @@ def verify_leg(name: str, matches: int, ticks: int, seed: int) -> bool:
     dump = pool.flight_dump(target, last=32)
     print(f"  flight recorder (target slot {target}, last 32 events):")
     print("\n".join(f"  {line}" for line in dump.splitlines()))
+    report = pool.desync_report(target)
+    report_path = None
+    if report is not None:
+        # the desync-class fault left a forensic artifact, not a bare event
+        print("  " + report.summary().replace("\n", "\n  "))
+        if artifact_dir is not None:
+            out = Path(artifact_dir)
+            out.mkdir(parents=True, exist_ok=True)
+            report_path = report.write(out / f"{name}.desync_report.json")
+            print(f"  desync report: {report_path}")
+    if name == "desync":
+        violations += _verify_desync_forensics(ticks, seed, artifact_dir)
+    verdict = not violations
+    _write_artifact(artifact_dir, name, {
+        "scenario": name,
+        "verdict": "PASS" if verdict else "FAIL",
+        "violations": violations,
+        "target_slot": target,
+        "target_state": chaos["states"][target],
+        "target_frame": chaos["frames"][target],
+        "fault_log": [
+            {"tick": f.tick, "code": f.code, "detail": f.detail}
+            for f in pool.fault_log(target)
+        ],
+        "crossings": {"tick": pool.crossings, "harvest": pool.harvests,
+                      "stats": pool.stat_crossings},
+        "desync_report": str(report_path) if report_path else None,
+        "metrics": json_snapshot(chaos["registry"]),
+    })
     if violations:
         print("  BLAST RADIUS VIOLATED:")
         for v in violations:
@@ -178,7 +234,46 @@ def verify_leg(name: str, matches: int, ticks: int, seed: int) -> bool:
     return True
 
 
-def verify_broadcast_leg(matches: int, ticks: int, seed: int) -> bool:
+def _verify_desync_forensics(ticks: int, seed: int, artifact_dir=None):
+    """The forensic leg of the desync scenario: the REFERENCE detection
+    path (two Python sessions, interval-1 checksum exchange) with a state
+    fault seeded at a known frame — the resulting DesyncReport's
+    first-divergent-frame bisection must land exactly on it."""
+    from ggrs_tpu.obs import Tracer
+
+    fault_frame = max(20, min(60, ticks // 3))
+    run = drive_desync_forensics(
+        max(ticks, fault_frame + 60), fault_frame=fault_frame, seed=seed,
+        interval=1, tracer=Tracer(),
+    )
+    violations = []
+    print(f"  forensic leg: state fault seeded at frame {fault_frame} "
+          f"(checksum interval 1)")
+    for side, reports in (("A", run["reports_a"]), ("B", run["reports_b"])):
+        if not reports:
+            violations.append(f"peer {side} produced no DesyncReport")
+            continue
+        r = reports[0]
+        print(f"  peer {side}: " + r.summary().replace("\n", "\n  "))
+        if r.first_divergent_frame != fault_frame:
+            violations.append(
+                f"peer {side}: first divergent frame "
+                f"{r.first_divergent_frame} != fault frame {fault_frame}"
+            )
+    if run["reports_a"] and run["reports_b"]:
+        # both ends' recorder dumps ride one artifact
+        report = run["reports_a"][0]
+        report.remote_recorder_dump = run["recorders"][1].dump(32)
+        if artifact_dir is not None:
+            out = Path(artifact_dir)
+            out.mkdir(parents=True, exist_ok=True)
+            path = report.write(out / "desync.forensic_report.json")
+            print(f"  forensic report: {path}")
+    return violations
+
+
+def verify_broadcast_leg(matches: int, ticks: int, seed: int,
+                         artifact_dir=None) -> bool:
     """The broadcast scenario: chaos-kill a hub-fanned, journaled match
     whose native harvest is dead; verify journal recovery, viewer
     continuity, and survivor bit-identity — then print the hub's metrics
@@ -236,6 +331,19 @@ def verify_broadcast_leg(matches: int, ticks: int, seed: int) -> bool:
             violations.append(f"side socket {k}: wire diverged")
     print("  hub metrics digest:")
     print(chaos["hub"].metrics_digest())
+    _write_artifact(artifact_dir, "spectator", {
+        "scenario": "spectator",
+        "verdict": "PASS" if not violations else "FAIL",
+        "violations": violations,
+        "target_state": chaos["states"][0],
+        "target_frame": chaos["frames"][0],
+        "fault_log": [
+            {"tick": f.tick, "code": f.code, "detail": f.detail}
+            for f in pool.fault_log(0)
+        ],
+        "metrics": json_snapshot(chaos["registry"]),
+        "desync_report": None,
+    })
     if violations:
         print("  BROADCAST SCENARIO VIOLATED:")
         for v in violations:
@@ -254,6 +362,9 @@ def main() -> int:
     ap.add_argument("--seed", type=int, default=3)
     ap.add_argument("--fault", choices=[*FAULTS, "spectator", "all"],
                     default="all")
+    ap.add_argument("--artifact-dir", default=None, metavar="DIR",
+                    help="write one machine-readable JSON artifact per "
+                         "scenario (digest + verdict + DesyncReport paths)")
     args = ap.parse_args()
 
     names = (
@@ -263,10 +374,12 @@ def main() -> int:
     for name in names:
         if name == "spectator":
             ok &= verify_broadcast_leg(
-                min(args.matches, 2), args.ticks, args.seed
+                min(args.matches, 2), args.ticks, args.seed,
+                artifact_dir=args.artifact_dir,
             )
         else:
-            ok &= verify_leg(name, args.matches, args.ticks, args.seed)
+            ok &= verify_leg(name, args.matches, args.ticks, args.seed,
+                             artifact_dir=args.artifact_dir)
     print("chaos verdict:", "PASS" if ok else "FAIL")
     return 0 if ok else 1
 

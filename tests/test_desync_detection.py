@@ -1,0 +1,149 @@
+"""Tests for the reference desync-detection path in ``sessions/p2p.py``
+(p2p_session.rs:904-975) — interval scheduling, checksum compare, and
+event emission under lossy traffic — previously pinned only indirectly.
+
+The driver is ``ggrs_tpu.chaos.drive_desync_forensics``: two Python
+``P2PSession`` peers with ``DesyncDetection.on(interval)`` where peer B's
+saves carry perturbed checksums from ``fault_frame`` on (the classic
+nondeterminism bug, seeded at a known frame).
+"""
+
+from __future__ import annotations
+
+from ggrs_tpu.chaos import drive_desync_forensics
+from ggrs_tpu.core.types import DesyncDetected
+from ggrs_tpu.net.protocol import MAX_CHECKSUM_HISTORY_SIZE
+
+# far past any driven frame: the "no fault" sentinel
+NEVER = 1 << 40
+
+
+class TestIntervalScheduling:
+    def test_reports_land_on_the_interval_grid(self):
+        """With interval K the session sends checksum reports for frames
+        K, 2K, 3K, ... (reference: frame_to_send starts at the interval
+        and advances by it) — and a clean run emits no events."""
+        run = drive_desync_forensics(120, fault_frame=NEVER, interval=3,
+                                     seed=1)
+        for side in (0, 1):
+            frames = sorted(run[("a", "b")[side]]._local_checksum_history)
+            assert frames, "no checksum reports were ever scheduled"
+            assert all(f % 3 == 0 and f > 0 for f in frames)
+            # consecutive grid points: the scheduler never skips one
+            assert frames == list(range(frames[0], frames[-1] + 3, 3))
+        assert not run["desyncs"][0] and not run["desyncs"][1]
+
+    def test_remote_history_mirrors_the_grid(self):
+        """What each peer accumulates from the other's reports sits on the
+        same grid (the compare consumes pending_checksums; the forensic
+        window keeps them) — held by the attached flight recorder, with
+        the session-local store staying empty (one store, never both)."""
+        run = drive_desync_forensics(120, fault_frame=NEVER, interval=4,
+                                     seed=2)
+        hist = run["recorders"][0].remote_checksums
+        assert len(hist) == 1
+        frames = next(iter(hist.values())).frames()
+        assert frames and all(f % 4 == 0 for f in frames)
+        assert not run["a"]._remote_checksum_history
+
+    def test_remote_history_without_recorder(self):
+        """No recorder attached: the window falls back to the session's
+        own store and reports still bisect."""
+        from ggrs_tpu.chaos import two_peer_builder
+        from ggrs_tpu.core.types import DesyncDetection
+        from ggrs_tpu.net import InMemoryNetwork
+
+        clock = [0]
+        net = InMemoryNetwork(latency_ticks=1, seed=21)
+        sessions = [
+            two_peer_builder(clock, 60 + me, me, ("B", "A")[me])
+            .with_desync_detection_mode(DesyncDetection.on(1))
+            .start_p2p_session(net.socket(("A", "B")[me]))
+            for me in (0, 1)
+        ]
+        for i in range(120):
+            clock[0] += 16
+            for me, s in enumerate(sessions):
+                s.add_local_input(me, i % 16)
+                for r in s.advance_frame():
+                    if type(r).__name__ == "SaveGameState":
+                        cs = r.frame + (500 if me == 1 and r.frame >= 30
+                                        else 0)
+                        r.cell.save(r.frame, r.frame, cs)
+                s.events()
+            net.tick()
+        assert sessions[0]._remote_checksum_history
+        assert sessions[0].desync_reports
+        assert sessions[0].desync_reports[0].first_divergent_frame == 30
+
+    def test_local_history_pruned_to_max(self):
+        """The local checksum history stays bounded by
+        MAX_CHECKSUM_HISTORY_SIZE (reference: p2p_session.rs:966-975)."""
+        run = drive_desync_forensics(
+            MAX_CHECKSUM_HISTORY_SIZE + 120, fault_frame=NEVER, interval=1,
+            seed=3,
+        )
+        hist = run["a"]._local_checksum_history
+        assert 0 < len(hist) <= MAX_CHECKSUM_HISTORY_SIZE
+        # pruning keeps the newest window
+        sent = run["a"]._last_sent_checksum_frame
+        assert max(hist) == sent
+
+
+class TestChecksumCompare:
+    def test_divergence_detected_on_both_ends(self):
+        """A state divergence at frame F with interval 1 fires
+        DesyncDetected on BOTH peers, first at exactly frame F, carrying
+        the two differing checksums."""
+        run = drive_desync_forensics(160, fault_frame=40, interval=1,
+                                     seed=4)
+        for side in (0, 1):
+            events = run["desyncs"][side]
+            assert events, f"peer {side} never detected the desync"
+            first = min(events, key=lambda e: e.frame)
+            assert first.frame == 40
+            assert first.local_checksum != first.remote_checksum
+            assert isinstance(first, DesyncDetected)
+
+    def test_detection_lands_on_next_grid_point(self):
+        """With interval K, a fault between grid points is first detected
+        at the next reported frame (the interval is the detection
+        granularity)."""
+        run = drive_desync_forensics(200, fault_frame=42, interval=4,
+                                     seed=5)
+        assert min(e.frame for e in run["desyncs"][0]) == 44
+        assert min(e.frame for e in run["desyncs"][1]) == 44
+
+    def test_agreeing_frames_never_fire(self):
+        """Every detected frame is at or after the fault — frames before
+        it agreed and must not fire (false positives page humans)."""
+        run = drive_desync_forensics(200, fault_frame=60, interval=1,
+                                     seed=6)
+        for side in (0, 1):
+            assert all(e.frame >= 60 for e in run["desyncs"][side])
+
+
+class TestUnderLossAndReorder:
+    def test_detection_survives_faulty_transport(self):
+        """Checksum reports ride the unreliable channel (no retransmit):
+        loss/dup/reorder may delay detection past the fault frame but must
+        not break it, and must never produce a pre-fault detection."""
+        run = drive_desync_forensics(
+            400, fault_frame=50, interval=2, seed=7,
+            fault_cfg=dict(latency_ticks=1, loss=0.05, duplicate=0.03,
+                           reorder=0.05, seed=77),
+        )
+        for side in (0, 1):
+            events = run["desyncs"][side]
+            assert events, f"peer {side} lost the desync to packet loss"
+            assert min(e.frame for e in events) >= 50
+
+    def test_clean_under_faulty_transport(self):
+        """Loss and reordering alone (no state fault) never fabricate a
+        desync."""
+        run = drive_desync_forensics(
+            300, fault_frame=NEVER, interval=2, seed=8,
+            fault_cfg=dict(latency_ticks=1, loss=0.08, duplicate=0.05,
+                           reorder=0.08, seed=78),
+        )
+        assert not run["desyncs"][0] and not run["desyncs"][1]
