@@ -120,6 +120,13 @@ CONFIGS = {
         "run_broadcast_fanout", 900,
         {"GGRS_BENCH_PLATFORM": "cpu"},
     ),
+    # the kernel-batched socket datapath (DESIGN.md §15): B=64 matches
+    # over real loopback UDP with per-match viewer fan-out — socket
+    # syscalls per pool tick and host-loop p99, native_io on vs off
+    "host_bank_io": (
+        "run_host_bank_io", 900,
+        {"GGRS_BENCH_PLATFORM": "cpu"},
+    ),
     "flagship": ("run_flagship", 900),
 }
 
@@ -1817,6 +1824,241 @@ def run_host_bank_degraded() -> None:
         healthy[0][1] / d99 if d99 else 0.0,
         obs=dsnap,  # the degraded run's fault/eviction/crossing counters
     )
+
+
+class _AckingViewer:
+    """Minimal spectator endpoint for the io bench: drains its UDP
+    socket, tracks the newest InputMessage start frame, and acks once per
+    tick — enough inbound/outbound viewer traffic to make the host's
+    per-datagram syscall bill honest without ticking 512 full
+    ``SpectatorSession`` objects."""
+
+    def __init__(self, host_addr):
+        from ggrs_tpu.net.sockets import UdpNonBlockingSocket
+
+        self.sock = UdpNonBlockingSocket(0)
+        self.addr = ("127.0.0.1", self.sock.local_port())
+        self.host = host_addr
+        self.last = -1
+
+    def tick(self) -> None:
+        from ggrs_tpu.net.messages import InputAck, InputMessage, Message
+
+        saw = False
+        for _, msg in self.sock.receive_all_messages():
+            if isinstance(msg.body, InputMessage):
+                if msg.body.start_frame > self.last:
+                    self.last = msg.body.start_frame
+                saw = True
+        if saw:
+            self.sock.send_to(
+                Message(0x5150, InputAck(self.last)), self.host
+            )
+
+
+def run_host_bank_io() -> None:
+    """The kernel-batched socket datapath (DESIGN.md §15): B=64 matches
+    over REAL loopback UDP, each host slot with one external peer and
+    ``IO_VIEWERS`` fan-out viewers — the topology whose packet path is
+    hundreds of sendto/recvfrom syscalls per pool tick on the Python
+    shuttle.  Two legs, identical traffic: ``native_io=True`` (one
+    recvmmsg + one sendmmsg per slot per tick via ggrs_bank_pump) vs the
+    per-datagram shuttle.  Reported: host socket syscalls per pool tick
+    (target ≥10× fewer; ``vs_baseline`` = ratio/10, ≥1 = met) and the
+    host-loop p99 (``vs_baseline`` = shuttle p99 / batched p99, ≥1 = no
+    worse)."""
+    import random as _random
+
+    from ggrs_tpu.broadcast import SpectatorHub
+    from ggrs_tpu.core import Local, Remote
+    from ggrs_tpu.core.config import Config
+    from ggrs_tpu.net import _native
+    from ggrs_tpu.net.sockets import UdpNonBlockingSocket
+    from ggrs_tpu.obs import Registry
+    from ggrs_tpu.parallel import HostSessionPool
+    from ggrs_tpu.sessions import SessionBuilder
+
+    if os.environ.get("GGRS_TPU_NO_NATIVE") or _native.bank_lib() is None:
+        print("# skip: host_bank_io needs the native toolchain", flush=True)
+        return
+    io_available = _native.net_lib() is not None
+
+    B = 64
+    IO_VIEWERS = 8
+    WARMUP, T = 16, 120
+    cfg = Config.for_uint(16)
+
+    def leg(native_io: bool, trace: bool = False, warmup: int = WARMUP,
+            t: int = T):
+        from ggrs_tpu.obs import Tracer
+
+        clock = [0]
+        pool = HostSessionPool(
+            native_io=native_io, metrics=Registry(),
+            tracer=Tracer(capacity=1 << 12) if trace else None,
+        )
+        hub = SpectatorHub(pool, rng=_random.Random(99))
+        peers = []
+        host_socks = []
+        viewer_groups = []
+        for m in range(B):
+            host_sock = UdpNonBlockingSocket(0)
+            peer_sock = UdpNonBlockingSocket(0)
+            host_addr = ("127.0.0.1", host_sock.local_port())
+            pool.add_session(
+                SessionBuilder(cfg)
+                .with_clock(lambda: clock[0])
+                .with_rng(_random.Random(3 + 5 * m))
+                .add_player(Local(), 0)
+                .add_player(
+                    Remote(("127.0.0.1", peer_sock.local_port())), 1
+                ),
+                host_sock,
+            )
+            peers.append(
+                SessionBuilder(cfg)
+                .with_clock(lambda: clock[0])
+                .with_rng(_random.Random(4 + 5 * m))
+                .add_player(Local(), 1)
+                .add_player(Remote(host_addr), 0)
+                .start_p2p_session(peer_sock)
+            )
+            host_socks.append(host_sock)
+            viewer_groups.append(
+                [_AckingViewer(host_addr) for _ in range(IO_VIEWERS)]
+            )
+        for m, group in enumerate(viewer_groups):
+            for v in group:
+                hub.attach(m, v.addr)
+        if not pool.native_active:
+            return None
+        if native_io and not pool.native_io_active:
+            return None
+
+        def fulfill(reqs):
+            for r in reqs:
+                if type(r).__name__ == "SaveGameState":
+                    r.cell.save(r.frame, None, None)
+
+        host_ms = np.empty(t)
+
+        def tick(i, record=None):
+            clock[0] += 16
+            for m, peer in enumerate(peers):
+                peer.add_local_input(1, (i + m) % 16)
+                fulfill(peer.advance_frame())
+            for group in viewer_groups:
+                for v in group:
+                    v.tick()
+            t0 = time.perf_counter()
+            for m in range(B):
+                pool.add_local_input(m, 0, (i + m) % 16)
+            for reqs in pool.advance_all():
+                fulfill(reqs)
+            if record is not None:
+                host_ms[record] = (time.perf_counter() - t0) * 1e3
+
+        enter_honest_timing_mode()
+        for i in range(warmup):
+            tick(i)
+        io0 = pool.io_stats()
+        py0 = sum(s.io_syscalls for s in host_socks)
+        for i in range(t):
+            tick(warmup + i, record=i)
+        io1 = pool.io_stats()
+        py1 = sum(s.io_syscalls for s in host_socks)
+        native_calls = (
+            io1["recv_calls"] + io1["send_calls"]
+            - io0["recv_calls"] - io0["send_calls"]
+        )
+        datagrams = (
+            io1["recv_datagrams"] + io1["send_datagrams"]
+            - io0["recv_datagrams"] - io0["send_datagrams"]
+        )
+        syscalls_per_tick = (native_calls + (py1 - py0)) / t
+        p99 = float(np.percentile(host_ms, 99))
+        p50 = float(np.percentile(host_ms, 50))
+        frames = [pool.current_frame(m) for m in range(B)]
+        phases = None
+        if trace:
+            totals = pool.native_phase_totals()
+            if totals is not None:
+                timed, ph = totals
+                phases = {
+                    k: ph.get(k, 0) / max(timed, 1) / 1e3  # us/tick
+                    for k in ("inbound", "outbound", "fanout")
+                }
+        result = dict(
+            syscalls=syscalls_per_tick,
+            dgrams_per_tick=datagrams / t,
+            p99=p99, p50=p50,
+            min_frame=min(frames),
+            phases=phases,
+        )
+        # release the leg's ~640 fds NOW: the pool<->hub cycle keeps the
+        # socket objects alive until a full GC pass, and four legs of
+        # unclosed fds would trip a default 1024-fd ulimit mid-bench
+        del pool, hub
+        for sock in host_socks:
+            sock.close()
+        for peer in peers:
+            peer._socket.close()
+        for group in viewer_groups:
+            for v in group:
+                v.sock.close()
+        return result
+
+    shuttle = leg(False)
+    if shuttle is None:
+        print("# skip: host_bank_io pool did not engage the native bank",
+              flush=True)
+        return
+    batched = leg(True) if io_available else None
+    if batched is None:
+        print("# skip: host_bank_io batched leg unavailable "
+              "(no recvmmsg/sendmmsg)", flush=True)
+        return
+    assert batched["min_frame"] > T - 32, "a batched match stalled"
+    ratio = (
+        shuttle["syscalls"] / batched["syscalls"]
+        if batched["syscalls"] else 0.0
+    )
+    emit(
+        "host_bank_io_syscalls_per_tick", batched["syscalls"],
+        f"host socket syscalls per pool tick, B={B} matches x "
+        f"{IO_VIEWERS} viewers, native_io on (shuttle "
+        f"{shuttle['syscalls']:.0f}/tick; {ratio:.1f}x fewer; "
+        f"~{batched['dgrams_per_tick']:.0f} datagrams/tick batched; "
+        f"target >=10x)",
+        ratio / 10.0,
+    )
+    emit(
+        f"host_bank_io_b{B}_tick_ms_p99", batched["p99"],
+        f"ms/tick p99, host loop only, native_io on (p50 "
+        f"{batched['p50']:.2f} ms; shuttle p99 {shuttle['p99']:.2f} ms "
+        f"p50 {shuttle['p50']:.2f} ms; >=1.0 = no worse than shuttle)",
+        shuttle["p99"] / batched["p99"] if batched["p99"] else 0.0,
+    )
+    # the PR 5 in-crossing phase timers price the move honestly: on the
+    # batched leg, inbound/outbound now INCLUDE the kernel I/O that used
+    # to live in Python outside the crossing (short traced legs; the p99
+    # above stays untraced)
+    ph_shuttle = leg(False, trace=True, warmup=8, t=60)
+    ph_batched = leg(True, trace=True, warmup=8, t=60)
+    if (ph_shuttle and ph_batched and ph_shuttle["phases"]
+            and ph_batched["phases"]):
+        ps, pb = ph_shuttle["phases"], ph_batched["phases"]
+        total_b = sum(pb.values())
+        emit(
+            "host_bank_io_phase_us_per_tick", total_b,
+            "us/tick in-crossing inbound+outbound+fanout with native_io on "
+            f"(inbound {pb['inbound']:.0f} outbound {pb['outbound']:.0f} "
+            f"fanout {pb['fanout']:.0f}; shuttle crossing-only "
+            f"{ps['inbound']:.0f}/{ps['outbound']:.0f}/{ps['fanout']:.0f} "
+            "us — the batched phases now CONTAIN the kernel I/O the "
+            "shuttle paid per-datagram in Python outside the crossing)",
+            1.0,
+        )
 
 
 # ---------------------------------------------------------------------------

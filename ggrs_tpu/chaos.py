@@ -199,6 +199,106 @@ def drive_chaos(
     return ctx
 
 
+def drive_socket_chaos(
+    ticks: int,
+    n_matches: int = 3,
+    seed: int = 0,
+    inject: Optional[Callable[[int, Dict[str, Any]], Any]] = None,
+    metrics: Optional[Registry] = None,
+) -> Dict[str, Any]:
+    """The batched-datapath sibling of :func:`drive_chaos` (DESIGN.md
+    §15): ``n_matches + 1`` host slots over REAL loopback UDP with
+    ``native_io=True``, each matched against an external Python
+    ``P2PSession`` on a frozen list-clock (loopback UDP is reliable and
+    ordered at this volume, so identical arguments produce a bit-identical
+    run — the control/chaos comparison contract).  The last slot is the
+    target; ``inject(i, ctx)`` typically fires
+    ``pool.inject_socket_errno`` storms at it.  Every slot's outbound
+    wire bytes are recorded through the NetBatch capture tee (exact
+    sendmmsg order), so survivors can be pinned bit-identical to a
+    fault-free control leg.
+
+    Raises ``RuntimeError`` when the kernel-batched datapath is
+    unavailable on this platform — callers skip the scenario.
+    """
+    from .net import _native
+    from .net.sockets import UdpNonBlockingSocket
+
+    if _native.net_lib() is None:
+        raise RuntimeError("kernel-batched socket datapath unavailable")
+    base = seed * 1000
+    clock = [0]
+    registry = metrics if metrics is not None else Registry()
+    pool = HostSessionPool(metrics=registry, native_io=True)
+    peers = []
+    n = n_matches + 1
+    for m in range(n):
+        host_sock = UdpNonBlockingSocket(0)
+        peer_sock = UdpNonBlockingSocket(0)
+        pool.add_session(
+            two_peer_builder(
+                clock, base + 3 + 5 * m, 0,
+                ("127.0.0.1", peer_sock.local_port()),
+            ),
+            host_sock,
+        )
+        peers.append(two_peer_builder(
+            clock, base + 4 + 5 * m, 1,
+            ("127.0.0.1", host_sock.local_port()),
+        ).start_p2p_session(peer_sock))
+    if not pool.native_active:
+        raise RuntimeError("native session bank unavailable")
+    if not pool.native_io_active:
+        raise RuntimeError("batched datapath did not attach")
+    target = n - 1
+    for m in range(n):
+        pool._io_set_capture(m)
+
+    wire: List[List[bytes]] = [[] for _ in range(n)]
+    reqs_log: List[List] = [[] for _ in range(n)]
+    events_log: List[List] = [[] for _ in range(n)]
+
+    def sched(i, idx):
+        return ((i + 2 * idx) // (2 + idx % 3)) % 16
+
+    ctx: Dict[str, Any] = dict(
+        pool=pool, peers=peers, target=target, clock=clock, seed=seed,
+    )
+    for i in range(ticks):
+        clock[0] += 16
+        if inject is not None:
+            inject(i, ctx)
+        for m, peer in enumerate(peers):
+            peer.add_local_input(1, sched(i, m))
+            fulfill(peer.advance_frame())
+        for idx in range(n):
+            pool.add_local_input(idx, 0, sched(i, idx))
+        for idx, reqs in enumerate(pool.advance_all()):
+            fulfill(reqs)
+            reqs_log[idx].append(req_summary(reqs))
+        for idx in range(n):
+            events_log[idx].extend(pool.events(idx))
+            # evicted slots leave the capture tee (their sends ride the
+            # Python socket again); drain what the tee still holds
+            if pool.io_state(idx) == "native":
+                wire[idx].extend(
+                    data for _, data in pool._io_drain_capture(idx)
+                )
+    ctx.update(
+        wire=wire,
+        reqs=reqs_log,
+        events=events_log,
+        states=[pool.slot_state(i) for i in range(n)],
+        io_states=[pool.io_state(i) for i in range(n)],
+        frames=[pool.current_frame(i) for i in range(n)],
+        peer_frames=[p.current_frame for p in peers],
+        io=pool.io_stats(),
+        registry=registry,
+        scrape=pool.scrape(),
+    )
+    return ctx
+
+
 def drive_desync_forensics(
     ticks: int,
     fault_frame: int,

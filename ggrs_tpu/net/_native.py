@@ -113,6 +113,7 @@ def _sources() -> List[Path]:
         _native_dir() / "endpoint.cpp",
         _native_dir() / "sync_core.cpp",
         _native_dir() / "session_bank.cpp",
+        _native_dir() / "net_batch.cpp",
     ]
 
 
@@ -461,6 +462,65 @@ def _load() -> Optional[ctypes.CDLL]:
                 lib.ggrs_bank_set_timing.argtypes = [
                     ctypes.c_void_p, ctypes.c_int,
                 ]
+            if hasattr(lib, "ggrs_bank_pump"):
+                # kernel-batched socket datapath (net_batch.cpp + the
+                # bank's pump entry, DESIGN.md §15); absent on a prebuilt
+                # pre-io .so — pools keep the Python shuttle, and the
+                # stats layout then carries no per-slot io tail
+                lib.ggrs_bank_pump.restype = ctypes.c_int
+                lib.ggrs_bank_pump.argtypes = [
+                    ctypes.c_void_p, ctypes.c_int64,
+                    ctypes.c_char_p, ctypes.c_size_t,
+                    ctypes.c_char_p, ctypes.c_size_t,
+                    ctypes.POINTER(ctypes.c_size_t),
+                ]
+                lib.ggrs_bank_attach_socket.restype = ctypes.c_int
+                lib.ggrs_bank_attach_socket.argtypes = [
+                    ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+                ]
+                lib.ggrs_bank_detach_socket.restype = ctypes.c_int
+                lib.ggrs_bank_detach_socket.argtypes = [
+                    ctypes.c_void_p, ctypes.c_int64,
+                ]
+                lib.ggrs_bank_map_addr.restype = ctypes.c_int
+                lib.ggrs_bank_map_addr.argtypes = [
+                    ctypes.c_void_p, ctypes.c_int64, ctypes.c_int,
+                    ctypes.c_int64, ctypes.c_uint32, ctypes.c_uint16,
+                ]
+                lib.ggrs_net_supported.restype = ctypes.c_int
+                lib.ggrs_net_supported.argtypes = []
+                lib.ggrs_net_attach.restype = ctypes.c_void_p
+                lib.ggrs_net_attach.argtypes = [ctypes.c_int, ctypes.c_int]
+                lib.ggrs_net_free.restype = None
+                lib.ggrs_net_free.argtypes = [ctypes.c_void_p]
+                lib.ggrs_net_recv_all.restype = ctypes.c_int
+                lib.ggrs_net_recv_all.argtypes = [ctypes.c_void_p]
+                lib.ggrs_net_stage.restype = ctypes.c_int
+                lib.ggrs_net_stage.argtypes = [
+                    ctypes.c_void_p, ctypes.c_uint32, ctypes.c_uint16,
+                    ctypes.c_char_p, ctypes.c_size_t,
+                ]
+                lib.ggrs_net_flush.restype = ctypes.c_int
+                lib.ggrs_net_flush.argtypes = [ctypes.c_void_p]
+                lib.ggrs_net_staged_len.restype = ctypes.c_int64
+                lib.ggrs_net_staged_len.argtypes = [ctypes.c_void_p]
+                lib.ggrs_net_stats.restype = None
+                lib.ggrs_net_stats.argtypes = [
+                    ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+                ]
+                lib.ggrs_net_set_capture.restype = None
+                lib.ggrs_net_set_capture.argtypes = [
+                    ctypes.c_void_p, ctypes.c_int,
+                ]
+                lib.ggrs_net_drain_capture.restype = ctypes.c_int
+                lib.ggrs_net_drain_capture.argtypes = [
+                    ctypes.c_void_p, ctypes.c_char_p, ctypes.c_size_t,
+                    ctypes.POINTER(ctypes.c_size_t),
+                ]
+                lib.ggrs_net_inject_send_errno.restype = None
+                lib.ggrs_net_inject_send_errno.argtypes = [
+                    ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+                ]
         _lib = lib
         return _lib
 
@@ -494,6 +554,23 @@ BANK_ERR_NO_PLAYERS = -74
 BANK_ERR_SEQUENCE = -75
 BANK_ERR_INJECTED = -76  # chaos-harness simulated slot fault (ctrl op 2)
 BANK_ERR_SPEC_STREAM = -77  # confirmed-input fan-out / journal tap failed
+BANK_ERR_IO = -78  # batched socket I/O failed fatally (per-slot fault)
+
+# net_batch.cpp return codes
+NET_OK = 0
+NET_ERR_UNSUPPORTED = -80
+NET_ERR_FATAL = -81
+NET_ERR_BAD_ARGS = -82
+
+# NetBatch counter order (ggrs_net_stats; also the per-slot io tail of
+# ggrs_bank_stats).  After the six scalars come two 8-bucket batch-size
+# histograms (recv then send) with upper bounds IO_BATCH_BUCKETS + inf.
+IO_STAT_FIELDS = (
+    "recv_calls", "recv_datagrams", "send_calls", "send_datagrams",
+    "send_errors", "oversized",
+)
+IO_BATCH_BUCKETS = (1, 2, 4, 8, 16, 32, 64)
+IO_STAT_WORDS = len(IO_STAT_FIELDS) + 2 * (len(IO_BATCH_BUCKETS) + 1)  # 22
 
 # endpoint-core observability counter order (ggrs_ep_stats out7; also the
 # per-endpoint tail of each ggrs_bank_stats record)
@@ -520,7 +597,26 @@ BANK_ERR_NAMES = {
     BANK_ERR_SEQUENCE: "remote input frame out of sequence",
     BANK_ERR_INJECTED: "injected fault (chaos harness)",
     BANK_ERR_SPEC_STREAM: "confirmed-input fan-out failed",
+    BANK_ERR_IO: "batched socket I/O failed fatally",
 }
+
+
+def net_lib() -> Optional[ctypes.CDLL]:
+    """The loaded library when the kernel-batched socket datapath is
+    usable: net_batch.cpp built with the bank's pump entry AND
+    recvmmsg/sendmmsg supported on this platform (``ggrs_net_supported``
+    is 0 on non-Linux stub builds).  ``GGRS_TPU_NO_NATIVE_IO=1`` forces
+    None — pools then keep the per-datagram Python shuttle, the
+    documented fallback (DESIGN.md §15)."""
+    lib = bank_lib()
+    if (
+        lib is None
+        or os.environ.get("GGRS_TPU_NO_NATIVE_IO")
+        or not hasattr(lib, "ggrs_bank_pump")
+        or not lib.ggrs_net_supported()
+    ):
+        return None
+    return lib
 
 
 def broadcast_lib() -> Optional[ctypes.CDLL]:

@@ -34,6 +34,19 @@ _OBS_OVERSIZED = default_registry().counter(
     "ggrs_socket_oversized_packets_total",
     "datagrams sent above the ideal fragmentation-safe UDP size",
 )
+# Syscall accounting (DESIGN.md §15): the Python shuttle pays one syscall
+# per datagram (plus the EAGAIN probe per drain) — these counters are what
+# the host_bank_io bench and the native recvmmsg/sendmmsg counters (which
+# ride the pool's one-crossing stats scrape) are compared against.
+# Increments are batched per drain, not per datagram.
+_OBS_SYSCALLS = default_registry().counter(
+    "ggrs_io_syscalls_total",
+    "socket syscalls by kind (sendto/recvfrom = per-datagram Python path; "
+    "recvmmsg/sendmmsg = kernel-batched native path)",
+    labels=("kind",),
+)
+_OBS_SENDTO = _OBS_SYSCALLS.labels(kind="sendto")
+_OBS_RECVFROM = _OBS_SYSCALLS.labels(kind="recvfrom")
 
 # Transient send failures a UDP socket can surface on Linux (often from a
 # previous datagram's ICMP error): the datagram counts as lost — which the
@@ -62,9 +75,17 @@ IDEAL_MAX_UDP_PACKET_SIZE = 508
 
 
 class NonBlockingSocket(Protocol[A]):
-    """Send one message; receive everything that arrived since last poll."""
+    """Send one message; receive everything that arrived since last poll.
+
+    ``send_datagram`` is the raw sibling of ``send_to`` for callers that
+    already hold encoded wire bytes (the session bank, the spectator hub):
+    no Message wrapper, no re-encode.  Implementations that also provide
+    ``receive_all_datagrams``/``fileno`` unlock the pool fast paths (raw
+    native parsing; kernel-batched I/O)."""
 
     def send_to(self, msg: Message, addr: A) -> None: ...
+
+    def send_datagram(self, data: bytes, addr: A) -> None: ...
 
     def receive_all_messages(self) -> List[Tuple[A, Message]]: ...
 
@@ -80,24 +101,59 @@ class UdpNonBlockingSocket:
         # socket-level counters (send_errors is the live field here; the
         # per-endpoint protocol stats carry their own copy of the rest)
         self.stats = NetworkStats()
+        # persistent receive buffer: the drain loop reads into this one
+        # bytearray via recvfrom_into instead of allocating a fresh 4 KiB
+        # bytes per datagram (the old recvfrom path's per-packet garbage)
+        self._recv_buf = bytearray(RECV_BUFFER_SIZE)
+        self._recv_view = memoryview(self._recv_buf)
+        # per-socket syscall count (sendto + recvfrom attempts) — the
+        # host_bank_io bench sums these over exactly the pool's sockets,
+        # which the process-wide _OBS_SYSCALLS counters cannot isolate
+        self.io_syscalls = 0
+        # oversized-warning rate limit: one log line per (addr, size-class)
+        # per socket; the obs counter still counts every oversized datagram
+        self._oversized_warned: set = set()
 
     @staticmethod
     def bind_to_port(port: int) -> "UdpNonBlockingSocket":
         return UdpNonBlockingSocket(port)
 
+    def fileno(self) -> int:
+        """The bound fd — what the pool hands to the native batched
+        datapath (``ggrs_net_attach``)."""
+        return self._sock.fileno()
+
+    def local_port(self) -> int:
+        return self._sock.getsockname()[1]
+
     def send_to(self, msg: Message, addr: Tuple[str, int]) -> None:
-        buf = msg.encode()
-        if len(buf) > IDEAL_MAX_UDP_PACKET_SIZE:
+        self.send_datagram(msg.encode(), addr)
+
+    def send_datagram(self, data: bytes, addr: Tuple[str, int]) -> None:
+        """Send already-encoded wire bytes: the raw sibling of ``send_to``
+        (no Message wrapper, no re-encode — the bank and the hub hold
+        encoded bytes already)."""
+        if len(data) > IDEAL_MAX_UDP_PACKET_SIZE:
             # Occasional large packets usually get through; persistent ones
-            # mean the input struct is too big.  Warn, don't fail.
+            # mean the input struct is too big.  Warn, don't fail — and
+            # warn ONCE per (addr, size-class): a steady state of oversized
+            # fan-out must not melt the log at pool scale.
             _OBS_OVERSIZED.inc()
-            logger.warning(
-                "Sending UDP packet of size %d bytes, larger than ideal (%d)",
-                len(buf),
-                IDEAL_MAX_UDP_PACKET_SIZE,
-            )
+            key = (addr, len(data) // 512)
+            if key not in self._oversized_warned:
+                self._oversized_warned.add(key)
+                logger.warning(
+                    "Sending UDP packet of size %d bytes to %s, larger than "
+                    "ideal (%d); further sends in this size class are "
+                    "counted, not logged",
+                    len(data),
+                    addr,
+                    IDEAL_MAX_UDP_PACKET_SIZE,
+                )
+        self.io_syscalls += 1
+        _OBS_SENDTO.inc()
         try:
-            self._sock.sendto(buf, addr)
+            self._sock.sendto(data, addr)
         except OSError as e:
             # mirror of the receive path's ConnectionResetError handling:
             # transient OS errors count as packet loss, not session death
@@ -121,17 +177,31 @@ class UdpNonBlockingSocket:
         """Raw variant of ``receive_all_messages``: undecoded datagram bytes.
         Sessions prefer this when the endpoint datapath can parse natively;
         undecodable packets are then dropped at the endpoint instead of here
-        (same observable behavior)."""
+        (same observable behavior).  Reads land in the persistent buffer
+        (``recvfrom_into``); only the datagram's actual bytes are copied
+        out, preserving arrival order."""
         received: List[Tuple[Tuple[str, int], bytes]] = []
+        sock = self._sock
+        view = self._recv_view
+        buf = self._recv_buf
+        calls = 0
         while True:
+            calls += 1  # every attempt is one syscall, the EAGAIN probe too
             try:
-                data, src = self._sock.recvfrom(RECV_BUFFER_SIZE)
+                n, src = sock.recvfrom_into(buf, RECV_BUFFER_SIZE)
             except BlockingIOError:
-                return received
-            except ConnectionResetError:
-                # datagram sockets surface this after send_to on some OSes
+                break
+            except ConnectionError:
+                # async ICMP errors (port unreachable after a send to a
+                # dead peer, reset after send_to on some OSes) surface on
+                # the NEXT receive of an unconnected UDP socket — skip
+                # them all, like the native path's ECONNRESET/ECONNREFUSED
+                # skip; one dead peer must not kill the whole drain
                 continue
-            received.append((src, data))
+            received.append((src, bytes(view[:n])))
+        self.io_syscalls += calls
+        _OBS_RECVFROM.inc(calls)
+        return received
 
     def close(self) -> None:
         self._sock.close()
@@ -174,11 +244,12 @@ class InMemoryNetwork:
         """Advance simulated time by one delivery tick."""
         self._tick += 1
 
-    def _send(self, from_addr: Hashable, to_addr: Hashable, msg: Message) -> None:
+    def _send(self, from_addr: Hashable, to_addr: Hashable,
+              payload: bytes) -> None:
+        # callers pass encoded bytes (real sockets don't share references)
         q = self._queues.get(to_addr)
         if q is None:
             return  # unroutable: dropped silently, like real UDP
-        payload = msg.encode()  # serialize: real sockets don't share references
         if self._faultless:
             # fast path for the common perfect-link configuration: no RNG
             # draws, no reordering checks
@@ -246,7 +317,12 @@ class FakeSocket:
         self.addr = addr
 
     def send_to(self, msg: Message, addr: Hashable) -> None:
-        self._network._send(self.addr, addr, msg)
+        self._network._send(self.addr, addr, msg.encode())
+
+    def send_datagram(self, data: bytes, addr: Hashable) -> None:
+        """Raw sibling of ``send_to`` (same fault injection, no Message
+        wrapper) — protocol parity with ``UdpNonBlockingSocket``."""
+        self._network._send(self.addr, addr, bytes(data))
 
     def receive_all_messages(self) -> List[Tuple[Hashable, Message]]:
         return self._network._receive(self.addr)

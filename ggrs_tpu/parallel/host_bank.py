@@ -48,6 +48,17 @@ tests and ``scripts/chaos.py`` drive faults through the real tick path;
 tests/test_bank_faults.py pins blast radius = 1 slot with the survivors
 bit-identical to a fault-free run.
 
+NATIVE I/O (DESIGN.md §15): with ``native_io=True`` each slot's UDP fd is
+attached to the kernel-batched datapath (native/net_batch.cpp) and the
+tick crossing becomes ``ggrs_bank_pump``: datagrams flow socket →
+crossing → socket through recvmmsg/sendmmsg with ZERO Python on the
+packet path — same wire bytes, same send order (pinned by
+tests/test_native_io.py under seeded loss/dup/reorder), one receive
+drain + one send flush per slot per tick instead of one syscall per
+datagram.  Fallback is per-slot and automatic: unattachable sockets
+(in-memory networks, wrappers without fileno, unresolvable addresses,
+non-Linux, GGRS_TPU_NO_NATIVE_IO) keep the exact Python shuttle below.
+
 OBSERVABILITY (PR 3, DESIGN.md §12): the pool is the obs subsystem's main
 instrumented surface.  Counters/gauges land in a ``ggrs_tpu.obs.Registry``
 (constructor argument; the process-wide default when omitted), a per-slot
@@ -69,6 +80,7 @@ from __future__ import annotations
 import ctypes
 import os
 import random
+import socket as _pysocket
 import struct
 import time
 import zlib
@@ -274,7 +286,7 @@ class _SessionMirror:
         "saved_states", "current_frame", "last_confirmed", "frames_ahead",
         "local_disc", "local_last", "event_queue", "next_recommended_sleep",
         "staged_inputs", "pending_ctrl",
-        "spectators", "addr_to_spec", "next_spec_frame",
+        "spectators", "addr_to_spec", "next_spec_frame", "send_raw",
     )
 
     def __init__(self, config, socket, num_players, max_prediction,
@@ -304,6 +316,15 @@ class _SessionMirror:
         self.spectators: List[_SpectatorMirror] = []
         self.addr_to_spec: Dict[Any, int] = {}
         self.next_spec_frame: Frame = 0
+        # raw datagram send: the socket's send_datagram when it has one
+        # (no RawMessage wrapper, no re-encode), else a send_to shim —
+        # bound once at finalization, called per outbound datagram
+        send = getattr(socket, "send_datagram", None)
+        if send is None:
+            send = lambda data, addr, _s=socket: _s.send_to(  # noqa: E731
+                RawMessage(data), addr
+            )
+        self.send_raw = send
 
     def push_event(self, event) -> None:
         self.event_queue.append(event)
@@ -337,7 +358,24 @@ class HostSessionPool:
     def __init__(self, retire_dead_matches: bool = False,
                  metrics: Optional[Registry] = None,
                  flight_recorder_size: int = 256,
-                 tracer: Optional[Tracer] = None) -> None:
+                 tracer: Optional[Tracer] = None,
+                 native_io: bool = False) -> None:
+        # native_io (DESIGN.md §15): attach each slot's UDP fd to the
+        # kernel-batched datapath (net_batch.cpp) so datagrams flow
+        # socket -> crossing -> socket with zero Python on the packet path
+        # (one recvmmsg + one sendmmsg per slot per tick instead of one
+        # syscall per datagram).  Per-slot automatic fallback to the
+        # Python shuttle whenever the fd is not native-attachable:
+        # in-memory fault networks, wrapped sockets, unresolvable peer
+        # addresses, non-Linux builds, GGRS_TPU_NO_NATIVE_IO=1.
+        self.native_io = native_io
+        self._use_pump = False
+        self._net_handles: List[Optional[int]] = []
+        self._io_attached: List[bool] = []
+        self._io_prev: Dict[Tuple[int, int], int] = {}  # (slot, word) deltas
+        # final counter snapshots of detached/evicted slots: io_stats()
+        # totals must never regress when a NetBatch is released
+        self._io_final: Dict[int, Dict[str, Any]] = {}
         self._builders: List[Tuple[Any, Any]] = []
         self._finalized = False
         self._native_active = False
@@ -428,6 +466,34 @@ class HostSessionPool:
             "ggrs_spectator_catchup_lag",
             "frames broadcast but not yet acked by the viewer",
             labels=("slot", "spectator"))
+        # ---- batched I/O (DESIGN.md §15): refreshed from the scrape's
+        # per-slot io tail (the native counters ride the SAME one-crossing
+        # stats harvest; nothing here touches the packet path) ----
+        self._m_io_syscalls = m.counter(
+            "ggrs_io_syscalls_total",
+            "socket syscalls by kind (sendto/recvfrom = per-datagram "
+            "Python path; recvmmsg/sendmmsg = kernel-batched native path)",
+            labels=("kind",))
+        self._m_io_dgrams = m.counter(
+            "ggrs_io_datagrams_total",
+            "datagrams moved by the kernel-batched datapath, by direction",
+            labels=("dir",))
+        self._m_io_send_errors = m.counter(
+            "ggrs_io_send_errors_total",
+            "transient native send failures counted as packet loss")
+        self._m_io_oversized = m.counter(
+            "ggrs_io_oversized_total",
+            "natively-sent datagrams above the ideal UDP size")
+        self._m_io_recv_batch = m.histogram(
+            "ggrs_io_recv_batch_size",
+            "datagrams per recvmmsg call", buckets=_native.IO_BATCH_BUCKETS)
+        self._m_io_send_batch = m.histogram(
+            "ggrs_io_send_batch_size",
+            "datagrams per sendmmsg call", buckets=_native.IO_BATCH_BUCKETS)
+        self._m_io_recvmmsg = self._m_io_syscalls.labels(kind="recvmmsg")
+        self._m_io_sendmmsg = self._m_io_syscalls.labels(kind="sendmmsg")
+        self._m_io_dgrams_in = self._m_io_dgrams.labels(dir="in")
+        self._m_io_dgrams_out = self._m_io_dgrams.labels(dir="out")
         self._quarantined_at: Dict[int, int] = {}  # index -> quarantine tick
         self._stats_cache: Optional[Tuple[int, List[Dict[str, Any]]]] = None
         self._setter_cache: Dict[int, Any] = {}  # slot -> prebound gauge sets
@@ -493,6 +559,7 @@ class HostSessionPool:
         # (crash recovery — the chaos suite kills a slot's native state).
         self._spectator_hub: Optional[Any] = None
         self._has_spec = False
+        self._has_io_layout = False
         self._journal_sinks: Dict[int, Any] = {}
         self._journal_recovery: Dict[int, Any] = {}
 
@@ -511,6 +578,8 @@ class HostSessionPool:
     def _finalize(self) -> None:
         self._finalized = True
         self._slot_state = [SLOT_NATIVE] * len(self._builders)
+        self._net_handles = [None] * len(self._builders)
+        self._io_attached = [False] * len(self._builders)
         self._fault_log = [[] for _ in self._builders]
         self._recorders = [
             FlightRecorder(self._flight_capacity) if self._obs_on else None
@@ -565,6 +634,9 @@ class HostSessionPool:
         # the broadcast command/output layout is spoken whenever the
         # library carries the entry points — spectator tables may be empty
         self._has_spec = hasattr(lib, "ggrs_bank_attach_spectator")
+        # a library built with the batched datapath emits a per-slot io
+        # tail on every stats dump (u8 flag + counters when attached)
+        self._has_io_layout = hasattr(lib, "ggrs_bank_pump")
         # arm the in-crossing phase timers only when someone is tracing:
         # disarmed, the tick performs zero clock reads and emits the exact
         # pre-timing output layout (the on/off wire pin rides on this)
@@ -677,6 +749,107 @@ class HostSessionPool:
         self._out_buf = ctypes.create_string_buffer(
             max(1 << 16, per_session * len(self._mirrors))
         )
+        # ---- batched socket datapath (DESIGN.md §15) ----
+        # opt-in, per-slot, and failure is always a clean per-slot fallback
+        # to the Python shuttle — never an error.  net_lib() is None when
+        # the platform has no recvmmsg/sendmmsg, the library predates the
+        # datapath, or GGRS_TPU_NO_NATIVE_IO is set.
+        if self.native_io and _native.net_lib() is lib and lib is not None:
+            for i, m in enumerate(self._mirrors):
+                self._try_attach_io(i, m)
+            # pump only when someone actually attached: with zero attached
+            # slots the pump is semantically the tick but pays a per-tick
+            # cmd re-parse for its pre-drain scan
+            self._use_pump = any(self._io_attached)
+
+    @staticmethod
+    def _resolve_wire_addr(addr) -> Tuple[int, int]:
+        """(s_addr word, host-order port) for an ``(ipv4, port)`` tuple;
+        raises for anything the native datapath cannot address (hostnames,
+        in-memory addresses) — the caller falls back to the shuttle."""
+        host, port = addr
+        packed = _pysocket.inet_aton(host)
+        # "little" = host order: the native side stores this u32 straight
+        # into sin_addr.s_addr, so the bytes must round-trip unchanged.
+        # Sound because the native fast paths REFUSE to build on
+        # big-endian hosts (wire_common.h static_assert) — no library,
+        # no attach, no wrong-endian address.
+        return int.from_bytes(packed, "little"), int(port)
+
+    def _try_attach_io(self, index: int, m: _SessionMirror) -> None:
+        """Attach one slot's socket to the native datapath: the fd must be
+        a real one and every remote/spectator address must resolve to
+        (ipv4, port).  Any miss leaves the slot on the Python shuttle."""
+        lib = self._lib
+        fileno = getattr(m.socket, "fileno", None)
+        if fileno is None:
+            return
+        try:
+            fd = fileno()
+        except Exception:
+            return
+        if not isinstance(fd, int) or fd < 0:
+            return
+        try:
+            eps = [
+                (idx,) + self._resolve_wire_addr(addr)
+                for addr, idx in m.addr_to_ep.items()
+            ]
+            sps = [
+                (idx,) + self._resolve_wire_addr(addr)
+                for addr, idx in m.addr_to_spec.items()
+            ]
+        except (TypeError, ValueError, OSError):
+            return
+        handle = lib.ggrs_net_attach(fd, 64)
+        if not handle:
+            return
+        if lib.ggrs_bank_attach_socket(self._bank, index, handle) != 0:
+            lib.ggrs_net_free(handle)
+            return
+        for idx, ip, port in eps:
+            lib.ggrs_bank_map_addr(self._bank, index, 0, idx, ip, port)
+        for idx, ip, port in sps:
+            lib.ggrs_bank_map_addr(self._bank, index, 1, idx, ip, port)
+        self._net_handles[index] = handle
+        self._io_attached[index] = True
+
+    @staticmethod
+    def _io_words_to_dict(words) -> Dict[str, Any]:
+        """One NetBatch counter dump (22 u64s) as the scrape's io-record
+        shape."""
+        nf = len(_native.IO_STAT_FIELDS)
+        nb = len(_native.IO_BATCH_BUCKETS) + 1
+        io: Dict[str, Any] = dict(zip(_native.IO_STAT_FIELDS, words[:nf]))
+        io["recv_batches"] = list(words[nf:nf + nb])
+        io["send_batches"] = list(words[nf + nb:nf + 2 * nb])
+        return io
+
+    def _detach_io(self, index: int) -> None:
+        """Per-slot automatic fallback: return the slot to the Python
+        shuttle (eviction, or a late-attached spectator address the
+        native side cannot route) and release its NetBatch.  The final
+        counter snapshot is retained (and folded into the registry) so
+        ``io_stats()`` totals never regress across a detach."""
+        if not self._io_attached[index]:
+            return
+        self._lib.ggrs_bank_detach_socket(self._bank, index)
+        self._io_attached[index] = False
+        handle = self._net_handles[index]
+        self._net_handles[index] = None
+        if handle:
+            words = (ctypes.c_uint64 * _native.IO_STAT_WORDS)()
+            self._lib.ggrs_net_stats(handle, words)
+            io = self._io_words_to_dict(list(words))
+            self._io_final[index] = io
+            # flush the tail accrued since the last scrape into the
+            # registry counters before the source disappears
+            self._apply_io_metrics([dict(index=index, io=io)])
+            self._lib.ggrs_net_free(handle)
+        if not any(self._io_attached):
+            # last attached slot gone: drop back to the plain tick entry
+            # (the pump's pre-drain scan would walk the cmd for nothing)
+            self._use_pump = False
 
     # ------------------------------------------------------------------
     # per-tick API
@@ -768,15 +941,19 @@ class HostSessionPool:
                 cmd_parts.append(pack("<BHq", op, ep_idx, frame))
             datagrams = []
             spec_datagrams = []
-            addr_to_spec = m.addr_to_spec
-            for from_addr, data in m.socket.receive_all_datagrams():
-                ep_idx = m.addr_to_ep.get(from_addr)
-                if ep_idx is not None:
-                    datagrams.append((ep_idx, data))
-                elif addr_to_spec:
-                    sp_idx = addr_to_spec.get(from_addr)
-                    if sp_idx is not None:
-                        spec_datagrams.append((sp_idx, data))
+            if not self._io_attached[i]:
+                # the Python shuttle: drain + route per datagram here.
+                # Attached slots drain INSIDE the crossing (recvmmsg) —
+                # only injected chaos traffic rides the cmd sections.
+                addr_to_spec = m.addr_to_spec
+                for from_addr, data in m.socket.receive_all_datagrams():
+                    ep_idx = m.addr_to_ep.get(from_addr)
+                    if ep_idx is not None:
+                        datagrams.append((ep_idx, data))
+                    elif addr_to_spec:
+                        sp_idx = addr_to_spec.get(from_addr)
+                        if sp_idx is not None:
+                            spec_datagrams.append((sp_idx, data))
             datagrams.extend(self._inject_dgrams.pop(i, ()))
             cmd_parts.append(pack("<H", len(datagrams)))
             for ep_idx, data in datagrams:
@@ -794,7 +971,13 @@ class HostSessionPool:
         self.crossings += 1
         self._m_cross_tick.inc()
         t_cross = tracer.now_ns() if tracing else 0
-        rc = self._lib.ggrs_bank_tick(
+        # the pump is the tick crossing plus native socket I/O for
+        # attached slots — still exactly ONE crossing per pool tick
+        crossing = (
+            self._lib.ggrs_bank_pump if self._use_pump
+            else self._lib.ggrs_bank_tick
+        )
+        rc = crossing(
             self._bank, self._clock(), cmd, len(cmd),
             self._out_buf, len(self._out_buf), ctypes.byref(self._out_len),
         )
@@ -922,7 +1105,8 @@ class HostSessionPool:
             # advance_frame sends the remote input messages inline; the
             # fan-out messages it queues flush at the NEXT tick's poll).
             has_spec = self._has_spec
-            socket = m.socket
+            send_raw = m.send_raw  # socket.send_datagram (raw bytes, no
+            # RawMessage wrapper / re-encode) or the send_to shim
             send_failed: Optional[str] = None
             (n_out_poll,) = unpack_from("<H", buf, pos)
             pos += 2
@@ -939,7 +1123,7 @@ class HostSessionPool:
                     rec.record(self._tick_no, EV_WIRE,
                                (ep_idx, dlen, zlib.crc32(data)))
                 try:
-                    socket.send_to(RawMessage(data), m.endpoints[ep_idx].addr)
+                    send_raw(data, m.endpoints[ep_idx].addr)
                 except Exception as e:  # a send fault is THIS slot's fault
                     send_failed = f"socket send failed: {e!r}"
             adv_out: List[Tuple[int, bytes]] = []
@@ -1065,7 +1249,7 @@ class HostSessionPool:
                                      zlib.crc32(data)),
                                 )
                             try:
-                                socket.send_to(RawMessage(data), sp.addr)
+                                send_raw(data, sp.addr)
                                 fan_d()
                                 fan_b(len(data))
                             except Exception as exc:
@@ -1083,7 +1267,7 @@ class HostSessionPool:
                     rec.record(self._tick_no, EV_WIRE,
                                (ep_idx, len(data), zlib.crc32(data)))
                 try:
-                    socket.send_to(RawMessage(data), m.endpoints[ep_idx].addr)
+                    send_raw(data, m.endpoints[ep_idx].addr)
                 except Exception as e:
                     send_failed = f"socket send failed: {e!r}"
             if has_spec and live and m.spectators:
@@ -1269,6 +1453,12 @@ class HostSessionPool:
         if old == new_state:
             return
         self._slot_state[index] = new_state
+        if new_state != SLOT_NATIVE and self._io_attached[index]:
+            # a slot leaving the bank leaves the batched datapath with it:
+            # the evicted session owns the socket (per-datagram Python
+            # path), so io_state() must say "python" and the NetBatch is
+            # released rather than idling attached forever
+            self._detach_io(index)
         self._m_transitions.labels(src=old, dst=new_state).inc()
         self._m_slot_state.labels(state=old).dec()
         self._m_slot_state.labels(state=new_state).inc()
@@ -1685,6 +1875,19 @@ class HostSessionPool:
         m.addr_to_spec[addr] = int(sp_idx)
         m.spectators.append(_SpectatorMirror(addr, magic, handles or []))
         self._m_spectators.labels(slot=str(index)).set(len(m.spectators))
+        if self._io_attached[index]:
+            # the native datapath must be able to route this viewer; an
+            # unresolvable address drops the WHOLE slot back to the Python
+            # shuttle (per-slot automatic fallback) rather than silently
+            # never fanning out to one viewer
+            try:
+                ip, port = self._resolve_wire_addr(addr)
+            except (TypeError, ValueError, OSError):
+                self._detach_io(index)
+            else:
+                self._lib.ggrs_bank_map_addr(
+                    self._bank, index, 1, int(sp_idx), ip, port
+                )
         return int(sp_idx)
 
     def _detach_spectator(self, index: int, addr) -> None:
@@ -1784,6 +1987,160 @@ class HostSessionPool:
             )
             for sp in m.spectators
         ]
+
+    # ------------------------------------------------------------------
+    # batched socket datapath (DESIGN.md §15): observables + seams
+    # ------------------------------------------------------------------
+
+    def _io_delta(self, slot: int, key, value: int) -> int:
+        """Delta of a cumulative native counter since the last scrape (the
+        registry's counters are inc-only; the NetBatch reports totals)."""
+        k = (slot, key)
+        prev = self._io_prev.get(k, 0)
+        if value > prev:
+            self._io_prev[k] = value
+            return value - prev
+        return 0
+
+    def _bump_io_hist(self, fam, slot: int, key: str, buckets, sum_delta):
+        """Fold one slot's cumulative batch-size buckets into the pool
+        histogram (sum approximated by the datagram delta — a batch-size
+        histogram's sum IS its datagram count)."""
+        child = getattr(fam, "_default", None)
+        if child is None or getattr(fam, "kind", "") != "histogram":
+            return
+        total = 0
+        for j, v in enumerate(buckets):
+            d = self._io_delta(slot, (key, j), v)
+            child.counts[j] += d
+            total += d
+        child.count += total
+        child.sum += sum_delta
+
+    def _apply_io_metrics(self, stats: List[Dict[str, Any]]) -> None:
+        """Refresh the io instruments from the scrape's per-slot NetBatch
+        tails — the batched datapath's observability rides the SAME
+        one-crossing stats harvest (zero packet-path cost)."""
+        if not self._obs_on:
+            return
+        for s in stats:
+            io = s.get("io")
+            if not io:
+                continue
+            slot = s["index"]
+            recv_d = self._io_delta(slot, "recv_datagrams",
+                                    io["recv_datagrams"])
+            send_d = self._io_delta(slot, "send_datagrams",
+                                    io["send_datagrams"])
+            self._m_io_recvmmsg.inc(
+                self._io_delta(slot, "recv_calls", io["recv_calls"]))
+            self._m_io_sendmmsg.inc(
+                self._io_delta(slot, "send_calls", io["send_calls"]))
+            self._m_io_dgrams_in.inc(recv_d)
+            self._m_io_dgrams_out.inc(send_d)
+            self._m_io_send_errors.inc(
+                self._io_delta(slot, "send_errors", io["send_errors"]))
+            self._m_io_oversized.inc(
+                self._io_delta(slot, "oversized", io["oversized"]))
+            self._bump_io_hist(self._m_io_recv_batch, slot, "rb",
+                               io["recv_batches"], recv_d)
+            self._bump_io_hist(self._m_io_send_batch, slot, "sb",
+                               io["send_batches"], send_d)
+
+    @property
+    def native_io_active(self) -> bool:
+        """At least one slot's datagrams flow through the kernel-batched
+        native datapath (socket → crossing → socket, zero Python)."""
+        if not self._finalized:
+            self._finalize()
+        return any(self._io_attached)
+
+    def io_state(self, index: int) -> str:
+        """``"native"`` when the slot's socket is attached to the batched
+        datapath, ``"python"`` when it rides the per-datagram shuttle."""
+        if not self._finalized:
+            self._finalize()
+        return "native" if self._io_attached[index] else "python"
+
+    def io_stats(self) -> Dict[str, int]:
+        """Aggregated NetBatch counters over every attached slot (from
+        the one-crossing stats scrape; all zeros when nothing is
+        attached).  Keys: ``_native.IO_STAT_FIELDS``."""
+        out = dict.fromkeys(_native.IO_STAT_FIELDS, 0)
+        if not self._finalized:
+            self._finalize()
+        if not self._native_active:
+            return out
+        for s in self._bank_stats():
+            io = s.get("io")
+            # a detached slot's live tail is gone; its retained final
+            # snapshot keeps the totals monotonic
+            if io is None:
+                io = self._io_final.get(s["index"])
+            if io:
+                for k in _native.IO_STAT_FIELDS:
+                    out[k] += io[k]
+        return out
+
+    def _io_set_capture(self, index: int, on: bool = True) -> None:
+        """Test seam: tee every natively-sent datagram of slot ``index``
+        into a drainable buffer (the wire-parity pin's capture side)."""
+        if not self._finalized:
+            self._finalize()
+        if not self._io_attached[index]:
+            raise InvalidRequest(f"slot {index} is not on the native io path")
+        self._lib.ggrs_net_set_capture(
+            self._net_handles[index], 1 if on else 0
+        )
+
+    def _io_drain_capture(self, index: int) -> List[Tuple[Any, bytes]]:
+        """Drain slot ``index``'s capture tee: ``((ip, port), bytes)`` per
+        datagram, in exact send order."""
+        if not self._finalized:
+            self._finalize()
+        if not self._io_attached[index]:
+            raise InvalidRequest(f"slot {index} is not on the native io path")
+        handle = self._net_handles[index]
+        buf = ctypes.create_string_buffer(1 << 16)
+        out_len = ctypes.c_size_t(0)
+        while True:
+            rc = self._lib.ggrs_net_drain_capture(
+                handle, buf, len(buf), ctypes.byref(out_len)
+            )
+            if rc == _native.BANK_ERR_BUFFER_TOO_SMALL:
+                buf = ctypes.create_string_buffer(
+                    max(out_len.value, 2 * len(buf))
+                )
+                continue
+            if rc != 0:
+                raise RuntimeError(f"ggrs_net_drain_capture failed: {rc}")
+            break
+        b = buf.raw[: out_len.value]
+        out: List[Tuple[Any, bytes]] = []
+        pos = 0
+        unpack_from = struct.unpack_from
+        while pos < len(b):
+            ip, port, dlen = unpack_from("<IHI", b, pos)
+            pos += 10
+            addr = (_pysocket.inet_ntoa(ip.to_bytes(4, "little")), port)
+            out.append((addr, b[pos : pos + dlen]))
+            pos += dlen
+        return out
+
+    def inject_socket_errno(self, index: int, err: int,
+                            count: int = 1) -> None:
+        """Chaos hook: the next ``count`` datagrams slot ``index`` stages
+        on the native datapath fail with errno ``err`` before any syscall
+        — transient errnos (ENOBUFS, EAGAIN...) count as packet loss, a
+        fatal errno faults the slot (``BANK_ERR_IO``) exactly like a
+        raising ``sendto`` on the Python path."""
+        if not self._finalized:
+            self._finalize()
+        if not self._io_attached[index]:
+            raise InvalidRequest(f"slot {index} is not on the native io path")
+        self._lib.ggrs_net_inject_send_errno(
+            self._net_handles[index], int(err), int(count)
+        )
 
     # ------------------------------------------------------------------
     # chaos hooks (tests + scripts/chaos.py)
@@ -1938,6 +2295,7 @@ class HostSessionPool:
                     raise RuntimeError(f"ggrs_bank_stats failed: {rc}")
                 break
             stats = self._refresh_bank_records(out_len.value)
+            self._apply_io_metrics(stats)
         # evicted (and dead-after-eviction) slots: the bank record froze at
         # fault time; the live numbers are the Python session's
         for i, session in self._evicted.items():
@@ -1976,6 +2334,7 @@ class HostSessionPool:
                     ],
                     next_spectator_frame=0,
                     spectators=[],
+                    io=None,
                 )
                 for i, m in enumerate(self._mirrors)
             ]
@@ -2036,6 +2395,31 @@ class HostSessionPool:
                         "<B6q", buf, pos
                     )
                     pos += 49
+            if self._has_io_layout:
+                # batched-datapath tail (DESIGN.md §15): u8 flag, then 22
+                # u64 NetBatch counters when this slot has a socket
+                # attached.  Refilled in place, like everything else here.
+                (has_io,) = unpack_from("<B", buf, pos)
+                pos += 1
+                if has_io:
+                    words = unpack_from(
+                        f"<{_native.IO_STAT_WORDS}Q", buf, pos
+                    )
+                    pos += 8 * _native.IO_STAT_WORDS
+                    nf = len(_native.IO_STAT_FIELDS)
+                    nb = len(_native.IO_BATCH_BUCKETS) + 1
+                    io = rec["io"]
+                    if io is None:
+                        io = rec["io"] = dict.fromkeys(
+                            _native.IO_STAT_FIELDS, 0
+                        ) | {"recv_batches": [0] * nb,
+                             "send_batches": [0] * nb}
+                    for k, v in zip(_native.IO_STAT_FIELDS, words):
+                        io[k] = v
+                    io["recv_batches"][:] = words[nf:nf + nb]
+                    io["send_batches"][:] = words[nf + nb:]
+                else:
+                    rec["io"] = None
         if pos != end:
             raise RuntimeError("bank stats buffer layout mismatch")
         # a fresh list (the evicted overrides below must not clobber the
@@ -2373,5 +2757,10 @@ class HostSessionPool:
             if self._bank and self._lib is not None:
                 self._lib.ggrs_bank_free(self._bank)
                 self._bank = None
+            if self._lib is not None:
+                for i, handle in enumerate(self._net_handles):
+                    if handle:
+                        self._net_handles[i] = None
+                        self._lib.ggrs_net_free(handle)
         except Exception:
             pass

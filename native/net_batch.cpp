@@ -1,0 +1,422 @@
+// Kernel-batched UDP datapath: recvmmsg/sendmmsg ring buffers around one
+// bound non-blocking UDP fd (DESIGN.md §15).
+//
+// The Python shuttle pays one syscall PLUS one Python→C round trip per
+// datagram on both sides of the tick crossing; at B matches × peers plus
+// the spectator fan-out that is hundreds-to-thousands of sendto/recvfrom
+// calls per pool tick.  A NetBatch replaces them with (typically) one
+// recvmmsg and one sendmmsg per slot per tick: preallocated iovec +
+// sockaddr slabs, datagrams copied once into a per-tick accumulation slab
+// so the session bank can route them by source address without holding the
+// kernel rings.
+//
+// SEMANTICS mirror ggrs_tpu.net.sockets.UdpNonBlockingSocket exactly:
+//  - receive drains until EAGAIN/EWOULDBLOCK; ECONNRESET/ECONNREFUSED
+//    between datagrams is skipped (the post-sendto ICMP echo some OSes
+//    surface), anything else is fatal;
+//  - transient send errnos (the _TRANSIENT_SEND_ERRNOS set: ENETUNREACH,
+//    EHOSTUNREACH, ECONNREFUSED, ENETDOWN, EHOSTDOWN, ENOBUFS, EAGAIN,
+//    EWOULDBLOCK) count the datagram as lost — the endpoint protocol's
+//    redundant sends already cover loss — and the flush continues;
+//  - EMSGSIZE / EPERM and friends are deterministic local faults: the
+//    flush aborts fatally (the bank turns that into a per-slot fault, the
+//    same blast radius a raising socket.sendto has on the Python path);
+//  - datagrams above the 4096-byte receive buffer truncate, datagrams
+//    above the 508-byte ideal UDP size are counted (never blocked).
+//
+// The NetBatch is owned by the Python pool (ggrs_net_attach/free); the
+// session bank only borrows the pointer (ggrs_bank_attach_socket).  One
+// NetBatch serves one fd and is single-threaded, like everything else in
+// the host loop.
+//
+// TEST SEAMS (observational; zero cost when unused):
+//  - capture tee: every staged datagram is mirrored into a drainable
+//    buffer so parity fuzzes can pin the batched path's full wire byte
+//    sequence — content AND send order — against the Python shuttle;
+//  - errno injection: the next N staged datagrams fail with a chosen
+//    errno before reaching sendmmsg (scripts/chaos.py --fault socket).
+//
+// Non-Linux builds compile the same extern-C surface as stubs
+// (ggrs_net_supported() == 0); the pool then keeps the Python shuttle —
+// the fallback matrix in DESIGN.md §15.
+
+#include <cstddef>
+#include <cstdint>
+#include <cstring>
+#include <new>
+#include <vector>
+
+namespace {
+
+// return codes (mirrored in ggrs_tpu/net/_native.py)
+constexpr int kNetOk = 0;
+constexpr int kNetErrUnsupported = -80;
+constexpr int kNetErrFatal = -81;
+constexpr int kNetErrBadArgs = -82;
+constexpr int kNetErrBufferTooSmall = -11;  // wire_common kErrBufferTooSmall
+
+// sockets.py RECV_BUFFER_SIZE / IDEAL_MAX_UDP_PACKET_SIZE
+constexpr size_t kRecvBufSize = 4096;
+constexpr size_t kIdealMaxUdp = 508;
+
+// stat slots (mirrored as _native.IO_STAT_FIELDS + two 8-bucket
+// histograms; 22 u64 total, the per-slot io tail of ggrs_bank_stats)
+enum NetStat : int {
+  kStRecvCalls = 0,   // recvmmsg invocations (incl. the EAGAIN probe)
+  kStRecvDgrams = 1,  // datagrams received
+  kStSendCalls = 2,   // sendmmsg invocations
+  kStSendDgrams = 3,  // datagrams handed to the kernel
+  kStSendErrors = 4,  // transient send failures counted as loss
+  kStOversized = 5,   // staged datagrams above kIdealMaxUdp
+  kStRecvHist0 = 6,   // recv batch-size buckets: 1,2,4,8,16,32,64,+inf
+  kStSendHist0 = 14,  // send batch-size buckets, same bounds
+  kNumNetStats = 22,
+};
+
+inline int batch_bucket(int n) {
+  int b = 0, upper = 1;
+  while (b < 7 && n > upper) {
+    upper <<= 1;
+    ++b;
+  }
+  return b;
+}
+
+}  // namespace
+
+#if defined(__linux__)
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+
+namespace {
+
+bool transient_send_errno(int e) {
+  // _TRANSIENT_SEND_ERRNOS in sockets.py, member for member.  EMSGSIZE and
+  // EPERM are deliberately NOT here: deterministic local faults that every
+  // retransmission would hit identically must fail loudly, not stall.
+  switch (e) {
+    case ENETUNREACH:
+    case EHOSTUNREACH:
+    case ECONNREFUSED:
+    case ENETDOWN:
+#ifdef EHOSTDOWN
+    case EHOSTDOWN:
+#endif
+    case ENOBUFS:
+    case EAGAIN:
+#if EWOULDBLOCK != EAGAIN
+    case EWOULDBLOCK:
+#endif
+      return true;
+    default:
+      return false;
+  }
+}
+
+struct Dgram {
+  uint32_t ip;    // sin_addr.s_addr, network byte order as stored
+  uint16_t port;  // host byte order
+  uint32_t off, len;  // slice into the owning slab
+};
+
+struct NetBatch {
+  int fd = -1;
+  int vlen = 64;
+  // receive rings (kernel-facing, reused every recvmmsg)
+  std::vector<mmsghdr> rmsgs;
+  std::vector<iovec> riov;
+  std::vector<sockaddr_in> raddr;
+  std::vector<uint8_t> rbuf;  // vlen * kRecvBufSize
+  // per-tick accumulation (bank-facing: stable until the next recv_all)
+  std::vector<uint8_t> rslab;
+  std::vector<Dgram> rlist;
+  // staged sends (flushed in stage order)
+  std::vector<uint8_t> sslab;
+  std::vector<Dgram> slist;
+  std::vector<mmsghdr> smsgs;
+  std::vector<iovec> siov;
+  std::vector<sockaddr_in> saddr;
+  uint64_t st[kNumNetStats] = {0};
+  // test seams
+  bool capture = false;
+  std::vector<uint8_t> capture_buf;  // [u32 ip][u16 port][u32 len][bytes]*
+  int inject_errno = 0;
+  int inject_count = 0;
+};
+
+void put_u16le(std::vector<uint8_t>* b, uint16_t v) {
+  b->push_back(v & 0xFF);
+  b->push_back(v >> 8);
+}
+
+void put_u32le(std::vector<uint8_t>* b, uint32_t v) {
+  for (int i = 0; i < 4; ++i) b->push_back((v >> (8 * i)) & 0xFF);
+}
+
+}  // namespace
+
+extern "C" {
+
+int ggrs_net_supported(void) { return 1; }
+
+// Wrap a bound, non-blocking UDP fd.  The fd stays owned by the caller
+// (the Python socket object); max_batch bounds each recvmmsg/sendmmsg
+// window.  Returns NULL on bad args / allocation failure.
+void* ggrs_net_attach(int fd, int max_batch) {
+  if (fd < 0) return nullptr;
+  if (max_batch < 1) max_batch = 64;
+  if (max_batch > 1024) max_batch = 1024;
+  NetBatch* nb = new (std::nothrow) NetBatch();
+  if (!nb) return nullptr;
+  nb->fd = fd;
+  nb->vlen = max_batch;
+  size_t v = static_cast<size_t>(max_batch);
+  nb->rmsgs.resize(v);
+  nb->riov.resize(v);
+  nb->raddr.resize(v);
+  nb->rbuf.resize(v * kRecvBufSize);
+  nb->smsgs.resize(v);
+  nb->siov.resize(v);
+  nb->saddr.resize(v);
+  for (size_t i = 0; i < v; ++i) {
+    nb->riov[i].iov_base = nb->rbuf.data() + i * kRecvBufSize;
+    nb->riov[i].iov_len = kRecvBufSize;
+    std::memset(&nb->rmsgs[i], 0, sizeof(mmsghdr));
+    nb->rmsgs[i].msg_hdr.msg_iov = &nb->riov[i];
+    nb->rmsgs[i].msg_hdr.msg_iovlen = 1;
+    nb->rmsgs[i].msg_hdr.msg_name = &nb->raddr[i];
+    nb->rmsgs[i].msg_hdr.msg_namelen = sizeof(sockaddr_in);
+  }
+  return nb;
+}
+
+void ggrs_net_free(void* p) { delete static_cast<NetBatch*>(p); }
+
+// Drain everything available on the fd into the accumulation slab (the
+// receive_all_datagrams analog: loop until EAGAIN, but a partial batch
+// already proves the queue ran dry at call time, saving the probe call).
+// Returns the datagram count, or kNetErrFatal on an unexpected errno.
+int ggrs_net_recv_all(void* p) {
+  NetBatch* nb = static_cast<NetBatch*>(p);
+  nb->rslab.clear();
+  nb->rlist.clear();
+  while (true) {
+    for (int i = 0; i < nb->vlen; ++i) {
+      // the kernel shrinks msg_namelen / sets msg_len; reset per call
+      nb->rmsgs[i].msg_hdr.msg_namelen = sizeof(sockaddr_in);
+      nb->rmsgs[i].msg_len = 0;
+    }
+    int r = recvmmsg(nb->fd, nb->rmsgs.data(),
+                     static_cast<unsigned>(nb->vlen), 0, nullptr);
+    nb->st[kStRecvCalls] += 1;
+    if (r < 0) {
+      if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+      if (errno == EINTR || errno == ECONNRESET || errno == ECONNREFUSED) {
+        continue;  // the ConnectionResetError-continue of the Python path
+      }
+      return kNetErrFatal;
+    }
+    if (r == 0) break;
+    nb->st[kStRecvDgrams] += static_cast<uint64_t>(r);
+    nb->st[kStRecvHist0 + batch_bucket(r)] += 1;
+    for (int i = 0; i < r; ++i) {
+      size_t len = nb->rmsgs[i].msg_len;  // > 4096 already truncated
+      Dgram d;
+      d.ip = nb->raddr[i].sin_addr.s_addr;
+      d.port = ntohs(nb->raddr[i].sin_port);
+      d.off = static_cast<uint32_t>(nb->rslab.size());
+      d.len = static_cast<uint32_t>(len);
+      nb->rslab.insert(nb->rslab.end(), nb->rbuf.data() + i * kRecvBufSize,
+                       nb->rbuf.data() + i * kRecvBufSize + len);
+      nb->rlist.push_back(d);
+    }
+    if (r < nb->vlen) break;  // queue ran dry mid-batch: no probe needed
+  }
+  return static_cast<int>(nb->rlist.size());
+}
+
+// Datagram count of the last recv_all (the accumulation list survives
+// until the next recv_all, so a caller may drain early and route later).
+int ggrs_net_recv_count(void* p) {
+  return static_cast<int>(static_cast<NetBatch*>(p)->rlist.size());
+}
+
+// Accessor for datagram `i` of the last recv_all.  Pointers stay valid
+// until the next recv_all on this NetBatch.
+int ggrs_net_datagram(void* p, int i, uint32_t* ip, uint16_t* port,
+                      const uint8_t** data, uint32_t* len) {
+  NetBatch* nb = static_cast<NetBatch*>(p);
+  if (i < 0 || static_cast<size_t>(i) >= nb->rlist.size()) {
+    return kNetErrBadArgs;
+  }
+  const Dgram& d = nb->rlist[static_cast<size_t>(i)];
+  *ip = d.ip;
+  *port = d.port;
+  *data = nb->rslab.data() + d.off;
+  *len = d.len;
+  return kNetOk;
+}
+
+// Stage one datagram for the next flush (bytes are copied into the send
+// slab; the caller's buffer may be reused immediately).
+int ggrs_net_stage(void* p, uint32_t ip, uint16_t port, const uint8_t* data,
+                   size_t len) {
+  NetBatch* nb = static_cast<NetBatch*>(p);
+  if (len > kIdealMaxUdp) nb->st[kStOversized] += 1;
+  if (nb->capture) {
+    put_u32le(&nb->capture_buf, ip);
+    put_u16le(&nb->capture_buf, port);
+    put_u32le(&nb->capture_buf, static_cast<uint32_t>(len));
+    nb->capture_buf.insert(nb->capture_buf.end(), data, data + len);
+  }
+  Dgram d;
+  d.ip = ip;
+  d.port = port;
+  d.off = static_cast<uint32_t>(nb->sslab.size());
+  d.len = static_cast<uint32_t>(len);
+  nb->sslab.insert(nb->sslab.end(), data, data + len);
+  nb->slist.push_back(d);
+  return kNetOk;
+}
+
+// Flush everything staged, in stage order, via sendmmsg windows.  Transient
+// errnos drop the failing datagram (counted; the protocol's redundancy
+// covers loss) and keep going; a fatal errno abandons the remaining
+// datagrams and returns kNetErrFatal — the caller faults the slot, exactly
+// like a raising socket.sendto on the Python path.
+int ggrs_net_flush(void* p) {
+  NetBatch* nb = static_cast<NetBatch*>(p);
+  size_t i = 0;
+  const size_t n = nb->slist.size();
+  int rc_out = kNetOk;
+  while (i < n) {
+    if (nb->inject_count > 0) {
+      // chaos seam: the head datagram "fails" with the injected errno
+      // before any syscall (an ENOBUFS/EAGAIN storm, or a fatal EPERM)
+      nb->inject_count -= 1;
+      if (transient_send_errno(nb->inject_errno)) {
+        nb->st[kStSendErrors] += 1;
+        i += 1;
+        continue;
+      }
+      rc_out = kNetErrFatal;
+      break;
+    }
+    size_t win = n - i;
+    if (win > static_cast<size_t>(nb->vlen)) win = nb->vlen;
+    for (size_t k = 0; k < win; ++k) {
+      const Dgram& d = nb->slist[i + k];
+      nb->siov[k].iov_base = nb->sslab.data() + d.off;
+      nb->siov[k].iov_len = d.len;
+      std::memset(&nb->saddr[k], 0, sizeof(sockaddr_in));
+      nb->saddr[k].sin_family = AF_INET;
+      nb->saddr[k].sin_addr.s_addr = d.ip;
+      nb->saddr[k].sin_port = htons(d.port);
+      std::memset(&nb->smsgs[k], 0, sizeof(mmsghdr));
+      nb->smsgs[k].msg_hdr.msg_iov = &nb->siov[k];
+      nb->smsgs[k].msg_hdr.msg_iovlen = 1;
+      nb->smsgs[k].msg_hdr.msg_name = &nb->saddr[k];
+      nb->smsgs[k].msg_hdr.msg_namelen = sizeof(sockaddr_in);
+    }
+    int r = sendmmsg(nb->fd, nb->smsgs.data(), static_cast<unsigned>(win), 0);
+    nb->st[kStSendCalls] += 1;
+    if (r < 0) {
+      if (errno == EINTR) continue;  // retry the same window: PEP 475
+      // semantics — a signal mid-send is invisible on the Python path
+      // the errno belongs to the FIRST datagram of the window
+      if (transient_send_errno(errno)) {
+        nb->st[kStSendErrors] += 1;
+        i += 1;
+        continue;
+      }
+      rc_out = kNetErrFatal;
+      break;
+    }
+    nb->st[kStSendDgrams] += static_cast<uint64_t>(r);
+    nb->st[kStSendHist0 + batch_bucket(r)] += 1;
+    i += static_cast<size_t>(r);
+    // r < win without an errno: the next loop iteration retries from the
+    // stall point and surfaces the real errno if one is pending
+  }
+  nb->slist.clear();
+  nb->sslab.clear();
+  return rc_out;
+}
+
+int64_t ggrs_net_staged_len(void* p) {
+  return static_cast<int64_t>(static_cast<NetBatch*>(p)->slist.size());
+}
+
+void ggrs_net_stats(void* p, uint64_t* out) {
+  NetBatch* nb = static_cast<NetBatch*>(p);
+  std::memcpy(out, nb->st, sizeof(nb->st));
+}
+
+// ---- test seams ---------------------------------------------------------
+
+void ggrs_net_set_capture(void* p, int on) {
+  NetBatch* nb = static_cast<NetBatch*>(p);
+  nb->capture = on != 0;
+  if (!nb->capture) nb->capture_buf.clear();
+}
+
+// Drain the capture tee: [u32 ip][u16 port][u32 len][bytes] per datagram,
+// in stage (= send) order.  kNetErrBufferTooSmall reports the needed size
+// without consuming.
+int ggrs_net_drain_capture(void* p, uint8_t* out, size_t cap,
+                           size_t* out_len) {
+  NetBatch* nb = static_cast<NetBatch*>(p);
+  *out_len = nb->capture_buf.size();
+  if (nb->capture_buf.size() > cap) return kNetErrBufferTooSmall;
+  std::memcpy(out, nb->capture_buf.data(), nb->capture_buf.size());
+  nb->capture_buf.clear();
+  return kNetOk;
+}
+
+// The next `count` staged datagrams fail with `err` before any syscall.
+void ggrs_net_inject_send_errno(void* p, int err, int count) {
+  NetBatch* nb = static_cast<NetBatch*>(p);
+  nb->inject_errno = err;
+  nb->inject_count = count;
+}
+
+}  // extern "C"
+
+#else  // !__linux__ -------------------------------------------------------
+
+// Stub surface: same symbols, no batched path.  ggrs_net_supported() == 0
+// keeps the pool on the Python shuttle (the documented fallback), and the
+// bank never sees an attached socket.
+
+extern "C" {
+
+int ggrs_net_supported(void) { return 0; }
+void* ggrs_net_attach(int, int) { return nullptr; }
+void ggrs_net_free(void*) {}
+int ggrs_net_recv_all(void*) { return kNetErrUnsupported; }
+int ggrs_net_recv_count(void*) { return 0; }
+int ggrs_net_datagram(void*, int, uint32_t*, uint16_t*, const uint8_t**,
+                      uint32_t*) {
+  return kNetErrUnsupported;
+}
+int ggrs_net_stage(void*, uint32_t, uint16_t, const uint8_t*, size_t) {
+  return kNetErrUnsupported;
+}
+int ggrs_net_flush(void*) { return kNetErrUnsupported; }
+int64_t ggrs_net_staged_len(void*) { return 0; }
+void ggrs_net_stats(void*, uint64_t* out) {
+  std::memset(out, 0, sizeof(uint64_t) * kNumNetStats);
+}
+void ggrs_net_set_capture(void*, int) {}
+int ggrs_net_drain_capture(void*, uint8_t*, size_t, size_t* out_len) {
+  *out_len = 0;
+  return kNetErrUnsupported;
+}
+void ggrs_net_inject_send_errno(void*, int, int) {}
+
+}  // extern "C"
+
+#endif  // __linux__

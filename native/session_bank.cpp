@@ -90,6 +90,15 @@ int ggrs_ep_dump_recv(void*, uint8_t*, size_t, size_t*);
 
 int64_t ggrs_ep_last_acked_frame(void*);
 void ggrs_ep_stats(void*, uint64_t*);
+
+// ---- batched socket datapath (net_batch.cpp, same .so; DESIGN.md §15) ----
+int ggrs_net_recv_all(void*);
+int ggrs_net_recv_count(void*);
+int ggrs_net_datagram(void*, int, uint32_t*, uint16_t*, const uint8_t**,
+                      uint32_t*);
+int ggrs_net_stage(void*, uint32_t, uint16_t, const uint8_t*, size_t);
+int ggrs_net_flush(void*);
+void ggrs_net_stats(void*, uint64_t*);
 }
 
 namespace {
@@ -121,6 +130,20 @@ constexpr int kBankErrNoPlayers = -74;   // every player disconnected
 constexpr int kBankErrSequence = -75;    // remote input frame gap (assert)
 constexpr int kBankErrInjected = -76;    // chaos-harness simulated fault
 constexpr int kBankErrSpecStream = -77;  // confirmed-input fan-out failed
+constexpr int kBankErrIo = -78;          // batched socket I/O failed fatally
+
+// net_batch.cpp return codes the bank interprets
+constexpr int kNetOk = 0;
+constexpr int kNumNetStats = 22;
+
+// address key for the native inbound routing tables: s_addr (as stored,
+// network order) in the low 32 bits, host-order port above.  kNoAddr marks
+// an endpoint the pool never mapped (its datagrams stay on the Python
+// shuttle — unreachable when the pool attaches a socket, kept as a guard).
+inline uint64_t addr_key(uint32_t ip, uint16_t port) {
+  return static_cast<uint64_t>(ip) | (static_cast<uint64_t>(port) << 32);
+}
+constexpr uint64_t kNoAddr = ~uint64_t{0};
 
 // command flags (host_bank.py mirrors)
 constexpr uint8_t kFlagInputs = 1;  // local inputs present -> advance runs
@@ -224,6 +247,11 @@ struct BankEndpoint {
   // poll messages][per-endpoint input messages], which multi-endpoint
   // sessions observe (and the fault-injecting net's rng stream feels)
   std::vector<uint8_t> out_poll, out_adv;
+  // batched-I/O spectator deferral (the native twin of the pool mirror's
+  // sp.deferred): fan-out datagrams assembled in the adv phase go out at
+  // the NEXT tick, reproducing the Python session's flush order.  Framed
+  // like the out streams; only populated for attached-socket slots.
+  std::vector<uint8_t> deferred;
   std::vector<uint8_t>* cur_out = nullptr;
   uint32_t out_count = 0;
 
@@ -268,6 +296,14 @@ struct BankSession {
   uint64_t stat_rollback_frames = 0;  // total frames resimulated
   uint64_t stat_max_rollback = 0;     // deepest single rollback
   uint64_t stat_faults = 0;           // per-slot faults reported (err != 0)
+  // ---- batched socket datapath (ggrs_bank_attach_socket) ----
+  // net: a net_batch.cpp NetBatch borrowed from the pool (never owned or
+  // freed here); ep_keys/spec_keys: inbound routing tables, indexed like
+  // endpoints/spectators, filled by ggrs_bank_map_addr
+  void* net = nullptr;
+  std::vector<uint64_t> ep_keys;
+  std::vector<uint64_t> spec_keys;
+  int pending_io_err = 0;  // fatal recv errno from the pump's pre-drain
   // scratch
   std::vector<uint8_t> sync_buf;     // players * input_size
   std::vector<int32_t> status_buf;   // players
@@ -796,9 +832,12 @@ void emit_out_section(std::vector<uint8_t>* o,
 // status mirror, the phase-tagged spectator outbound streams, the hub
 // event stream, and the journal tap's confirmed-input records.  A non-live
 // record (skip / fault) carries states only — its streams were suppressed.
+// An attached-socket slot (io_slot) already sent/deferred its streams
+// through the NetBatch, so n_spec_out is 0 while the hub events and the
+// journal tap records still ride the record.
 void emit_spectator_tail(std::vector<uint8_t>* o, BankSession* s, bool live,
                          const std::vector<uint8_t>* spec_events = nullptr,
-                         uint16_t n_spec_events = 0) {
+                         uint16_t n_spec_events = 0, bool io_slot = false) {
   put_i64(o, s->next_spectator_frame);
   put_u8(o, static_cast<uint8_t>(s->spectators.size()));
   for (BankEndpoint& sp : s->spectators) {
@@ -811,13 +850,17 @@ void emit_spectator_tail(std::vector<uint8_t>* o, BankSession* s, bool live,
     put_u16(o, 0);  // n_conf
     return;
   }
-  uint32_t count = 0;
-  size_t count_pos = o->size();
-  put_u16(o, 0);  // n_spec_out, patched below
-  for (int phase = 0; phase < 2; ++phase) {
-    emit_out_records(o, s->spectators, phase, true, &count);
+  if (io_slot) {
+    put_u16(o, 0);  // streams already went through the NetBatch
+  } else {
+    uint32_t count = 0;
+    size_t count_pos = o->size();
+    put_u16(o, 0);  // n_spec_out, patched below
+    for (int phase = 0; phase < 2; ++phase) {
+      emit_out_records(o, s->spectators, phase, true, &count);
+    }
+    patch_u16(o, count_pos, count);
   }
-  patch_u16(o, count_pos, count);
   put_u16(o, n_spec_events);
   if (spec_events != nullptr) {
     put_raw(o, spec_events->data(), spec_events->size());
@@ -828,6 +871,61 @@ void emit_spectator_tail(std::vector<uint8_t>* o, BankSession* s, bool live,
     put_raw(o, s->conf_stream.data(), s->conf_stream.size());
   }
   return;
+}
+
+// ---- batched socket datapath helpers (DESIGN.md §15) ---------------------
+
+inline uint64_t key_at(const std::vector<uint64_t>& keys, size_t i) {
+  return i < keys.size() ? keys[i] : kNoAddr;
+}
+
+// Stage one framed out stream ([u32 len][bytes]*) to `key` on the slot's
+// NetBatch.  Unmapped endpoints are skipped — unreachable when the pool
+// attached the socket (it maps every address first), kept as a guard.
+void stage_stream_io(BankSession* s, uint64_t key,
+                     const std::vector<uint8_t>& stream) {
+  if (key == kNoAddr || stream.empty()) return;
+  uint32_t ip = static_cast<uint32_t>(key & 0xFFFFFFFFu);
+  uint16_t port = static_cast<uint16_t>(key >> 32);
+  size_t pos = 0;
+  while (pos + 4 <= stream.size()) {
+    uint32_t dlen = 0;
+    for (int i = 0; i < 4; ++i) {
+      dlen |= static_cast<uint32_t>(stream[pos + i]) << (8 * i);
+    }
+    pos += 4;
+    if (pos + dlen > stream.size()) break;  // corrupt framing: never stage
+    // bytes past the stream (the header check above is just as defensive)
+    ggrs_net_stage(s->net, ip, port, stream.data() + pos, dlen);
+    pos += dlen;
+  }
+}
+
+// The attached-socket outbound path, staged in EXACTLY the order the pool
+// sends on the Python shuttle (host_bank._parse_output): every remote
+// endpoint's poll-phase datagrams, then per spectator last tick's deferred
+// fan-out followed by this tick's poll messages, then the remote adv-phase
+// (input) datagrams; this tick's fan-out datagrams rotate into the
+// deferral for the next tick.  One sendmmsg flush for the whole slot.
+int stage_and_flush_io(BankSession* s) {
+  for (size_t e = 0; e < s->endpoints.size(); ++e) {
+    stage_stream_io(s, key_at(s->ep_keys, e), s->endpoints[e].out_poll);
+  }
+  for (size_t e = 0; e < s->spectators.size(); ++e) {
+    BankEndpoint& sp = s->spectators[e];
+    uint64_t key = key_at(s->spec_keys, e);
+    stage_stream_io(s, key, sp.deferred);
+    sp.deferred.clear();
+    stage_stream_io(s, key, sp.out_poll);
+  }
+  for (size_t e = 0; e < s->endpoints.size(); ++e) {
+    stage_stream_io(s, key_at(s->ep_keys, e), s->endpoints[e].out_adv);
+  }
+  for (BankEndpoint& sp : s->spectators) {
+    sp.deferred.swap(sp.out_adv);
+    sp.out_adv.clear();
+  }
+  return ggrs_net_flush(s->net) == kNetOk ? kBankOk : kBankErrIo;
 }
 
 void emit_status_mirrors(std::vector<uint8_t>* o, const BankSession* s) {
@@ -1177,7 +1275,11 @@ int ggrs_bank_detach_spectator(void* ptr, int64_t session, int64_t spec) {
   if (spec < 0 || static_cast<size_t>(spec) >= s->spectators.size()) {
     return kBankErrCmd;
   }
-  s->spectators[static_cast<size_t>(spec)].state = kShutdown;
+  BankEndpoint& sp = s->spectators[static_cast<size_t>(spec)];
+  sp.state = kShutdown;
+  // drop the batched-I/O deferral too: the shuttle clears sp.deferred on
+  // detach, and a stale tick of fan-out must not chase a departed viewer
+  sp.deferred.clear();
   return kBankOk;
 }
 
@@ -1256,9 +1358,19 @@ int ggrs_bank_set_timing(void* ptr, int enabled) {
 //     last so the caller parses it from the END of the buffer]
 // Returns 0, kErrBufferTooSmall (retry with a bigger out), or kBankErrCmd
 // (malformed command stream — the one remaining whole-bank failure).
-int ggrs_bank_tick(void* ptr, int64_t now, const uint8_t* cmd, size_t cmd_len,
-                   uint8_t* out, size_t out_cap, size_t* out_len) {
-  Bank* bank = static_cast<Bank*>(ptr);
+//
+// `io` (ggrs_bank_pump): slots with an attached NetBatch additionally
+// drain their socket via recvmmsg at the top of the slot step (routed by
+// the address tables; the cmd's datagram sections then carry only
+// injected traffic) and flush their outbound + fan-out streams via
+// sendmmsg at the bottom — same wire bytes, same send order, with the
+// outbound sections of the output record emitted empty.  A fatal socket
+// error is a PER-SLOT fault (kBankErrIo), exactly the blast radius a
+// raising socket.sendto has on the shuttle path.  Slots without an
+// attached socket behave identically under both entry points.
+static int bank_tick_impl(Bank* bank, int64_t now, const uint8_t* cmd,
+                          size_t cmd_len, uint8_t* out, size_t out_cap,
+                          size_t* out_len, bool io) {
   CmdReader r{cmd, cmd_len};
   bank->out.clear();
   std::vector<uint8_t> ops;
@@ -1268,13 +1380,58 @@ int ggrs_bank_tick(void* ptr, int64_t now, const uint8_t* cmd, size_t cmd_len,
   pt.on = bank->timing;
   const uint64_t tick_t0 = pt.on ? mono_ns() : 0;
 
+  if (io) {
+    // PRE-DRAIN every attached, non-skipped slot before ANY slot steps or
+    // flushes — the shuttle drains all sockets before its single crossing,
+    // so when one pool hosts both sides of a match, slot j must see slot
+    // i's tick-T datagrams at tick T+1, not mid-crossing at tick T.  The
+    // scan walks the cmd structure only to find each slot's skip flag
+    // (skipped slots' sockets belong to their evicted sessions); the
+    // drained lists stay on each NetBatch until routed in the slot step.
+    pt.skip();  // pre-drain kernel I/O is inbound time (the §14 contract:
+    // the inbound phase CONTAINS the receive-side syscalls)
+    CmdReader scan{cmd, cmd_len};
+    for (BankSession* s : bank->sessions) {
+      uint8_t flags = scan.u8();
+      if (!scan.ok) return kBankErrCmd;
+      if (flags & kFlagSkip) continue;
+      if (flags & kFlagInputs) {
+        scan.raw(s->local_handles.size() *
+                 static_cast<size_t>(s->input_size));
+      }
+      uint16_t n_ctrl = scan.u16();
+      for (uint16_t i = 0; i < n_ctrl; ++i) {
+        scan.u8();
+        scan.u16();
+        scan.i64();
+      }
+      for (int section = 0; section < 2; ++section) {
+        uint16_t nd = scan.u16();
+        for (uint16_t i = 0; i < nd; ++i) {
+          scan.u16();
+          scan.raw(scan.u32());
+        }
+      }
+      if (!scan.ok) return kBankErrCmd;
+      if (s->net) {
+        int n_rx = ggrs_net_recv_all(s->net);
+        if (n_rx < 0) s->pending_io_err = kBankErrIo;
+      }
+    }
+    pt.lap(kPhInbound);
+  }
+
   for (BankSession* s : bank->sessions) {
     uint8_t flags = r.u8();
     if (!r.ok) return kBankErrCmd;
     std::vector<uint8_t>* o = &bank->out;
     if (flags & kFlagSkip) {
       // quarantined/evicted slot: nothing runs, emit a status-only record
-      // so the output stream stays positionally aligned
+      // so the output stream stays positionally aligned.  The stale
+      // fan-out deferral is dropped, like the shuttle's sp.deferred on a
+      // non-live tick (eviction re-sends from the harvested window);
+      // the socket is NOT drained — the evicted session owns it now.
+      for (BankEndpoint& sp : s->spectators) sp.deferred.clear();
       put_u32(o, 0);  // err = 0 (the fault was reported when it happened)
       put_i64(o, kNullFrame);
       put_u32(o, 0);
@@ -1339,6 +1496,38 @@ int ggrs_bank_tick(void* ptr, int64_t now, const uint8_t* cmd, size_t cmd_len,
 
     // ---- poll phase (p2p.py poll_remote_clients) ----
     pt.skip();
+    const bool io_slot = io && s->net != nullptr;
+    int n_rx = 0;
+    if (io_slot) {
+      // the socket was already drained by the pre-pass above (before any
+      // slot could flush into it); route the retained list here.  A fatal
+      // receive errno is this slot's fault, nobody else's — and even a
+      // slot faulted by an earlier ctrl op was drained (the shuttle
+      // drains before the crossing too); only the PROCESSING is gated.
+      if (s->pending_io_err != kBankOk) {
+        if (err == kBankOk) err = s->pending_io_err;
+        s->pending_io_err = kBankOk;
+      }
+      n_rx = ggrs_net_recv_count(s->net);
+      // pass 1: remote-endpoint datagrams in arrival order — the shuttle
+      // builds its cmd section the same way (socket drain first, injected
+      // datagrams appended after)
+      for (int i = 0; err == kBankOk && i < n_rx; ++i) {
+        uint32_t ip, dlen;
+        uint16_t port;
+        const uint8_t* data;
+        if (ggrs_net_datagram(s->net, i, &ip, &port, &data, &dlen) != kNetOk) {
+          break;
+        }
+        uint64_t key = addr_key(ip, port);
+        for (size_t e = 0; e < s->endpoints.size(); ++e) {
+          if (key_at(s->ep_keys, e) == key) {
+            process_datagram(bank, s, &s->endpoints[e], now, data, dlen);
+            break;
+          }
+        }
+      }
+    }
     uint16_t n_datagrams = r.u16();
     if (!r.ok) return kBankErrCmd;
     for (uint16_t i = 0; i < n_datagrams; ++i) {
@@ -1348,6 +1537,37 @@ int ggrs_bank_tick(void* ptr, int64_t now, const uint8_t* cmd, size_t cmd_len,
       if (!r.ok) return kBankErrCmd;  // parse ALL datagrams: stream alignment
       if (err == kBankOk && ep_idx < s->endpoints.size()) {
         process_datagram(bank, s, &s->endpoints[ep_idx], now, data, dlen);
+      }
+    }
+    if (io_slot) {
+      // pass 2: spectator datagrams (the shuttle's separate spec section —
+      // all remote traffic processes before any viewer traffic).  A
+      // datagram from an unknown address routes nowhere and drops, like
+      // the shuttle's addr_to_ep/addr_to_spec misses.  Remote addresses
+      // are EXCLUDED, mirroring the shuttle's if/elif routing: a key that
+      // matched pass 1 must not feed a second endpoint.
+      for (int i = 0; err == kBankOk && i < n_rx; ++i) {
+        uint32_t ip, dlen;
+        uint16_t port;
+        const uint8_t* data;
+        if (ggrs_net_datagram(s->net, i, &ip, &port, &data, &dlen) != kNetOk) {
+          break;
+        }
+        uint64_t key = addr_key(ip, port);
+        bool is_remote = false;
+        for (size_t e = 0; e < s->endpoints.size(); ++e) {
+          if (key_at(s->ep_keys, e) == key) {
+            is_remote = true;
+            break;
+          }
+        }
+        if (is_remote) continue;
+        for (size_t e = 0; e < s->spectators.size(); ++e) {
+          if (key_at(s->spec_keys, e) == key) {
+            process_datagram(bank, s, &s->spectators[e], now, data, dlen);
+            break;
+          }
+        }
       }
     }
     // inbound spectator traffic (acks, quality reports, keep-alives, sync
@@ -1468,6 +1688,18 @@ int ggrs_bank_tick(void* ptr, int64_t now, const uint8_t* cmd, size_t cmd_len,
         frames_ahead = max_frame_advantage(s);
       }
     }
+    // ---- batched socket outbound (attached slots): stage + one flush ----
+    // Runs only when the tick produced a clean slot (a faulted slot's
+    // streams are suppressed below, exactly like the shuttle's empty
+    // outbound sections); a fatal flush errno faults the slot AFTER the
+    // datagrams that did go out — the same partial-send window a raising
+    // socket.sendto leaves on the Python path.
+    if (io_slot && err == kBankOk) {
+      pt.skip();
+      int rc = stage_and_flush_io(s);
+      if (rc != kBankOk) err = rc;
+      pt.lap(kPhOutbound);
+    }
     s->stat_ticks += 1;
     if (err != kBankOk) {
       s->stat_faults += 1;
@@ -1492,6 +1724,10 @@ int ggrs_bank_tick(void* ptr, int64_t now, const uint8_t* cmd, size_t cmd_len,
         ep.out_poll.clear();
         ep.out_adv.clear();
         ep.out_count = 0;
+        // the deferral is stale the moment the slot faults (the shuttle
+        // clears sp.deferred on every non-live tick); eviction re-sends
+        // the fan-out window from the harvest
+        ep.deferred.clear();
       }
       s->conf_stream.clear();
       s->conf_count = 0;
@@ -1512,13 +1748,20 @@ int ggrs_bank_tick(void* ptr, int64_t now, const uint8_t* cmd, size_t cmd_len,
     // Python session's per-socket send order interleaves the spectator
     // queues between them (poll's send_all_messages flushes remotes then
     // spectators, then advance sends the remote input messages), so the
-    // pool needs the phase boundary to reproduce that order exactly
-    emit_out_section(o, s->endpoints, 0);
-    emit_out_section(o, s->endpoints, 1);
+    // pool needs the phase boundary to reproduce that order exactly.
+    // Attached-socket slots already sent everything through the NetBatch:
+    // their sections are empty and the packet path never re-enters Python.
+    if (io_slot) {
+      put_u16(o, 0);  // n_out_poll
+      put_u16(o, 0);  // n_out_adv
+    } else {
+      emit_out_section(o, s->endpoints, 0);
+      emit_out_section(o, s->endpoints, 1);
+    }
     put_u16(o, n_out_events);
     put_raw(o, out_events.data(), out_events.size());
     emit_status_mirrors(o, s);
-    emit_spectator_tail(o, s, true, &spec_events, n_spec_events);
+    emit_spectator_tail(o, s, true, &spec_events, n_spec_events, io_slot);
     pt.lap(kPhEmit);
   }
 
@@ -1550,6 +1793,73 @@ int ggrs_bank_tick(void* ptr, int64_t now, const uint8_t* cmd, size_t cmd_len,
   }
   std::memcpy(out, bank->out.data(), bank->out.size());
   *out_len = bank->out.size();
+  return kBankOk;
+}
+
+int ggrs_bank_tick(void* ptr, int64_t now, const uint8_t* cmd, size_t cmd_len,
+                   uint8_t* out, size_t out_cap, size_t* out_len) {
+  return bank_tick_impl(static_cast<Bank*>(ptr), now, cmd, cmd_len, out,
+                        out_cap, out_len, false);
+}
+
+// The crossing of the batched datapath (DESIGN.md §15): ggrs_bank_tick
+// plus native socket I/O for every slot with an attached NetBatch —
+// datagrams flow socket → crossing → socket with zero Python on the
+// packet path.  Same command/output wire format; still exactly ONE
+// crossing per pool tick.
+int ggrs_bank_pump(void* ptr, int64_t now, const uint8_t* cmd, size_t cmd_len,
+                   uint8_t* out, size_t out_cap, size_t* out_len) {
+  return bank_tick_impl(static_cast<Bank*>(ptr), now, cmd, cmd_len, out,
+                        out_cap, out_len, true);
+}
+
+// Attach a net_batch.cpp NetBatch (borrowed, never freed here) to one
+// slot: ggrs_bank_pump then drains/flushes this slot's datagrams natively.
+// The pool must map every remote/spectator address via ggrs_bank_map_addr
+// before the first pump.
+int ggrs_bank_attach_socket(void* ptr, int64_t session, void* net) {
+  Bank* bank = static_cast<Bank*>(ptr);
+  if (session < 0 ||
+      static_cast<size_t>(session) >= bank->sessions.size() || !net) {
+    return kBankErrCmd;
+  }
+  bank->sessions[static_cast<size_t>(session)]->net = net;
+  return kBankOk;
+}
+
+// Detach: the slot returns to the Python shuttle on the next tick (the
+// pool's per-slot automatic fallback, e.g. an unresolvable late-attached
+// spectator address).  Routing tables are kept — re-attach is cheap.
+int ggrs_bank_detach_socket(void* ptr, int64_t session) {
+  Bank* bank = static_cast<Bank*>(ptr);
+  if (session < 0 ||
+      static_cast<size_t>(session) >= bank->sessions.size()) {
+    return kBankErrCmd;
+  }
+  BankSession* s = bank->sessions[static_cast<size_t>(session)];
+  s->net = nullptr;
+  for (BankEndpoint& sp : s->spectators) sp.deferred.clear();
+  return kBankOk;
+}
+
+// Register the wire address of one endpoint (kind 0 = remote, 1 =
+// spectator) for the native inbound routing and outbound staging.  `ip`
+// is sin_addr.s_addr as stored (the bytes of inet_aton), `port` is
+// host-order.
+int ggrs_bank_map_addr(void* ptr, int64_t session, int kind, int64_t idx,
+                       uint32_t ip, uint16_t port) {
+  Bank* bank = static_cast<Bank*>(ptr);
+  if (session < 0 ||
+      static_cast<size_t>(session) >= bank->sessions.size() || idx < 0 ||
+      idx > 0xFFFF || (kind != 0 && kind != 1)) {
+    return kBankErrCmd;
+  }
+  BankSession* s = bank->sessions[static_cast<size_t>(session)];
+  std::vector<uint64_t>& keys = kind == 0 ? s->ep_keys : s->spec_keys;
+  if (keys.size() <= static_cast<size_t>(idx)) {
+    keys.resize(static_cast<size_t>(idx) + 1, kNoAddr);
+  }
+  keys[static_cast<size_t>(idx)] = addr_key(ip, port);
   return kBankOk;
 }
 
@@ -1701,6 +2011,11 @@ int ggrs_bank_harvest(void* ptr, int64_t session, uint8_t* out, size_t cap,
 //     i64 packets_sent, i64 bytes_sent, i64 stats_start_ms
 //   (the catchup-lag gauge is (next_spectator_frame-1) - last_acked_frame;
 //   harvested in the SAME crossing as everything else)
+//   u8 has_io; [if 1] 22 * u64 NetBatch counters (ggrs_net_stats order:
+//     recv_calls, recv_datagrams, send_calls, send_datagrams, send_errors,
+//     oversized, 8 recv batch-size buckets, 8 send batch-size buckets) —
+//   the batched datapath's syscall/batch observability rides the SAME
+//   one-crossing scrape (DESIGN.md §15)
 // When the phase timers are armed (ggrs_bank_set_timing), a cumulative
 // timing tail follows the last session:
 //   u64 timed_ticks, kNumPhases * u64 total_phase_ns, u8 n_phases
@@ -1743,6 +2058,12 @@ int ggrs_bank_stats(void* ptr, uint8_t* out, size_t cap, size_t* out_len) {
       put_i64(&h, sp.packets_sent);
       put_i64(&h, sp.bytes_sent);
       put_i64(&h, sp.stats_start);
+    }
+    put_u8(&h, s->net ? 1 : 0);
+    if (s->net) {
+      uint64_t io[kNumNetStats];
+      ggrs_net_stats(s->net, io);
+      for (int i = 0; i < kNumNetStats; ++i) put_u64(&h, io[i]);
     }
   }
   if (bank->timing) {

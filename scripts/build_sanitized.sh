@@ -29,7 +29,7 @@ g++ -O1 -g -shared -fPIC -std=c++17 \
     -fsanitize=address,undefined -fno-sanitize-recover=all \
     -o "$out" \
     native/codec.cpp native/endpoint.cpp native/sync_core.cpp \
-    native/session_bank.cpp
+    native/session_bank.cpp native/net_batch.cpp
 
 # detect_leaks=0: CPython itself "leaks" interned objects at exit, which is
 # noise here — the target is heap corruption / UB in the native cores while
@@ -48,5 +48,6 @@ JAX_PLATFORMS=cpu \
 python -m pytest tests/test_session_bank.py tests/test_bank_faults.py \
     tests/test_obs.py tests/test_broadcast.py tests/test_replay_journal.py \
     tests/test_trace.py tests/test_desync_detection.py \
+    tests/test_native_io.py tests/test_socket_datapath.py \
     -q -p no:cacheprovider -m "not slow" \
     -k "not batched_executor and not size_mismatch and not fused_scrub and not scrub_matches" "$@"

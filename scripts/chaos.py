@@ -31,6 +31,12 @@ Fault classes (all driven through the pool's real tick path):
                 keep following, and the in-bank side matches must stay
                 bit-identical to control (ends with the hub's metrics
                 digest — DESIGN.md §13)
+  socket        batched-datapath leg (real loopback UDP, native_io=True —
+                DESIGN.md §15): an ENOBUFS/EAGAIN storm on the target's
+                sendmmsg path must count as loss without faulting the
+                slot, a fatal EPERM must fault exactly that slot
+                (BANK_ERR_IO) and evict it onto the Python socket path —
+                survivors' wire bytes bit-identical to control either way
   all           every class, sequentially
 
 Usage:
@@ -56,6 +62,7 @@ from ggrs_tpu.chaos import (  # noqa: E402
     drive_broadcast,
     drive_chaos,
     drive_desync_forensics,
+    drive_socket_chaos,
 )
 from ggrs_tpu.net import _native  # noqa: E402
 from ggrs_tpu.obs import json_snapshot  # noqa: E402
@@ -354,13 +361,132 @@ def verify_broadcast_leg(matches: int, ticks: int, seed: int,
     return True
 
 
+def verify_socket_leg(matches: int, ticks: int, seed: int,
+                      artifact_dir=None) -> bool:
+    """The batched-datapath scenario (DESIGN.md §15): errno storms on the
+    target slot's sendmmsg path, a fault-free control leg, and per-leg
+    verification that the blast radius stayed ≤ 1 slot with survivors'
+    wire bytes (captured at the NetBatch tee, exact send order)
+    bit-identical to control."""
+    import errno as _errno
+
+    from ggrs_tpu.net import _native as _nat
+
+    ticks = max(ticks, 160)
+    print("--- socket ---")
+    try:
+        control = drive_socket_chaos(ticks, n_matches=matches, seed=seed)
+    except RuntimeError as e:
+        # no recvmmsg/sendmmsg on this platform / library: the fallback
+        # matrix says the Python shuttle serves — nothing to storm
+        print(f"  skip: {e}")
+        return True
+
+    def storm_transient(i, ctx):
+        if 40 <= i < 60:
+            ctx["pool"].inject_socket_errno(
+                ctx["target"], _errno.ENOBUFS, 4
+            )
+        elif 60 <= i < 70:
+            ctx["pool"].inject_socket_errno(
+                ctx["target"], _errno.EAGAIN, 4
+            )
+
+    def storm_fatal(i, ctx):
+        if i == 50:
+            ctx["pool"].inject_socket_errno(ctx["target"], _errno.EPERM, 1)
+
+    violations = []
+    legs = {}
+    for name, storm in (("transient", storm_transient),
+                        ("fatal", storm_fatal)):
+        chaos = drive_socket_chaos(
+            ticks, n_matches=matches, seed=seed, inject=storm
+        )
+        legs[name] = chaos
+        target = chaos["target"]
+        pool = chaos["pool"]
+        for f in pool.fault_log(target):
+            print(f"    [{name}] fault@tick {f.tick}: code={f.code} "
+                  f"{f.detail}")
+        if name == "transient":
+            if chaos["states"][target] != "native":
+                violations.append(
+                    f"transient storm faulted the slot: "
+                    f"{chaos['states'][target]}"
+                )
+            if chaos["io"]["send_errors"] < 20:
+                violations.append(
+                    "transient storm left no send_errors trace "
+                    f"({chaos['io']['send_errors']})"
+                )
+        else:
+            if chaos["states"][target] != "evicted":
+                violations.append(
+                    f"fatal errno did not evict: {chaos['states'][target]}"
+                )
+            if not any(f.code == _nat.BANK_ERR_IO
+                       for f in pool.fault_log(target)):
+                violations.append("fault log missing BANK_ERR_IO")
+        if chaos["frames"][target] < ticks - 80:
+            violations.append(
+                f"{name}: target stalled at frame {chaos['frames'][target]}"
+            )
+        for idx in range(target):
+            if chaos["states"][idx] != "native":
+                violations.append(f"{name}: survivor slot {idx} left native")
+            if chaos["wire"][idx] != control["wire"][idx]:
+                violations.append(
+                    f"{name}: survivor slot {idx} wire diverged "
+                    f"({len(chaos['wire'][idx])} vs "
+                    f"{len(control['wire'][idx])} datagrams)"
+                )
+            if chaos["reqs"][idx] != control["reqs"][idx]:
+                violations.append(f"{name}: survivor slot {idx} reqs diverged")
+        print(f"  [{name}] target state={chaos['states'][target]} "
+              f"frame={chaos['frames'][target]} "
+              f"io={{recv_calls: {chaos['io']['recv_calls']}, "
+              f"send_calls: {chaos['io']['send_calls']}, "
+              f"send_errors: {chaos['io']['send_errors']}}}")
+    verdict = not violations
+    _write_artifact(artifact_dir, "socket", {
+        "scenario": "socket",
+        "verdict": "PASS" if verdict else "FAIL",
+        "violations": violations,
+        "target_slot": control["target"],
+        "legs": {
+            name: {
+                "target_state": leg["states"][leg["target"]],
+                "target_frame": leg["frames"][leg["target"]],
+                "io": leg["io"],
+                "fault_log": [
+                    {"tick": f.tick, "code": f.code, "detail": f.detail}
+                    for f in leg["pool"].fault_log(leg["target"])
+                ],
+            }
+            for name, leg in legs.items()
+        },
+        "metrics": json_snapshot(legs["fatal"]["registry"]),
+        "desync_report": None,
+    })
+    if violations:
+        print("  SOCKET SCENARIO VIOLATED:")
+        for v in violations:
+            print(f"    {v}")
+        return False
+    print(f"  OK: storms contained; {control['target']} surviving slots "
+          "bit-identical to control")
+    return True
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--matches", type=int, default=4,
                     help="in-bank 2-peer matches (default 4 -> B=9 slots)")
     ap.add_argument("--ticks", type=int, default=300)
     ap.add_argument("--seed", type=int, default=3)
-    ap.add_argument("--fault", choices=[*FAULTS, "spectator", "all"],
+    ap.add_argument("--fault", choices=[*FAULTS, "spectator", "socket",
+                                        "all"],
                     default="all")
     ap.add_argument("--artifact-dir", default=None, metavar="DIR",
                     help="write one machine-readable JSON artifact per "
@@ -368,13 +494,19 @@ def main() -> int:
     args = ap.parse_args()
 
     names = (
-        [*FAULTS, "spectator"] if args.fault == "all" else [args.fault]
+        [*FAULTS, "spectator", "socket"] if args.fault == "all"
+        else [args.fault]
     )
     ok = True
     for name in names:
         if name == "spectator":
             ok &= verify_broadcast_leg(
                 min(args.matches, 2), args.ticks, args.seed,
+                artifact_dir=args.artifact_dir,
+            )
+        elif name == "socket":
+            ok &= verify_socket_leg(
+                min(args.matches, 3), args.ticks, args.seed,
                 artifact_dir=args.artifact_dir,
             )
         else:

@@ -1,0 +1,542 @@
+"""Pins for the kernel-batched socket datapath (native/net_batch.cpp +
+ggrs_bank_pump, DESIGN.md §15).
+
+The headline pin is WIRE PARITY: with ``native_io=True`` every attached
+slot's datagrams flow socket → crossing → socket through recvmmsg/sendmmsg
+with zero Python on the packet path — and the full outbound byte sequence
+(content AND send order, spectator fan-out included) must be bit-identical
+to the per-datagram Python shuttle under seeded loss/dup/reorder inbound
+traffic.  The shuttle leg records through a wrapping socket; the batched
+leg records through the NetBatch capture tee (a stage-time mirror of the
+exact bytes handed to sendmmsg).
+
+Also pinned: native_io adds ZERO extra tick crossings; unattachable
+sockets (in-memory, wrapped, kill switch env) fall back to the shuttle
+per slot; transient errno storms (ENOBUFS/EAGAIN) are counted as loss
+without faulting the slot; a fatal errno faults exactly one slot
+(BANK_ERR_IO) and the supervision layer evicts it onto the Python path.
+"""
+
+from __future__ import annotations
+
+import errno
+import random
+
+import pytest
+
+from ggrs_tpu.core import Local, Remote
+from ggrs_tpu.core.config import Config
+from ggrs_tpu.net import _native
+from ggrs_tpu.net.sockets import InMemoryNetwork, UdpNonBlockingSocket
+from ggrs_tpu.parallel.host_bank import HostSessionPool
+from ggrs_tpu.sessions import SessionBuilder
+
+needs_native = pytest.mark.skipif(
+    _native.bank_lib() is None, reason="native session bank unavailable"
+)
+needs_io = pytest.mark.skipif(
+    _native.net_lib() is None,
+    reason="kernel-batched socket datapath unavailable",
+)
+
+
+class RecordingUdpSocket:
+    """Wraps a UdpNonBlockingSocket, recording every raw datagram sent —
+    the shuttle leg's capture side.  Deliberately exposes no ``fileno``,
+    so a native_io pool cannot attach it (also the wrapped-socket
+    fallback fixture)."""
+
+    def __init__(self, inner: UdpNonBlockingSocket):
+        self.inner = inner
+        self.sent = []
+
+    def send_datagram(self, data: bytes, addr) -> None:
+        self.sent.append((addr, bytes(data)))
+        self.inner.send_datagram(data, addr)
+
+    def send_to(self, msg, addr) -> None:
+        self.send_datagram(msg.encode(), addr)
+
+    def receive_all_datagrams(self):
+        return self.inner.receive_all_datagrams()
+
+    def receive_all_messages(self):
+        return self.inner.receive_all_messages()
+
+    def local_port(self) -> int:
+        return self.inner.local_port()
+
+
+class FaultingUdpSocket:
+    """Peer-side socket: real UDP underneath, with InMemoryNetwork-style
+    seeded loss/duplication/reordering applied to sends (staged per tick,
+    flushed by the driver).  All three rng draws happen unconditionally so
+    the fault schedule is a pure function of the send sequence — identical
+    across the two parity legs."""
+
+    def __init__(self, inner: UdpNonBlockingSocket, seed: int,
+                 loss=0.0, duplicate=0.0, reorder=0.0):
+        self.inner = inner
+        self._rng = random.Random(seed)
+        self.loss, self.duplicate, self.reorder = loss, duplicate, reorder
+        self._staged = []
+
+    def send_to(self, msg, addr) -> None:
+        payload = msg.encode()
+        rng = self._rng
+        drop = rng.random() < self.loss
+        dup = rng.random() < self.duplicate
+        swap = rng.random() < self.reorder
+        if drop:
+            return
+        self._staged.append((addr, payload))
+        if dup:
+            self._staged.append((addr, payload))
+        if swap and len(self._staged) >= 2:
+            self._staged[-1], self._staged[-2] = (
+                self._staged[-2], self._staged[-1]
+            )
+
+    def flush(self) -> None:
+        for addr, payload in self._staged:
+            self.inner.send_datagram(payload, addr)
+        self._staged.clear()
+
+    def receive_all_datagrams(self):
+        return self.inner.receive_all_datagrams()
+
+    def receive_all_messages(self):
+        return self.inner.receive_all_messages()
+
+
+def fulfill(requests):
+    for r in requests:
+        if type(r).__name__ == "SaveGameState":
+            r.cell.save(r.frame, None, None)
+
+
+def _builder(cfg, clock, seed, me, other_addr):
+    return (
+        SessionBuilder(cfg)
+        .with_clock(lambda: clock[0])
+        .with_rng(random.Random(seed))
+        .add_player(Local(), me)
+        .add_player(Remote(other_addr), 1 - me)
+    )
+
+
+def run_udp_leg(native_io: bool, seed: int, ticks: int, n_matches: int,
+                faults: dict, n_viewers: int = 0, metrics=None):
+    """One parity leg over real loopback UDP: ``n_matches`` host slots in
+    the pool (2-player, one out-of-pool peer each, inbound traffic passed
+    through a seeded fault stage), optionally ``n_viewers`` real spectator
+    sessions per match attached through the hub.  Returns the per-slot
+    outbound capture as (role-label, bytes) pairs in exact send order."""
+    from ggrs_tpu.core.errors import NotSynchronized, PredictionThreshold
+
+    cfg = Config.for_uint(16)
+    clock = [0]
+    pool = HostSessionPool(native_io=native_io, metrics=metrics)
+    hub = None
+    if n_viewers:
+        from ggrs_tpu.broadcast import SpectatorHub
+
+        hub = SpectatorHub(pool, rng=random.Random(9000 + seed))
+    peers = []
+    peer_socks = []
+    viewers = []
+    host_socks = []
+    labels = []  # per match: addr -> role label
+    for m in range(n_matches):
+        raw = UdpNonBlockingSocket(0)
+        host_sock = raw if native_io else RecordingUdpSocket(raw)
+        host_port = raw.local_port()
+        peer_inner = UdpNonBlockingSocket(0)
+        peer_addr = ("127.0.0.1", peer_inner.local_port())
+        peer_sock = FaultingUdpSocket(peer_inner, seed * 101 + m, **faults)
+        pool.add_session(
+            _builder(cfg, clock, 3 + 5 * m, 0, peer_addr), host_sock
+        )
+        peer = _builder(
+            cfg, clock, 4 + 5 * m, 1, ("127.0.0.1", host_port)
+        ).start_p2p_session(peer_sock)
+        peers.append(peer)
+        peer_socks.append(peer_sock)
+        host_socks.append(host_sock)
+        labels.append({peer_addr: "peer"})
+    # viewers attach AFTER every session is registered (attach finalizes
+    # the pool) but before the first tick confirms frame 0
+    for m in range(n_matches):
+        host_port = (
+            host_socks[m].local_port()
+        )
+        for v in range(n_viewers):
+            vsock = UdpNonBlockingSocket(0)
+            vaddr = ("127.0.0.1", vsock.local_port())
+            viewer = (
+                SessionBuilder(cfg)
+                .with_clock(lambda: clock[0])
+                .with_rng(random.Random(7000 + 13 * m + v))
+            ).start_spectator_session(
+                ("127.0.0.1", host_port), vsock
+            )
+            viewers.append(viewer)
+            labels[m][vaddr] = f"viewer{v}"
+            hub.attach(m, vaddr)
+    assert pool.native_active, "native bank did not engage"
+    if native_io:
+        assert pool.native_io_active, "batched datapath did not attach"
+        for m in range(n_matches):
+            pool._io_set_capture(m)
+
+    sent = [[] for _ in range(n_matches)]
+
+    def sched(i, m):
+        return ((i + 2 * m) // (2 + m % 3)) % 16
+
+    for i in range(ticks):
+        clock[0] += 16
+        for m, peer in enumerate(peers):
+            peer.add_local_input(1, sched(i, m))
+            fulfill(peer.advance_frame())
+            # the peer's faulted sends reach the host before its crossing
+            peer_socks[m].flush()
+        for m in range(n_matches):
+            pool.add_local_input(m, 0, sched(i, m))
+        reqs = pool.advance_all()
+        for r in reqs:
+            fulfill(r)
+        for viewer in viewers:
+            try:
+                fulfill(viewer.advance_frame())
+            except (NotSynchronized, PredictionThreshold):
+                pass
+        if native_io:
+            for m in range(n_matches):
+                sent[m].extend(pool._io_drain_capture(m))
+    if not native_io:
+        for m in range(n_matches):
+            sent[m] = list(host_socks[m].sent)
+    # rewrite addresses (ephemeral ports differ between legs) to roles
+    out = []
+    for m in range(n_matches):
+        out.append([
+            (labels[m].get(addr, f"?{addr}"), data) for addr, data in sent[m]
+        ])
+    return dict(
+        sent=out,
+        frames=[pool.current_frame(m) for m in range(n_matches)],
+        crossings=pool.crossings,
+        pool=pool,
+        viewers=viewers,
+    )
+
+
+@needs_io
+class TestWireParity:
+    @pytest.mark.parametrize("seed", [1, 23])
+    def test_two_peer_matches_under_faults(self, seed):
+        """The headline pin: the batched datapath's full wire byte
+        sequence — content and send order — bit-identical to the Python
+        shuttle under seeded loss/dup/reorder inbound traffic."""
+        faults = dict(loss=0.05, duplicate=0.03, reorder=0.03)
+        ticks, n_matches = 200, 3
+        a = run_udp_leg(False, seed, ticks, n_matches, faults)
+        b = run_udp_leg(True, seed, ticks, n_matches, faults)
+        for m in range(n_matches):
+            assert a["sent"][m] == b["sent"][m], (
+                f"match {m}: wire bytes diverged "
+                f"(shuttle {len(a['sent'][m])} datagrams, "
+                f"batched {len(b['sent'][m])})"
+            )
+            assert a["frames"][m] == b["frames"][m]
+        assert all(f >= ticks - 64 for f in b["frames"]), (
+            "a batched session stalled short of the horizon"
+        )
+
+    @pytest.mark.parametrize("faults",
+                             [dict(), dict(loss=0.04, duplicate=0.02,
+                                           reorder=0.03)])
+    def test_both_sides_in_pool_parity(self, faults):
+        """One pool hosting BOTH peers of every match (the capacity-bench
+        topology): the pump must pre-drain every attached socket before
+        any slot flushes, or slot j would see slot i's tick-T datagrams
+        one tick early (mid-crossing) and the wire bytes would diverge
+        from the shuttle's drain-all-then-cross order."""
+        cfg = Config.for_uint(16)
+        ticks, n_matches = 150, 2
+
+        def leg(native_io):
+            clock = [0]
+            pool = HostSessionPool(native_io=native_io)
+            raws = []
+            socks = []
+            for m in range(n_matches):
+                raws.extend(UdpNonBlockingSocket(0) for _ in range(2))
+            for k, raw in enumerate(raws):
+                m, me = divmod(k, 2)
+                other = raws[2 * m + (1 - me)].local_port()
+                sock = raw if native_io else RecordingUdpSocket(raw)
+                socks.append(sock)
+                pool.add_session(
+                    _builder(cfg, clock, 3 + 7 * m + me, me,
+                             ("127.0.0.1", other)),
+                    sock,
+                )
+            assert pool.native_active
+            if native_io:
+                assert pool.native_io_active
+                for i in range(2 * n_matches):
+                    pool._io_set_capture(i)
+            sent = [[] for _ in range(2 * n_matches)]
+            rng = random.Random(99)
+            for i in range(ticks):
+                # jittered clock steps (seeded identically across legs)
+                # drive retry/quality/keep-alive timers through varied
+                # phases — the faults dict selects the jitter profile
+                clock[0] += 16 if not faults else rng.choice((5, 16, 40))
+                for idx in range(2 * n_matches):
+                    pool.add_local_input(
+                        idx, idx % 2, ((i + idx) // (2 + idx % 3)) % 16
+                    )
+                for reqs in pool.advance_all():
+                    fulfill(reqs)
+                if native_io:
+                    for idx in range(2 * n_matches):
+                        sent[idx].extend(
+                            data for _, data in pool._io_drain_capture(idx)
+                        )
+            if not native_io:
+                for idx in range(2 * n_matches):
+                    sent[idx] = [data for _, data in socks[idx].sent]
+            frames = [pool.current_frame(i) for i in range(2 * n_matches)]
+            return sent, frames
+
+        sent_a, frames_a = leg(False)
+        sent_b, frames_b = leg(True)
+        for idx in range(2 * n_matches):
+            assert sent_a[idx] == sent_b[idx], (
+                f"slot {idx}: in-pool wire bytes diverged (shuttle "
+                f"{len(sent_a[idx])} vs batched {len(sent_b[idx])})"
+            )
+        assert frames_a == frames_b
+        assert all(f >= ticks - 64 for f in frames_b)
+
+    def test_spectator_fanout_parity(self):
+        """Fan-out rides the batched path too: per-viewer deferral (the
+        one-tick-late flush order) must hold natively, and the captured
+        stream — remote and viewer datagrams interleaved — must match the
+        shuttle byte-for-byte."""
+        faults = dict(loss=0.03, duplicate=0.02, reorder=0.02)
+        ticks, n_matches = 150, 2
+        a = run_udp_leg(False, 7, ticks, n_matches, faults, n_viewers=2)
+        b = run_udp_leg(True, 7, ticks, n_matches, faults, n_viewers=2)
+        for m in range(n_matches):
+            assert a["sent"][m] == b["sent"][m], (
+                f"match {m}: fan-out wire bytes diverged"
+            )
+        # the viewers actually followed the broadcast on the batched leg
+        assert all(v.current_frame > ticks - 80 for v in b["viewers"]), (
+            "a viewer stalled on the batched leg"
+        )
+        # and fan-out datagrams really went through the NetBatch
+        st = b["pool"].io_stats()
+        assert st["send_datagrams"] > ticks * n_matches
+
+    def test_zero_extra_crossings_and_syscall_shape(self):
+        """native_io must not add crossings: exactly one pump crossing per
+        tick, and the syscall counters show the batching (≤ a couple of
+        recvmmsg/sendmmsg per slot-tick vs one syscall per datagram)."""
+        from ggrs_tpu.obs import Registry
+
+        ticks, n_matches = 80, 2
+        leg = run_udp_leg(True, 5, ticks, n_matches, dict(),
+                          metrics=Registry())
+        pool = leg["pool"]
+        assert leg["crossings"] == ticks
+        st = pool.io_stats()
+        assert st["recv_datagrams"] > 0 and st["send_datagrams"] > 0
+        # one drain loop + one flush per slot per tick, with slack for
+        # multi-batch drains
+        assert st["recv_calls"] <= 2 * ticks * n_matches
+        assert st["send_calls"] <= 2 * ticks * n_matches
+        # the shuttle would have paid ~one syscall per datagram
+        assert st["recv_calls"] + st["send_calls"] < (
+            st["recv_datagrams"] + st["send_datagrams"]
+        )
+        # the scrape surfaced the same counters through the registry
+        reg = pool.metrics
+        assert (reg.value("ggrs_io_syscalls_total", kind="recvmmsg") or 0) \
+            == st["recv_calls"]
+        assert (reg.value("ggrs_io_datagrams_total", dir="out") or 0) \
+            == st["send_datagrams"]
+
+
+@needs_io
+class TestErrnoStorms:
+    def _make(self, n_matches=2):
+        cfg = Config.for_uint(16)
+        clock = [0]
+        pool = HostSessionPool(native_io=True)
+        peers = []
+        for m in range(n_matches):
+            host_sock = UdpNonBlockingSocket(0)
+            peer_sock = UdpNonBlockingSocket(0)
+            peer_addr = ("127.0.0.1", peer_sock.local_port())
+            pool.add_session(
+                _builder(cfg, clock, 1 + m, 0, peer_addr), host_sock
+            )
+            peers.append(_builder(
+                cfg, clock, 100 + m, 1, ("127.0.0.1", host_sock.local_port())
+            ).start_p2p_session(peer_sock))
+        assert pool.native_active and pool.native_io_active
+        return pool, peers, clock
+
+    def _tick(self, pool, peers, clock, i):
+        clock[0] += 16
+        for m, peer in enumerate(peers):
+            peer.add_local_input(1, (i + m) % 16)
+            fulfill(peer.advance_frame())
+            pool.add_local_input(m, 0, (i + m) % 16)
+        for r in pool.advance_all():
+            fulfill(r)
+
+    def test_transient_storm_counts_as_loss(self):
+        """An ENOBUFS/EAGAIN storm drops datagrams (counted) but never
+        faults the slot — the protocol's redundant sends ride it out."""
+        pool, peers, clock = self._make()
+        for i in range(30):
+            self._tick(pool, peers, clock, i)
+        pool.inject_socket_errno(0, errno.ENOBUFS, 10)
+        for i in range(30, 45):
+            self._tick(pool, peers, clock, i)
+        pool.inject_socket_errno(0, errno.EAGAIN, 10)
+        for i in range(45, 120):
+            self._tick(pool, peers, clock, i)
+        assert pool.slot_state(0) == "native", "transient storm faulted slot"
+        assert pool.io_state(0) == "native"
+        st = pool.io_stats()
+        assert st["send_errors"] >= 20
+        assert pool.current_frame(0) > 80, "storm stalled the match"
+        assert pool.current_frame(1) > 80
+
+    def test_fatal_errno_faults_one_slot_and_evicts(self):
+        """A fatal errno (EPERM — the firewall/seccomp class the Python
+        path raises on) faults exactly the storm's slot with BANK_ERR_IO;
+        supervision evicts it onto the Python socket path and the match
+        resumes, while the other slot never leaves the bank."""
+        pool, peers, clock = self._make()
+        for i in range(30):
+            self._tick(pool, peers, clock, i)
+        pool.inject_socket_errno(0, errno.EPERM, 1)
+        for i in range(30, 90):
+            self._tick(pool, peers, clock, i)
+        assert pool.slot_state(0) == "evicted"
+        assert any(
+            f.code == _native.BANK_ERR_IO for f in pool.fault_log(0)
+        ), "fault log missing BANK_ERR_IO"
+        assert pool.slot_state(1) == "native", "blast radius exceeded 1 slot"
+        assert pool.current_frame(1) > 70
+        # the evicted slot resumed on the Python path and kept advancing
+        assert pool.current_frame(0) > 40
+        # eviction detached the batched datapath for that slot...
+        assert pool.io_state(0) == "python"
+        assert pool.io_state(1) == "native"
+        # ...without regressing the io totals: the detached slot's final
+        # counter snapshot stays in the aggregate
+        st = pool.io_stats()
+        assert st["recv_calls"] > 30 and st["send_calls"] > 30
+
+
+@needs_native
+class TestFallback:
+    def test_in_memory_sockets_stay_on_shuttle(self):
+        """native_io over an InMemoryNetwork: no fd to attach — every slot
+        stays on the Python shuttle and the pool behaves exactly as
+        before (the native bank itself still engages)."""
+        clock = [0]
+        net = InMemoryNetwork(latency_ticks=1)
+        pool = HostSessionPool(native_io=True)
+        names = ("X", "Y")
+        cfg = Config.for_uint(16)
+        for me in (0, 1):
+            b = (
+                SessionBuilder(cfg)
+                .with_clock(lambda: clock[0])
+                .with_rng(random.Random(me))
+                .add_player(Local(), me)
+                .add_player(Remote(names[1 - me]), 1 - me)
+            )
+            pool.add_session(b, net.socket(names[me]))
+        assert pool.native_active
+        assert not pool.native_io_active
+        assert pool.io_state(0) == "python"
+        for i in range(40):
+            clock[0] += 16
+            for idx in range(2):
+                pool.add_local_input(idx, idx, (i + idx) % 16)
+            for reqs in pool.advance_all():
+                fulfill(reqs)
+            net.tick()
+        assert pool.current_frame(0) > 20
+        assert pool.io_stats() == dict.fromkeys(_native.IO_STAT_FIELDS, 0)
+
+    def test_wrapped_socket_stays_on_shuttle(self):
+        """A socket without fileno (any wrapper) is not attachable: the
+        slot silently keeps the shuttle — fallback is per slot, never an
+        error."""
+        if _native.net_lib() is None:
+            pytest.skip("io datapath unavailable")
+        cfg = Config.for_uint(16)
+        clock = [0]
+        pool = HostSessionPool(native_io=True)
+        host_sock = RecordingUdpSocket(UdpNonBlockingSocket(0))
+        peer_sock = UdpNonBlockingSocket(0)
+        pool.add_session(
+            _builder(cfg, clock, 1, 0,
+                     ("127.0.0.1", peer_sock.local_port())),
+            host_sock,
+        )
+        peer = _builder(
+            cfg, clock, 2, 1, ("127.0.0.1", host_sock.local_port())
+        ).start_p2p_session(peer_sock)
+        assert pool.native_active
+        assert not pool.native_io_active
+        for i in range(40):
+            clock[0] += 16
+            peer.add_local_input(1, i % 16)
+            fulfill(peer.advance_frame())
+            pool.add_local_input(0, 0, i % 16)
+            for reqs in pool.advance_all():
+                fulfill(reqs)
+        assert pool.current_frame(0) > 20
+        assert len(host_sock.sent) > 0  # sends rode the Python path
+
+    def test_env_kill_switch(self, monkeypatch):
+        """GGRS_TPU_NO_NATIVE_IO=1 forces the shuttle even on attachable
+        sockets (the recvmmsg-unavailable / operator-override fallback)."""
+        monkeypatch.setenv("GGRS_TPU_NO_NATIVE_IO", "1")
+        assert _native.net_lib() is None
+        cfg = Config.for_uint(16)
+        clock = [0]
+        pool = HostSessionPool(native_io=True)
+        host_sock = UdpNonBlockingSocket(0)
+        peer_sock = UdpNonBlockingSocket(0)
+        pool.add_session(
+            _builder(cfg, clock, 1, 0,
+                     ("127.0.0.1", peer_sock.local_port())),
+            host_sock,
+        )
+        peer = _builder(
+            cfg, clock, 2, 1, ("127.0.0.1", host_sock.local_port())
+        ).start_p2p_session(peer_sock)
+        assert pool.native_active
+        assert not pool.native_io_active
+        for i in range(30):
+            clock[0] += 16
+            peer.add_local_input(1, i % 16)
+            fulfill(peer.advance_frame())
+            pool.add_local_input(0, 0, i % 16)
+            for reqs in pool.advance_all():
+                fulfill(reqs)
+        assert pool.current_frame(0) > 15

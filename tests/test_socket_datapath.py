@@ -1,0 +1,148 @@
+"""Satellite pins for the socket-layer datapath changes (DESIGN.md §15):
+
+- ``receive_all_datagrams`` drains through ONE persistent buffer
+  (``recvfrom_into``) instead of allocating 4 KiB per datagram — a burst
+  of N datagrams must come back intact and order-preserved (the buffer is
+  reused, so any aliasing bug corrupts earlier entries);
+- ``send_datagram`` is the raw sibling of ``send_to`` (no Message
+  wrapper, no re-encode) on both the UDP socket and the in-memory fake;
+- the oversized-packet warning fires once per (addr, size-class) while
+  the counter keeps counting every oversized datagram;
+- per-socket syscall accounting (``io_syscalls``) matches the
+  datagram-plus-probe arithmetic the host_bank_io bench relies on.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+
+from ggrs_tpu.net.messages import KeepAlive, Message, RawMessage
+from ggrs_tpu.net.sockets import (
+    IDEAL_MAX_UDP_PACKET_SIZE,
+    InMemoryNetwork,
+    UdpNonBlockingSocket,
+)
+
+
+def _pair():
+    a = UdpNonBlockingSocket(0)
+    b = UdpNonBlockingSocket(0)
+    return a, b, ("127.0.0.1", a.local_port()), ("127.0.0.1", b.local_port())
+
+
+class TestPersistentReceiveBuffer:
+    def test_burst_intact_and_order_preserved(self):
+        """N datagrams of varying sizes, one drain: every payload intact
+        (the persistent buffer must not alias earlier returns) and in
+        send order."""
+        a, b, _, addr_b = _pair()
+        try:
+            rng = random.Random(7)
+            payloads = [
+                bytes(rng.randrange(256) for _ in range(rng.randrange(1, 900)))
+                for _ in range(50)
+            ]
+            for p in payloads:
+                a.send_datagram(p, addr_b)
+            got = b.receive_all_datagrams()
+            assert [d for _, d in got] == payloads
+            assert all(src[0] == "127.0.0.1" for src, _ in got)
+            # the follow-up drain is empty, not a repeat
+            assert b.receive_all_datagrams() == []
+        finally:
+            a.close()
+            b.close()
+
+    def test_truncation_matches_recv_buffer_size(self):
+        """Datagrams above the 4096-byte receive buffer truncate (the
+        recvfrom contract the persistent buffer must preserve)."""
+        a, b, _, addr_b = _pair()
+        try:
+            a.send_datagram(b"\xab" * 6000, addr_b)
+            got = b.receive_all_datagrams()
+            assert len(got) == 1
+            assert got[0][1] == b"\xab" * 4096
+        finally:
+            a.close()
+            b.close()
+
+    def test_syscall_accounting(self):
+        """Each datagram is one recvfrom; the EAGAIN probe is one more —
+        the per-socket counter the io bench sums."""
+        a, b, _, addr_b = _pair()
+        try:
+            base = b.io_syscalls
+            for i in range(5):
+                a.send_datagram(bytes([i]), addr_b)
+            assert len(b.receive_all_datagrams()) == 5
+            assert b.io_syscalls - base == 6  # 5 datagrams + 1 probe
+            sends = a.io_syscalls
+            assert sends >= 5
+        finally:
+            a.close()
+            b.close()
+
+
+class TestSendDatagram:
+    def test_raw_send_equals_wrapped_send(self):
+        """send_datagram(bytes) puts the same wire bytes out as
+        send_to(RawMessage(bytes)) — the bank/hub path stops paying the
+        wrapper + re-encode for already-encoded datagrams."""
+        a, b, _, addr_b = _pair()
+        try:
+            wire = Message(0x1234, KeepAlive()).encode()
+            a.send_datagram(wire, addr_b)
+            a.send_to(RawMessage(wire), addr_b)
+            got = [d for _, d in b.receive_all_datagrams()]
+            assert got == [wire, wire]
+        finally:
+            a.close()
+            b.close()
+
+    def test_fake_socket_send_datagram_parity(self):
+        """FakeSocket.send_datagram rides the same fault-injection path
+        (and the same rng stream) as send_to."""
+        wire = Message(0x4242, KeepAlive()).encode()
+        net_a = InMemoryNetwork(seed=3, loss=0.3, duplicate=0.2, reorder=0.2)
+        net_b = InMemoryNetwork(seed=3, loss=0.3, duplicate=0.2, reorder=0.2)
+        sa, sb = net_a.socket("S"), net_b.socket("S")
+        net_a.socket("D")
+        net_b.socket("D")
+        for _ in range(50):
+            sa.send_datagram(wire, "D")
+            sb.send_to(RawMessage(wire), "D")
+        got_a = net_a._receive_raw("D")
+        got_b = net_b._receive_raw("D")
+        assert got_a == got_b
+        assert 0 < len(got_a) < 70  # faults actually fired
+
+    def test_oversized_warning_rate_limited(self, caplog):
+        """One warning per (addr, size-class); the obs counter still
+        counts every oversized datagram."""
+        from ggrs_tpu.net import sockets as sockets_mod
+
+        a, b, _, addr_b = _pair()
+        c = UdpNonBlockingSocket(0)
+        addr_c = ("127.0.0.1", c.local_port())
+        try:
+            counter = sockets_mod._OBS_OVERSIZED
+            base = counter.value
+            big = b"x" * (IDEAL_MAX_UDP_PACKET_SIZE + 100)   # class 1
+            bigger = b"y" * (IDEAL_MAX_UDP_PACKET_SIZE + 700)  # class 2
+            with caplog.at_level(logging.WARNING, logger="ggrs_tpu.net.sockets"):
+                for _ in range(4):
+                    a.send_datagram(big, addr_b)       # 4 sends, 1 warning
+                a.send_datagram(bigger, addr_b)        # new class: warns
+                a.send_datagram(big, addr_c)           # new addr: warns
+                a.send_datagram(b"z" * 10, addr_b)     # small: never warns
+            warnings = [
+                r for r in caplog.records
+                if "larger than ideal" in r.getMessage()
+            ]
+            assert len(warnings) == 3
+            assert counter.value - base == 6
+        finally:
+            a.close()
+            b.close()
+            c.close()
