@@ -11,6 +11,7 @@ boundary as the reference.
 """
 
 from . import broadcast  # noqa: F401  - spectator fan-out + journals (§13)
+from . import fleet  # noqa: F401  - sharded serving/migration/failover (§16)
 from . import obs  # noqa: F401  - metrics/flight-recorder/exporters (§12)
 from .core import *  # noqa: F401,F403
 from .core import __all__ as _core_all
@@ -47,5 +48,6 @@ __all__ = list(_core_all) + [
     "SyncTestSession",
     "UdpNonBlockingSocket",
     "broadcast",
+    "fleet",
     "obs",
 ]

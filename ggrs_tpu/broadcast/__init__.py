@@ -14,13 +14,14 @@ model, the per-match confirmed-input stream (DESIGN.md §13).
   checkpoint-seek and fused device fast-forward.
 """
 
-from .hub import SpectatorHub
+from .hub import SpectatorHub, graft_spectator_endpoints
 from .journal import (
     JournalError,
     JournalExhausted,
     JournalTap,
     MatchJournal,
     read_journal,
+    resume_from_file,
 )
 
 __all__ = [
@@ -29,5 +30,7 @@ __all__ = [
     "JournalTap",
     "MatchJournal",
     "SpectatorHub",
+    "graft_spectator_endpoints",
     "read_journal",
+    "resume_from_file",
 ]

@@ -42,8 +42,10 @@ from ..core.types import (
     GgrsEvent,
     NetworkInterrupted,
     NetworkResumed,
+    NULL_FRAME,
 )
 from ..net.protocol import draw_magic
+from ..net.wire import encode_uvarint
 from ..obs.registry import Registry
 from .journal import JournalTap, MatchJournal
 
@@ -53,6 +55,51 @@ _EV_RESUMED = 2
 _EV_DISCONNECTED = 3
 
 MAX_EVENT_QUEUE_SIZE = 100
+
+
+def graft_spectator_endpoints(session, builder, specs) -> None:
+    """Graft fan-out endpoints onto a resumed Python session — the shared
+    spectator carry-over of eviction (same pool,
+    ``HostSessionPool._adopt_spectators``) and of live migration / crash
+    failover (``parallel.host_bank.adopt_resume_bundle`` on a destination
+    shard).  Each viewer resumes its harvested send window (ack base +
+    unacked pending), so it sees a retransmission hiccup, not a reset
+    stream.
+
+    ``specs``: one dict per viewer — the identity (``addr``, ``magic``,
+    ``handles``, ``running``) plus the harvested window (``state``: a
+    harvest spectator record with ``last_acked_frame`` / ``send_base`` /
+    ``pending``, or None for a viewer with no harvested window, which
+    restarts its delta base from the default-input frame)."""
+    config = builder._config
+    players = builder._num_players
+    default_blob = config.input_encode(config.input_default())
+    default_base = b"".join(
+        encode_uvarint(len(default_blob)) + default_blob
+        for _ in range(players)
+    )
+    for spec in specs:
+        addr = spec["addr"]
+        hs = spec.get("state")
+        ep = session._player_reg.spectators.get(addr)
+        if ep is None:
+            ep = builder._create_endpoint(
+                list(spec.get("handles") or []), addr, players
+            )
+            session.adopt_spectator_endpoint(addr, ep)
+        base = hs["send_base"] if hs and hs["send_base"] else default_base
+        ep.adopt_endpoint_state(
+            magic=spec["magic"],
+            running=(
+                hs["state"] == 0 if hs else bool(spec.get("running", True))
+            ),
+            peer_connect_status=[(False, NULL_FRAME)] * players,
+            last_recv_frame=NULL_FRAME,
+            recv_entries=(),
+            last_acked_frame=hs["last_acked_frame"] if hs else NULL_FRAME,
+            send_base=base,
+            pending=hs["pending"] if hs else (),
+        )
 
 
 class SpectatorHub:

@@ -32,7 +32,15 @@ Record kinds: FRAME (payload = num_players blank flags + num_players *
 input_size raw input bytes), CHECKPOINT (payload = a self-contained npz
 blob from ``utils.checkpoint.dumps_pytree``; ``frame`` = the next frame to
 simulate from that state), GAP (a known hole — e.g. frames suppressed by a
-mid-fan-out slot fault; replays stop here), CLOSE (clean end of match).
+mid-fan-out slot fault; replays stop here), CLOSE (clean end of match),
+LOCAL (payload = u16 player handle + input_size raw bytes: one staged
+LOCAL input, written at staging time — i.e. BEFORE the frame confirms and
+ahead of the confirmed stream).  LOCAL records exist for fleet crash
+failover (DESIGN.md §16): a rollback host sends its local inputs for
+predicted frames immediately, so the peers hold frames the confirmed
+stream doesn't — after a crash, the resumed incarnation must re-send
+bit-identical values for exactly those frames, and the LOCAL tail is the
+only durable place they can come from.
 """
 
 from __future__ import annotations
@@ -55,6 +63,7 @@ REC_FRAME = 1
 REC_CHECKPOINT = 2
 REC_GAP = 3
 REC_CLOSE = 4
+REC_LOCAL = 5
 
 _HEADER_FMT = "<BIqI"  # kind, payload_len, frame, crc
 _HEADER_SIZE = struct.calcsize(_HEADER_FMT)
@@ -103,6 +112,7 @@ class MatchJournal:
         self.next_frame = 0  # next frame the journal expects to append
         self._fsync_every = fsync_every
         self._since_fsync = 0
+        self._local_dirty = False
         self._closed = False
         # tracing (DESIGN.md §14): fsync stalls show up as journal.fsync
         # spans on the pool timeline — the classic hidden tick-p99 spike
@@ -190,6 +200,26 @@ class MatchJournal:
         if self._fsync_every and self._since_fsync >= self._fsync_every:
             self.flush(fsync=True)
 
+    def append_local_input(
+        self, frame: int, handle: int, payload: bytes
+    ) -> None:
+        """Journal one staged LOCAL input (the fleet failover seam): the
+        value player ``handle`` staged for ``frame``, written BEFORE the
+        tick that sends it — callers fsync via :meth:`flush_local` ahead
+        of the send so a crashed incarnation's successor can re-send
+        bit-identical values for every frame the peers might hold."""
+        if self._closed:
+            return
+        self._append(REC_LOCAL, frame, struct.pack("<H", handle) + payload)
+        self._local_dirty = True
+
+    def flush_local(self) -> None:
+        """Fsync pending LOCAL records (no-op when none were appended
+        since the last flush) — the durable-before-send barrier."""
+        if self._local_dirty and not self._closed:
+            self.flush(fsync=True)
+            self._local_dirty = False
+
     def append_checkpoint(
         self, frame: int, state: Any, meta: Optional[Dict[str, Any]] = None
     ) -> None:
@@ -247,66 +277,193 @@ class MatchJournal:
         if not self.tail:
             raise JournalError("journal tail is empty: nothing to resume")
         m = pool._mirrors[index]
-        isize = self.input_size
-        window = list(self.tail)
-        frames = [f for f, _, _ in window]
-        w0, tip = frames[0], frames[-1]
-        blob_at = {f: blob for f, _, blob in window}
-
-        def join(handles: Sequence[int], frame: int) -> bytes:
-            blob = blob_at[frame]
-            return b"".join(
-                encode_uvarint(isize) + blob[h * isize : (h + 1) * isize]
-                for h in handles
-            )
-
-        def send_window(handles: Sequence[int]):
-            """(last_acked, base, pending) so the pending head follows the
-            base exactly (the emit-side invariant)."""
-            if w0 == 0:
-                zeros = bytes(isize)
-                base = b"".join(encode_uvarint(isize) + zeros for _ in handles)
-                return NULL_FRAME, base, [
-                    (f, join(handles, f)) for f in frames
-                ]
-            return w0, join(handles, w0), [
-                (f, join(handles, f)) for f in frames[1:]
-            ]
-
-        local_handles = m.local_handles
-        endpoints = []
-        for ep in m.endpoints:
-            acked, base, pending = send_window(local_handles)
-            endpoints.append(dict(
-                state=0 if ep.running else 1,
-                last_acked_frame=acked, send_base=base, pending=pending,
-                last_recv=tip,
-                recv_entries=[(f, join(ep.handles, f)) for f in frames],
-            ))
-        all_players = list(range(self.num_players))
-        spectators = []
-        for sp in m.spectators:
-            acked, base, pending = send_window(all_players)
-            spectators.append(dict(
-                state=0 if sp.running else 1,
-                last_acked_frame=acked, send_base=base, pending=pending,
-            ))
-        player_inputs = [
-            (w0, [blob_at[f][p * isize : (p + 1) * isize] for f in frames])
-            for p in all_players
-        ]
-        resume = min(tip, m.current_frame)
-        return dict(
+        return _window_resume(
+            list(self.tail),
+            num_players=self.num_players,
+            input_size=self.input_size,
+            local_handles=m.local_handles,
+            endpoints=[(ep.handles, ep.running) for ep in m.endpoints],
+            spectators=[sp.running for sp in m.spectators],
+            disc=self._disc,
+            last=self._last,
             current=m.current_frame,
-            last_confirmed=resume,
-            disconnect_frame=NULL_FRAME,
-            local_disc=list(self._disc),
-            local_last=list(self._last),
-            player_inputs=player_inputs,
-            endpoints=endpoints,
-            next_spectator_frame=tip + 1,
-            spectators=spectators,
         )
+
+
+def _window_resume(
+    window: Sequence[Tuple[int, bytes, bytes]],
+    *,
+    num_players: int,
+    input_size: int,
+    local_handles: Sequence[int],
+    endpoints: Sequence[Tuple[Sequence[int], bool]],
+    spectators: Sequence[bool],
+    disc: Sequence[bool],
+    last: Sequence[int],
+    current: int,
+) -> Dict[str, Any]:
+    """A ``ggrs_bank_harvest``-shaped resume dict from one contiguous
+    window of confirmed frames (``(frame, blank_flags, joined_blob)``
+    triples) — the core shared by :meth:`MatchJournal.recovery_harvest`
+    (live in-memory tail + pool mirrors) and :func:`resume_from_file`
+    (durable journal alone, fleet crash failover).  ``endpoints`` is
+    ``(handles, running)`` per remote endpoint; ``spectators`` is one
+    running flag per fan-out endpoint."""
+    isize = input_size
+    frames = [f for f, _, _ in window]
+    w0, tip = frames[0], frames[-1]
+    blob_at = {f: blob for f, _, blob in window}
+
+    def join(handles: Sequence[int], frame: int) -> bytes:
+        blob = blob_at[frame]
+        return b"".join(
+            encode_uvarint(isize) + blob[h * isize : (h + 1) * isize]
+            for h in handles
+        )
+
+    def send_window(handles: Sequence[int]):
+        """(last_acked, base, pending) so the pending head follows the
+        base exactly (the emit-side invariant)."""
+        if w0 == 0:
+            zeros = bytes(isize)
+            base = b"".join(encode_uvarint(isize) + zeros for _ in handles)
+            return NULL_FRAME, base, [
+                (f, join(handles, f)) for f in frames
+            ]
+        return w0, join(handles, w0), [
+            (f, join(handles, f)) for f in frames[1:]
+        ]
+
+    eps = []
+    for handles, running in endpoints:
+        acked, base, pending = send_window(local_handles)
+        eps.append(dict(
+            state=0 if running else 1,
+            last_acked_frame=acked, send_base=base, pending=pending,
+            last_recv=tip,
+            recv_entries=[(f, join(handles, f)) for f in frames],
+        ))
+    all_players = list(range(num_players))
+    sps = []
+    for running in spectators:
+        acked, base, pending = send_window(all_players)
+        sps.append(dict(
+            state=0 if running else 1,
+            last_acked_frame=acked, send_base=base, pending=pending,
+        ))
+    player_inputs = [
+        (w0, [blob_at[f][p * isize : (p + 1) * isize] for f in frames])
+        for p in all_players
+    ]
+    resume = min(tip, current)
+    return dict(
+        current=current,
+        last_confirmed=resume,
+        disconnect_frame=NULL_FRAME,
+        local_disc=list(disc),
+        local_last=list(last),
+        player_inputs=player_inputs,
+        endpoints=eps,
+        next_spectator_frame=tip + 1,
+        spectators=sps,
+    )
+
+
+def resume_from_file(
+    path,
+    *,
+    local_handles: Sequence[int],
+    endpoints: Sequence[Tuple[Sequence[int], bool]],
+    spectators: Sequence[bool] = (),
+    tail_window: int = 128,
+) -> Dict[str, Any]:
+    """Crash-failover recovery from the DURABLE journal alone (fleet
+    layer, DESIGN.md §16): parse the intact crc32 prefix of ``path`` and
+    synthesize the resume material for a match whose shard process — its
+    native bank, mirrors, and in-memory journal tail — is GONE.
+
+    Safe to call while the (dead or dying) writer's last append is torn
+    mid-record: the crc chain truncates the parse at the last durable
+    record, so the result always resumes to the last durable frame (pinned
+    by tests/test_fleet.py under concurrent appends).
+
+    Topology comes from the caller (the fleet supervisor's match
+    registry), not the journal: ``endpoints`` is ``(handles, running)``
+    per remote endpoint in the source slot's endpoint order,
+    ``spectators`` one running flag per carried-over viewer.
+
+    Returns ``dict(harvest=…, checkpoint=(frame, npz_blob) | None,
+    durable_tip=frame, window=[(frame, flags, blob), …],
+    local_tail={frame: {handle: raw_input}})``: ``harvest``
+    is the harvest-shaped resume dict over the newest contiguous
+    confirmed window (capped at ``tail_window`` frames, returned raw as
+    ``window`` so failover can build its fast-forward prelude),
+    ``checkpoint`` the newest embedded state checkpoint whose frame lies
+    inside that window (the only state a dead process leaves behind;
+    without one the game state cannot be rebuilt and the caller must
+    treat the match as unrecoverable)."""
+    parsed = read_journal(path)
+    frames = parsed["frames"]
+    if not frames:
+        raise JournalError(f"{path}: no durable frames to resume from")
+    window: List[Tuple[int, bytes, bytes]] = []
+    for rec in reversed(frames):
+        if window and rec[0] != window[-1][0] - 1:
+            break  # a gap record (or lost prefix) ends the usable window
+        window.append(rec)
+        if len(window) >= tail_window:
+            break
+    window.reverse()
+    meta = parsed["meta"]
+    players = int(meta["num_players"])
+    isize = int(meta["input_size"])
+    disc = [False] * players
+    last = [NULL_FRAME] * players
+    for f, flags, _ in frames:
+        for p in range(players):
+            if flags[p]:
+                disc[p] = True
+            else:
+                disc[p] = False
+                last[p] = f
+    w0, tip = window[0][0], window[-1][0]
+    checkpoint = None
+    for cf, blob in reversed(parsed["checkpoints"]):
+        # resumable: the state at cf (frames 0..cf-1 applied) plus the
+        # confirmed inputs cf..tip-1 (all in the window) rebuild the
+        # state AT the durable tip.  cf == tip+1 is NOT resumable even
+        # though it is durable: that state already includes frame tip,
+        # and the fast-forward prelude would store it under the tip's
+        # cell, making the resumed session re-apply frame tip — a silent
+        # desync.  (Reachable for bank-tier matches: checkpoints follow
+        # the pool's confirmed watermark while the journal's frame feed
+        # trails it by the fan-out deferral.)
+        if w0 <= cf <= tip:
+            checkpoint = (cf, blob)
+            break
+    harvest = _window_resume(
+        window,
+        num_players=players,
+        input_size=isize,
+        local_handles=list(local_handles),
+        endpoints=list(endpoints),
+        spectators=list(spectators),
+        disc=disc,
+        last=last,
+        current=tip,
+    )
+    # the staged-local tail: values the dead incarnation SENT for frames
+    # at/after the durable tip (a rollback host sends predicted frames
+    # immediately), which the resumed incarnation must replay verbatim —
+    # re-sending different values for frames the peers already hold would
+    # silently desync the match.  Last record wins (re-staging after a
+    # readmission overwrites).
+    local_tail: Dict[int, Dict[int, bytes]] = {}
+    for f, handle, payload in parsed["local_inputs"]:
+        if f >= tip:
+            local_tail.setdefault(f, {})[handle] = payload
+    return dict(harvest=harvest, checkpoint=checkpoint, durable_tip=tip,
+                window=window, local_tail=local_tail)
 
 
 class JournalTap:
@@ -384,7 +541,7 @@ class JournalTap:
 
 def read_journal(path) -> Dict[str, Any]:
     """Parse a journal file into ``{meta, frames, checkpoints, gaps,
-    closed, truncated}``.  The crc chain is verified record by record; a
+    local_inputs, closed, truncated}``.  The crc chain is verified record by record; a
     mismatch (torn write, bit rot) truncates the parse at the last intact
     record instead of raising — the recovered prefix is still a valid
     replay (``truncated`` reports it)."""
@@ -414,6 +571,7 @@ def read_journal(path) -> Dict[str, Any]:
     frames: List[Tuple[int, bytes, bytes]] = []
     checkpoints: List[Tuple[int, bytes]] = []
     gaps: List[int] = []
+    local_inputs: List[Tuple[int, int, bytes]] = []
     closed = False
     truncated = False
     while pos < len(data):
@@ -447,9 +605,16 @@ def read_journal(path) -> Dict[str, Any]:
             gaps.append(frame)
         elif kind == REC_CLOSE:
             closed = True
+        elif kind == REC_LOCAL:
+            if plen != 2 + isize:
+                raise JournalError(
+                    f"local record is {plen} bytes, expected {2 + isize}"
+                )
+            (handle,) = struct.unpack_from("<H", payload)
+            local_inputs.append((frame, handle, payload[2:]))
         else:
             raise JournalError(f"unknown journal record kind {kind}")
     return dict(
         meta=meta, frames=frames, checkpoints=checkpoints, gaps=gaps,
-        closed=closed, truncated=truncated,
+        local_inputs=local_inputs, closed=closed, truncated=truncated,
     )

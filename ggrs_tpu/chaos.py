@@ -551,6 +551,267 @@ def drive_broadcast(
     return ctx
 
 
+class CrcGame:
+    """A minimal deterministic 'simulation' whose state is a crc32 chain
+    over every advanced frame's inputs — cheap, rollback-correct (save and
+    load round-trip the int state), and divergence-sensitive: any two ends
+    that ever advance a frame with different inputs disagree on every
+    checksum afterwards, which ``DesyncDetection.on(1)`` turns into
+    ``DesyncDetected`` events.  The fleet chaos legs use one per
+    participant so a failover that re-sends different inputs cannot hide."""
+
+    def __init__(self) -> None:
+        import zlib
+
+        self._crc32 = zlib.crc32
+        self.state = 0
+
+    def fulfill(self, requests) -> None:
+        for r in requests:
+            k = type(r).__name__
+            if k == "AdvanceFrame":
+                # hash the input VALUES only: a correctly-predicted frame
+                # never rolls back, so its saved state keeps the PREDICTED
+                # status the peer's CONFIRMED copy lacks — hashing statuses
+                # would desync every match at frame 1
+                values = tuple(v for v, _status in r.inputs)
+                self.state = self._crc32(repr(values).encode(), self.state)
+            elif k == "SaveGameState":
+                r.cell.save(r.frame, self.state, self.state)
+            elif k == "LoadGameState":
+                data = r.cell.data()
+                assert data is not None, (
+                    f"load of unfulfilled cell at frame {r.frame}"
+                )
+                self.state = data
+
+
+def drive_fleet_chaos(
+    ticks: int,
+    matches_per_shard: int = 4,
+    seed: int = 0,
+    inject: Optional[Callable[[int, Dict[str, Any]], Any]] = None,
+    n_spectators: int = 0,
+    spectate_match: str = "m0",
+    fault_cfg: Optional[Dict[str, Any]] = None,
+    journal_dir=None,
+    checkpoint_every: int = 8,
+    desync_interval: int = 1,
+    capacity: int = 64,
+    metrics: Optional[Registry] = None,
+) -> Dict[str, Any]:
+    """The fleet-scale chaos world (DESIGN.md §16): a two-shard
+    ``ShardSupervisor`` serving ``2 * matches_per_shard`` journaled 2-peer
+    matches — ``m0..`` pinned to shard ``s0``, the rest to ``s1`` so
+    placement is identical across legs — each against an external Python
+    ``P2PSession`` peer on its own seeded fault network, every participant
+    running a :class:`CrcGame` with per-frame desync detection.
+    ``n_spectators`` real ``SpectatorSession`` viewers watch
+    ``spectate_match``.
+
+    ``inject(i, ctx)`` runs at the top of tick ``i`` and drives the fleet
+    verbs under test: ``ctx['sup'].kill('s1')``, ``.drain('s1')``,
+    ``.migrate(mid)``.  Identical arguments produce a bit-identical run —
+    the control/chaos comparison contract — so a leg with an inject is
+    compared against one without, match by match.
+    """
+    import tempfile
+
+    from .core.types import Spectator
+    from .core.errors import (
+        NotSynchronized,
+        PredictionThreshold,
+        SpectatorTooFarBehind,
+    )
+    from .fleet import ShardSupervisor
+
+    base = seed * 1000
+    clock = [0]
+    registry = metrics if metrics is not None else Registry()
+    if journal_dir is None:
+        journal_dir = tempfile.mkdtemp(prefix="ggrs_fleet_chaos_")
+    sup = ShardSupervisor(
+        ("s0", "s1"), capacity=capacity, metrics=registry,
+        journal_dir=journal_dir, checkpoint_every=checkpoint_every,
+        journal_tail_window=8 * checkpoint_every,
+        identity_refresh_every=4, seed=base + 1,
+    )
+    n = 2 * matches_per_shard
+    match_ids = [f"m{k}" for k in range(n)]
+    nets: Dict[str, InMemoryNetwork] = {}
+    peers: Dict[str, Any] = {}
+    host_socks: Dict[str, RecordingSocket] = {}
+    games: Dict[str, CrcGame] = {}
+    peer_games: Dict[str, CrcGame] = {}
+    viewers: List[Any] = []
+    viewer_names = [f"V{v}" for v in range(n_spectators)]
+    for k, mid in enumerate(match_ids):
+        cfg = dict(fault_cfg or {"latency_ticks": 1})
+        cfg.setdefault("seed", base + 100 + k)
+        net = InMemoryNetwork(**cfg)
+        nets[mid] = net
+        host_sock = RecordingSocket(net.socket(f"H{k}"))
+        host_socks[mid] = host_sock
+
+        def builder_factory(k=k, mid=mid):
+            b = two_peer_builder(
+                clock, base + 3 + 7 * k, 0, f"P{k}"
+            ).with_desync_detection_mode(DesyncDetection.on(desync_interval))
+            if mid == spectate_match:
+                for v, vname in enumerate(viewer_names):
+                    b = b.add_player(Spectator(vname), 2 + v)
+            return b
+
+        sup.admit(
+            mid, builder_factory, (lambda s=host_sock: s),
+            state_template=0,
+            shard="s0" if k < matches_per_shard else "s1",
+        )
+        peers[mid] = two_peer_builder(
+            clock, base + 4 + 7 * k, 1, f"H{k}", other_handle=0
+        ).with_desync_detection_mode(
+            DesyncDetection.on(desync_interval)
+        ).start_p2p_session(net.socket(f"P{k}"))
+        games[mid] = CrcGame()
+        peer_games[mid] = CrcGame()
+    k_spec = match_ids.index(spectate_match) if n_spectators else None
+    for v, vname in enumerate(viewer_names):
+        vb = (
+            SessionBuilder(Config.for_uint(16))
+            .with_clock(lambda: clock[0])
+            .with_rng(random.Random(base + 900 + v))
+        )
+        viewers.append(vb.start_spectator_session(
+            f"H{k_spec}", nets[spectate_match].socket(vname)
+        ))
+
+    reqs_log: Dict[str, List] = {mid: [] for mid in match_ids}
+    host_events: Dict[str, List] = {mid: [] for mid in match_ids}
+    peer_events: Dict[str, List] = {mid: [] for mid in match_ids}
+    viewer_streams: List[List] = [[] for _ in viewers]
+
+    def sched(i, k):
+        return ((i + 2 * k) // (2 + k % 3)) % 16
+
+    ctx: Dict[str, Any] = dict(
+        sup=sup, peers=peers, nets=nets, clock=clock, seed=seed,
+        match_ids=match_ids, viewers=viewers, journal_dir=journal_dir,
+    )
+    for i in range(ticks):
+        clock[0] += 16
+        if inject is not None:
+            inject(i, ctx)
+        for mid, peer in peers.items():
+            try:
+                peer.add_local_input(1, (i * 5) % 16)
+                peer_games[mid].fulfill(peer.advance_frame())
+            except (NotSynchronized, PredictionThreshold):
+                pass  # host mid-migration: backpressure, not a fault
+            peer_events[mid].extend(peer.events())
+        for k, mid in enumerate(match_ids):
+            sup.add_local_input(mid, 0, sched(i, k))
+        out = sup.advance_all()
+        for mid, reqs in out.items():
+            games[mid].fulfill(reqs)
+            reqs_log[mid].append(req_summary(reqs))
+        for mid in match_ids:
+            host_events[mid].extend(sup.events(mid))
+        for v, viewer in enumerate(viewers):
+            try:
+                for r in viewer.advance_frame():
+                    viewer_streams[v].append(
+                        (viewer.current_frame, tuple(r.inputs))
+                    )
+            except (NotSynchronized, PredictionThreshold,
+                    SpectatorTooFarBehind):
+                pass
+        for net in nets.values():
+            net.tick()
+    ctx.update(
+        wire={mid: s.sent for mid, s in host_socks.items()},
+        reqs=reqs_log,
+        host_events=host_events,
+        peer_events=peer_events,
+        viewer_streams=viewer_streams,
+        locations={mid: sup.match_location(mid) for mid in match_ids},
+        lost=sup.lost_matches(),
+        frames={
+            mid: (sup.current_frame(mid)
+                  if sup.match_location(mid) is not None else None)
+            for mid in match_ids
+        },
+        peer_frames={mid: p.current_frame for mid, p in peers.items()},
+        states={mid: games[mid].state for mid in match_ids},
+        peer_states={mid: g.state for mid, g in peer_games.items()},
+        healthz=sup.healthz(),
+        registry=registry,
+    )
+    return ctx
+
+
+def fleet_survivor_violations(
+    chaos: Dict[str, Any],
+    control: Dict[str, Any],
+    survivors: List[str],
+) -> List[str]:
+    """Fleet acceptance, part 1: matches on the un-touched shard must be
+    bit-identical — wire bytes, request lists, events — between the chaos
+    leg and the fault-free control leg, and stay where they were placed."""
+    out = []
+    for mid in survivors:
+        if chaos["locations"][mid] != control["locations"][mid]:
+            out.append(
+                f"{mid}: moved to {chaos['locations'][mid]} "
+                f"(control {control['locations'][mid]})"
+            )
+        for field in ("wire", "reqs", "host_events"):
+            if chaos[field][mid] != control[field][mid]:
+                out.append(f"{mid}: {field} diverged from control")
+    return out
+
+
+def fleet_recovery_violations(
+    ctx: Dict[str, Any],
+    affected: List[str],
+    dead_shards: List[str] = (),
+    max_lag: int = 40,
+) -> List[str]:
+    """Fleet acceptance, part 2 (within the chaos leg): every affected
+    match recovered — placed on a live shard, peer still connected, no
+    desync on either end, and caught back up to within ``max_lag`` frames
+    of its external peer."""
+
+    out = []
+    for mid, reason in ctx["lost"].items():
+        out.append(f"{mid}: LOST ({reason})")
+    for mid in affected:
+        loc = ctx["locations"][mid]
+        if loc is None:
+            continue  # already reported as lost
+        if loc in dead_shards:
+            out.append(f"{mid}: still on dead shard {loc}")
+        peer_frame = ctx["peer_frames"][mid]
+        frame = ctx["frames"][mid]
+        if frame is None or peer_frame - frame > max_lag:
+            out.append(
+                f"{mid}: stalled at frame {frame} (peer {peer_frame})"
+            )
+    for mid in ctx["match_ids"]:
+        for side in ("host_events", "peer_events"):
+            desyncs = [
+                e for e in ctx[side][mid] if isinstance(e, DesyncDetected)
+            ]
+            if desyncs:
+                out.append(f"{mid}: {side} desync {desyncs[:2]}")
+        discs = [
+            e for e in ctx["peer_events"][mid]
+            if type(e).__name__ == "Disconnected"
+        ]
+        if discs:
+            out.append(f"{mid}: peer disconnected {discs}")
+    return out
+
+
 def blast_radius_violations(
     chaos: Dict[str, Any],
     control: Dict[str, Any],

@@ -1,0 +1,35 @@
+"""ggrs_tpu.fleet — sharded pool serving above ``HostSessionPool``
+(DESIGN.md §16).
+
+The layer that survives losing a shard: a :class:`ShardSupervisor` owns N
+:class:`PoolShard` shards behind a consistent-hash, capacity-aware
+placement front (:class:`HashRing`), and treats a running match as a
+portable object — **live migration** between shards through the harvest
+seam, **graceful drain** (admission off, migrate everything, retire), and
+**crash failover** from the durable match journals when a shard dies.
+Chaos coverage: ``scripts/chaos.py --fault shard``.
+"""
+
+from .placement import HashRing
+from .shard import (
+    AdoptedMatch,
+    PoolShard,
+    SHARD_ACTIVE,
+    SHARD_DEAD,
+    SHARD_DRAINING,
+    SHARD_RETIRED,
+)
+from .supervisor import FleetError, MatchRecord, ShardSupervisor
+
+__all__ = [
+    "AdoptedMatch",
+    "FleetError",
+    "HashRing",
+    "MatchRecord",
+    "PoolShard",
+    "SHARD_ACTIVE",
+    "SHARD_DEAD",
+    "SHARD_DRAINING",
+    "SHARD_RETIRED",
+    "ShardSupervisor",
+]

@@ -1,0 +1,784 @@
+"""ShardSupervisor: the fleet's placement/drain/failover control plane
+(DESIGN.md §16).
+
+One supervisor owns N :class:`~ggrs_tpu.fleet.shard.PoolShard` shards
+(threads or subprocesses sharing the host — here: in-process pools, each
+with its own native bank) behind a placement front:
+
+- **admission** — consistent-hash owner first
+  (:class:`~ggrs_tpu.fleet.placement.HashRing`), then the ring's fallback
+  order, each shard consulted through its capacity-aware
+  ``admission_refusal`` check (slot occupancy, tick p99, ``/healthz``
+  staleness).  A fully-refused match parks in a retry queue with
+  exponential backoff plus seeded jitter — a thundering re-admission herd
+  after a shard-wide event must not hammer one tick.
+- **live migration** — ``migrate(match_id, dst)``: export on the source
+  via the harvest seam (``HostSessionPool.export_resume_state``, falling
+  back to the journal when the native harvest is dead), force the bundle
+  through a serialize→deserialize round trip (the process-portability
+  contract, pinned by tests), adopt on the destination
+  (``adopt_resume_bundle``), re-attach the journal tap.  Peers and
+  viewers see a retransmission hiccup, never a reset.
+- **graceful drain** — ``drain(shard)``: admission closes, matches
+  migrate off a few per tick (bounded work per tick), the empty shard
+  retires.
+- **crash failover** — a failed health check (or the chaos ``kill``)
+  marks the shard dead; every match on it re-adopts onto survivors from
+  its DURABLE journal alone (``broadcast.journal.resume_from_file``):
+  the newest embedded state checkpoint, fast-forwarded to the last
+  durable frame through a request prelude the game fulfills, plus the
+  wire identity the supervisor cached while the shard was healthy.
+  Matches without a usable checkpoint are counted lost, loudly.
+
+The supervisor is single-threaded like everything session-shaped: the
+serving loop calls ``add_local_input`` per match and ``advance_all()``
+once per tick; control-plane work (drain steps, health checks, admission
+retries) rides the same tick.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import random
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..core.errors import GgrsError, InvalidRequest
+from ..core.sync_layer import SavedStates
+from ..core.types import (
+    AdvanceFrame,
+    GgrsRequest,
+    InputStatus,
+    LoadGameState,
+    SaveGameState,
+)
+from ..obs.registry import Registry, default_registry
+from ..utils.tracing import get_logger
+from .placement import HashRing
+from .shard import (
+    PoolShard,
+    SHARD_ACTIVE,
+    SHARD_DEAD,
+    SHARD_DRAINING,
+    SHARD_RETIRED,
+)
+
+_logger = get_logger("fleet")
+
+# re-admission retry policy (satellite of DESIGN.md §16): exponential
+# backoff with seeded jitter, bounded attempts
+READMIT_BACKOFF_TICKS = 8
+READMIT_MAX_ATTEMPTS = 6
+
+
+class FleetError(GgrsError):
+    """A fleet-layer operation failed (placement, migration, failover)."""
+
+
+class MatchRecord:
+    """Control-plane registry entry for one match: how to rebuild it
+    (factories), where it lives, its journal incarnations, and the cached
+    wire identity crash failover needs when the serving process is gone."""
+
+    __slots__ = (
+        "match_id", "builder_factory", "socket_factory", "state_template",
+        "journaled", "location", "incarnation", "journal_paths",
+        "identity", "lost", "num_players", "input_size", "max_prediction",
+        "local_handles",
+    )
+
+    def __init__(self, match_id: str, builder_factory, socket_factory,
+                 state_template) -> None:
+        self.match_id = match_id
+        self.builder_factory = builder_factory
+        self.socket_factory = socket_factory
+        self.state_template = state_template
+        self.journaled = False
+        self.location: Optional[str] = None
+        self.incarnation = 0
+        self.journal_paths: List[str] = []
+        self.identity: Optional[Dict[str, Any]] = None
+        self.lost: Optional[str] = None
+        self.num_players = 0
+        self.input_size = 0
+        self.max_prediction = 0
+        self.local_handles: List[int] = []
+
+
+class _PendingAdmission:
+    __slots__ = ("record", "attempts", "next_try")
+
+    def __init__(self, record: MatchRecord, attempts: int, next_try: int):
+        self.record = record
+        self.attempts = attempts
+        self.next_try = next_try
+
+
+class ShardSupervisor:
+    """N pool shards behind one placement/drain/failover front."""
+
+    def __init__(
+        self,
+        shard_ids=("shard0", "shard1"),
+        *,
+        capacity: int = 64,
+        metrics: Optional[Registry] = None,
+        tracer=None,
+        journal_dir=None,
+        # fsync per confirmed frame: the durable tip then tracks the
+        # confirmed watermark exactly, so crash failover is lossless.  Any
+        # frame a dead shard ACKED beyond its durable tip is unrecoverable
+        # (the peer trimmed its resend window), and the resumed match
+        # stalls — raising this trades fsync load for that risk window
+        # (DESIGN.md §16, "the durable-ack window").
+        journal_fsync_every: int = 1,
+        journal_tail_window: int = 128,
+        checkpoint_every: int = 32,
+        seed: int = 0,
+        max_migrations_per_tick: int = 4,
+        identity_refresh_every: int = 8,
+        p99_budget_ms: Optional[float] = None,
+        stale_after_s: Optional[float] = None,
+        native_io: bool = False,
+        retire_dead_matches: bool = False,
+    ) -> None:
+        self.metrics = metrics if metrics is not None else default_registry()
+        self.journal_dir = (
+            os.fspath(journal_dir) if journal_dir is not None else None
+        )
+        self.journal_fsync_every = journal_fsync_every
+        self.journal_tail_window = journal_tail_window
+        self.max_migrations_per_tick = max_migrations_per_tick
+        self.identity_refresh_every = identity_refresh_every
+        self._rng = random.Random(seed)
+        self.shards: Dict[str, PoolShard] = {}
+        self.ring = HashRing()
+        for sid in shard_ids:
+            self.shards[str(sid)] = PoolShard(
+                str(sid), capacity=capacity, metrics=self.metrics,
+                tracer=tracer, checkpoint_every=checkpoint_every,
+                p99_budget_ms=p99_budget_ms, stale_after_s=stale_after_s,
+                native_io=native_io,
+                retire_dead_matches=retire_dead_matches,
+            )
+            self.ring.add(str(sid))
+        self._records: Dict[str, MatchRecord] = {}
+        self._pending: List[_PendingAdmission] = []
+        self._tick = 0
+        self.last_tick_at: Optional[float] = None
+        m = self.metrics
+        self._g_shards = m.gauge(
+            "ggrs_fleet_shards", "shards per lifecycle state",
+            labels=("state",))
+        self._g_matches = m.gauge(
+            "ggrs_fleet_matches", "matches tracked by the fleet, by status",
+            labels=("status",))
+        self._m_admissions = m.counter(
+            "ggrs_fleet_admissions_total", "matches placed, by tier",
+            labels=("tier",))
+        self._m_refusals = m.counter(
+            "ggrs_fleet_admission_refusals_total",
+            "per-shard admission refusals, by reason", labels=("reason",))
+        self._m_retries = m.counter(
+            "ggrs_fleet_admission_retries_total",
+            "re-admission attempts from the backoff queue")
+        self._m_migrations = m.counter(
+            "ggrs_fleet_migrations_total",
+            "matches moved between shards, by reason", labels=("reason",))
+        self._m_migration_failures = m.counter(
+            "ggrs_fleet_migration_failures_total",
+            "migrations/failovers that could not restore the match")
+        self._m_failovers = m.counter(
+            "ggrs_fleet_failovers_total",
+            "shards failed over (every match journal-recovered)")
+        self._m_lost = m.counter(
+            "ggrs_fleet_matches_lost_total",
+            "matches the fleet could not recover")
+        self._update_shard_gauge()
+
+    # ------------------------------------------------------------------
+    # admission (placement front)
+    # ------------------------------------------------------------------
+
+    def admit(
+        self,
+        match_id: str,
+        builder_factory: Callable[[], Any],
+        socket_factory: Callable[[], Any],
+        *,
+        journal: Optional[bool] = None,
+        state_template: Any = None,
+        shard: Optional[str] = None,
+    ) -> Optional[str]:
+        """Place one match on the fleet.  ``builder_factory`` /
+        ``socket_factory`` must return a FRESH fully-populated
+        ``SessionBuilder`` / socket each call — migration and failover
+        rebuild the session from them, so they are the match's durable
+        description.  ``journal`` defaults to on when the supervisor has a
+        ``journal_dir`` (journaling is what makes a match survive its
+        shard); ``state_template`` is the pytree template failover rebuilds
+        checkpointed game state into.  ``shard`` pins placement (bypassing
+        the ring, not the admission check) — chaos/control topologies use
+        it to make placement identical across legs.
+
+        Returns the shard id, or None when every shard refused and the
+        match parked in the re-admission backoff queue."""
+        if match_id in self._records:
+            raise InvalidRequest(f"match {match_id!r} already admitted")
+        record = MatchRecord(
+            match_id, builder_factory, socket_factory, state_template
+        )
+        record.journaled = (
+            journal if journal is not None else self.journal_dir is not None
+        )
+        if record.journaled and self.journal_dir is None:
+            raise InvalidRequest(
+                "journal=True needs a supervisor journal_dir"
+            )
+        probe = builder_factory()
+        record.num_players = probe._num_players
+        record.input_size = probe._config.native_input_size
+        record.max_prediction = probe._max_prediction
+        from ..core.types import Remote, Spectator
+
+        record.local_handles = sorted(
+            h for h, t in probe._player_reg.handles.items()
+            if not isinstance(t, (Remote, Spectator))
+        )
+        self._records[match_id] = record
+        placed = self._try_place(record, builder=probe, pinned=shard)
+        if placed is None:
+            self._park(record, attempts=0)
+        self._update_match_gauge()
+        return placed
+
+    def _candidate_shards(self, match_id: str,
+                          pinned: Optional[str] = None,
+                          exclude: Optional[str] = None):
+        if pinned is not None:
+            yield pinned
+            return
+        for sid in self.ring.preference(match_id):
+            if sid != exclude:
+                yield sid
+
+    def _try_place(self, record: MatchRecord, *, builder=None,
+                   pinned: Optional[str] = None,
+                   exclude: Optional[str] = None) -> Optional[str]:
+        for sid in self._candidate_shards(record.match_id, pinned, exclude):
+            shard = self.shards[sid]
+            refusal = shard.admission_refusal()
+            if refusal is not None:
+                self._m_refusals.labels(reason=refusal).inc()
+                continue
+            b = builder if builder is not None else record.builder_factory()
+            journal = self._open_journal(record) if record.journaled else None
+            tier = shard.admit(
+                record.match_id, b, record.socket_factory(), journal=journal
+            )
+            record.location = sid
+            self._m_admissions.labels(tier=tier).inc()
+            return sid
+        return None
+
+    def _park(self, record: MatchRecord, attempts: int) -> None:
+        if attempts >= READMIT_MAX_ATTEMPTS:
+            record.lost = "admission refused by every shard"
+            self._m_lost.inc()
+            _logger.error("match %s lost: %s", record.match_id, record.lost)
+            return
+        delay = (
+            READMIT_BACKOFF_TICKS * (2 ** attempts)
+            + self._rng.randrange(READMIT_BACKOFF_TICKS)
+        )
+        self._pending.append(_PendingAdmission(
+            record, attempts + 1, self._tick + delay
+        ))
+
+    def _retry_pending(self) -> None:
+        if not self._pending:
+            return
+        due = [p for p in self._pending if self._tick >= p.next_try]
+        if not due:
+            return
+        self._pending = [p for p in self._pending if self._tick < p.next_try]
+        for p in due:
+            self._m_retries.inc()
+            placed = self._try_place(p.record)
+            if placed is None:
+                self._park(p.record, p.attempts)
+        self._update_match_gauge()
+
+    def pending_admissions(self) -> int:
+        return len(self._pending)
+
+    # ------------------------------------------------------------------
+    # journals
+    # ------------------------------------------------------------------
+
+    def _open_journal(self, record: MatchRecord):
+        from ..broadcast.journal import MatchJournal
+
+        path = os.path.join(
+            self.journal_dir,
+            f"{record.match_id}.{record.incarnation:03d}.ggjl",
+        )
+        journal = MatchJournal(
+            path, record.num_players, record.input_size,
+            meta=dict(match_id=record.match_id,
+                      incarnation=record.incarnation),
+            fsync_every=self.journal_fsync_every,
+            tail_window=self.journal_tail_window,
+            metrics=self.metrics,
+        )
+        record.journal_paths.append(path)
+        return journal
+
+    # ------------------------------------------------------------------
+    # serving loop
+    # ------------------------------------------------------------------
+
+    def add_local_input(self, match_id: str, handle: int, value) -> None:
+        record = self._records[match_id]
+        if record.lost is not None or record.location is None:
+            return  # parked or lost: inputs drop, like dead pool slots
+        self.shards[record.location].add_local_input(match_id, handle, value)
+
+    def advance_all(self) -> Dict[str, List[GgrsRequest]]:
+        """One fleet tick: every serving shard's tick (each pool still one
+        native crossing), then the control plane — drain steps, health
+        checks + failover, admission retries.  Returns ``{match_id:
+        request_list}`` over every match that ticked."""
+        self._tick += 1
+        out: Dict[str, List[GgrsRequest]] = {}
+        for sid in sorted(self.shards):
+            out.update(self.shards[sid].advance_all())
+        self._drive_drains()
+        self._health_check()
+        self._retry_pending()
+        if self.identity_refresh_every and (
+            self._tick % self.identity_refresh_every == 0
+        ):
+            self._refresh_identities()
+        self.last_tick_at = time.monotonic()
+        return out
+
+    def events(self, match_id: str) -> List:
+        record = self._records[match_id]
+        if record.location is None:
+            return []
+        return self.shards[record.location].events(match_id)
+
+    def current_frame(self, match_id: str) -> int:
+        record = self._records[match_id]
+        if record.location is None:
+            raise InvalidRequest(f"match {match_id!r} is not placed")
+        return self.shards[record.location].current_frame(match_id)
+
+    def match_location(self, match_id: str) -> Optional[str]:
+        return self._records[match_id].location
+
+    def lost_matches(self) -> Dict[str, str]:
+        return {
+            mid: r.lost for mid, r in self._records.items()
+            if r.lost is not None
+        }
+
+    def _refresh_identities(self) -> None:
+        """Cache every healthy match's wire identity (endpoint/spectator
+        magics) in the control plane — the piece of failover a dead
+        process cannot provide.  Read-only; never perturbs the match."""
+        for record in self._records.values():
+            sid = record.location
+            if sid is None or record.lost is not None:
+                continue
+            shard = self.shards[sid]
+            if shard.killed or shard.state == SHARD_DEAD:
+                continue
+            try:
+                record.identity = shard.wire_identity(record.match_id)
+            except Exception:
+                pass  # e.g. pool not started yet; next refresh catches it
+
+    # ------------------------------------------------------------------
+    # live migration
+    # ------------------------------------------------------------------
+
+    def migrate(self, match_id: str, dst_shard: Optional[str] = None,
+                *, reason: str = "manual") -> str:
+        """Move one running match to ``dst_shard`` (or the first accepting
+        shard on its preference walk).  Bank matches move live through the
+        harvest seam; adopted matches move through their (flushed) journal
+        — both land as an adopted session on the destination with the
+        peers/viewers seeing a retransmission hiccup."""
+        record = self._records[match_id]
+        if record.lost is not None or record.location is None:
+            raise FleetError(f"match {match_id!r} is not serving")
+        src_id = record.location
+        src = self.shards[src_id]
+        if dst_shard is None:
+            for sid in self._candidate_shards(match_id, exclude=src_id):
+                if self.shards[sid].admission_refusal() is None:
+                    dst_shard = sid
+                    break
+            if dst_shard is None:
+                raise FleetError("no shard accepts the migration")
+        elif dst_shard == src_id:
+            raise FleetError("destination is the source shard")
+        else:
+            refusal = self.shards[dst_shard].admission_refusal()
+            if refusal is not None:
+                raise FleetError(
+                    f"shard {dst_shard} refuses the migration: {refusal}"
+                )
+        dst = self.shards[dst_shard]
+        # refresh the identity first: failover of the NEW incarnation needs
+        # the same magics the bundle carries
+        record.identity = src.wire_identity(match_id)
+        bundle = None
+        if match_id in src._matches:
+            try:
+                bundle = src.evict_match(match_id)
+            except InvalidRequest:
+                # no native harvest on the source (degraded Python bank,
+                # or the harvest AND its journal-recovery slot hook are
+                # gone): fall through to the durable-journal ladder below
+                if not record.journaled:
+                    raise FleetError(
+                        f"match {match_id!r}: source shard cannot export "
+                        "natively and the match is not journaled"
+                    )
+        if bundle is not None:
+            try:
+                # the process-portability contract, enforced on every
+                # migration: the bundle must survive leaving this process
+                bundle = pickle.loads(pickle.dumps(bundle))
+                record.incarnation += 1
+                journal = (
+                    self._open_journal(record) if record.journaled else None
+                )
+                try:
+                    builder = record.builder_factory()
+                    dst.adopt_match(
+                        match_id, builder, record.socket_factory(), bundle,
+                        journal=journal,
+                    )
+                except Exception:
+                    # the failed incarnation's journal is empty: close it
+                    # and forget the path so a journal fallback reads the
+                    # PREVIOUS incarnation, not this stub
+                    if journal is not None:
+                        record.journal_paths.pop()
+                        try:
+                            journal.close()
+                        except Exception:
+                            pass
+                    raise
+            except Exception as e:
+                # the source slot is already released — never leave the
+                # match half-tracked: fall back to the durable journal,
+                # else it is lost, loudly (mirrors _fail_shard)
+                self._m_migration_failures.inc()
+                _logger.error(
+                    "migration of %s to %s failed after eviction: %s",
+                    match_id, dst_shard, e,
+                )
+                self._recover_or_lose(record, dst_shard, e)
+            else:
+                record.location = dst_shard
+        else:
+            if not record.journaled:
+                raise FleetError(
+                    f"adopted match {match_id!r} has no journal to migrate "
+                    "through"
+                )
+            src.drop_match(match_id, reason=f"migrated ({reason})")
+            try:
+                self._readopt_from_journal(record, dst_shard)
+            except Exception as e:
+                self._m_migration_failures.inc()
+                self._recover_or_lose(record, dst_shard, e,
+                                      try_journal=False)
+        self._m_migrations.labels(reason=reason).inc()
+        self._update_match_gauge()
+        return dst_shard
+
+    def _recover_or_lose(self, record: MatchRecord, dst_shard: str,
+                         cause: Exception, *,
+                         try_journal: bool = True) -> None:
+        """Last-ditch path for a migration that failed AFTER the source
+        released the match: one journal re-adoption attempt (skipped when
+        the journal path is what just failed), else the match is marked
+        lost (loudly) and a ``FleetError`` raised — a plain exception
+        here would abort the whole fleet tick from ``_drive_drains``."""
+        if try_journal and record.journaled and record.journal_paths:
+            try:
+                self._readopt_from_journal(record, dst_shard)
+                return
+            except Exception as e:
+                cause = e
+        record.lost = f"migration failed: {cause}"
+        record.location = None
+        self._m_lost.inc()
+        _logger.error("match %s lost: %s", record.match_id, record.lost)
+        self._update_match_gauge()
+        raise FleetError(
+            f"match {record.match_id!r} lost in migration: {cause}"
+        ) from cause
+
+    # ------------------------------------------------------------------
+    # graceful drain
+    # ------------------------------------------------------------------
+
+    def drain(self, shard_id: str) -> None:
+        """Begin draining ``shard_id``: admission closes now; matches
+        migrate off a bounded few per tick; the empty shard retires."""
+        shard = self.shards[shard_id]
+        if shard.state != SHARD_ACTIVE:
+            raise InvalidRequest(
+                f"shard {shard_id} is {shard.state}: only active shards "
+                "drain"
+            )
+        shard.state = SHARD_DRAINING
+        self._update_shard_gauge()
+
+    def _drive_drains(self) -> None:
+        for sid in sorted(self.shards):
+            shard = self.shards[sid]
+            if shard.state != SHARD_DRAINING or shard.killed:
+                continue
+            moved = 0
+            for match_id in sorted(shard.match_ids()):
+                if moved >= self.max_migrations_per_tick:
+                    break
+                try:
+                    self.migrate(match_id, reason="drain")
+                except FleetError as e:
+                    # no capacity anywhere right now: stay draining, the
+                    # next tick retries (bounded work either way)
+                    _logger.warning(
+                        "drain of %s stalled on %s: %s", sid, match_id, e
+                    )
+                    break
+                moved += 1
+            if shard.live_matches() == 0:
+                shard.retire()
+                self._update_shard_gauge()
+                _logger.info("shard %s drained and retired", sid)
+
+    # ------------------------------------------------------------------
+    # crash failover
+    # ------------------------------------------------------------------
+
+    def kill(self, shard_id: str) -> None:
+        """Chaos entry: simulate the shard process dying mid-tick.  The
+        next ``advance_all`` health check fails it over."""
+        self.shards[shard_id].kill()
+
+    def _health_check(self) -> None:
+        for sid in sorted(self.shards):
+            shard = self.shards[sid]
+            if shard.state in (SHARD_RETIRED, SHARD_DEAD):
+                continue
+            if not shard.healthz()["ok"]:
+                self._fail_shard(sid)
+
+    def _fail_shard(self, shard_id: str) -> None:
+        """Every match on the failed shard journal-recovers onto the
+        survivors — the durable artifacts (journal + checkpoints + cached
+        identity) are all that is assumed to exist."""
+        shard = self.shards[shard_id]
+        shard.state = SHARD_DEAD
+        self.ring.remove(shard_id)
+        self._m_failovers.inc()
+        self._update_shard_gauge()
+        matches = sorted(
+            set(shard.match_ids()) | {
+                mid for mid, r in self._records.items()
+                if r.location == shard_id and r.lost is None
+            }
+        )
+        _logger.error(
+            "shard %s failed health check; failing over %d matches",
+            shard_id, len(matches),
+        )
+        for match_id in matches:
+            record = self._records[match_id]
+            try:
+                self._readopt_from_journal(record, exclude=shard_id)
+            except Exception as e:
+                record.lost = f"failover failed: {e}"
+                record.location = None
+                self._m_migration_failures.inc()
+                self._m_lost.inc()
+                _logger.error("match %s lost: %s", match_id, record.lost)
+            else:
+                self._m_migrations.labels(reason="failover").inc()
+        self._update_match_gauge()
+
+    def _readopt_from_journal(self, record: MatchRecord,
+                              dst_shard: Optional[str] = None,
+                              exclude: Optional[str] = None) -> str:
+        """Rebuild one match from its durable journal alone and adopt it
+        on ``dst_shard`` (or the first accepting survivor): load the
+        newest in-window checkpoint, fast-forward to the last durable
+        frame through a request prelude the game fulfills, resume the
+        wire from the synthesized harvest + cached identity."""
+        from ..broadcast.journal import resume_from_file
+        from ..utils.checkpoint import loads_pytree
+
+        if not record.journaled or not record.journal_paths:
+            raise FleetError("match has no journal to recover from")
+        identity = record.identity
+        if identity is None:
+            raise FleetError("no cached wire identity (shard died before "
+                             "the first identity refresh)")
+        res = resume_from_file(
+            record.journal_paths[-1],
+            local_handles=identity["local_handles"],
+            endpoints=[
+                (e["handles"], True) for e in identity["endpoints"]
+            ],
+            spectators=[True] * len(identity["spectators"]),
+            tail_window=self.journal_tail_window,
+        )
+        if res["checkpoint"] is None:
+            raise FleetError(
+                "no state checkpoint inside the durable window "
+                "(checkpoint_every too large vs tail_window?)"
+            )
+        cf, blob = res["checkpoint"]
+        state, _meta = loads_pytree(blob, record.state_template)
+        tip = res["durable_tip"]
+        harvest = res["harvest"]
+        saved = SavedStates(record.max_prediction)
+        cell_cf = saved.get_cell(cf)
+        cell_cf.save(cf, state, None)
+        # the fast-forward prelude: restore the checkpoint, advance the
+        # journaled confirmed frames cf..tip-1, save at the durable tip —
+        # fulfilled by the game ahead of the session's own first requests
+        builder = record.builder_factory()
+        decode = builder._config.input_decode
+        isize = record.input_size
+        window_at = {f: (flags, b) for f, flags, b in res["window"]}
+        prelude: List[GgrsRequest] = [
+            LoadGameState(cell=cell_cf, frame=cf)
+        ]
+        for f in range(cf, tip):
+            flags, fblob = window_at[f]
+            prelude.append(AdvanceFrame(inputs=[
+                (
+                    decode(fblob[p * isize:(p + 1) * isize]),
+                    InputStatus.DISCONNECTED if flags[p]
+                    else InputStatus.CONFIRMED,
+                )
+                for p in range(record.num_players)
+            ]))
+        prelude.append(
+            SaveGameState(cell=saved.get_cell(tip), frame=tip)
+        )
+        bundle = dict(
+            version=1,
+            num_players=record.num_players,
+            input_size=record.input_size,
+            max_prediction=record.max_prediction,
+            local_handles=list(record.local_handles),
+            resume_frame=tip,
+            harvest=harvest,
+            next_recommended_sleep=0,
+            pending_events=[],
+            endpoints=[
+                dict(
+                    addr=e["addr"], handles=list(e["handles"]),
+                    magic=e["magic"], running=True,
+                    peer_disc=list(harvest["local_disc"]),
+                    peer_last=list(harvest["local_last"]),
+                    pending_checksums={},
+                )
+                for e in identity["endpoints"]
+            ],
+            spectators=[dict(sp) for sp in identity["spectators"]],
+            staged_inputs={},
+        )
+        # the staged-local replay map: values the dead incarnation already
+        # SENT for frames at/after the durable tip.  The resumed session
+        # re-walks those frames with the recorded inputs substituted, so
+        # its wire stream is bit-identical to what the peers hold — this
+        # is what keeps journal failover desync-free, not just stall-free.
+        replay_local = {
+            f: {h: decode(p) for h, p in per_handle.items()}
+            for f, per_handle in res["local_tail"].items()
+        }
+        if dst_shard is None:
+            for sid in self._candidate_shards(
+                record.match_id, exclude=exclude
+            ):
+                shard = self.shards[sid]
+                if shard.state == SHARD_DEAD or shard.killed:
+                    continue
+                if shard.admission_refusal() is None:
+                    dst_shard = sid
+                    break
+            if dst_shard is None:
+                raise FleetError("no surviving shard accepts the match")
+        record.incarnation += 1
+        journal = self._open_journal(record)
+        self.shards[dst_shard].adopt_match(
+            record.match_id, builder, record.socket_factory(), bundle,
+            saved_states=saved, prelude=prelude, journal=journal,
+            replay_local=replay_local,
+        )
+        record.location = dst_shard
+        return dst_shard
+
+    # ------------------------------------------------------------------
+    # health + gauges
+    # ------------------------------------------------------------------
+
+    def healthz(self) -> Dict[str, Any]:
+        """Fleet-wide aggregate for the ``/healthz`` endpoint
+        (``start_http_server(health=supervisor.healthz)``): per-shard
+        records plus one top-level verdict — ok while every non-retired
+        shard is healthy and at least one shard still admits."""
+        shards = {
+            sid: shard.healthz() for sid, shard in self.shards.items()
+        }
+        serving = [
+            h for h in shards.values()
+            if h["state"] not in (SHARD_RETIRED, SHARD_DEAD)
+        ]
+        ok = bool(serving) and all(h["ok"] for h in serving)
+        age = (
+            None if self.last_tick_at is None
+            else max(0.0, time.monotonic() - self.last_tick_at)
+        )
+        return dict(
+            ok=ok,
+            tick=self._tick,
+            last_tick_age_s=age,
+            shards=shards,
+            matches=sum(h["matches"] for h in shards.values()),
+            pending_admissions=len(self._pending),
+            lost_matches=len(self.lost_matches()),
+        )
+
+    def _update_shard_gauge(self) -> None:
+        counts: Dict[str, int] = {}
+        for shard in self.shards.values():
+            state = SHARD_DEAD if shard.killed else shard.state
+            counts[state] = counts.get(state, 0) + 1
+        for state in (SHARD_ACTIVE, SHARD_DRAINING, SHARD_RETIRED,
+                      SHARD_DEAD):
+            self._g_shards.labels(state=state).set(counts.get(state, 0))
+
+    def _update_match_gauge(self) -> None:
+        placed = sum(
+            1 for r in self._records.values()
+            if r.location is not None and r.lost is None
+        )
+        self._g_matches.labels(status="placed").set(placed)
+        self._g_matches.labels(status="pending").set(len(self._pending))
+        self._g_matches.labels(status="lost").set(
+            len(self.lost_matches())
+        )

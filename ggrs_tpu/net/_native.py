@@ -323,6 +323,15 @@ def _load() -> Optional[ctypes.CDLL]:
                 ctypes.c_void_p, ctypes.c_int64,
                 ctypes.c_char_p, ctypes.c_size_t,
             ]
+        if hasattr(lib, "ggrs_ep_rewind_send"):
+            # fleet failover seam (send-window rewind on regressive acks);
+            # absent on a prebuilt older .so — PeerProtocol then skips the
+            # rewind and the match degrades exactly as before the seam
+            lib.ggrs_ep_rewind_send.restype = None
+            lib.ggrs_ep_rewind_send.argtypes = [
+                ctypes.c_void_p, ctypes.c_int64,
+                ctypes.c_char_p, ctypes.c_size_t,
+            ]
         if hasattr(lib, "ggrs_ep_stats"):
             # observability counters (obs stat harvest); absent on a
             # prebuilt pre-obs .so — readers degrade to zeros

@@ -214,6 +214,16 @@ class PyEndpointCore:
         if last_recv > self._last_recv:
             self._last_recv = last_recv
 
+    def rewind_send(self, frame: Frame, base: bytes) -> bool:
+        """Rewind the send window to an earlier delta base (the fleet
+        failover seam): the peer resumed from its durable journal and holds
+        less than it once acked.  Drops the pending window — the caller
+        re-pushes everything after ``frame`` from its sent-payload ring."""
+        self._pending.clear()
+        self._last_acked_frame = frame
+        self._last_acked = base
+        return True
+
 
 class NativeEndpointCore:
     """C++-backed endpoint datapath (native/endpoint.cpp via ctypes)."""
@@ -481,6 +491,15 @@ class NativeEndpointCore:
             self._lib.ggrs_ep_store_one(self._ptr, frame, payload, len(payload))
         if last_recv > self._last_recv:
             self._last_recv = last_recv
+
+    def rewind_send(self, frame: Frame, base: bytes) -> bool:
+        """``PyEndpointCore.rewind_send`` over the native core; False on a
+        prebuilt .so that predates the seam (the caller then skips the
+        rewind — the match degrades exactly as before it existed)."""
+        if not hasattr(self._lib, "ggrs_ep_rewind_send"):
+            return False
+        self._lib.ggrs_ep_rewind_send(self._ptr, frame, base, len(base))
+        return True
 
 
 def make_endpoint_core(

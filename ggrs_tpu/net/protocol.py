@@ -63,10 +63,37 @@ _DROP_UNDECODABLE = _OBS_DROPPED.labels(reason="undecodable")
 _DROP_MALFORMED = _OBS_DROPPED.labels(reason="malformed")
 _DROP_BAD_FRAME = _OBS_DROPPED.labels(reason="malformed_frame")
 _DROP_BAD_INPUT = _OBS_DROPPED.labels(reason="undecodable_input")
+# the fleet failover seam (DESIGN.md §16): send windows rewound on a
+# peer's regressive acks, and rewinds refused because the sent-payload
+# ring no longer reached back to the requested base
+_OBS_REWINDS = default_registry().counter(
+    "ggrs_protocol_send_rewinds_total",
+    "send windows rewound to a peer's regressed ack frame",
+)
+_OBS_REWIND_MISSES = default_registry().counter(
+    "ggrs_protocol_send_rewind_misses_total",
+    "send-window rewinds refused (ring too short / core too old)",
+)
 
 UDP_HEADER_SIZE = 28  # IP + UDP header bytes, for bandwidth estimation
 UDP_SHUTDOWN_TIMER_MS = 5000
 PENDING_OUTPUT_SIZE = 128
+# Send-window rewind (the fleet failover seam, DESIGN.md §16).  A peer
+# that resumed from its durable journal holds LESS input history than it
+# acked before dying; its post-resume acks therefore REGRESS below our
+# send base, and delta-encoded packets against the old base can never
+# decode there again.  REWIND_ACK_THRESHOLD identical consecutive
+# regressive acks (impossible from mere reordering, where newer acks
+# interleave) trigger a rebase to the regressed frame from the sent
+# ring — REWIND_RING_FRAMES of recently pushed payloads.  A spurious
+# rewind is self-healing: the receiver dup-skips and re-acks its true
+# watermark, advancing the base right back.
+REWIND_ACK_THRESHOLD = 3
+REWIND_RING_FRAMES = 512
+# rate limit for re-acking the true receive watermark on undecodable
+# input packets (the other half of the seam: the resumed side tells the
+# peer where its ring actually ends)
+NACK_INTERVAL_MS = 50
 RUNNING_RETRY_INTERVAL_MS = 200
 KEEP_ALIVE_INTERVAL_MS = 200
 QUALITY_REPORT_INTERVAL_MS = 200
@@ -279,8 +306,11 @@ class PeerProtocol(Generic[I, A]):
         # (C++) when the toolchain is available, pure Python otherwise —
         # wire-identical either way (net/endpoint.py).
         default_bytes = config.input_encode(config.input_default())
+        self._default_send_base = _encode_player_bytes(
+            [default_bytes] * local_players
+        )
         self._core = make_endpoint_core(
-            send_base=_encode_player_bytes([default_bytes] * local_players),
+            send_base=self._default_send_base,
             recv_base=_encode_player_bytes(
                 [default_bytes] * len(self.handles)
             ),
@@ -289,6 +319,20 @@ class PeerProtocol(Generic[I, A]):
         self._last_recv_frame: Frame = NULL_FRAME  # mirror of core state
         # fused-datagram receive (native core only; None → object path)
         self._fused_recv = getattr(self._core, "handle_input_datagram", None)
+        # send-window rewind state (the fleet failover seam): a ring of
+        # recently pushed payloads by frame, and the regressive-ack
+        # detector (see REWIND_ACK_THRESHOLD above)
+        self._sent_ring: Dict[Frame, bytes] = {}
+        self._sent_tip: Frame = NULL_FRAME
+        self._regress_ack: Optional[Frame] = None
+        self._regress_count = 0
+        self._last_nack_time = now - NACK_INTERVAL_MS
+        # Nacking undecodable inputs is ADOPTION-ONLY: a fresh endpoint's
+        # drops are malformed/hostile packets whose pinned semantic is
+        # silence (and the native bank drops them silently — wire parity).
+        # Only a mid-stream resume can create the legitimate missing-base
+        # case the nack exists for.
+        self._nack_on_drop = False
 
         self._time_sync = TimeSync()
         self.local_frame_advantage = 0
@@ -450,12 +494,25 @@ class PeerProtocol(Generic[I, A]):
         )
 
         pending = self._core.push_input(frame, payload)
+        self._remember_sent(frame, payload)
         # A peer that never acks 128 inputs is a stuck spectator: disconnect
         # (reference: protocol.rs:441-445).
         if pending > PENDING_OUTPUT_SIZE:
             self._event_queue.append(EvDisconnected())
 
         self._send_pending_output(connect_status)
+
+    def _remember_sent(self, frame: Frame, payload: bytes) -> None:
+        """Keep recently pushed payloads beyond the ack horizon: a
+        journal-resumed peer may regress its acks below our base, and the
+        rewind re-pushes from this ring (the core drops acked payloads)."""
+        self._sent_ring[frame] = payload
+        if frame > self._sent_tip:
+            self._sent_tip = frame
+        if len(self._sent_ring) > REWIND_RING_FRAMES + 64:
+            cutoff = self._sent_tip - REWIND_RING_FRAMES
+            for f in [f for f in self._sent_ring if f < cutoff]:
+                del self._sent_ring[f]
 
     def _send_pending_output(self, connect_status: Sequence[ConnectionStatus]) -> None:
         data = self._core.emit_input(
@@ -517,6 +574,74 @@ class PeerProtocol(Generic[I, A]):
             self._disconnect_notify_sent = False
             self._event_queue.append(EvNetworkResumed())
 
+    def _handle_ack(self, ack_frame: Frame) -> None:
+        """Apply a peer ack, watching for the journal-resume signature:
+        REWIND_ACK_THRESHOLD identical consecutive acks strictly below our
+        last-acked frame mean the peer genuinely lost input history (its
+        process died and it resumed from the durable journal) — rebase the
+        send window there so our deltas decode again.  Plain reordering
+        can't trip this: interleaved current acks reset the counter."""
+        la = self._core.last_acked_frame()
+        if la != NULL_FRAME and ack_frame < la:
+            if ack_frame == self._regress_ack:
+                self._regress_count += 1
+                if self._regress_count >= REWIND_ACK_THRESHOLD:
+                    self._regress_count = 0
+                    if self._rewind_send_window(ack_frame):
+                        _OBS_REWINDS.inc()
+                    else:
+                        _OBS_REWIND_MISSES.inc()
+            else:
+                self._regress_ack = ack_frame
+                self._regress_count = 1
+            return  # regressive: the core's ack() would be a no-op
+        self._regress_ack = None
+        self._regress_count = 0
+        self._core.ack(ack_frame)
+
+    def _rewind_send_window(self, ack_frame: Frame) -> bool:
+        """Rebase the send window to ``ack_frame`` from the sent ring:
+        clear pending, reseed the delta base, re-push every later frame.
+        False when the ring no longer reaches back that far (or the native
+        core predates the seam) — the caller counts the miss and the match
+        degrades exactly as before the seam existed."""
+        tip = self._sent_tip
+        if tip == NULL_FRAME:
+            return False
+        first = 0 if ack_frame == NULL_FRAME else ack_frame + 1
+        if first > tip + 1:
+            return False  # peer claims MORE than we ever sent: not ours
+        base = (
+            self._default_send_base if ack_frame == NULL_FRAME
+            else self._sent_ring.get(ack_frame)
+        )
+        if base is None:
+            return False
+        repush = []
+        for f in range(first, tip + 1):
+            p = self._sent_ring.get(f)
+            if p is None:
+                return False
+            repush.append((f, p))
+        if not self._core.rewind_send(ack_frame, base):
+            return False
+        for f, p in repush:
+            self._core.push_input(f, p)
+        return True
+
+    def _nack_current(self) -> None:
+        """An input packet arrived that cannot delta-decode against our
+        ring (we resumed from the journal and hold less than we once
+        acked): re-ack the true receive watermark, rate-limited, so the
+        peer's regressive-ack detector rewinds its send base to us."""
+        if not self._nack_on_drop:
+            return  # fresh endpoint: silent drop is the pinned semantic
+        now = self._clock()
+        if now - self._last_nack_time < NACK_INTERVAL_MS:
+            return
+        self._last_nack_time = now
+        self._queue_raw(encode_input_ack(self.magic, self._last_recv_frame))
+
     def handle_message(self, msg: Message) -> None:
         if self._state == _State.SHUTDOWN:
             return
@@ -534,7 +659,7 @@ class PeerProtocol(Generic[I, A]):
         elif isinstance(body, InputMessage):
             self._on_input(body)
         elif isinstance(body, InputAck):
-            self._core.ack(body.ack_frame)
+            self._handle_ack(body.ack_frame)
         elif isinstance(body, QualityReport):
             self.remote_frame_advantage = body.frame_advantage
             self._queue_message(QualityReply(pong=body.ping))
@@ -576,7 +701,7 @@ class PeerProtocol(Generic[I, A]):
             self._send_sync_request()  # next round trip immediately
 
     def _on_input(self, body: InputMessage) -> None:
-        self._core.ack(body.ack_frame)
+        self._handle_ack(body.ack_frame)
 
         if body.disconnect_requested:
             if self._state != _State.DISCONNECTED and not self._disconnect_event_sent:
@@ -600,6 +725,7 @@ class PeerProtocol(Generic[I, A]):
         # the gap, protocol.rs:588-590; we drop instead of crashing).
         staged = self._core.on_input(body.start_frame, body.bytes)
         if staged is None:
+            self._nack_current()
             return
         self._finish_input(staged)
 
@@ -666,7 +792,7 @@ class PeerProtocol(Generic[I, A]):
         ack = parse_input_ack_frame(data)  # the other hot tag
         if ack is not None:
             self._mark_alive()
-            self._core.ack(ack)
+            self._handle_ack(ack)
             return
         fused = self._fused_recv
         if fused is None or len(data) < 3 or data[2] != 0:  # 0 = input tag
@@ -701,6 +827,10 @@ class PeerProtocol(Generic[I, A]):
                     ours.last_frame = last_frame
         if staged is not None:
             self._finish_input(staged)
+        else:
+            # EP_DROP: an input packet whose base our ring lacks (or a
+            # gap) — tell the peer where our ring actually ends
+            self._nack_current()
 
     # ------------------------------------------------------------------
     # adoption (fallback eviction)
@@ -730,14 +860,18 @@ class PeerProtocol(Generic[I, A]):
         RTT, and the time-sync windows — liveness restarts from ``now`` and
         the advantage estimate re-converges within one FRAME_WINDOW."""
         self.magic = magic
+        self._nack_on_drop = True
         for ours, (disc, lf) in zip(self.peer_connect_status, peer_connect_status):
             ours.disconnected = bool(disc)
             ours.last_frame = lf
         self._core.seed_recv(last_recv_frame, recv_entries)
         self._last_recv_frame = last_recv_frame
         self._core.seed_send(last_acked_frame, send_base)
+        if last_acked_frame != NULL_FRAME:
+            self._remember_sent(last_acked_frame, send_base)
         for frame, payload in pending:
             self._core.push_input(frame, payload)
+            self._remember_sent(frame, payload)
         if pending_checksums:
             self.pending_checksums = dict(pending_checksums)
         if running:

@@ -118,12 +118,14 @@ class MetricsServer:
     against concurrent increments (plain attribute reads), so no
     coordination with the driving thread is needed.
 
-    ``health``: optional callable returning the driving loop's last-tick
-    timestamp on the ``time.monotonic()`` clock (or None before the first
-    tick).  ``/healthz`` reports 200 with the age while it stays under
-    ``stale_after`` seconds, 503 once the loop has gone quiet — the
-    pageable "pool wedged" signal.  ``tracer``: optional
-    :class:`~ggrs_tpu.obs.trace.Tracer` served on ``/trace``.
+    ``health``: optional callable returning either the driving loop's
+    last-tick timestamp on the ``time.monotonic()`` clock (or None before
+    the first tick), or an aggregate health DICT with an ``"ok"`` key
+    (e.g. ``ShardSupervisor.healthz`` — the fleet-wide ``/healthz``
+    aggregation, served verbatim).  ``/healthz`` reports 200 while
+    healthy (timestamp age under ``stale_after`` seconds / ``ok`` true),
+    503 otherwise — the pageable "pool wedged" signal.  ``tracer``:
+    optional :class:`~ggrs_tpu.obs.trace.Tracer` served on ``/trace``.
     """
 
     def __init__(self, registry: Registry, port: int = 0,
@@ -132,11 +134,27 @@ class MetricsServer:
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         def healthz_body() -> tuple:
+            last = health() if health is not None else None
+            if isinstance(last, dict):
+                # an aggregate health report (e.g.
+                # ``ShardSupervisor.healthz``: fleet-wide verdict +
+                # per-shard records): its "ok" decides the status code,
+                # AND the server's stale_after still applies to the
+                # report's last_tick_age_s — a wedged serving loop that
+                # stops calling advance_all() must go 503 here exactly
+                # like the timestamp path (the pageable signal), because
+                # the aggregate's own ok is computed from state the dead
+                # loop can no longer update
+                age = last.get("last_tick_age_s")
+                ok = bool(last.get("ok")) and (
+                    age is None or age <= stale_after
+                )
+                return (200 if ok else 503), json.dumps(
+                    dict(last, ok=ok), default=str
+                ).encode()
             age = None
-            if health is not None:
-                last = health()
-                if last is not None:
-                    age = max(0.0, time.monotonic() - last)
+            if last is not None:
+                age = max(0.0, time.monotonic() - last)
             ok = age is None or age <= stale_after
             body = json.dumps({
                 "ok": ok,

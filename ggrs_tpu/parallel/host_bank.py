@@ -79,6 +79,7 @@ from __future__ import annotations
 
 import ctypes
 import os
+import pickle
 import random
 import socket as _pysocket
 import struct
@@ -111,7 +112,6 @@ from ..core.types import (
 )
 from ..net import _native
 from ..net.messages import RawMessage
-from ..net.wire import encode_uvarint
 from ..net.protocol import (
     MAX_CHECKSUM_HISTORY_SIZE,
     UDP_HEADER_SIZE,
@@ -163,11 +163,56 @@ SLOT_NATIVE = "native"          # stepped by the bank (or the py fallback)
 SLOT_QUARANTINED = "quarantined"  # faulted; eviction pending/backing off
 SLOT_EVICTED = "evicted"        # resumed on a per-session Python P2PSession
 SLOT_DEAD = "dead"              # unrecoverable; request lists stay empty
+SLOT_MIGRATED = "migrated"      # exported to another pool (fleet layer);
+#                                 behaves like dead here — the match lives on
 
-# eviction retry policy: attempt n+1 waits n * backoff ticks; after the
-# bounded attempts the slot is marked dead
+# eviction retry policy: attempt n+1 waits n * backoff ticks PLUS a
+# deterministic per-slot jitter draw; after the bounded attempts the slot
+# is marked dead.  The jitter decorrelates a shard-wide failure (N slots
+# quarantined on the same tick) so the retries do not all land on the same
+# tick cadence, and EVICT_MAX_PER_TICK clamps how many eviction attempts
+# one supervision pass may run — the rest stay quarantined and retry next
+# tick (a retry storm must never turn one bad tick into a stalled pool).
 EVICT_MAX_ATTEMPTS = 3
 EVICT_BACKOFF_TICKS = 8
+EVICT_MAX_PER_TICK = 4
+
+
+def _evict_jitter(index: int, attempt: int) -> int:
+    """Deterministic backoff jitter in ``[0, EVICT_BACKOFF_TICKS)``: a
+    stateless hash of (slot, attempt) so identical runs stay bit-identical
+    (the control/chaos comparison contract) while co-quarantined slots
+    draw different delays."""
+    h = ((index + 1) * 2654435761 + attempt * 40503) & 0xFFFFFFFF
+    h ^= h >> 16
+    return h % EVICT_BACKOFF_TICKS
+
+
+def _select_resume_frame(h: Dict[str, Any], saved_states):
+    """Resume from the newest frame whose save the game actually
+    fulfilled.  Normally that is the confirmed watermark, but a fault
+    tick can raise the watermark and then have its own save op
+    suppressed (native fault after set_last_confirmed, or a send
+    failure dropping the parsed requests) — then the watermark-1 cell
+    is the newest committed state, and the harvest keeps that frame's
+    inputs precisely for this case.  Frames at or below the watermark
+    can never hold misprediction state (the watermark cannot pass the
+    first incorrect frame), so either cell is sound to resume from.
+    Shared by eviction (``_evict``) and the fleet export seam
+    (``export_resume_state``); returns ``(frame, cell)``."""
+    for r in (h["last_confirmed"], h["last_confirmed"] - 1):
+        if r < 0:
+            continue
+        c = saved_states.get_cell(r)
+        if c.frame != r:
+            continue
+        if any(blobs and start > r for start, blobs in h["player_inputs"]):
+            continue  # harvested inputs do not reach back to r
+        return r, c
+    raise RuntimeError(
+        f"no committed resumable frame at or below "
+        f"{h['last_confirmed']} (unfulfilled saves?)"
+    )
 
 
 class SlotFault:
@@ -846,6 +891,12 @@ class HostSessionPool:
             # registry counters before the source disappears
             self._apply_io_metrics([dict(index=index, io=io)])
             self._lib.ggrs_net_free(handle)
+        # drop the slot's delta-tracking keys: a later attach on this fd
+        # (e.g. the match re-admitted on a destination pool) starts its
+        # NetBatch counters at zero, and stale high-water marks here would
+        # silently swallow its deltas — the classic re-attach leak
+        for k in [k for k in self._io_prev if k[0] == index]:
+            del self._io_prev[k]
         if not any(self._io_attached):
             # last attached slot gone: drop back to the plain tick entry
             # (the pump's pre-drain scan would walk the cmd for nothing)
@@ -868,8 +919,8 @@ class HostSessionPool:
         if not self._finalized:
             self._finalize()
         state = self._slot_state[index]
-        if state == SLOT_DEAD:
-            return  # dead slots accept and drop (the match is over)
+        if state in (SLOT_DEAD, SLOT_MIGRATED):
+            return  # dead/migrated slots accept and drop (nothing here ticks)
         if not self._native_active:
             self._sessions[index].add_local_input(handle, value)
             return
@@ -1357,7 +1408,7 @@ class HostSessionPool:
         # once for all of them, losslessly: nothing has advanced yet.
         synchronizing = False
         for i, s in enumerate(self._sessions):
-            if self._slot_state[i] == SLOT_DEAD:
+            if self._slot_state[i] in (SLOT_DEAD, SLOT_MIGRATED):
                 continue
             if s.current_state() is SessionState.SYNCHRONIZING:
                 s.poll_remote_clients()
@@ -1367,11 +1418,11 @@ class HostSessionPool:
         if synchronizing:
             raise NotSynchronized()
         for i, s in enumerate(self._sessions):
-            if self._slot_state[i] != SLOT_DEAD:
+            if self._slot_state[i] not in (SLOT_DEAD, SLOT_MIGRATED):
                 s.validate_local_inputs()
         out: List[List[GgrsRequest]] = []
         for i, s in enumerate(self._sessions):
-            if self._slot_state[i] == SLOT_DEAD:
+            if self._slot_state[i] in (SLOT_DEAD, SLOT_MIGRATED):
                 out.append([])
                 continue
             try:
@@ -1409,6 +1460,7 @@ class HostSessionPool:
         """Post-tick supervision pass: retire dead matches, drive pending
         evictions, and tick evicted sessions — filling their slots of
         ``request_lists`` in place."""
+        evictions_this_tick = 0
         for i, state in enumerate(self._slot_state):
             if state == SLOT_NATIVE:
                 if self.retire_dead_matches:
@@ -1418,7 +1470,15 @@ class HostSessionPool:
                     ))
                 continue
             if state == SLOT_QUARANTINED:
-                self._try_evict(i)
+                # retry-storm clamp: a shard-wide failure quarantines many
+                # slots on one tick; at most EVICT_MAX_PER_TICK eviction
+                # attempts (each a harvest crossing + session build) run
+                # per supervision pass — the rest stay quarantined and are
+                # picked up on following ticks, keeping the tick budget
+                # bounded while the jittered backoff spreads the retries
+                if evictions_this_tick < EVICT_MAX_PER_TICK:
+                    if self._try_evict(i):
+                        evictions_this_tick += 1
                 state = self._slot_state[i]
             if state != SLOT_EVICTED:
                 continue
@@ -1542,13 +1602,17 @@ class HostSessionPool:
         lives on Python sessions — see ``P2PSession.desync_reports``.)"""
         return self._desync_reports.get(index)
 
-    def _try_evict(self, index: int) -> None:
+    def _try_evict(self, index: int) -> bool:
+        """One eviction attempt for a quarantined slot.  Returns True when
+        an attempt actually ran (success or failure) so the caller's
+        per-tick clamp counts real work, not backoff skips."""
         if self._tick_no < self._evict_next_try.get(index, 0):
-            return  # backing off
+            return False  # backing off
         attempt = self._evict_attempts.get(index, 0) + 1
         self._evict_attempts[index] = attempt
         self._evict_next_try[index] = (
             self._tick_no + EVICT_BACKOFF_TICKS * attempt
+            + _evict_jitter(index, attempt)
         )
         rec = self._recorders[index] if self._recorders else None
         try:
@@ -1570,7 +1634,7 @@ class HostSessionPool:
                         "flight recorder (last 32 events):\n%s",
                         index, attempt, rec.dump(32),
                     )
-            return
+            return True
         self._evicted[index] = session
         self._pending_load[index] = load_req
         self._set_slot_state(index, SLOT_EVICTED)
@@ -1591,6 +1655,7 @@ class HostSessionPool:
                 "recorder (last 32 events):\n%s",
                 index, self._tick_no, load_req.frame, rec.dump(32),
             )
+        return True
 
     def _evict(self, index: int):
         """Build a fresh ``P2PSession`` resuming from the slot's last
@@ -1616,34 +1681,7 @@ class HostSessionPool:
                 "harvest unavailable; resuming from journal tail "
                 f"(frame {h['last_confirmed']})",
             ))
-        # Resume from the newest frame whose save the game actually
-        # fulfilled.  Normally that is the confirmed watermark, but a fault
-        # tick can raise the watermark and then have its own save op
-        # suppressed (native fault after set_last_confirmed, or a send
-        # failure dropping the parsed requests) — then the watermark-1 cell
-        # is the newest committed state, and the harvest keeps that frame's
-        # inputs precisely for this case.  Frames at or below the watermark
-        # can never hold misprediction state (the watermark cannot pass the
-        # first incorrect frame), so either cell is sound to resume from.
-        resume = None
-        cell = None
-        for r in (h["last_confirmed"], h["last_confirmed"] - 1):
-            if r < 0:
-                continue
-            c = m.saved_states.get_cell(r)
-            if c.frame != r:
-                continue
-            if any(
-                blobs and start > r for start, blobs in h["player_inputs"]
-            ):
-                continue  # harvested inputs do not reach back to r
-            resume, cell = r, c
-            break
-        if resume is None:
-            raise RuntimeError(
-                f"no committed resumable frame at or below "
-                f"{h['last_confirmed']} (unfulfilled saves?)"
-            )
+        resume, cell = _select_resume_frame(h, m.saved_states)
         session = builder.start_p2p_session(socket)
         endpoint_states = {}
         for e, ep in enumerate(m.endpoints):
@@ -1815,36 +1853,125 @@ class HostSessionPool:
         hub-attached viewers get fresh ``PeerProtocol``s through
         ``P2PSession.adopt_spectator_endpoint``.  Each resumes its harvested
         send window (ack base + unacked pending), so the viewer sees a
-        retransmission hiccup, not a reset stream."""
-        players = m.num_players
-        default_blob = m.config.input_encode(m.config.input_default())
-        default_base = b"".join(
-            encode_uvarint(len(default_blob)) + default_blob
-            for _ in range(players)
-        )
+        retransmission hiccup, not a reset stream.  The grafting itself is
+        shared with the fleet's migration/failover adoption
+        (``broadcast.hub.graft_spectator_endpoints``)."""
+        from ..broadcast.hub import graft_spectator_endpoints
+
         spec_states = h.get("spectators") or []
-        for e, sp in enumerate(m.spectators):
-            hs = spec_states[e] if e < len(spec_states) else None
-            ep = session._player_reg.spectators.get(sp.addr)
-            if ep is None:
-                ep = builder._create_endpoint(
-                    list(sp.handles), sp.addr, builder._num_players
-                )
-                session.adopt_spectator_endpoint(sp.addr, ep)
-            base = hs["send_base"] if hs and hs["send_base"] else default_base
-            ep.adopt_endpoint_state(
-                magic=sp.magic,
-                running=(hs["state"] == 0) if hs else sp.running,
-                peer_connect_status=[(False, NULL_FRAME)] * players,
-                last_recv_frame=NULL_FRAME,
-                recv_entries=(),
-                last_acked_frame=(
-                    hs["last_acked_frame"] if hs else NULL_FRAME
-                ),
-                send_base=base,
-                pending=hs["pending"] if hs else (),
+        graft_spectator_endpoints(session, builder, [
+            dict(
+                addr=sp.addr, magic=sp.magic, handles=list(sp.handles),
+                running=sp.running,
+                state=spec_states[e] if e < len(spec_states) else None,
             )
+            for e, sp in enumerate(m.spectators)
+        ])
+        for sp in m.spectators:
             sp.deferred = []
+
+    # ------------------------------------------------------------------
+    # fleet seam (ggrs_tpu/fleet): live migration export + slot release
+    # ------------------------------------------------------------------
+
+    def export_resume_state(self, index: int) -> Dict[str, Any]:
+        """Process-portable resume bundle for one bank-resident slot — the
+        source half of live match migration (DESIGN.md §16).  The bundle
+        carries everything ``adopt_resume_bundle`` needs to resume the
+        match on ANOTHER pool, possibly in another process: the harvested
+        native state (falling back to the registered journal recovery when
+        the harvest is dead), the resume frame's fulfilled game state
+        (pickled), the endpoint/spectator wire identities (magics, connect
+        mirrors, pending checksums), and this tick's staged inputs.  Plain
+        data only — it must survive a serialize→deserialize round trip
+        (pinned by tests/test_fleet.py).  Read-only and retry-safe; pair
+        with :meth:`release_slot` once the bundle is adopted elsewhere."""
+        if not self._finalized:
+            self._finalize()
+        if not self._native_active:
+            raise InvalidRequest(
+                "export_resume_state requires the native bank"
+            )
+        state = self._slot_state[index]
+        if state not in (SLOT_NATIVE, SLOT_QUARANTINED):
+            raise InvalidRequest(
+                f"slot {index} is {state}: only bank-resident slots can "
+                "export a resume bundle"
+            )
+        m = self._mirrors[index]
+        try:
+            h = self._harvest(index)
+        except Exception:
+            # same crash-recovery ladder as eviction: the journal tail
+            # stands in when the native resumable state is gone
+            recover = self._journal_recovery.get(index)
+            if recover is None:
+                raise
+            h = recover()
+        resume, cell = _select_resume_frame(h, m.saved_states)
+        return dict(
+            version=1,
+            num_players=m.num_players,
+            input_size=m.input_size,
+            max_prediction=m.max_prediction,
+            local_handles=list(m.local_handles),
+            resume_frame=resume,
+            state_blob=pickle.dumps((cell.data(), cell.checksum)),
+            harvest=h,
+            next_recommended_sleep=m.next_recommended_sleep,
+            pending_events=list(m.event_queue),
+            endpoints=[
+                dict(
+                    addr=ep.addr, handles=list(ep.handles), magic=ep.magic,
+                    running=ep.running, peer_disc=list(ep.peer_disc),
+                    peer_last=list(ep.peer_last),
+                    pending_checksums=dict(ep.pending_checksums),
+                )
+                for ep in m.endpoints
+            ],
+            spectators=[
+                dict(addr=sp.addr, magic=sp.magic, handles=list(sp.handles),
+                     running=sp.running)
+                for sp in m.spectators
+            ],
+            staged_inputs={
+                handle: bytes(blob)
+                for handle, blob in m.staged_inputs.items()
+            },
+        )
+
+    def release_slot(self, index: int, detail: str = "migrated") -> None:
+        """Retire a slot whose match now lives on another pool (the commit
+        point of live migration): the bank stops stepping it, its native
+        I/O detaches cleanly (NetBatch freed, delta keys purged — the
+        ``_detach_io`` leak check), its journal tap and staged state drop,
+        and the slot lands in the MIGRATED state — request lists and
+        events go empty, like dead, but the state records that the match
+        itself lives on elsewhere."""
+        if not self._finalized:
+            self._finalize()
+        state = self._slot_state[index]
+        if state in (SLOT_DEAD, SLOT_MIGRATED):
+            return
+        if state == SLOT_EVICTED:
+            self._evicted.pop(index, None)
+            self._pending_load.pop(index, None)
+        if self._native_active and index < len(self._mirrors):
+            m = self._mirrors[index]
+            m.staged_inputs.clear()
+            m.event_queue.clear()
+            m.pending_ctrl = []
+            for sp in m.spectators:
+                sp.deferred = []
+        self._inject_dgrams.pop(index, None)
+        self._inject_err.pop(index, None)
+        if index in self._journal_sinks:
+            # the destination journals through its own tap from here on
+            self.set_confirmed_stream(index, None)
+        self._fault_log[index].append(
+            SlotFault(self._tick_no, 0, f"released: {detail}")
+        )
+        self._set_slot_state(index, SLOT_MIGRATED)
 
     # ------------------------------------------------------------------
     # broadcast seams (driven by ggrs_tpu.broadcast.SpectatorHub)
@@ -2612,7 +2739,7 @@ class HostSessionPool:
             return self._sessions[index].network_stats(handle)
         if index in self._evicted:
             return self._evicted[index].network_stats(handle)
-        if self._slot_state[index] == SLOT_DEAD:
+        if self._slot_state[index] in (SLOT_DEAD, SLOT_MIGRATED):
             raise StatsUnavailable()
         m = self._mirrors[index]
         ep_idx = next(
@@ -2764,3 +2891,82 @@ class HostSessionPool:
                         self._lib.ggrs_net_free(handle)
         except Exception:
             pass
+
+
+def adopt_resume_bundle(builder, socket, bundle: Dict[str, Any], *,
+                        journal=None, saved_states=None):
+    """Resume a migrated/failed-over match on THIS side of the wire: build
+    a fresh ``P2PSession`` from an ``export_resume_state`` bundle (or the
+    journal-synthesized equivalent the fleet failover path builds) — the
+    destination half of live match migration (DESIGN.md §16).
+
+    ``builder`` must describe the SAME match topology as the source slot
+    (player count, config, remote/spectator addresses); the adopted wire
+    identities (endpoint magics, send/recv windows, connect mirrors) make
+    the peers and viewers see a retransmission hiccup, never a new
+    endpoint.  ``journal``, when given, is tapped so the resumed session
+    keeps journaling its confirmed stream (``JournalTap``).
+
+    Returns ``(session, load_request)``: the caller must lead the
+    session's next request list with ``load_request`` so the game restores
+    the state saved at the resume frame (the bundle carries that state;
+    its cell is pre-filled).
+
+    ``saved_states``: a pre-built ``SavedStates`` ring for callers that
+    rebuild the resume state some other way (crash failover loads a
+    journal checkpoint and fast-forwards through a request prelude); when
+    given, the bundle's ``state_blob`` is ignored and the caller owns
+    filling the resume cell."""
+    h = bundle["harvest"]
+    resume = bundle["resume_frame"]
+    if saved_states is None:
+        saved = SavedStates(bundle["max_prediction"])
+        data, checksum = pickle.loads(bundle["state_blob"])
+        saved.get_cell(resume).save(resume, data, checksum)
+    else:
+        saved = saved_states
+    cell = saved.get_cell(resume)
+    session = builder.start_p2p_session(socket)
+    endpoint_states: Dict[Any, Dict[str, Any]] = {}
+    for e, em in enumerate(bundle["endpoints"]):
+        he = h["endpoints"][e]
+        endpoint_states[em["addr"]] = dict(
+            magic=em["magic"],
+            running=he["state"] == 0,
+            peer_connect_status=list(zip(em["peer_disc"], em["peer_last"])),
+            last_recv_frame=he["last_recv"],
+            recv_entries=he["recv_entries"],
+            last_acked_frame=he["last_acked_frame"],
+            send_base=he["send_base"],
+            pending=he["pending"],
+            pending_checksums=em.get("pending_checksums") or {},
+        )
+    session.adopt_resume_state(
+        frame=resume,
+        last_confirmed=resume,
+        saved_states=saved,
+        connect_status=list(zip(h["local_disc"], h["local_last"])),
+        player_inputs=h["player_inputs"],
+        endpoint_states=endpoint_states,
+        next_recommended_sleep=bundle.get("next_recommended_sleep", 0),
+        pending_events=list(bundle.get("pending_events", ())),
+        next_spectator_frame=h.get("next_spectator_frame", 0),
+    )
+    if bundle.get("spectators"):
+        from ..broadcast.hub import graft_spectator_endpoints
+
+        spec_states = h.get("spectators") or []
+        graft_spectator_endpoints(session, builder, [
+            dict(sp, state=spec_states[e] if e < len(spec_states) else None)
+            for e, sp in enumerate(bundle["spectators"])
+        ])
+    if journal is not None:
+        from ..broadcast.journal import JournalTap
+
+        session.adopt_spectator_endpoint(
+            JournalTap.ADDR, JournalTap(journal, builder._config)
+        )
+    decode = builder._config.input_decode
+    for handle, blob in (bundle.get("staged_inputs") or {}).items():
+        session.add_local_input(int(handle), decode(blob))
+    return session, LoadGameState(cell=cell, frame=resume)

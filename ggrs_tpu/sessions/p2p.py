@@ -565,6 +565,10 @@ class P2PSession(ThreadOwned, Generic[I, S, A]):
         # slot's fan-out stopped — restarting at 0 would assert on inputs
         # the watermark already discarded
         self._next_spectator_frame = next_spectator_frame
+        # desync-detection continuity: checksum reporting resumes from the
+        # adopted frame — the default cursor (NULL_FRAME → send at
+        # `interval`) would assert on cells the resumed ring never held
+        self._last_sent_checksum_frame = frame
 
     def adopt_spectator_endpoint(self, addr: A, endpoint) -> None:
         """Graft a spectator endpoint onto a LIVE session — the broadcast

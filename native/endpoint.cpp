@@ -636,6 +636,20 @@ void ggrs_ep_seed_send(void* ptr, int64_t last_acked_frame,
   ep->last_acked.assign(base, base + len);
 }
 
+// Rewind the send window to an earlier delta base (the fleet failover
+// seam): a peer that resumed from its durable journal may genuinely hold
+// LESS than it once acked, and its repeated regressive acks ask us to
+// rebase.  Drops the whole pending window (the caller re-pushes the
+// frames after `frame` from its sent-payload ring) and reseeds the base,
+// exactly like seed_send on a fresh endpoint.
+void ggrs_ep_rewind_send(void* ptr, int64_t frame, const uint8_t* base,
+                         size_t len) {
+  Endpoint* ep = static_cast<Endpoint*>(ptr);
+  ep->pending.clear();
+  ep->last_acked_frame = frame;
+  ep->last_acked.assign(base, base + len);
+}
+
 // ---- observability (the obs stat harvest) --------------------------------
 
 int64_t ggrs_ep_last_acked_frame(void* ptr) {

@@ -37,6 +37,16 @@ Fault classes (all driven through the pool's real tick path):
                 slot, a fatal EPERM must fault exactly that slot
                 (BANK_ERR_IO) and evict it onto the Python socket path —
                 survivors' wire bytes bit-identical to control either way
+  shard         fleet leg (DESIGN.md §16): a two-shard ShardSupervisor
+                (B = --fleet-matches journaled matches per shard, default
+                32) runs three scenarios — kill-a-shard (every affected
+                match journal-recovers onto the survivor within bounded
+                lag; the surviving shard's matches bit-identical to a
+                fault-free control), drain-under-load (admission closes,
+                every match migrates off, the shard retires), and
+                migrate-under-loss (a live migration under seeded
+                loss/dup/reorder keeps the peer connected and
+                desync-free, spectators resume from their ack window)
   all           every class, sequentially
 
 Usage:
@@ -479,6 +489,166 @@ def verify_socket_leg(matches: int, ticks: int, seed: int,
     return True
 
 
+def verify_fleet_leg(matches_per_shard: int, ticks: int, seed: int,
+                     artifact_dir=None) -> bool:
+    """The fleet scenarios (DESIGN.md §16), over ``drive_fleet_chaos`` —
+    the SAME driver tests/test_fleet.py pins.  Three sub-scenarios, each a
+    control/chaos pair with its own JSON verdict:
+
+    - ``shard_kill``: one of two shards dies mid-tick; every affected
+      match must journal-recover onto the survivor within bounded lag,
+      with the surviving shard's matches bit-identical to control.
+    - ``shard_drain``: graceful drain under load; every match migrates
+      off a bounded few per tick and the shard retires.
+    - ``shard_migrate``: a live migration under seeded loss/dup/reorder;
+      the migrated match's peer stays connected and desync-free, the
+      untouched matches stay bit-identical to their lossy control, and
+      the spectator resumes from its ack window (stream never resets).
+    """
+    from ggrs_tpu.chaos import (
+        drive_fleet_chaos,
+        fleet_recovery_violations,
+        fleet_survivor_violations,
+    )
+
+    p = matches_per_shard
+    ticks = max(96, min(ticks, 240))  # bounded: B is the scale knob here
+    survivors = [f"m{k}" for k in range(p)]           # pinned to s0
+    affected = [f"m{k}" for k in range(p, 2 * p)]     # pinned to s1
+    ok = True
+
+    def fleet_digest(ctx) -> dict:
+        reg = ctx["registry"]
+        return {
+            "locations": ctx["locations"],
+            "lost": ctx["lost"],
+            "healthz": {
+                k: v for k, v in ctx["healthz"].items() if k != "shards"
+            },
+            "migrations": {
+                labels["reason"]: int(child.value)
+                for f in reg.families()
+                if f.name == "ggrs_fleet_migrations_total"
+                for labels, child in f.samples()
+            },
+            "failovers": int(
+                reg.value("ggrs_fleet_failovers_total") or 0
+            ),
+        }
+
+    def report(name: str, violations, ctx, extra=None) -> bool:
+        digest = fleet_digest(ctx)
+        print(f"  [{name}] locations: "
+              f"{sum(1 for s in ctx['locations'].values() if s == 's0')} "
+              f"on s0, lost={len(ctx['lost'])}, "
+              f"migrations={digest['migrations']}")
+        _write_artifact(artifact_dir, name, {
+            "scenario": name,
+            "verdict": "PASS" if not violations else "FAIL",
+            "violations": violations,
+            "matches_per_shard": p,
+            "ticks": ticks,
+            **digest,
+            **(extra or {}),
+            "metrics": json_snapshot(ctx["registry"]),
+        })
+        if violations:
+            print(f"  {name.upper()} VIOLATED:")
+            for v in violations:
+                print(f"    {v}")
+            return False
+        return True
+
+    print("--- shard ---")
+    print(f"  two shards x {p} journaled matches, {ticks} ticks")
+    control = drive_fleet_chaos(ticks, matches_per_shard=p, seed=seed)
+
+    # 1. kill-a-shard: crash failover from the durable journals alone
+    def kill(i, ctx):
+        if i == ticks // 2:
+            ctx["sup"].kill("s1")
+
+    chaos = drive_fleet_chaos(
+        ticks, matches_per_shard=p, seed=seed, inject=kill
+    )
+    violations = fleet_survivor_violations(chaos, control, survivors)
+    violations += fleet_recovery_violations(
+        chaos, affected, dead_shards=["s1"]
+    )
+    recovered = sum(
+        1 for m in affected if chaos["locations"][m] not in (None, "s1")
+    )
+    lag = max(
+        (chaos["peer_frames"][m] - (chaos["frames"][m] or 0)
+         for m in affected), default=0,
+    )
+    print(f"  [shard_kill] s1 killed @tick {ticks // 2}: {recovered}/{p} "
+          f"matches journal-recovered onto s0, max lag {lag} frames")
+    ok &= report("shard_kill", violations, chaos,
+                 extra={"recovered": recovered, "max_lag_frames": lag})
+
+    # 2. drain-under-load: admission off, migrate all, retire
+    def drain(i, ctx):
+        if i == ticks // 3:
+            ctx["sup"].drain("s1")
+
+    chaos = drive_fleet_chaos(
+        ticks, matches_per_shard=p, seed=seed, inject=drain
+    )
+    violations = fleet_survivor_violations(chaos, control, survivors)
+    violations += fleet_recovery_violations(chaos, affected)
+    state = chaos["sup"].shards["s1"].state
+    if state != "retired":
+        violations.append(f"drained shard is {state}, not retired")
+    print(f"  [shard_drain] s1 drained @tick {ticks // 3}: shard {state}, "
+          f"{sum(1 for m in affected if chaos['locations'][m] == 's0')}/{p} "
+          "matches migrated to s0")
+    ok &= report("shard_drain", violations, chaos,
+                 extra={"drained_shard_state": state})
+
+    # 3. migrate-under-loss: live migration on a lossy wire + spectators
+    lossy = dict(latency_ticks=1, loss=0.05, duplicate=0.02, reorder=0.05)
+    lossy_control = drive_fleet_chaos(
+        ticks, matches_per_shard=p, seed=seed, fault_cfg=dict(lossy),
+        n_spectators=2,
+    )
+
+    def migrate(i, ctx):
+        if i == ticks // 3:
+            ctx["sup"].migrate("m0")
+
+    chaos = drive_fleet_chaos(
+        ticks, matches_per_shard=p, seed=seed, inject=migrate,
+        fault_cfg=dict(lossy), n_spectators=2,
+    )
+    untouched = [m for m in chaos["match_ids"] if m != "m0"]
+    violations = fleet_survivor_violations(chaos, lossy_control, untouched)
+    violations += fleet_recovery_violations(chaos, ["m0"])
+    if chaos["locations"]["m0"] == lossy_control["locations"]["m0"]:
+        violations.append("m0 never moved")
+    # spectator continuity: the stream resumes from the ack window — it
+    # never resets/regresses and advances well past the migration tick
+    viewer_tips = []
+    for v, stream in enumerate(chaos["viewer_streams"]):
+        frames = [f for f, _ in stream]
+        if frames != sorted(set(frames)):
+            violations.append(f"viewer {v} stream reset/regressed")
+        if not frames or frames[-1] < ticks // 3 + 8:
+            violations.append(
+                f"viewer {v} stalled at {frames[-1] if frames else None}"
+            )
+        viewer_tips.append(frames[-1] if frames else None)
+    print(f"  [shard_migrate] m0 -> {chaos['locations']['m0']} under "
+          f"loss/dup/reorder; viewers at {viewer_tips}")
+    ok &= report("shard_migrate", violations, chaos,
+                 extra={"migrated_to": chaos["locations"]["m0"],
+                        "viewer_tips": viewer_tips})
+    if ok:
+        print(f"  OK: {p}-per-shard fleet survived kill, drain, and "
+              "lossy migration")
+    return ok
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--matches", type=int, default=4,
@@ -486,15 +656,18 @@ def main() -> int:
     ap.add_argument("--ticks", type=int, default=300)
     ap.add_argument("--seed", type=int, default=3)
     ap.add_argument("--fault", choices=[*FAULTS, "spectator", "socket",
-                                        "all"],
+                                        "shard", "all"],
                     default="all")
+    ap.add_argument("--fleet-matches", type=int, default=32, metavar="B",
+                    help="matches per shard for --fault shard (default 32; "
+                         "the acceptance floor)")
     ap.add_argument("--artifact-dir", default=None, metavar="DIR",
                     help="write one machine-readable JSON artifact per "
                          "scenario (digest + verdict + DesyncReport paths)")
     args = ap.parse_args()
 
     names = (
-        [*FAULTS, "spectator", "socket"] if args.fault == "all"
+        [*FAULTS, "spectator", "socket", "shard"] if args.fault == "all"
         else [args.fault]
     )
     ok = True
@@ -507,6 +680,11 @@ def main() -> int:
         elif name == "socket":
             ok &= verify_socket_leg(
                 min(args.matches, 3), args.ticks, args.seed,
+                artifact_dir=args.artifact_dir,
+            )
+        elif name == "shard":
+            ok &= verify_fleet_leg(
+                args.fleet_matches, args.ticks, args.seed,
                 artifact_dir=args.artifact_dir,
             )
         else:
