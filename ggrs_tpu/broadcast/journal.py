@@ -114,6 +114,20 @@ class MatchJournal:
         self._since_fsync = 0
         self._local_dirty = False
         self._closed = False
+        # write-failure degradation (fleet satellite, DESIGN.md §17): the
+        # first OSError out of an append/flush/fsync (ENOSPC, EIO, a
+        # yanked volume) marks the journal FAILED — further records are
+        # dropped (writing past a torn record would corrupt the crc-chain
+        # prefix a reader can still recover), the failure is counted and
+        # logged loudly, and the owning shard must treat the match as
+        # journal-less for failover purposes: the durable tip now trails
+        # what the live match keeps acking, so resuming from this file
+        # after a crash would silently desync the peers.  The in-memory
+        # tail keeps updating — live eviction recovery needs no disk.
+        self.failed: Optional[str] = None
+        # test seam: callable(stage) with stage in {"write", "flush",
+        # "fsync"}; raise OSError to inject ENOSPC/EIO at that stage
+        self._inject_fault = None
         # tracing (DESIGN.md §14): fsync stalls show up as journal.fsync
         # spans on the pool timeline — the classic hidden tick-p99 spike
         from ..obs.trace import NULL_TRACER
@@ -136,6 +150,9 @@ class MatchJournal:
         self._m_fsync = m.histogram(
             "ggrs_journal_fsync_seconds", "journal fsync latency",
             buckets=_FSYNC_BUCKETS)
+        self._m_write_failures = m.counter(
+            "ggrs_journal_write_failures_total",
+            "journals degraded by an append/flush/fsync I/O error")
 
         header_meta = dict(meta or {})
         header_meta.setdefault("num_players", num_players)
@@ -158,14 +175,39 @@ class MatchJournal:
     # writing
     # ------------------------------------------------------------------
 
+    def _fail(self, reason: str) -> None:
+        """First write failure: degrade loudly, once.  The journal stays
+        open (the fd may still close cleanly) but appends no more."""
+        if self.failed is not None:
+            return
+        self.failed = reason
+        self._m_write_failures.inc()
+        from ..utils.tracing import get_logger
+
+        get_logger("journal").error(
+            "journal %s degraded (%s): further records dropped; crash "
+            "failover must treat this incarnation as journal-less",
+            self.path, reason,
+        )
+
     def _append(self, kind: int, frame: int, payload: bytes) -> None:
+        if self.failed is not None:
+            return
         head = struct.pack("<BIq", kind, len(payload), frame)
-        self._crc = zlib.crc32(
-            payload, zlib.crc32(head, self._crc)
-        ) & 0xFFFFFFFF
-        self._f.write(head)
-        self._f.write(struct.pack("<I", self._crc))
-        self._f.write(payload)
+        crc = zlib.crc32(payload, zlib.crc32(head, self._crc)) & 0xFFFFFFFF
+        try:
+            if self._inject_fault is not None:
+                self._inject_fault("write")
+            self._f.write(head)
+            self._f.write(struct.pack("<I", crc))
+            self._f.write(payload)
+        except OSError as e:
+            # the record may be TORN on disk (partial write); the crc
+            # chain makes readers recover exactly the intact prefix, and
+            # never appending again keeps that prefix stable
+            self._fail(f"append: {e}")
+            return
+        self._crc = crc
         self._m_bytes.inc(_HEADER_SIZE + len(payload))
 
     def append_frames(
@@ -184,10 +226,12 @@ class MatchJournal:
                 continue  # duplicate delivery: already journaled
             if frame > self.next_frame:
                 self._append(REC_GAP, frame, b"")
-                self._m_gaps.inc()
+                if self.failed is None:
+                    self._m_gaps.inc()
                 self.tail.clear()  # the tail window must stay contiguous
             self._append(REC_FRAME, frame, flags + blob)
-            self._m_frames.inc()
+            if self.failed is None:
+                self._m_frames.inc()
             self.tail.append((frame, flags, blob))
             for p in range(self.num_players):
                 if flags[p]:
@@ -208,10 +252,10 @@ class MatchJournal:
         tick that sends it — callers fsync via :meth:`flush_local` ahead
         of the send so a crashed incarnation's successor can re-send
         bit-identical values for every frame the peers might hold."""
-        if self._closed:
+        if self._closed or self.failed is not None:
             return
         self._append(REC_LOCAL, frame, struct.pack("<H", handle) + payload)
-        self._local_dirty = True
+        self._local_dirty = self.failed is None
 
     def flush_local(self) -> None:
         """Fsync pending LOCAL records (no-op when none were appended
@@ -227,20 +271,39 @@ class MatchJournal:
         state from which ``frame`` is the NEXT frame to advance — i.e. the
         state after applying frames ``0..frame-1``.  ``ReplaySession.seek``
         lands on the newest checkpoint at or below its target."""
-        if self._closed:
-            return
+        if self._closed or self.failed is not None:
+            return  # degraded: don't serialize, don't count
         from ..utils.checkpoint import dumps_pytree
 
         blob = dumps_pytree(state, dict(meta or {}, frame=frame))
         self._append(REC_CHECKPOINT, frame, blob)
-        self._m_checkpoints.inc()
+        if self.failed is None:
+            self._m_checkpoints.inc()
 
     def flush(self, fsync: bool = False) -> None:
-        self._f.flush()
+        if self.failed is not None:
+            return
+        try:
+            if self._inject_fault is not None:
+                self._inject_fault("flush")
+            self._f.flush()
+        except OSError as e:
+            self._fail(f"flush: {e}")
+            return
         if fsync:
             with self._tracer.span("journal.fsync", cat="io"):
                 t0 = time.perf_counter()
-                os.fsync(self._f.fileno())
+                try:
+                    if self._inject_fault is not None:
+                        self._inject_fault("fsync")
+                    os.fsync(self._f.fileno())
+                except OSError as e:
+                    # an fsync failure means UNKNOWN durability for every
+                    # record since the last good fsync — same degradation
+                    # as a torn append (fsync-gate semantics: a second
+                    # fsync cannot resurrect pages the kernel dropped)
+                    self._fail(f"fsync: {e}")
+                    return
                 self._m_fsync.observe(time.perf_counter() - t0)
             self._since_fsync = 0
 
@@ -249,7 +312,10 @@ class MatchJournal:
             return
         self._append(REC_CLOSE, self.next_frame, b"")
         self.flush(fsync=True)
-        self._f.close()
+        try:
+            self._f.close()
+        except OSError as e:
+            self._fail(f"close: {e}")
         self._closed = True
 
     def __enter__(self) -> "MatchJournal":

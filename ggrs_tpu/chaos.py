@@ -47,6 +47,30 @@ class RecordingSocket:
         return self.inner.receive_all_messages()
 
 
+class RecvRecordingSocket:
+    """Wraps a socket, recording every datagram's BYTES as received —
+    the observer for hosts whose sends happen in another process (the
+    proc-fleet legs compare what the peer actually decoded, port-free so
+    two legs with different ephemeral ports still compare equal)."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.received = []
+
+    def receive_all_datagrams(self):
+        out = self.inner.receive_all_datagrams()
+        self.received.extend(data for _, data in out)
+        return out
+
+    def receive_all_messages(self):
+        out = self.inner.receive_all_messages()
+        self.received.extend(msg.encode() for _, msg in out)
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self.inner, name)
+
+
 def two_peer_builder(clock, rng_seed, me, other_name, other_handle=None):
     """One side of a 2-peer uint16 match on a frozen list-clock."""
     return (
@@ -742,6 +766,188 @@ def drive_fleet_chaos(
         },
         peer_frames={mid: p.current_frame for mid, p in peers.items()},
         states={mid: games[mid].state for mid in match_ids},
+        peer_states={mid: g.state for mid, g in peer_games.items()},
+        healthz=sup.healthz(),
+        registry=registry,
+    )
+    return ctx
+
+
+def drive_proc_fleet(
+    ticks: int,
+    matches_per_shard: int = 4,
+    seed: int = 0,
+    backend: str = "proc",
+    inject: Optional[Callable[[int, Dict[str, Any]], Any]] = None,
+    tuning=None,
+    journal_dir=None,
+    checkpoint_every: int = 8,
+    desync_interval: int = 1,
+    capacity: int = 64,
+    tick_sleep_s: float = 0.0,
+    metrics: Optional[Registry] = None,
+) -> Dict[str, Any]:
+    """The out-of-process sibling of :func:`drive_fleet_chaos`
+    (DESIGN.md §17): a two-shard ``ShardSupervisor`` where ``s0`` is
+    always in-process and ``s1`` is a real subprocess when
+    ``backend="proc"`` (``"inproc"`` runs the IDENTICAL topology fully
+    in-process — the backend-parity comparison leg).  ``2 *
+    matches_per_shard`` journaled 2-peer matches over REAL loopback UDP,
+    ``m0..`` pinned to ``s0``, the rest to ``s1``; every match is
+    described by picklable factories (``fleet.proc.proc_match_builder``
+    + ``udp_socket_factory`` + :class:`CrcGame`) so it can serve on —
+    and fail over between — either backend.  External Python peers run
+    in THIS process either way; each peer's received datagram bytes are
+    recorded (:class:`RecvRecordingSocket`) as the port-free wire
+    observable two legs are compared on.
+
+    ``inject(i, ctx)`` runs at the top of tick ``i`` with ``ctx``
+    carrying ``sup``/``peers``/``clock``; proc scenarios typically
+    ``os.kill(ctx['sup'].shards['s1'].pid, SIGKILL/SIGSTOP)``.
+    ``tick_sleep_s`` stretches real time per tick so the (wall-clock)
+    watchdog deadlines can elapse while the logical clock stays small
+    enough that no peer hits its disconnect timeout.
+
+    The supervisor is returned live in ``ctx["sup"]`` — callers MUST
+    ``sup.close()`` (the tests/chaos script do it in ``finally``); on an
+    exception mid-run the driver closes it before re-raising.
+    """
+    import functools
+    import tempfile
+
+    from .core.errors import NotSynchronized, PredictionThreshold
+    from .fleet import ShardSupervisor
+    from .fleet.proc import (
+        proc_match_builder,
+        set_runner_clock,
+        udp_socket_factory,
+    )
+    from .net.sockets import UdpNonBlockingSocket
+
+    if backend not in ("proc", "inproc"):
+        raise ValueError(f"backend {backend!r}")
+    base = seed * 1000
+    clock = [0]
+    registry = metrics if metrics is not None else Registry()
+    if journal_dir is None:
+        journal_dir = tempfile.mkdtemp(prefix="ggrs_proc_fleet_")
+    sup = ShardSupervisor(
+        ("s0", "s1"), capacity=capacity, metrics=registry,
+        journal_dir=journal_dir, checkpoint_every=checkpoint_every,
+        journal_tail_window=8 * checkpoint_every,
+        identity_refresh_every=4, seed=base + 1,
+        proc_shards=("s1",) if backend == "proc" else (),
+        proc_clock=lambda: clock[0],
+        tuning=tuning,
+    )
+    n = 2 * matches_per_shard
+    match_ids = [f"m{k}" for k in range(n)]
+    peers: Dict[str, Any] = {}
+    peer_socks: Dict[str, RecvRecordingSocket] = {}
+    games: Dict[str, CrcGame] = {}
+    peer_games: Dict[str, CrcGame] = {}
+    import socket as _socket
+
+    def _free_udp_port() -> int:
+        s = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        return port
+
+    try:
+        for k, mid in enumerate(match_ids):
+            pin = "s0" if k < matches_per_shard else "s1"
+            peer_sock = RecvRecordingSocket(UdpNonBlockingSocket(0))
+            peer_socks[mid] = peer_sock
+            bf = functools.partial(
+                proc_match_builder, base + 3 + 7 * k, 0,
+                ("127.0.0.1", peer_sock.local_port()),
+                desync_interval=desync_interval,
+            )
+            # the match's wire address must be STABLE across
+            # incarnations — the peer only knows this port — so the
+            # socket_factory is the match's durable address (PR 7's
+            # contract).  Matches pinned to the subprocess shard ship a
+            # picklable rebind-the-port factory (the dying incarnation's
+            # process releases the port before the next one binds);
+            # matches served in THIS process reuse one long-lived socket
+            # object, exactly like the in-memory fleet topologies.
+            if backend == "proc" and pin == "s1":
+                host_port = _free_udp_port()
+                sf = functools.partial(udp_socket_factory, host_port)
+            else:
+                host_sock = UdpNonBlockingSocket(0)
+                host_port = host_sock.local_port()
+                sf = lambda s=host_sock: s  # noqa: E731
+            sup.admit(
+                mid, bf, sf,
+                state_template=0, game_factory=CrcGame, shard=pin,
+            )
+            assert sup.shards[pin].match_port(mid) == host_port
+            peers[mid] = two_peer_builder(
+                clock, base + 4 + 7 * k, 1, ("127.0.0.1", host_port),
+                other_handle=0,
+            ).with_desync_detection_mode(
+                DesyncDetection.on(desync_interval)
+            ).start_p2p_session(peer_sock)
+            games[mid] = CrcGame()
+            peer_games[mid] = CrcGame()
+
+        reqs_log: Dict[str, List] = {mid: [] for mid in match_ids}
+        host_events: Dict[str, List] = {mid: [] for mid in match_ids}
+        peer_events: Dict[str, List] = {mid: [] for mid in match_ids}
+
+        def sched(i, k):
+            return ((i + 2 * k) // (2 + k % 3)) % 16
+
+        ctx: Dict[str, Any] = dict(
+            sup=sup, peers=peers, clock=clock, seed=seed,
+            match_ids=match_ids, journal_dir=journal_dir,
+        )
+        import time as _time
+
+        for i in range(ticks):
+            clock[0] += 16
+            # drive the shared clock cell for every match this process
+            # serves (in-proc shards + failover adoptions); proc shards
+            # get the same value shipped with their tick RPC
+            set_runner_clock(clock[0])
+            if inject is not None:
+                inject(i, ctx)
+            for mid, peer in peers.items():
+                try:
+                    peer.add_local_input(1, (i * 5) % 16)
+                    peer_games[mid].fulfill(peer.advance_frame())
+                except (NotSynchronized, PredictionThreshold):
+                    pass  # host mid-failover: backpressure, not a fault
+                peer_events[mid].extend(peer.events())
+            for k, mid in enumerate(match_ids):
+                sup.add_local_input(mid, 0, sched(i, k))
+            out = sup.advance_all()
+            for mid, reqs in out.items():
+                games[mid].fulfill(reqs)
+                reqs_log[mid].append(req_summary(reqs))
+            for mid in match_ids:
+                host_events[mid].extend(sup.events(mid))
+            if tick_sleep_s:
+                _time.sleep(tick_sleep_s)
+    except BaseException:
+        sup.close()
+        raise
+    ctx.update(
+        wire={mid: list(s.received) for mid, s in peer_socks.items()},
+        reqs=reqs_log,
+        host_events=host_events,
+        peer_events=peer_events,
+        locations={mid: sup.match_location(mid) for mid in match_ids},
+        lost=sup.lost_matches(),
+        frames={
+            mid: (sup.current_frame(mid)
+                  if sup.match_location(mid) is not None else None)
+            for mid in match_ids
+        },
+        peer_frames={mid: p.current_frame for mid, p in peers.items()},
         peer_states={mid: g.state for mid, g in peer_games.items()},
         healthz=sup.healthz(),
         registry=registry,

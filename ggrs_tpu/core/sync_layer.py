@@ -45,6 +45,18 @@ class GameStateCell(Generic[S]):
         self._lock = threading.Lock()
         self._state: GameState[S] = GameState()
 
+    # cells ride the fleet's failover preludes across process boundaries
+    # (fleet/proc.py adopt RPC); the lock is process-local state — drop
+    # it on pickle, recreate it fresh on load
+    def __getstate__(self):
+        state = self.__dict__.copy()
+        del state["_lock"]
+        return state
+
+    def __setstate__(self, state) -> None:
+        self.__dict__.update(state)
+        self._lock = threading.Lock()
+
     def save(self, frame: Frame, data: Optional[S], checksum) -> None:
         """``checksum`` is a non-negative u128 int, None, or a lazy object
         with a ``materialize() -> int`` method (e.g. ``ops.DeviceChecksum``) —

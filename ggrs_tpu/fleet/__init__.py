@@ -11,6 +11,15 @@ Chaos coverage: ``scripts/chaos.py --fault shard``.
 """
 
 from .placement import HashRing
+from .proc import ProcShard, ShardRunner, proc_match_builder, runner_clock
+from .rpc import (
+    FrameError,
+    RpcClosed,
+    RpcConn,
+    RpcError,
+    RpcRemoteError,
+    RpcTimeout,
+)
 from .shard import (
     AdoptedMatch,
     PoolShard,
@@ -20,16 +29,28 @@ from .shard import (
     SHARD_RETIRED,
 )
 from .supervisor import FleetError, MatchRecord, ShardSupervisor
+from .tuning import FleetTuning
 
 __all__ = [
     "AdoptedMatch",
     "FleetError",
+    "FleetTuning",
+    "FrameError",
     "HashRing",
     "MatchRecord",
     "PoolShard",
+    "ProcShard",
+    "RpcClosed",
+    "RpcConn",
+    "RpcError",
+    "RpcRemoteError",
+    "RpcTimeout",
     "SHARD_ACTIVE",
     "SHARD_DEAD",
     "SHARD_DRAINING",
     "SHARD_RETIRED",
+    "ShardRunner",
     "ShardSupervisor",
+    "proc_match_builder",
+    "runner_clock",
 ]

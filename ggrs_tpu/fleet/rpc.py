@@ -1,0 +1,319 @@
+"""Supervisor ↔ shard-runner frame protocol (DESIGN.md §17).
+
+A deliberately small transport for the out-of-process shard backend: the
+supervisor and its shard runners share one stream socket (a socketpair
+for spawned runners, a UNIX socket for adopted ones) and speak
+length-prefixed, crc32-checked, version-tagged frames:
+
+  ``magic u16 "GR" | version u8 | kind u8 | payload_len u32 | crc u32``
+  followed by ``payload_len`` payload bytes (pickled message object).
+  ``crc = crc32(header[:8] + payload)`` — the crc covers the header
+  fields too, so a corrupted length cannot silently resync the stream.
+
+Properties the fleet layer leans on, each pinned adversarially by
+``tests/test_fleet_rpc.py``:
+
+- **max-frame clamp** both directions: an oversized frame is refused at
+  send time and rejected at receive time (:class:`FrameError`), never
+  buffered to OOM.
+- **typed failures**: garbage magic, wrong version, crc mismatch,
+  oversized length, undecodable payload → :class:`FrameError`; orderly
+  EOF / reset / mid-frame close → :class:`RpcClosed`; deadline →
+  :class:`RpcTimeout`.  A supervisor can always tell "the peer is gone"
+  from "the stream is poisoned" from "the peer is slow" — the three have
+  different watchdog consequences.
+- **poisoned-stream containment**: after any :class:`FrameError` the
+  connection refuses further traffic (there is no way to resync a
+  corrupted length-prefixed stream); the caller must tear down and
+  reconnect/failover — never retry-parse into a wedge.
+- **partial-read tolerance**: frames arrive in arbitrary chunkings
+  (slow sockets, interleaved heartbeats); the parser buffers across
+  reads and never blocks past its deadline.
+
+The payload codec is pickle: both ends are the same codebase on the same
+machine (the trust boundary is the process, not the network), and the
+fleet's migration bundles are already pinned pickle-portable by the PR 7
+tests — the RPC layer inherits that contract instead of inventing a
+second serialization.
+"""
+
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+import time
+import zlib
+from typing import Any, List, Optional, Tuple
+
+from ..core.errors import GgrsError
+
+MAGIC = b"GR"
+VERSION = 1
+
+# frame kinds
+KIND_CALL = 1       # supervisor → runner: {op: ..., **args}
+KIND_REPLY = 2      # runner → supervisor: the op's result
+KIND_ERR = 3        # runner → supervisor: {type, msg, traceback}
+KIND_HEARTBEAT = 4  # runner → supervisor, unsolicited liveness
+KIND_GOODBYE = 5    # runner → supervisor: graceful exit notice
+
+_KINDS = (KIND_CALL, KIND_REPLY, KIND_ERR, KIND_HEARTBEAT, KIND_GOODBYE)
+
+_HEADER = struct.Struct("<2sBBII")  # magic, version, kind, len, crc
+HEADER_SIZE = _HEADER.size
+
+DEFAULT_MAX_FRAME = 64 << 20
+
+
+class RpcError(GgrsError):
+    """Base of every supervisor↔runner transport failure."""
+
+
+class FrameError(RpcError):
+    """Malformed frame (bad magic/version/crc/size, undecodable
+    payload).  The stream cannot be resynced: close and reconnect."""
+
+
+class RpcClosed(RpcError):
+    """The peer is gone: orderly EOF, reset, or close mid-frame."""
+
+
+class RpcTimeout(RpcError):
+    """The deadline elapsed before a complete frame arrived."""
+
+
+class RpcRemoteError(RpcError):
+    """The runner executed the call and raised: carries the remote
+    exception's type name, message, and traceback text."""
+
+    def __init__(self, type_name: str, msg: str, traceback_text: str = ""):
+        super().__init__(f"{type_name}: {msg}")
+        self.type_name = type_name
+        self.msg = msg
+        self.traceback_text = traceback_text
+
+
+def encode_frame(kind: int, payload: bytes,
+                 max_frame: int = DEFAULT_MAX_FRAME) -> bytes:
+    """One wire frame.  Refuses oversized payloads at the SENDER — the
+    receiver's clamp is the backstop, not the policy."""
+    if kind not in _KINDS:
+        raise FrameError(f"unknown frame kind {kind}")
+    if len(payload) > max_frame:
+        raise FrameError(
+            f"frame of {len(payload)} bytes exceeds the "
+            f"{max_frame}-byte clamp"
+        )
+    head = struct.pack("<2sBBI", MAGIC, VERSION, kind, len(payload))
+    crc = zlib.crc32(payload, zlib.crc32(head)) & 0xFFFFFFFF
+    return head + struct.pack("<I", crc) + payload
+
+
+class RpcConn:
+    """One framed connection over a stream socket.
+
+    Single-threaded like everything session-shaped: one reader, one
+    writer, no interleaved calls.  ``recv`` returns ``(kind, obj)`` and
+    transparently buffers partial frames; ``call`` is the supervisor's
+    request/response helper (heartbeats arriving mid-call update
+    ``last_frame_at`` and are skipped).  Every received frame of any
+    kind refreshes ``last_frame_at`` — any traffic proves liveness.
+    """
+
+    def __init__(self, sock: socket.socket, *,
+                 max_frame: int = DEFAULT_MAX_FRAME) -> None:
+        self._sock = sock
+        self._buf = bytearray()
+        self.max_frame = max_frame
+        self.closed = False
+        self._poisoned: Optional[str] = None
+        self.last_frame_at: float = time.monotonic()
+        self.goodbye: Optional[Any] = None  # payload of a received GOODBYE
+
+    def fileno(self) -> int:
+        return self._sock.fileno()
+
+    # ------------------------------------------------------------------
+    # sending
+    # ------------------------------------------------------------------
+
+    def send(self, kind: int, obj: Any,
+             timeout: Optional[float] = 30.0) -> None:
+        """Pickle + frame + sendall.  A send timeout raises
+        :class:`RpcTimeout` — a SIGSTOPped peer with a full socket
+        buffer must wedge the WATCHDOG path, not the supervisor."""
+        payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        frame = encode_frame(kind, payload, self.max_frame)
+        self._check_usable()
+        self._sock.settimeout(timeout)
+        try:
+            self._sock.sendall(frame)
+        except socket.timeout:
+            # an unknown prefix of the frame may be on the wire: the
+            # stream can never be resynced — poison it so the next use
+            # fails loudly instead of feeding the peer a torn frame
+            self._poisoned = "send timed out mid-frame"
+            raise RpcTimeout(
+                f"send of a {len(frame)}-byte frame timed out "
+                "(stream poisoned)"
+            ) from None
+        except OSError as e:
+            self.closed = True
+            raise RpcClosed(f"send failed: {e}") from None
+
+    # ------------------------------------------------------------------
+    # receiving
+    # ------------------------------------------------------------------
+
+    def recv(self, timeout: Optional[float] = None) -> Tuple[int, Any]:
+        """The next frame, blocking up to ``timeout`` seconds."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            frame = self._parse_one()
+            if frame is not None:
+                return frame
+            remaining = None
+            if deadline is not None:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise RpcTimeout("no complete frame before the deadline")
+            self._check_usable()
+            self._sock.settimeout(remaining)
+            try:
+                chunk = self._sock.recv(65536)
+            except socket.timeout:
+                raise RpcTimeout(
+                    "no complete frame before the deadline"
+                ) from None
+            except OSError as e:
+                self.closed = True
+                raise RpcClosed(f"recv failed: {e}") from None
+            if not chunk:
+                self.closed = True
+                if self._buf:
+                    raise RpcClosed(
+                        f"connection closed mid-frame "
+                        f"({len(self._buf)} buffered bytes)"
+                    )
+                raise RpcClosed("connection closed")
+            self._buf += chunk
+
+    def poll_frames(self) -> List[Tuple[int, Any]]:
+        """Drain whatever frames are already readable without blocking —
+        the supervisor's control plane calls this each tick to pick up
+        heartbeats/goodbyes between RPCs.  EOF is recorded (``closed``),
+        not raised; a malformed frame still raises :class:`FrameError`."""
+        if self.closed or self._poisoned:
+            return []
+        try:
+            self._sock.settimeout(0)
+            while True:
+                chunk = self._sock.recv(65536)
+                if not chunk:
+                    self.closed = True
+                    break
+                self._buf += chunk
+        except (BlockingIOError, socket.timeout):
+            pass
+        except OSError:
+            self.closed = True
+        out = []
+        while True:
+            frame = self._parse_one()
+            if frame is None:
+                return out
+            out.append(frame)
+
+    def _check_usable(self) -> None:
+        if self._poisoned:
+            raise FrameError(f"stream poisoned: {self._poisoned}")
+        if self.closed:
+            raise RpcClosed("connection already closed")
+
+    def _poison(self, why: str) -> "FrameError":
+        self._poisoned = why
+        return FrameError(why)
+
+    def _parse_one(self) -> Optional[Tuple[int, Any]]:
+        """One frame from the buffer, or None when incomplete.  Any
+        malformation poisons the connection and raises."""
+        if self._poisoned:
+            raise FrameError(f"stream poisoned: {self._poisoned}")
+        if len(self._buf) < HEADER_SIZE:
+            return None
+        magic, version, kind, plen, crc = _HEADER.unpack_from(self._buf)
+        if magic != MAGIC:
+            raise self._poison(f"bad magic {bytes(magic)!r}")
+        if version != VERSION:
+            raise self._poison(
+                f"frame version {version} != supported {VERSION}"
+            )
+        if kind not in _KINDS:
+            raise self._poison(f"unknown frame kind {kind}")
+        if plen > self.max_frame:
+            raise self._poison(
+                f"frame of {plen} bytes exceeds the "
+                f"{self.max_frame}-byte clamp"
+            )
+        if len(self._buf) < HEADER_SIZE + plen:
+            return None
+        payload = bytes(self._buf[HEADER_SIZE : HEADER_SIZE + plen])
+        expect = zlib.crc32(payload, zlib.crc32(bytes(self._buf[:8])))
+        if (expect & 0xFFFFFFFF) != crc:
+            raise self._poison("frame crc mismatch")
+        del self._buf[: HEADER_SIZE + plen]
+        try:
+            obj = pickle.loads(payload)
+        except Exception as e:
+            raise self._poison(f"undecodable frame payload: {e}")
+        self.last_frame_at = time.monotonic()
+        if kind == KIND_GOODBYE:
+            self.goodbye = obj
+        return kind, obj
+
+    # ------------------------------------------------------------------
+    # the supervisor's request/response helper
+    # ------------------------------------------------------------------
+
+    def call(self, op: str, timeout: float, **kw: Any) -> Any:
+        """Send ``{op, **kw}`` and wait for the matching reply.
+        Heartbeats arriving first are consumed (they refresh
+        ``last_frame_at``); a GOODBYE means the runner exited before
+        answering (:class:`RpcClosed`)."""
+        self.send(KIND_CALL, dict(kw, op=op), timeout=timeout)
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                kind, obj = self.recv(
+                    timeout=max(0.0, deadline - time.monotonic())
+                )
+            except RpcTimeout:
+                # the reply is abandoned but may still arrive later;
+                # with no call/reply correlation on the wire, a later
+                # call would consume it as ITS reply — poison the
+                # stream so the connection is torn down instead
+                self._poisoned = (
+                    f"reply to {op!r} abandoned after timeout"
+                )
+                raise
+            if kind == KIND_HEARTBEAT:
+                continue
+            if kind == KIND_REPLY:
+                return obj
+            if kind == KIND_ERR:
+                raise RpcRemoteError(
+                    obj.get("type", "Exception"), obj.get("msg", ""),
+                    obj.get("traceback", ""),
+                )
+            if kind == KIND_GOODBYE:
+                raise RpcClosed(f"runner said goodbye: {obj!r}")
+            raise self._poison(f"unexpected frame kind {kind} mid-call")
+
+    def close(self) -> None:
+        if not self.closed:
+            self.closed = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -87,6 +87,10 @@ class PoolShard:
     bank and adopted matches alike.
     """
 
+    # backend tag the supervisor branches on ("inproc" serves in the
+    # supervisor's process; fleet.proc.ProcShard says "proc")
+    backend = "inproc"
+
     def __init__(
         self,
         shard_id: str,
@@ -99,6 +103,7 @@ class PoolShard:
         checkpoint_every: int = 32,
         p99_budget_ms: Optional[float] = None,
         stale_after_s: Optional[float] = None,
+        tuning=None,
     ) -> None:
         import random
         import zlib
@@ -111,6 +116,9 @@ class PoolShard:
         self.pool = HostSessionPool(
             metrics=self.metrics, tracer=tracer, native_io=native_io,
             retire_dead_matches=retire_dead_matches,
+            evict_max_per_tick=(
+                None if tuning is None else tuning.evict_max_per_tick
+            ),
         )
         # seeded from the shard id: identical topologies then produce
         # identical viewer magics — the control/chaos comparison contract
@@ -134,6 +142,10 @@ class PoolShard:
         self._ckpt_next: Dict[str, int] = {}
         self._ckpt_disabled: set = set()
         self._tick_ms: deque = deque(maxlen=128)
+        # matches whose journal degraded (write failure): the shard keeps
+        # serving them, but failover must treat them as journal-less —
+        # the durable tip stopped tracking what the match acks (§17)
+        self._journal_failed: set = set()
         m = self.metrics
         self._g_matches = m.gauge(
             "ggrs_shard_matches", "matches served per shard, by tier",
@@ -141,6 +153,10 @@ class PoolShard:
         self._g_p99 = m.gauge(
             "ggrs_shard_tick_p99_ms",
             "shard tick p99 over the last 128 ticks (admission signal)",
+            labels=("shard",))
+        self._m_journal_failures = m.counter(
+            "ggrs_shard_journal_failures_total",
+            "matches whose journal degraded on a write failure",
             labels=("shard",))
 
     # ------------------------------------------------------------------
@@ -161,6 +177,32 @@ class PoolShard:
 
     def has_match(self, match_id: str) -> bool:
         return match_id in self._matches or match_id in self._adopted
+
+    def is_bank_match(self, match_id: str) -> bool:
+        """Bank-tier (native-harvest-exportable) vs adopted-tier — the
+        supervisor's migrate() branches on this instead of reaching into
+        ``_matches`` so process-backed shards can answer from cache."""
+        return match_id in self._matches
+
+    def journal_failed_matches(self) -> List[str]:
+        """Matches whose journal degraded on a write failure — the
+        supervisor marks them journal-less for failover purposes."""
+        return sorted(self._journal_failed)
+
+    def match_port(self, match_id: str) -> Optional[int]:
+        """The UDP port the match's host socket bound, when determinable
+        (None for in-memory networks) — how a driver that admitted
+        through a port-0 socket factory learns where to aim the peer."""
+        sock = None
+        slot = self._matches.get(match_id)
+        if slot is not None and slot < len(self.pool._builders):
+            sock = self.pool._builders[slot][1]
+        else:
+            am = self._adopted.get(match_id)
+            if am is not None:
+                sock = getattr(am.session, "_socket", None)
+        port = getattr(sock, "local_port", None)
+        return port() if callable(port) else None
 
     def admission_refusal(self) -> Optional[str]:
         """Why this shard refuses a new match right now, or None — the
@@ -306,6 +348,22 @@ class PoolShard:
         # then never leave the peers holding frames the journal lacks
         for journal in self._journals.values():
             journal.flush_local()
+        # journal write-failure sweep: a degraded journal (ENOSPC/EIO —
+        # the MatchJournal stops writing and flags itself) must degrade
+        # the SHARD loudly, not silently drop records: fault counter +
+        # health flag, and the supervisor marks the match journal-less
+        # for failover purposes
+        for match_id, journal in self._journals.items():
+            if journal.failed is not None and (
+                match_id not in self._journal_failed
+            ):
+                self._journal_failed.add(match_id)
+                self._m_journal_failures.labels(shard=self.shard_id).inc()
+                _logger.error(
+                    "shard %s match %s: journal degraded (%s); match is "
+                    "journal-less for failover until re-incarnated",
+                    self.shard_id, match_id, journal.failed,
+                )
         out: Dict[str, List[GgrsRequest]] = {}
         lists = self.pool.advance_all()
         for match_id, slot in self._matches.items():
@@ -600,6 +658,7 @@ class PoolShard:
         self._encoders.pop(match_id, None)
         self._ckpt_next.pop(match_id, None)
         self._ckpt_disabled.discard(match_id)
+        self._journal_failed.discard(match_id)
         if journal is not None:
             try:
                 journal.close()
@@ -618,6 +677,25 @@ class PoolShard:
 
     def retire(self) -> None:
         self.state = SHARD_RETIRED
+        for match_id in list(self._journals):
+            self._close_journal(match_id)
+
+    def flush_journals(self, close: bool = False) -> None:
+        """Fsync (or close: CLOSE record + fsync) every journal — the
+        shard runner's graceful-drain step, so a SIGTERM'd process leaves
+        journals durable to the last served frame."""
+        for match_id in list(self._journals):
+            if close:
+                self._close_journal(match_id)
+            else:
+                try:
+                    self._journals[match_id].flush(fsync=True)
+                except Exception:
+                    pass  # a degraded journal already no-ops/flags
+
+    def close(self) -> None:
+        """Release durable resources (journal fds).  Lifecycle state is
+        untouched — this is the supervisor's shutdown hook, not a drain."""
         for match_id in list(self._journals):
             self._close_journal(match_id)
 
@@ -640,6 +718,7 @@ class PoolShard:
             bank_matches=len(self._matches),
             adopted_matches=len(self._adopted),
             dead_matches=len(self._dead_matches),
+            journal_failed=len(self._journal_failed),
             capacity=self.capacity,
             ticks=self.ticks,
             last_tick_age_s=age,

@@ -56,6 +56,7 @@ from ..core.types import (
 from ..obs.registry import Registry, default_registry
 from ..utils.tracing import get_logger
 from .placement import HashRing
+from .rpc import FrameError, RpcError, RpcTimeout
 from .shard import (
     PoolShard,
     SHARD_ACTIVE,
@@ -63,11 +64,15 @@ from .shard import (
     SHARD_DRAINING,
     SHARD_RETIRED,
 )
+from .tuning import FleetTuning
 
 _logger = get_logger("fleet")
 
 # re-admission retry policy (satellite of DESIGN.md §16): exponential
-# backoff with seeded jitter, bounded attempts
+# backoff with seeded jitter, bounded attempts.  These module constants
+# are the documented defaults; each supervisor instance reads its OWN
+# FleetTuning (readmit_backoff_ticks / readmit_max_attempts), which
+# defaults to these values — see fleet/tuning.py.
 READMIT_BACKOFF_TICKS = 8
 READMIT_MAX_ATTEMPTS = 6
 
@@ -85,16 +90,25 @@ class MatchRecord:
         "match_id", "builder_factory", "socket_factory", "state_template",
         "journaled", "location", "incarnation", "journal_paths",
         "identity", "lost", "num_players", "input_size", "max_prediction",
-        "local_handles",
+        "local_handles", "game_factory", "journal_failed",
     )
 
     def __init__(self, match_id: str, builder_factory, socket_factory,
-                 state_template) -> None:
+                 state_template, game_factory=None) -> None:
         self.match_id = match_id
         self.builder_factory = builder_factory
         self.socket_factory = socket_factory
         self.state_template = state_template
+        # process-backed shards fulfill requests IN the runner: a
+        # picklable callable returning an object with .fulfill(requests).
+        # None keeps the match placeable on in-process shards only.
+        self.game_factory = game_factory
         self.journaled = False
+        # the CURRENT incarnation's journal degraded on a write failure:
+        # its durable tip no longer tracks what the live match acks, so
+        # failover must treat the match as journal-less (resuming from a
+        # stale tip would silently desync the peers)
+        self.journal_failed = False
         self.location: Optional[str] = None
         self.incarnation = 0
         self.journal_paths: List[str] = []
@@ -142,8 +156,18 @@ class ShardSupervisor:
         stale_after_s: Optional[float] = None,
         native_io: bool = False,
         retire_dead_matches: bool = False,
+        # out-of-process backend (DESIGN.md §17): shard ids listed here
+        # run as real subprocesses (scripts/shard_runner.py) behind the
+        # same supervisor interface — mixed fleets are the normal case.
+        # proc_clock feeds the runners' session clock (shipped by value
+        # with every tick RPC); tuning consolidates every fleet
+        # timeout/backoff knob (FleetTuning.from_env() by default).
+        proc_shards=(),
+        proc_clock: Optional[Callable[[], int]] = None,
+        tuning: Optional[FleetTuning] = None,
     ) -> None:
         self.metrics = metrics if metrics is not None else default_registry()
+        self.tuning = tuning if tuning is not None else FleetTuning.from_env()
         self.journal_dir = (
             os.fspath(journal_dir) if journal_dir is not None else None
         )
@@ -152,17 +176,33 @@ class ShardSupervisor:
         self.max_migrations_per_tick = max_migrations_per_tick
         self.identity_refresh_every = identity_refresh_every
         self._rng = random.Random(seed)
-        self.shards: Dict[str, PoolShard] = {}
+        self.shards: Dict[str, Any] = {}
         self.ring = HashRing()
+        proc_set = {str(s) for s in proc_shards}
         for sid in shard_ids:
-            self.shards[str(sid)] = PoolShard(
-                str(sid), capacity=capacity, metrics=self.metrics,
-                tracer=tracer, checkpoint_every=checkpoint_every,
-                p99_budget_ms=p99_budget_ms, stale_after_s=stale_after_s,
-                native_io=native_io,
-                retire_dead_matches=retire_dead_matches,
-            )
-            self.ring.add(str(sid))
+            sid = str(sid)
+            if sid in proc_set:
+                from .proc import ProcShard
+
+                self.shards[sid] = ProcShard(
+                    sid, capacity=capacity, metrics=self.metrics,
+                    tuning=self.tuning, clock=proc_clock,
+                    checkpoint_every=checkpoint_every,
+                    p99_budget_ms=p99_budget_ms,
+                    stale_after_s=stale_after_s, native_io=native_io,
+                    retire_dead_matches=retire_dead_matches,
+                )
+            else:
+                self.shards[sid] = PoolShard(
+                    sid, capacity=capacity, metrics=self.metrics,
+                    tracer=tracer, checkpoint_every=checkpoint_every,
+                    p99_budget_ms=p99_budget_ms,
+                    stale_after_s=stale_after_s,
+                    native_io=native_io,
+                    retire_dead_matches=retire_dead_matches,
+                    tuning=self.tuning,
+                )
+            self.ring.add(sid)
         self._records: Dict[str, MatchRecord] = {}
         self._pending: List[_PendingAdmission] = []
         self._tick = 0
@@ -195,6 +235,9 @@ class ShardSupervisor:
         self._m_lost = m.counter(
             "ggrs_fleet_matches_lost_total",
             "matches the fleet could not recover")
+        self._m_journal_failed = m.counter(
+            "ggrs_fleet_journal_failures_total",
+            "matches marked journal-less after a journal write failure")
         self._update_shard_gauge()
 
     # ------------------------------------------------------------------
@@ -210,6 +253,7 @@ class ShardSupervisor:
         journal: Optional[bool] = None,
         state_template: Any = None,
         shard: Optional[str] = None,
+        game_factory: Optional[Callable[[], Any]] = None,
     ) -> Optional[str]:
         """Place one match on the fleet.  ``builder_factory`` /
         ``socket_factory`` must return a FRESH fully-populated
@@ -222,12 +266,18 @@ class ShardSupervisor:
         the ring, not the admission check) — chaos/control topologies use
         it to make placement identical across legs.
 
+        ``game_factory`` (a picklable callable returning an object with
+        ``.fulfill(requests)``) makes the match placeable on
+        process-backed shards, whose runners fulfill requests in-process
+        — without one the match only lands on in-process shards.
+
         Returns the shard id, or None when every shard refused and the
         match parked in the re-admission backoff queue."""
         if match_id in self._records:
             raise InvalidRequest(f"match {match_id!r} already admitted")
         record = MatchRecord(
-            match_id, builder_factory, socket_factory, state_template
+            match_id, builder_factory, socket_factory, state_template,
+            game_factory=game_factory,
         )
         record.journaled = (
             journal if journal is not None else self.journal_dir is not None
@@ -263,35 +313,87 @@ class ShardSupervisor:
             if sid != exclude:
                 yield sid
 
+    def _placement_refusal(self, shard, record: MatchRecord):
+        """One shard's verdict on one match: the shard's own capacity/
+        health refusal, plus the backend constraint — a process-backed
+        shard cannot serve a match without a picklable game_factory
+        (its runner fulfills requests in-process)."""
+        refusal = shard.admission_refusal()
+        if refusal is None and shard.backend == "proc" and (
+            record.game_factory is None
+        ):
+            refusal = "no-game-factory"
+        return refusal
+
     def _try_place(self, record: MatchRecord, *, builder=None,
                    pinned: Optional[str] = None,
                    exclude: Optional[str] = None) -> Optional[str]:
         for sid in self._candidate_shards(record.match_id, pinned, exclude):
             shard = self.shards[sid]
-            refusal = shard.admission_refusal()
+            refusal = self._placement_refusal(shard, record)
             if refusal is not None:
                 self._m_refusals.labels(reason=refusal).inc()
                 continue
-            b = builder if builder is not None else record.builder_factory()
-            journal = self._open_journal(record) if record.journaled else None
-            tier = shard.admit(
-                record.match_id, b, record.socket_factory(), journal=journal
-            )
+            if shard.backend == "proc":
+                spec = (
+                    self._journal_spec(record) if record.journaled else None
+                )
+                try:
+                    tier = shard.admit_spec(
+                        record.match_id, record.builder_factory,
+                        record.socket_factory, record.game_factory,
+                        journal_spec=spec,
+                    )
+                except (RpcTimeout, FrameError):
+                    # AMBIGUOUS outcome: the runner may have completed
+                    # the admission before wedging.  Placing elsewhere
+                    # now could put two live copies on the wire, so the
+                    # match PARKS instead — by the backoff retry the
+                    # watchdog will have confirmed the runner dead (its
+                    # half-admitted copy with it) or healthy.
+                    self._m_refusals.labels(reason="rpc-ambiguous").inc()
+                    return None
+                except RpcError:
+                    # definitive failure (runner dead before completing,
+                    # or the admit itself raised): nothing lives there —
+                    # keep walking the preference order
+                    self._m_refusals.labels(reason="rpc-error").inc()
+                    continue
+                if spec is not None:
+                    record.journal_paths.append(spec["path"])
+            else:
+                b = (builder if builder is not None
+                     else record.builder_factory())
+                journal = (
+                    self._open_journal(record) if record.journaled else None
+                )
+                try:
+                    tier = shard.admit(
+                        record.match_id, b, record.socket_factory(),
+                        journal=journal,
+                    )
+                except Exception:
+                    # unwind the just-registered stub so a retry of the
+                    # same incarnation path can exclusive-create again
+                    if journal is not None:
+                        from .proc import _discard_stub_journal
+
+                        record.journal_paths.pop()
+                        _discard_stub_journal(journal)
+                    raise
             record.location = sid
             self._m_admissions.labels(tier=tier).inc()
             return sid
         return None
 
     def _park(self, record: MatchRecord, attempts: int) -> None:
-        if attempts >= READMIT_MAX_ATTEMPTS:
+        if attempts >= self.tuning.readmit_max_attempts:
             record.lost = "admission refused by every shard"
             self._m_lost.inc()
             _logger.error("match %s lost: %s", record.match_id, record.lost)
             return
-        delay = (
-            READMIT_BACKOFF_TICKS * (2 ** attempts)
-            + self._rng.randrange(READMIT_BACKOFF_TICKS)
-        )
+        backoff = self.tuning.readmit_backoff_ticks
+        delay = backoff * (2 ** attempts) + self._rng.randrange(backoff)
         self._pending.append(_PendingAdmission(
             record, attempts + 1, self._tick + delay
         ))
@@ -317,22 +419,42 @@ class ShardSupervisor:
     # journals
     # ------------------------------------------------------------------
 
-    def _open_journal(self, record: MatchRecord):
-        from ..broadcast.journal import MatchJournal
-
+    def _journal_spec(self, record: MatchRecord) -> Dict[str, Any]:
+        """The new incarnation's journal, described as plain data — the
+        in-process path opens a ``MatchJournal`` from it; the process
+        backend ships it and the RUNNER opens the file (the supervisor
+        must never create the file a runner will open with the
+        exclusive-create contract).  The path is NOT registered on the
+        record here: callers append it to ``journal_paths`` only once
+        the open/adoption succeeds, so a failure can never leave a
+        phantom path that a later journal failover would read instead
+        of the previous incarnation's valid file."""
         path = os.path.join(
             self.journal_dir,
             f"{record.match_id}.{record.incarnation:03d}.ggjl",
         )
-        journal = MatchJournal(
-            path, record.num_players, record.input_size,
+        return dict(
+            path=path,
+            num_players=record.num_players,
+            input_size=record.input_size,
             meta=dict(match_id=record.match_id,
                       incarnation=record.incarnation),
             fsync_every=self.journal_fsync_every,
             tail_window=self.journal_tail_window,
+        )
+
+    def _open_journal(self, record: MatchRecord):
+        from ..broadcast.journal import MatchJournal
+
+        spec = self._journal_spec(record)
+        journal = MatchJournal(
+            spec["path"], spec["num_players"], spec["input_size"],
+            meta=spec["meta"],
+            fsync_every=spec["fsync_every"],
+            tail_window=spec["tail_window"],
             metrics=self.metrics,
         )
-        record.journal_paths.append(path)
+        record.journal_paths.append(spec["path"])
         return journal
 
     # ------------------------------------------------------------------
@@ -354,6 +476,8 @@ class ShardSupervisor:
         out: Dict[str, List[GgrsRequest]] = {}
         for sid in sorted(self.shards):
             out.update(self.shards[sid].advance_all())
+        self._drive_procs()
+        self._check_journal_failures()
         self._drive_drains()
         self._health_check()
         self._retry_pending()
@@ -402,6 +526,75 @@ class ShardSupervisor:
                 pass  # e.g. pool not started yet; next refresh catches it
 
     # ------------------------------------------------------------------
+    # process-backend control plane (DESIGN.md §17)
+    # ------------------------------------------------------------------
+
+    def _drive_procs(self) -> None:
+        """One watchdog step per process-backed shard: crash detection
+        (waitpid/EOF), the hang escalation (SIGTERM → drain deadline →
+        SIGKILL), failover of a CONFIRMED-dead shard's matches from
+        their durable journals, and the jittered-backoff restart policy
+        behind its storm budget."""
+        now = time.monotonic()
+        for sid in sorted(self.shards):
+            shard = self.shards[sid]
+            if shard.backend != "proc":
+                continue
+            if shard.poll_lifecycle() == "died":
+                _logger.error(
+                    "proc shard %s confirmed dead (%s); failing over",
+                    sid, shard.last_exit,
+                )
+                self._fail_shard(sid, reason=shard.last_exit or "died")
+                shard.schedule_respawn(now)
+            if shard.respawn_due(now):
+                if shard.try_respawn():
+                    # the replacement serves NEW admissions; the dead
+                    # incarnation's matches already failed over
+                    shard.state = SHARD_ACTIVE
+                    self.ring.add(sid)
+                    self._update_shard_gauge()
+                else:
+                    # transient spawn failure: re-arm within the storm
+                    # budget (the failed attempt consumed a slot) rather
+                    # than silently going permanently dead
+                    shard.schedule_respawn(now)
+
+    def _check_journal_failures(self) -> None:
+        """Mark matches whose journal degraded (write failure) as
+        journal-less for failover purposes — the shard keeps serving
+        them, but a crash can no longer recover them from that file."""
+        for sid, shard in self.shards.items():
+            try:
+                failed = shard.journal_failed_matches()
+            except Exception:
+                continue
+            for mid in failed:
+                record = self._records.get(mid)
+                if record is None or record.journal_failed:
+                    continue
+                if record.location != sid:
+                    continue
+                record.journal_failed = True
+                self._m_journal_failed.inc()
+                _logger.error(
+                    "match %s: journal degraded on shard %s; the match "
+                    "is journal-less for failover until re-incarnated",
+                    mid, sid,
+                )
+
+    def close(self) -> None:
+        """Release every shard's durable/process resources: runners get
+        the drain → SIGTERM → SIGKILL ladder and are reaped (no orphan
+        children, no leaked fds — pinned by the leak-check test);
+        in-process shards close their journals."""
+        for shard in self.shards.values():
+            try:
+                shard.close()
+            except Exception:
+                pass
+
+    # ------------------------------------------------------------------
     # live migration
     # ------------------------------------------------------------------
 
@@ -419,7 +612,7 @@ class ShardSupervisor:
         src = self.shards[src_id]
         if dst_shard is None:
             for sid in self._candidate_shards(match_id, exclude=src_id):
-                if self.shards[sid].admission_refusal() is None:
+                if self._placement_refusal(self.shards[sid], record) is None:
                     dst_shard = sid
                     break
             if dst_shard is None:
@@ -427,7 +620,7 @@ class ShardSupervisor:
         elif dst_shard == src_id:
             raise FleetError("destination is the source shard")
         else:
-            refusal = self.shards[dst_shard].admission_refusal()
+            refusal = self._placement_refusal(self.shards[dst_shard], record)
             if refusal is not None:
                 raise FleetError(
                     f"shard {dst_shard} refuses the migration: {refusal}"
@@ -437,7 +630,7 @@ class ShardSupervisor:
         # the same magics the bundle carries
         record.identity = src.wire_identity(match_id)
         bundle = None
-        if match_id in src._matches:
+        if src.is_bank_match(match_id):
             try:
                 bundle = src.evict_match(match_id)
             except InvalidRequest:
@@ -454,27 +647,7 @@ class ShardSupervisor:
                 # the process-portability contract, enforced on every
                 # migration: the bundle must survive leaving this process
                 bundle = pickle.loads(pickle.dumps(bundle))
-                record.incarnation += 1
-                journal = (
-                    self._open_journal(record) if record.journaled else None
-                )
-                try:
-                    builder = record.builder_factory()
-                    dst.adopt_match(
-                        match_id, builder, record.socket_factory(), bundle,
-                        journal=journal,
-                    )
-                except Exception:
-                    # the failed incarnation's journal is empty: close it
-                    # and forget the path so a journal fallback reads the
-                    # PREVIOUS incarnation, not this stub
-                    if journal is not None:
-                        record.journal_paths.pop()
-                        try:
-                            journal.close()
-                        except Exception:
-                            pass
-                    raise
+                self._adopt_on(dst, record, bundle)
             except Exception as e:
                 # the source slot is already released — never leave the
                 # match half-tracked: fall back to the durable journal,
@@ -503,6 +676,52 @@ class ShardSupervisor:
         self._m_migrations.labels(reason=reason).inc()
         self._update_match_gauge()
         return dst_shard
+
+    def _adopt_on(self, dst, record: MatchRecord, bundle: Dict[str, Any],
+                  *, saved_states=None, prelude=None,
+                  replay_local=None) -> None:
+        """The destination half of migration/failover on EITHER backend:
+        bump the incarnation, open (in-process) or describe (process
+        backend — the runner opens the file) the new journal, adopt, and
+        unwind the journal bookkeeping when adoption fails so a journal
+        fallback reads the PREVIOUS incarnation, not an empty stub."""
+        journal = spec = None
+        record.incarnation += 1
+        if record.journaled:
+            spec = self._journal_spec(record)
+            if dst.backend != "proc":
+                journal = self._open_journal(record)  # registers the path
+        try:
+            if dst.backend == "proc":
+                dst.adopt_spec(
+                    record.match_id, record.builder_factory,
+                    record.socket_factory, record.game_factory, bundle,
+                    saved_states=saved_states, prelude=prelude,
+                    journal_spec=spec, replay_local=replay_local,
+                )
+            else:
+                dst.adopt_match(
+                    record.match_id, record.builder_factory(),
+                    record.socket_factory(), bundle,
+                    saved_states=saved_states, prelude=prelude,
+                    journal=journal, replay_local=replay_local,
+                )
+        except Exception:
+            # the failed incarnation's journal (if it got registered) is
+            # an empty stub: forget it so a journal fallback reads the
+            # PREVIOUS incarnation, not this one
+            if journal is not None:
+                record.journal_paths.pop()
+                try:
+                    journal.close()
+                except Exception:
+                    pass
+            raise
+        if dst.backend == "proc" and spec is not None:
+            record.journal_paths.append(spec["path"])
+        # a fresh incarnation journals from scratch: any write-failure
+        # degradation belonged to the previous incarnation's file
+        record.journal_failed = False
 
     def _recover_or_lose(self, record: MatchRecord, dst_shard: str,
                          cause: Exception, *,
@@ -554,9 +773,10 @@ class ShardSupervisor:
                     break
                 try:
                     self.migrate(match_id, reason="drain")
-                except FleetError as e:
-                    # no capacity anywhere right now: stay draining, the
-                    # next tick retries (bounded work either way)
+                except (FleetError, RpcError) as e:
+                    # no capacity anywhere right now (or the draining
+                    # runner wedged — the watchdog owns that): stay
+                    # draining, the next tick retries (bounded work)
                     _logger.warning(
                         "drain of %s stalled on %s: %s", sid, match_id, e
                     )
@@ -581,10 +801,17 @@ class ShardSupervisor:
             shard = self.shards[sid]
             if shard.state in (SHARD_RETIRED, SHARD_DEAD):
                 continue
+            if shard.backend == "proc":
+                # process liveness is owned by _drive_procs: a WEDGED
+                # runner must be escalated to confirmed-dead before its
+                # matches fail over (it may still be sending to peers —
+                # two live incarnations would fight over the wire)
+                continue
             if not shard.healthz()["ok"]:
-                self._fail_shard(sid)
+                self._fail_shard(sid, reason="failed health check")
 
-    def _fail_shard(self, shard_id: str) -> None:
+    def _fail_shard(self, shard_id: str,
+                    reason: str = "failed health check") -> None:
         """Every match on the failed shard journal-recovers onto the
         survivors — the durable artifacts (journal + checkpoints + cached
         identity) are all that is assumed to exist."""
@@ -600,8 +827,8 @@ class ShardSupervisor:
             }
         )
         _logger.error(
-            "shard %s failed health check; failing over %d matches",
-            shard_id, len(matches),
+            "shard %s %s; failing over %d matches",
+            shard_id, reason, len(matches),
         )
         for match_id in matches:
             record = self._records[match_id]
@@ -630,6 +857,15 @@ class ShardSupervisor:
 
         if not record.journaled or not record.journal_paths:
             raise FleetError("match has no journal to recover from")
+        if record.journal_failed:
+            # the incarnation's journal degraded on a write failure: its
+            # durable tip stopped tracking what the live match acked, so
+            # resuming from it would silently desync the peers — the
+            # match is journal-less, loudly (the §17 degradation contract)
+            raise FleetError(
+                "journal degraded by a write failure: the match is "
+                "journal-less for failover"
+            )
         identity = record.identity
         if identity is None:
             raise FleetError("no cached wire identity (shard died before "
@@ -717,16 +953,14 @@ class ShardSupervisor:
                 shard = self.shards[sid]
                 if shard.state == SHARD_DEAD or shard.killed:
                     continue
-                if shard.admission_refusal() is None:
+                if self._placement_refusal(shard, record) is None:
                     dst_shard = sid
                     break
             if dst_shard is None:
                 raise FleetError("no surviving shard accepts the match")
-        record.incarnation += 1
-        journal = self._open_journal(record)
-        self.shards[dst_shard].adopt_match(
-            record.match_id, builder, record.socket_factory(), bundle,
-            saved_states=saved, prelude=prelude, journal=journal,
+        self._adopt_on(
+            self.shards[dst_shard], record, bundle,
+            saved_states=saved, prelude=prelude,
             replay_local=replay_local,
         )
         record.location = dst_shard

@@ -404,7 +404,15 @@ class HostSessionPool:
                  metrics: Optional[Registry] = None,
                  flight_recorder_size: int = 256,
                  tracer: Optional[Tracer] = None,
-                 native_io: bool = False) -> None:
+                 native_io: bool = False,
+                 evict_max_per_tick: Optional[int] = None) -> None:
+        # per-pool override of the eviction storm clamp (None = the
+        # module default) — the fleet layer passes FleetTuning's value
+        # through so one dataclass owns every backoff/clamp knob
+        self._evict_max_per_tick = (
+            EVICT_MAX_PER_TICK if evict_max_per_tick is None
+            else evict_max_per_tick
+        )
         # native_io (DESIGN.md §15): attach each slot's UDP fd to the
         # kernel-batched datapath (net_batch.cpp) so datagrams flow
         # socket -> crossing -> socket with zero Python on the packet path
@@ -1476,7 +1484,7 @@ class HostSessionPool:
                 # per supervision pass — the rest stay quarantined and are
                 # picked up on following ticks, keeping the tick budget
                 # bounded while the jittered backoff spreads the retries
-                if evictions_this_tick < EVICT_MAX_PER_TICK:
+                if evictions_this_tick < self._evict_max_per_tick:
                     if self._try_evict(i):
                         evictions_this_tick += 1
                 state = self._slot_state[i]

@@ -41,6 +41,9 @@ g++ -O1 -g -shared -fPIC -std=c++17 \
 # run before the bank code under test even executes; the fused-scrub
 # replay test JITs too.  The slow soak is excluded by default; pass
 # "-m" "slow" to run it sanitized too.
+# tests/test_fleet_proc.py is included: its shard-runner children
+# inherit LD_PRELOAD/GGRS_NATIVE_SANITIZE, so the out-of-process serving
+# loop exercises the SANITIZED native bank in the subprocess too.
 LD_PRELOAD="$asan_rt" \
 ASAN_OPTIONS="detect_leaks=0:abort_on_error=1" \
 GGRS_NATIVE_SANITIZE=1 \
@@ -49,6 +52,6 @@ python -m pytest tests/test_session_bank.py tests/test_bank_faults.py \
     tests/test_obs.py tests/test_broadcast.py tests/test_replay_journal.py \
     tests/test_trace.py tests/test_desync_detection.py \
     tests/test_native_io.py tests/test_socket_datapath.py \
-    tests/test_fleet.py \
+    tests/test_fleet.py tests/test_fleet_rpc.py tests/test_fleet_proc.py \
     -q -p no:cacheprovider -m "not slow" \
     -k "not batched_executor and not size_mismatch and not fused_scrub and not scrub_matches" "$@"

@@ -37,6 +37,15 @@ Fault classes (all driven through the pool's real tick path):
                 slot, a fatal EPERM must fault exactly that slot
                 (BANK_ERR_IO) and evict it onto the Python socket path —
                 survivors' wire bytes bit-identical to control either way
+  proc          out-of-process leg (DESIGN.md §17): s1 is a REAL
+                subprocess (scripts/shard_runner.py) behind the
+                supervisor RPC — SIGKILL mid-traffic must be detected
+                within the heartbeat deadline with every match
+                journal-recovered and zero orphans, SIGSTOP must
+                escalate SIGTERM -> drain deadline -> SIGKILL before the
+                same recovery, and a 5x kill storm must exhaust the
+                restart budget instead of crash-looping; every artifact
+                records its FleetTuning knobs
   shard         fleet leg (DESIGN.md §16): a two-shard ShardSupervisor
                 (B = --fleet-matches journaled matches per shard, default
                 32) runs three scenarios — kill-a-shard (every affected
@@ -649,6 +658,231 @@ def verify_fleet_leg(matches_per_shard: int, ticks: int, seed: int,
     return ok
 
 
+def verify_proc_leg(matches_per_shard: int, ticks: int, seed: int,
+                    artifact_dir=None) -> bool:
+    """The out-of-process scenarios (DESIGN.md §17), over
+    ``drive_proc_fleet`` — the SAME driver tests/test_fleet_proc.py
+    pins.  Shard ``s0`` serves in-process, ``s1`` is a real subprocess
+    (scripts/shard_runner.py); every scenario is verified against a
+    fault-free proc-backend control and every artifact records the
+    ``FleetTuning`` knobs it ran with (round-trippable JSON):
+
+    - ``proc_sigkill``: SIGKILL the shard subprocess mid-traffic; death
+      must be detected within the heartbeat deadline, every match must
+      re-adopt from its durable journal onto the survivor, the
+      surviving shard's peer-observed wire must be bit-identical to
+      control, and zero orphan processes/fds may remain.
+    - ``proc_sigstop``: SIGSTOP (a hang, not a death) until the
+      watchdog escalates SIGTERM → drain deadline → SIGKILL, then the
+      same recovery contract — wedged ≠ dead, and failover only after
+      confirmed death.
+    - ``proc_restart_storm``: kill the same shard 5× fast; the
+      jittered-backoff restart policy must respawn it at most
+      ``restart_max`` times inside the storm window and then leave it
+      dead, with every match still recovered and nothing leaked.
+    """
+    import os
+    import signal
+    import time
+
+    from ggrs_tpu.chaos import (
+        drive_proc_fleet,
+        fleet_recovery_violations,
+        fleet_survivor_violations,
+    )
+    from ggrs_tpu.fleet import FleetTuning, SHARD_DEAD
+
+    p = matches_per_shard
+    ticks = max(120, min(ticks, 240))
+    tuning = FleetTuning(
+        heartbeat_interval_s=0.05, heartbeat_deadline_s=0.5,
+        rpc_timeout_s=0.75, drain_deadline_s=0.4,
+        spawn_timeout_s=120.0, restart_max=0,
+    )
+    survivors = [f"m{k}" for k in range(p)]           # pinned to s0
+    affected = [f"m{k}" for k in range(p, 2 * p)]     # pinned to s1
+    ok = True
+
+    def report(name, violations, ctx, extra=None) -> bool:
+        reg = ctx["registry"]
+        _write_artifact(artifact_dir, name, {
+            "scenario": name,
+            "verdict": "PASS" if not violations else "FAIL",
+            "violations": violations,
+            "matches_per_shard": p,
+            "ticks": ticks,
+            "tuning": tuning.as_dict(),
+            "locations": ctx["locations"],
+            "lost": ctx["lost"],
+            "healthz": {
+                k: v for k, v in ctx["healthz"].items() if k != "shards"
+            },
+            "s1": ctx["healthz"]["shards"]["s1"],
+            "watchdog": {
+                stage: int(reg.value(
+                    "ggrs_fleet_proc_watchdog_total",
+                    shard="s1", stage=stage) or 0)
+                for stage in ("sigterm", "sigkill")
+            },
+            "restarts": int(reg.value(
+                "ggrs_fleet_proc_restarts_total", shard="s1") or 0),
+            **(extra or {}),
+            "metrics": json_snapshot(reg),
+        })
+        if violations:
+            print(f"  {name.upper()} VIOLATED:")
+            for v in violations:
+                print(f"    {v}")
+            return False
+        return True
+
+    print("--- proc ---")
+    print(f"  s0 in-process + s1 subprocess x {p} journaled matches, "
+          f"{ticks} ticks")
+    control = drive_proc_fleet(
+        ticks, matches_per_shard=p, seed=seed, backend="proc",
+        tuning=tuning,
+    )
+    control["sup"].close()
+
+    # 1. SIGKILL mid-traffic: crash detection + journal failover
+    timing = {}
+
+    def sigkill(i, ctx):
+        sup = ctx["sup"]
+        if i == ticks // 2:
+            timing["pid"] = sup.shards["s1"].pid
+            timing["killed_at"] = time.monotonic()
+            os.kill(timing["pid"], signal.SIGKILL)
+        elif "killed_at" in timing and "detected_at" not in timing:
+            if sup.shards["s1"].state == SHARD_DEAD:
+                timing["detected_at"] = time.monotonic()
+
+    chaos = drive_proc_fleet(
+        ticks, matches_per_shard=p, seed=seed, backend="proc",
+        tuning=tuning, inject=sigkill,
+    )
+    chaos["sup"].close()
+    violations = fleet_survivor_violations(chaos, control, survivors)
+    violations += fleet_recovery_violations(
+        chaos, affected, dead_shards=["s1"]
+    )
+    detect_s = (
+        timing.get("detected_at", float("inf")) - timing["killed_at"]
+    )
+    if detect_s > tuning.heartbeat_deadline_s:
+        violations.append(
+            f"death detected in {detect_s:.2f}s > heartbeat deadline "
+            f"{tuning.heartbeat_deadline_s}s"
+        )
+    orphans = chaos["sup"].shards["s1"].orphan_count()
+    if orphans:
+        violations.append(f"{orphans} orphan runner processes")
+    if os.path.exists(f"/proc/{timing['pid']}"):
+        violations.append(f"killed runner pid {timing['pid']} not reaped")
+    recovered = sum(
+        1 for m in affected if chaos["locations"][m] not in (None, "s1")
+    )
+    print(f"  [proc_sigkill] pid {timing['pid']} SIGKILLed @tick "
+          f"{ticks // 2}: detected in {detect_s * 1000:.0f} ms, "
+          f"{recovered}/{p} matches journal-recovered, {orphans} orphans")
+    ok &= report("proc_sigkill", violations, chaos, extra={
+        "recovered": recovered,
+        "detect_seconds": detect_s,
+        "orphans": orphans,
+    })
+
+    # 2. SIGSTOP: a hang — watchdog escalation, then the same recovery.
+    # tick_sleep stretches real time so the (wall-clock) escalation
+    # deadlines can pass without the logical clock outrunning the
+    # peers' disconnect timeout.
+    def sigstop(i, ctx):
+        if i == ticks // 3:
+            os.kill(ctx["sup"].shards["s1"].pid, signal.SIGSTOP)
+
+    chaos = drive_proc_fleet(
+        ticks, matches_per_shard=p, seed=seed, backend="proc",
+        tuning=tuning, inject=sigstop, tick_sleep_s=0.02,
+    )
+    chaos["sup"].close()
+    reg = chaos["registry"]
+    violations = fleet_survivor_violations(chaos, control, survivors)
+    violations += fleet_recovery_violations(
+        chaos, affected, dead_shards=["s1"]
+    )
+    sigterms = int(reg.value("ggrs_fleet_proc_watchdog_total",
+                             shard="s1", stage="sigterm") or 0)
+    sigkills = int(reg.value("ggrs_fleet_proc_watchdog_total",
+                             shard="s1", stage="sigkill") or 0)
+    if not sigterms:
+        violations.append("watchdog never escalated to SIGTERM")
+    if not sigkills:
+        violations.append("watchdog never escalated to SIGKILL")
+    orphans = chaos["sup"].shards["s1"].orphan_count()
+    if orphans:
+        violations.append(f"{orphans} orphan runner processes")
+    print(f"  [proc_sigstop] hang @tick {ticks // 3}: escalation "
+          f"sigterm={sigterms} sigkill={sigkills}, "
+          f"{sum(1 for m in affected if chaos['locations'][m] == 's0')}"
+          f"/{p} matches recovered")
+    ok &= report("proc_sigstop", violations, chaos, extra={
+        "sigterms": sigterms, "sigkills": sigkills, "orphans": orphans,
+    })
+
+    # 3. restart storm: kill the same shard 5x fast; the backoff
+    # restart policy must respawn at most restart_max times, then stay
+    # dead — a crash loop must not melt the host
+    storm_tuning = FleetTuning(
+        heartbeat_interval_s=0.05, heartbeat_deadline_s=0.5,
+        rpc_timeout_s=0.75, drain_deadline_s=0.3,
+        spawn_timeout_s=120.0,
+        restart_backoff_s=0.05, restart_max=2, restart_window_s=60.0,
+    )
+    kills = {"n": 0}
+
+    def storm(i, ctx):
+        s1 = ctx["sup"].shards["s1"]
+        if i >= ticks // 3 and kills["n"] < 5 and s1.pid and s1._alive():
+            kills["n"] += 1
+            os.kill(s1.pid, signal.SIGKILL)
+
+    chaos = drive_proc_fleet(
+        max(ticks, 240), matches_per_shard=min(p, 4), seed=seed,
+        backend="proc", tuning=storm_tuning, inject=storm,
+        tick_sleep_s=0.01,
+    )
+    chaos["sup"].close()
+    s1 = chaos["sup"].shards["s1"]
+    storm_affected = [
+        m for m in chaos["match_ids"]
+        if m not in [f"m{k}" for k in range(min(p, 4))]
+    ]
+    violations = fleet_recovery_violations(
+        chaos, storm_affected, dead_shards=["s1"]
+    )
+    if s1.restarts != storm_tuning.restart_max:
+        violations.append(
+            f"{s1.restarts} restarts != storm budget "
+            f"{storm_tuning.restart_max}"
+        )
+    if s1.state != SHARD_DEAD:
+        violations.append(f"stormed shard is {s1.state}, not dead")
+    orphans = s1.orphan_count()
+    if orphans:
+        violations.append(f"{orphans} orphan runner processes")
+    print(f"  [proc_restart_storm] {kills['n']} kills: {s1.restarts} "
+          f"restarts (budget {storm_tuning.restart_max}), final state "
+          f"{s1.state}, {orphans} orphans")
+    ok &= report("proc_restart_storm", violations, chaos, extra={
+        "kills": kills["n"], "tuning": storm_tuning.as_dict(),
+        "orphans": orphans,
+    })
+    if ok:
+        print(f"  OK: {p}-per-shard subprocess fleet survived SIGKILL, "
+              "SIGSTOP escalation, and a restart storm")
+    return ok
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--matches", type=int, default=4,
@@ -656,7 +890,7 @@ def main() -> int:
     ap.add_argument("--ticks", type=int, default=300)
     ap.add_argument("--seed", type=int, default=3)
     ap.add_argument("--fault", choices=[*FAULTS, "spectator", "socket",
-                                        "shard", "all"],
+                                        "shard", "proc", "all"],
                     default="all")
     ap.add_argument("--fleet-matches", type=int, default=32, metavar="B",
                     help="matches per shard for --fault shard (default 32; "
@@ -667,12 +901,18 @@ def main() -> int:
     args = ap.parse_args()
 
     names = (
-        [*FAULTS, "spectator", "socket", "shard"] if args.fault == "all"
+        [*FAULTS, "spectator", "socket", "shard", "proc"]
+        if args.fault == "all"
         else [args.fault]
     )
     ok = True
     for name in names:
-        if name == "spectator":
+        if name == "proc":
+            ok &= verify_proc_leg(
+                args.fleet_matches, args.ticks, args.seed,
+                artifact_dir=args.artifact_dir,
+            )
+        elif name == "spectator":
             ok &= verify_broadcast_leg(
                 min(args.matches, 2), args.ticks, args.seed,
                 artifact_dir=args.artifact_dir,

@@ -1,0 +1,28 @@
+#!/usr/bin/env python
+"""Fleet shard runner: one ``PoolShard`` serving loop as a real OS
+process (DESIGN.md §17).
+
+Spawned by ``ShardSupervisor`` (socketpair fd handed down via ``--fd``)
+or started standalone for the supervisor to ADOPT over a UNIX socket:
+
+  python scripts/shard_runner.py --uds /run/ggrs/shard0.sock
+
+The process speaks the length-prefixed, crc32-checked frame protocol of
+``ggrs_tpu.fleet.rpc``; everything else (hello/tick/admit/adopt/evict
+ops, heartbeats, the SIGTERM graceful drain that leaves journals durable
+before the final GOODBYE) lives in ``ggrs_tpu.fleet.proc.ShardRunner``
+so the loop is importable and testable in-process too.
+
+Exit code 0 = drained (signal or supervisor-requested shutdown);
+1 = the supervisor vanished or the control stream was poisoned.
+"""
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from ggrs_tpu.fleet.proc import runner_main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(runner_main())

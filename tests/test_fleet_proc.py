@@ -1,0 +1,373 @@
+"""Out-of-process shard tests (DESIGN.md §17): the subprocess shard
+runner, supervisor RPC with heartbeats and watchdogs, and SIGKILL-grade
+chaos.
+
+The acceptance pins, mirrored by ``scripts/chaos.py --fault proc``:
+
+* SIGKILL of a shard subprocess is detected within the heartbeat
+  deadline; every match re-adopts from its durable journal onto the
+  survivors; the surviving shard's peer-observed wire bytes are
+  bit-identical to a fault-free control; zero orphan processes or
+  leaked fds remain in the supervisor.
+* SIGSTOP (a hang, not a death) escalates SIGTERM → drain deadline →
+  SIGKILL before any failover — wedged ≠ dead, and a wedged process
+  must be fenced off the wire before its matches are re-adopted.
+* The in-process and subprocess backends pass the SAME fleet matrix
+  behind one supervisor interface (parametrized here), and a
+  process-backed run is bit-identical to the identical in-process
+  topology under the same seeded traffic (the parity pin).
+* SIGTERM runs a graceful drain: journals closed durable, a final
+  GOODBYE, exit code 0.
+* After a death the shard respawns under the jittered-backoff restart
+  policy, bounded by the restart-storm budget.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+
+import pytest
+
+from ggrs_tpu.broadcast.journal import read_journal
+from ggrs_tpu.chaos import (
+    drive_proc_fleet,
+    fleet_recovery_violations,
+    fleet_survivor_violations,
+)
+from ggrs_tpu.fleet import FleetTuning, ProcShard, SHARD_DEAD
+from ggrs_tpu.net import _native
+from ggrs_tpu.obs import Registry
+
+needs_native = pytest.mark.skipif(
+    _native.bank_lib() is None, reason="native session bank unavailable"
+)
+
+TICKS = 48
+PER_SHARD = 2
+SURVIVORS = [f"m{k}" for k in range(PER_SHARD)]              # on s0
+AFFECTED = [f"m{k}" for k in range(PER_SHARD, 2 * PER_SHARD)]  # on s1
+
+# fast deadlines so the watchdog scenarios run in test time; restarts
+# off by default (the restart test opts back in)
+TUNING = FleetTuning(
+    heartbeat_interval_s=0.05,
+    heartbeat_deadline_s=1.0,
+    rpc_timeout_s=5.0,
+    spawn_timeout_s=120.0,
+    drain_deadline_s=0.5,
+    restart_max=0,
+)
+
+
+@pytest.fixture(scope="module")
+def control_inproc():
+    ctx = drive_proc_fleet(TICKS, matches_per_shard=PER_SHARD, seed=7,
+                           backend="inproc", tuning=TUNING)
+    yield ctx
+    ctx["sup"].close()
+
+
+@pytest.fixture(scope="module")
+def control_proc():
+    ctx = drive_proc_fleet(TICKS, matches_per_shard=PER_SHARD, seed=7,
+                           backend="proc", tuning=TUNING)
+    yield ctx
+    ctx["sup"].close()
+
+
+# ----------------------------------------------------------------------
+# backend parity: one topology, two backends, identical bytes
+# ----------------------------------------------------------------------
+
+
+@needs_native
+class TestBackendParity:
+    def test_wire_and_state_bit_identical(self, control_inproc,
+                                          control_proc):
+        """The same seeded traffic through a subprocess shard and the
+        identical in-process topology: every peer's RECEIVED datagram
+        byte sequence, final frame, and game state agree exactly."""
+        for mid in control_proc["match_ids"]:
+            assert (
+                control_proc["wire"][mid] == control_inproc["wire"][mid]
+            ), f"{mid}: peer-received wire diverged across backends"
+            assert (
+                control_proc["peer_states"][mid]
+                == control_inproc["peer_states"][mid]
+            )
+            assert (
+                control_proc["frames"][mid] == control_inproc["frames"][mid]
+            )
+        assert not control_proc["lost"] and not control_inproc["lost"]
+
+    def test_journal_streams_bit_identical(self, control_inproc,
+                                           control_proc):
+        """The durable artifact agrees too: the confirmed-input stream a
+        runner journals (in its own process, at supervisor-composed
+        paths) matches the in-process leg's record for record."""
+        for mid in AFFECTED:  # the matches that lived on the s1 backend
+            a = read_journal(
+                os.path.join(control_inproc["journal_dir"],
+                             f"{mid}.000.ggjl"))
+            b = read_journal(
+                os.path.join(control_proc["journal_dir"],
+                             f"{mid}.000.ggjl"))
+            assert a["frames"] == b["frames"]
+            assert len(b["frames"]) > 0
+
+    def test_healthz_reports_proc_backend(self, control_proc):
+        h = control_proc["healthz"]["shards"]["s1"]
+        assert h["backend"] == "proc" and h["ok"] and h["pid"]
+        assert h["heartbeat_age_s"] < TUNING.heartbeat_deadline_s
+
+
+# ----------------------------------------------------------------------
+# the same fleet matrix behind one interface (mixed backends)
+# ----------------------------------------------------------------------
+
+
+@needs_native
+class TestKillFailoverMatrix:
+    @pytest.mark.parametrize("backend", ["inproc", "proc"])
+    def test_kill_s1_fails_over_identically(self, backend, control_inproc,
+                                            control_proc, request):
+        """``sup.kill('s1')`` — a chaos switch in-process, a REAL
+        SIGKILL out-of-process — recovers every affected match from its
+        journal onto the survivor, with the surviving shard
+        bit-identical to control, under EITHER backend."""
+        control = (control_inproc if backend == "inproc"
+                   else control_proc)
+
+        def inject(i, ctx):
+            if i == TICKS // 2:
+                ctx["sup"].kill("s1")
+
+        chaos = drive_proc_fleet(
+            TICKS, matches_per_shard=PER_SHARD, seed=7, backend=backend,
+            tuning=TUNING, inject=inject,
+        )
+        try:
+            assert not fleet_survivor_violations(chaos, control, SURVIVORS)
+            assert not fleet_recovery_violations(
+                chaos, AFFECTED, dead_shards=["s1"]
+            )
+            for mid in AFFECTED:
+                assert chaos["locations"][mid] == "s0"
+            sup = chaos["sup"]
+            assert sup.shards["s1"].healthz()["state"] == SHARD_DEAD
+            assert chaos["registry"].value(
+                "ggrs_fleet_migrations_total", reason="failover"
+            ) == len(AFFECTED)
+        finally:
+            chaos["sup"].close()
+        if backend == "proc":
+            assert chaos["sup"].shards["s1"].orphan_count() == 0
+
+
+# ----------------------------------------------------------------------
+# watchdog: SIGSTOP is a hang, not a death
+# ----------------------------------------------------------------------
+
+
+@needs_native
+class TestHangWatchdog:
+    def test_sigstop_escalates_sigterm_then_sigkill_then_recovers(self):
+        """A SIGSTOPped runner answers nothing but is NOT dead: the
+        watchdog must escalate (SIGTERM is undeliverable to a stopped
+        process, so the drain deadline expires into SIGKILL) and only
+        then fail the matches over — never while the process breathes."""
+        t = FleetTuning(
+            heartbeat_interval_s=0.05, heartbeat_deadline_s=0.4,
+            rpc_timeout_s=0.3, drain_deadline_s=0.3,
+            spawn_timeout_s=120.0, restart_max=0,
+        )
+
+        def inject(i, ctx):
+            if i == 20:
+                os.kill(ctx["sup"].shards["s1"].pid, signal.SIGSTOP)
+
+        chaos = drive_proc_fleet(
+            90, matches_per_shard=1, seed=11, backend="proc", tuning=t,
+            inject=inject, tick_sleep_s=0.01,
+        )
+        try:
+            reg = chaos["registry"]
+            assert reg.value("ggrs_fleet_proc_watchdog_total",
+                             shard="s1", stage="sigterm") >= 1
+            assert reg.value("ggrs_fleet_proc_watchdog_total",
+                             shard="s1", stage="sigkill") >= 1
+            assert not fleet_recovery_violations(
+                chaos, ["m1"], dead_shards=["s1"]
+            )
+            assert chaos["locations"]["m1"] == "s0"
+            assert chaos["sup"].shards["s1"].state == SHARD_DEAD
+        finally:
+            chaos["sup"].close()
+        assert chaos["sup"].shards["s1"].orphan_count() == 0
+
+
+# ----------------------------------------------------------------------
+# graceful drain + leak checks
+# ----------------------------------------------------------------------
+
+
+def _count_fds() -> int:
+    return len(os.listdir("/proc/self/fd"))
+
+
+class TestRunnerLifecycle:
+    def test_sigterm_runs_graceful_drain(self, tmp_path):
+        """SIGTERM → admission off, journals flushed+fsynced+CLOSED, a
+        final GOODBYE, exit code 0 — the journal is readable as a clean
+        artifact afterwards."""
+        import functools
+
+        from ggrs_tpu.fleet.proc import (
+            proc_match_builder,
+            udp_socket_factory,
+        )
+
+        shard = ProcShard("g0", capacity=2, metrics=Registry(),
+                          tuning=TUNING, clock=lambda: clock[0])
+        clock = [0]
+        try:
+            from ggrs_tpu.chaos import CrcGame, two_peer_builder
+            from ggrs_tpu.net.sockets import UdpNonBlockingSocket
+
+            peer_sock = UdpNonBlockingSocket(0)
+            path = tmp_path / "g0.m0.ggjl"
+            shard.admit_spec(
+                "m0",
+                functools.partial(
+                    proc_match_builder, 301, 0,
+                    ("127.0.0.1", peer_sock.local_port()),
+                ),
+                functools.partial(udp_socket_factory, 0),
+                CrcGame,
+                journal_spec=dict(path=str(path), num_players=2,
+                                  input_size=2, fsync_every=1,
+                                  tail_window=32),
+            )
+            peer = two_peer_builder(
+                clock, 302, 1, ("127.0.0.1", shard.match_port("m0")),
+                other_handle=0,
+            ).start_p2p_session(peer_sock)
+            game = CrcGame()
+            from ggrs_tpu.core.errors import (
+                NotSynchronized,
+                PredictionThreshold,
+            )
+
+            for i in range(30):
+                clock[0] += 16
+                try:
+                    peer.add_local_input(1, i % 7)
+                    game.fulfill(peer.advance_frame())
+                except (NotSynchronized, PredictionThreshold):
+                    pass
+                shard.add_local_input("m0", 0, i % 5)
+                shard.advance_all()
+            assert shard.current_frame("m0") > 10
+            conn = shard._conn
+            os.kill(shard.pid, signal.SIGTERM)
+            shard._proc.wait(timeout=30)
+            assert shard._proc.returncode == 0
+            # the drain left a GOODBYE and a CLEANLY CLOSED journal
+            for _ in range(50):
+                if shard.poll_lifecycle() is not None or conn.goodbye:
+                    break
+                time.sleep(0.01)
+            assert conn.goodbye is not None
+            assert conn.goodbye["reason"] == "sigterm"
+            assert conn.goodbye["frames"]["m0"] > 10
+            parsed = read_journal(path)
+            assert parsed["closed"] and not parsed["truncated"]
+            assert len(parsed["frames"]) > 0
+        finally:
+            shard.close()
+        assert shard.orphan_count() == 0
+
+    def test_sigkill_leaves_no_orphans_or_leaked_fds(self):
+        """SIGKILL-only death: the supervisor reaps the child (no
+        zombie) and closes its socket end (no fd growth) — measured on
+        an isolated shard so the accounting is exact."""
+        fd_base = _count_fds()
+        shard = ProcShard("leak0", capacity=2, metrics=Registry(),
+                          tuning=TUNING)
+        pid = shard.pid
+        assert _count_fds() > fd_base  # the live conn holds an fd
+        os.kill(pid, signal.SIGKILL)
+        died = None
+        for _ in range(200):
+            died = shard.poll_lifecycle()
+            if died == "died":
+                break
+            time.sleep(0.01)
+        assert died == "died"
+        shard.close()
+        assert _count_fds() == fd_base
+        assert shard.orphan_count() == 0
+        assert shard.last_exit == "exit code -9"
+        # reaped for real: the pid is no longer our child
+        with pytest.raises(ChildProcessError):
+            os.waitpid(pid, os.WNOHANG)
+
+    def test_shutdown_rpc_closes_cleanly(self):
+        shard = ProcShard("c0", capacity=2, metrics=Registry(),
+                          tuning=TUNING)
+        shard.close()
+        assert shard.last_exit == "exit code 0"
+        assert shard.orphan_count() == 0
+        # idempotent
+        shard.close()
+
+
+# ----------------------------------------------------------------------
+# restart policy: jittered backoff + storm budget
+# ----------------------------------------------------------------------
+
+
+@needs_native
+class TestRestartPolicy:
+    def test_restart_after_crash_then_storm_budget(self):
+        """A killed shard respawns (capacity returns for new
+        admissions); killing it repeatedly exhausts the storm budget and
+        it stays dead — with every match still recovered and no
+        orphans."""
+        t = FleetTuning(
+            heartbeat_interval_s=0.05, heartbeat_deadline_s=0.5,
+            rpc_timeout_s=2.0, drain_deadline_s=0.3,
+            spawn_timeout_s=120.0,
+            restart_backoff_s=0.05, restart_max=2, restart_window_s=60.0,
+        )
+        kills = {"n": 0}
+
+        def inject(i, ctx):
+            s1 = ctx["sup"].shards["s1"]
+            if i >= 20 and kills["n"] < 5 and s1.pid and s1._alive():
+                kills["n"] += 1
+                os.kill(s1.pid, signal.SIGKILL)
+
+        chaos = drive_proc_fleet(
+            240, matches_per_shard=1, seed=3, backend="proc", tuning=t,
+            inject=inject, tick_sleep_s=0.01,
+        )
+        sup = chaos["sup"]
+        try:
+            s1 = sup.shards["s1"]
+            assert kills["n"] >= 3  # the storm actually stormed
+            assert s1.restarts == 2  # budget: exactly restart_max
+            assert s1.state == SHARD_DEAD  # then it STAYS dead
+            assert chaos["registry"].value(
+                "ggrs_fleet_proc_restarts_total", shard="s1"
+            ) == 2
+            assert not chaos["lost"]
+            assert chaos["locations"]["m1"] == "s0"
+            assert not fleet_recovery_violations(
+                chaos, ["m1"], dead_shards=["s1"]
+            )
+        finally:
+            sup.close()
+        assert sup.shards["s1"].orphan_count() == 0

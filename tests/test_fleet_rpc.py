@@ -1,0 +1,524 @@
+"""Fleet transport + tuning + journal-hardening tests (DESIGN.md §17).
+
+Adversarial coverage of the supervisor↔runner frame protocol: truncated
+frames, bad crc, wrong version tag, max-size violations, and interleaved
+partial reads each yield a TYPED error (never a wedged parser), and a
+poisoned stream refuses further traffic instead of resyncing into
+garbage.  Plus the ``FleetTuning`` consolidation satellite (env
+overrides, artifact round trip) and the journal write-failure hardening
+satellite (ENOSPC/EIO degrade the shard loudly; the
+torn-final-record-then-reopen path recovers the intact prefix).
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+import socket
+import struct
+import threading
+import time
+import zlib
+
+import pytest
+
+from ggrs_tpu.broadcast.journal import (
+    MatchJournal,
+    read_journal,
+    resume_from_file,
+)
+from ggrs_tpu.chaos import CrcGame, InMemoryNetwork, two_peer_builder
+from ggrs_tpu.core.errors import NotSynchronized, PredictionThreshold
+from ggrs_tpu.fleet import FleetTuning, PoolShard, ShardSupervisor
+from ggrs_tpu.fleet.rpc import (
+    DEFAULT_MAX_FRAME,
+    FrameError,
+    HEADER_SIZE,
+    KIND_CALL,
+    KIND_ERR,
+    KIND_HEARTBEAT,
+    KIND_REPLY,
+    MAGIC,
+    RpcClosed,
+    RpcConn,
+    RpcRemoteError,
+    RpcTimeout,
+    VERSION,
+    encode_frame,
+)
+from ggrs_tpu.obs import Registry
+
+
+def _pair(**kw):
+    a, b = socket.socketpair()
+    return RpcConn(a, **kw), RpcConn(b, **kw)
+
+
+# ----------------------------------------------------------------------
+# frame protocol: the happy path
+# ----------------------------------------------------------------------
+
+
+class TestFrameRoundTrip:
+    def test_objects_round_trip(self):
+        a, b = _pair()
+        try:
+            for kind, obj in (
+                (KIND_CALL, dict(op="tick", inputs=[("m0", 0, 7)])),
+                (KIND_REPLY, dict(frames={"m0": 31}, blob=b"\x00" * 4096)),
+                (KIND_HEARTBEAT, dict(ticks=12)),
+            ):
+                a.send(kind, obj)
+                got_kind, got = b.recv(timeout=5)
+                assert got_kind == kind and got == obj
+        finally:
+            a.close(), b.close()
+
+    def test_call_skips_interleaved_heartbeats(self):
+        a, b = _pair()
+        try:
+            def runner():
+                kind, msg = b.recv(timeout=5)
+                assert kind == KIND_CALL and msg["op"] == "ping"
+                b.send(KIND_HEARTBEAT, dict(ticks=1))
+                b.send(KIND_HEARTBEAT, dict(ticks=2))
+                b.send(KIND_REPLY, dict(pong=True))
+
+            t = threading.Thread(target=runner)
+            t.start()
+            before = a.last_frame_at
+            assert a.call("ping", timeout=5) == dict(pong=True)
+            t.join()
+            assert a.last_frame_at >= before  # heartbeats refreshed it
+        finally:
+            a.close(), b.close()
+
+    def test_remote_error_frame(self):
+        a, b = _pair()
+        try:
+            def runner():
+                b.recv(timeout=5)
+                b.send(KIND_ERR, dict(type="InvalidRequest",
+                                      msg="nope", traceback="tb"))
+
+            t = threading.Thread(target=runner)
+            t.start()
+            with pytest.raises(RpcRemoteError) as exc:
+                a.call("admit", timeout=5)
+            t.join()
+            assert exc.value.type_name == "InvalidRequest"
+        finally:
+            a.close(), b.close()
+
+    def test_interleaved_partial_reads_on_slow_socket(self):
+        """Frames dribbled a few bytes at a time (slow peer, fragmented
+        stream) parse intact — the buffer survives arbitrary chunking."""
+        a, b = _pair()
+        try:
+            payload = dict(blob=bytes(range(256)) * 64, n=7)
+            frame = encode_frame(
+                KIND_REPLY,
+                __import__("pickle").dumps(payload),
+            )
+            raw = a._sock  # write raw bytes, bypassing send()
+
+            def dribble():
+                for i in range(0, len(frame), 7):
+                    raw.sendall(frame[i : i + 7])
+                    time.sleep(0.001)
+
+            t = threading.Thread(target=dribble)
+            t.start()
+            kind, got = b.recv(timeout=10)
+            t.join()
+            assert kind == KIND_REPLY and got == payload
+        finally:
+            a.close(), b.close()
+
+    def test_recv_timeout_is_typed(self):
+        a, b = _pair()
+        try:
+            with pytest.raises(RpcTimeout):
+                b.recv(timeout=0.05)
+            # ... and the connection is still usable afterwards
+            a.send(KIND_HEARTBEAT, dict(ok=1))
+            assert b.recv(timeout=5)[1] == dict(ok=1)
+        finally:
+            a.close(), b.close()
+
+
+# ----------------------------------------------------------------------
+# frame protocol: adversarial
+# ----------------------------------------------------------------------
+
+
+def _raw_frame(payload: bytes, *, magic=MAGIC, version=VERSION,
+               kind=KIND_REPLY, plen=None, crc=None) -> bytes:
+    head = struct.pack("<2sBBI", magic, version, kind,
+                       len(payload) if plen is None else plen)
+    if crc is None:
+        crc = zlib.crc32(payload, zlib.crc32(head)) & 0xFFFFFFFF
+    return head + struct.pack("<I", crc) + payload
+
+
+class TestFrameAdversarial:
+    def _recv_raw(self, raw: bytes):
+        a, b = _pair()
+        try:
+            a._sock.sendall(raw)
+            return b.recv(timeout=5)
+        finally:
+            a.close(), b.close()
+
+    def test_truncated_frame_then_eof_is_closed(self):
+        """A peer dying mid-frame yields RpcClosed naming the torn tail,
+        never a hang or a bare parse exception."""
+        import pickle
+
+        frame = encode_frame(KIND_REPLY, pickle.dumps({"x": 1}))
+        a, b = _pair()
+        try:
+            a._sock.sendall(frame[: HEADER_SIZE + 3])
+            a._sock.close()
+            with pytest.raises(RpcClosed, match="mid-frame"):
+                b.recv(timeout=5)
+        finally:
+            a.close(), b.close()
+
+    def test_bad_crc(self):
+        import pickle
+
+        payload = pickle.dumps({"x": 1})
+        frame = bytearray(_raw_frame(payload))
+        frame[-1] ^= 0x40  # flip a payload byte under an intact crc
+        with pytest.raises(FrameError, match="crc"):
+            self._recv_raw(bytes(frame))
+
+    def test_wrong_version_tag(self):
+        with pytest.raises(FrameError, match="version"):
+            self._recv_raw(_raw_frame(b"x", version=VERSION + 1))
+
+    def test_bad_magic(self):
+        with pytest.raises(FrameError, match="magic"):
+            self._recv_raw(_raw_frame(b"x", magic=b"ZZ"))
+
+    def test_unknown_kind(self):
+        with pytest.raises(FrameError, match="kind"):
+            self._recv_raw(_raw_frame(b"x", kind=99))
+
+    def test_undecodable_payload(self):
+        with pytest.raises(FrameError, match="undecodable"):
+            self._recv_raw(_raw_frame(b"\xff not a pickle \x00"))
+
+    def test_max_size_violation_on_receive(self):
+        """An adversarial length field must be rejected from the HEADER,
+        before any buffering toward OOM."""
+        a, b = _pair(max_frame=1024)
+        try:
+            a._sock.sendall(_raw_frame(b"x", plen=1 << 30))
+            with pytest.raises(FrameError, match="clamp"):
+                b.recv(timeout=5)
+        finally:
+            a.close(), b.close()
+
+    def test_max_size_violation_on_send(self):
+        a, b = _pair(max_frame=1024)
+        try:
+            with pytest.raises(FrameError, match="clamp"):
+                a.send(KIND_REPLY, dict(blob=b"\x00" * 4096))
+        finally:
+            a.close(), b.close()
+
+    def test_poisoned_stream_refuses_further_use(self):
+        """There is no resync for a corrupted length-prefixed stream:
+        after one FrameError every later recv/send refuses — the caller
+        must tear down and reconnect (contained, never wedged)."""
+        a, b = _pair()
+        try:
+            a._sock.sendall(_raw_frame(b"x", magic=b"ZZ"))
+            with pytest.raises(FrameError):
+                b.recv(timeout=5)
+            with pytest.raises(FrameError, match="poisoned"):
+                b.recv(timeout=5)
+            with pytest.raises(FrameError, match="poisoned"):
+                b.send(KIND_HEARTBEAT, {})
+            assert b.poll_frames() == []
+        finally:
+            a.close(), b.close()
+
+    def test_default_clamp_matches_tuning_default(self):
+        assert DEFAULT_MAX_FRAME == FleetTuning().max_frame_bytes
+
+
+# ----------------------------------------------------------------------
+# FleetTuning: one dataclass for every knob
+# ----------------------------------------------------------------------
+
+
+class TestFleetTuning:
+    def test_defaults_mirror_module_constants(self):
+        from ggrs_tpu.fleet.supervisor import (
+            READMIT_BACKOFF_TICKS,
+            READMIT_MAX_ATTEMPTS,
+        )
+        from ggrs_tpu.parallel.host_bank import EVICT_MAX_PER_TICK
+
+        t = FleetTuning()
+        assert t.readmit_backoff_ticks == READMIT_BACKOFF_TICKS
+        assert t.readmit_max_attempts == READMIT_MAX_ATTEMPTS
+        assert t.evict_max_per_tick == EVICT_MAX_PER_TICK
+
+    def test_env_overrides(self):
+        t = FleetTuning.from_env({
+            "GGRS_FLEET_HEARTBEAT_DEADLINE_S": "7.5",
+            "GGRS_FLEET_RESTART_MAX": "9",
+            "GGRS_FLEET_MAX_FRAME_BYTES": "1048576",
+            "UNRELATED": "ignored",
+        })
+        assert t.heartbeat_deadline_s == 7.5
+        assert t.restart_max == 9
+        assert t.max_frame_bytes == 1 << 20
+        # kwargs beat env
+        t2 = FleetTuning.from_env(
+            {"GGRS_FLEET_RESTART_MAX": "9"}, restart_max=2
+        )
+        assert t2.restart_max == 2
+
+    def test_malformed_env_value_is_loud(self):
+        with pytest.raises(ValueError, match="GGRS_FLEET_RESTART_MAX"):
+            FleetTuning.from_env({"GGRS_FLEET_RESTART_MAX": "many"})
+
+    def test_negative_values_rejected(self):
+        with pytest.raises(ValueError, match="rpc_timeout_s"):
+            FleetTuning(rpc_timeout_s=-1)
+
+    def test_artifact_json_round_trip(self):
+        """Chaos artifacts record the knobs a run ran with; the dict must
+        survive JSON and rebuild an equal FleetTuning."""
+        t = FleetTuning(heartbeat_interval_s=0.125, restart_max=5)
+        assert FleetTuning.from_dict(json.loads(json.dumps(t.as_dict()))) == t
+
+    def test_supervisor_uses_its_tuning(self, tmp_path):
+        """The readmission backoff now flows from the instance's tuning,
+        not the module constants."""
+        t = FleetTuning(readmit_backoff_ticks=2, readmit_max_attempts=1)
+        sup = ShardSupervisor(("a",), capacity=0, seed=5, tuning=t,
+                              metrics=Registry())
+        clock = [0]
+        bf, sf, _, _ = _mk_match(clock, 41, "m0")
+        assert sup.admit("m0", bf, sf) is None
+        for _ in range(16):
+            sup.advance_all()
+            if sup.lost_matches():
+                break
+        assert "m0" in sup.lost_matches()
+
+    def test_evict_clamp_flows_into_the_pool(self):
+        t = FleetTuning(evict_max_per_tick=1)
+        shard = PoolShard("x", capacity=2, metrics=Registry(), tuning=t)
+        assert shard.pool._evict_max_per_tick == 1
+
+
+# ----------------------------------------------------------------------
+# journal write-failure hardening
+# ----------------------------------------------------------------------
+
+
+def _write_frames(journal, start, count, isize=2, players=2):
+    recs = []
+    for f in range(start, start + count):
+        blob = b"".join(
+            (f * 10 + p).to_bytes(isize, "little") for p in range(players)
+        )
+        recs.append((bytes(players), blob))
+    journal.append_frames(start, recs)
+
+
+def _mk_match(clock, seed, name):
+    """One fleet-admittable 2-peer match against an external peer."""
+    from ggrs_tpu.chaos import RecordingSocket
+
+    net = InMemoryNetwork(latency_ticks=1, seed=seed)
+    host_sock = RecordingSocket(net.socket(f"H-{name}"))
+    bf = lambda: two_peer_builder(clock, seed, 0, f"P-{name}")  # noqa: E731
+    peer = two_peer_builder(
+        clock, seed + 1, 1, f"H-{name}", other_handle=0
+    ).start_p2p_session(net.socket(f"P-{name}"))
+    return bf, (lambda: host_sock), peer, net
+
+
+class TestJournalWriteFailure:
+    def test_enospc_on_append_degrades_loudly_and_stops_writing(
+        self, tmp_path
+    ):
+        reg = Registry()
+        j = MatchJournal(tmp_path / "j.ggjl", 2, 2, metrics=reg)
+        _write_frames(j, 0, 8)
+        j.flush(fsync=True)
+        size_before = (tmp_path / "j.ggjl").stat().st_size
+
+        def fault(stage):
+            if stage == "write":
+                raise OSError(errno.ENOSPC, "no space left on device")
+
+        j._inject_fault = fault
+        _write_frames(j, 8, 4)
+        assert j.failed is not None and "append" in j.failed
+        assert reg.value("ggrs_journal_write_failures_total") == 1
+        # degraded, not dead: further appends drop silently, exactly once
+        # counted, and the file keeps its intact prefix
+        j._inject_fault = None
+        _write_frames(j, 12, 4)
+        assert reg.value("ggrs_journal_write_failures_total") == 1
+        assert (tmp_path / "j.ggjl").stat().st_size == size_before
+        j.close()  # must not raise
+        parsed = read_journal(tmp_path / "j.ggjl")
+        assert [f for f, _, _ in parsed["frames"]] == list(range(8))
+
+    def test_eio_on_fsync_degrades(self, tmp_path):
+        j = MatchJournal(tmp_path / "f.ggjl", 2, 2, metrics=Registry())
+        _write_frames(j, 0, 4)
+
+        def fault(stage):
+            if stage == "fsync":
+                raise OSError(errno.EIO, "I/O error")
+
+        j._inject_fault = fault
+        j.flush(fsync=True)
+        assert j.failed is not None and "fsync" in j.failed
+        j.close()
+
+    def test_torn_final_record_then_reopen(self, tmp_path):
+        """The acceptance path: a write failure tears the final record
+        mid-bytes; readers recover exactly the intact prefix, resume
+        works from it, and a NEW incarnation reopens at a fresh path."""
+        path = tmp_path / "torn.ggjl"
+        j = MatchJournal(path, 2, 2, tail_window=64)
+        _write_frames(j, 0, 8)
+        j.append_checkpoint(4, {"s": 4})
+        j.flush(fsync=True)
+        real_write = j._f.write
+
+        def torn_write(data):
+            real_write(data[:3])  # a few bytes land, then the disk dies
+            raise OSError(errno.ENOSPC, "no space left on device")
+
+        j._f.write = torn_write
+        _write_frames(j, 8, 1)
+        assert j.failed is not None
+        j._f.write = real_write
+        j.close()
+        parsed = read_journal(path)
+        assert parsed["truncated"]
+        assert [f for f, _, _ in parsed["frames"]] == list(range(8))
+        res = resume_from_file(path, local_handles=[0],
+                               endpoints=[([1], True)])
+        assert res["durable_tip"] == 7
+        assert res["checkpoint"][0] == 4
+        # the reopen: a fresh incarnation at a fresh path serves on
+        j2 = MatchJournal(tmp_path / "torn.001.ggjl", 2, 2, tail_window=64)
+        _write_frames(j2, 0, 4)
+        j2.close()
+        assert not read_journal(tmp_path / "torn.001.ggjl")["truncated"]
+
+    def test_in_memory_tail_keeps_tracking_after_disk_failure(
+        self, tmp_path
+    ):
+        """Live eviction recovery reads the in-memory tail, which needs
+        no disk: a degraded journal keeps the tail current even though
+        the file froze."""
+        j = MatchJournal(tmp_path / "t.ggjl", 2, 2, tail_window=8)
+        _write_frames(j, 0, 4)
+        j._inject_fault = lambda stage: (_ for _ in ()).throw(
+            OSError(errno.ENOSPC, "full")
+        )
+        _write_frames(j, 4, 4)
+        assert j.failed is not None
+        assert [f for f, _, _ in j.tail] == list(range(8))
+        assert j.next_frame == 8
+
+    def test_shard_degrades_loudly_and_keeps_serving(self, tmp_path):
+        """A shard whose match journal fails keeps the match ALIVE
+        (degraded) — fault counter + health flag, never a dropped tick."""
+        clock = [0]
+        reg = Registry()
+        shard = PoolShard("x", capacity=4, metrics=reg, checkpoint_every=4)
+        bf, sf, peer, net = _mk_match(clock, 71, "m0")
+        journal = MatchJournal(tmp_path / "m0.ggjl", 2, 2, metrics=reg)
+        shard.admit("m0", bf(), sf(), journal=journal)
+        game, peer_game = CrcGame(), CrcGame()
+
+        def drive(n):
+            for i in range(n):
+                clock[0] += 16
+                try:
+                    peer.add_local_input(1, i % 7)
+                    peer_game.fulfill(peer.advance_frame())
+                except (NotSynchronized, PredictionThreshold):
+                    pass
+                shard.add_local_input("m0", 0, i % 5)
+                game.fulfill(shard.advance_all().get("m0", []))
+                net.tick()
+
+        drive(16)
+        assert shard.journal_failed_matches() == []
+        journal._inject_fault = lambda stage: (_ for _ in ()).throw(
+            OSError(errno.ENOSPC, "full")
+        )
+        before = shard.current_frame("m0")
+        drive(16)
+        assert shard.journal_failed_matches() == ["m0"]
+        assert shard.healthz()["journal_failed"] == 1
+        assert shard.healthz()["ok"] is True  # degraded, not dead
+        assert reg.value(
+            "ggrs_shard_journal_failures_total", shard="x"
+        ) == 1
+        assert shard.current_frame("m0") > before  # still serving
+
+    def test_supervisor_marks_match_journal_less_for_failover(
+        self, tmp_path
+    ):
+        """The fleet contract: after a journal write failure the match
+        serves on, but failover treats it as journal-less — resuming
+        from the stale durable tip would silently desync the peers, so
+        a later crash loses it LOUDLY instead."""
+        clock = [0]
+        reg = Registry()
+        sup = ShardSupervisor(("a", "b"), capacity=4, seed=2, metrics=reg,
+                              journal_dir=tmp_path, checkpoint_every=4)
+        bf, sf, peer, net = _mk_match(clock, 81, "m0")
+        sup.admit("m0", bf, sf, state_template=0, shard="a")
+        game, peer_game = CrcGame(), CrcGame()
+
+        def drive(n):
+            for i in range(n):
+                clock[0] += 16
+                try:
+                    peer.add_local_input(1, i % 7)
+                    peer_game.fulfill(peer.advance_frame())
+                except (NotSynchronized, PredictionThreshold):
+                    pass
+                sup.add_local_input("m0", 0, i % 5)
+                out = sup.advance_all()
+                if "m0" in out:
+                    game.fulfill(out["m0"])
+                net.tick()
+
+        drive(16)
+        journal = sup.shards["a"]._journals["m0"]
+        journal._inject_fault = lambda stage: (_ for _ in ()).throw(
+            OSError(errno.EIO, "I/O error")
+        )
+        drive(8)
+        record = sup._records["m0"]
+        assert record.journal_failed is True
+        assert reg.value("ggrs_fleet_journal_failures_total") == 1
+        # crash the shard: the journal-less match is lost loudly, with
+        # the write failure named — never a silent desync
+        sup.kill("a")
+        drive(2)
+        assert "m0" in sup.lost_matches()
+        assert "journal" in sup.lost_matches()["m0"]
+        # a migration would have re-incarnated the journal and cleared
+        # the flag — pinned by the _adopt_on reset
+        assert record.location is None
