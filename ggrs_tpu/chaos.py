@@ -623,6 +623,7 @@ def drive_fleet_chaos(
     desync_interval: int = 1,
     capacity: int = 64,
     metrics: Optional[Registry] = None,
+    tracer=None,
 ) -> Dict[str, Any]:
     """The fleet-scale chaos world (DESIGN.md §16): a two-shard
     ``ShardSupervisor`` serving ``2 * matches_per_shard`` journaled 2-peer
@@ -659,6 +660,7 @@ def drive_fleet_chaos(
         journal_dir=journal_dir, checkpoint_every=checkpoint_every,
         journal_tail_window=8 * checkpoint_every,
         identity_refresh_every=4, seed=base + 1,
+        tracer=tracer,
     )
     n = 2 * matches_per_shard
     match_ids = [f"m{k}" for k in range(n)]
@@ -678,9 +680,11 @@ def drive_fleet_chaos(
         host_socks[mid] = host_sock
 
         def builder_factory(k=k, mid=mid):
-            b = two_peer_builder(
-                clock, base + 3 + 7 * k, 0, f"P{k}"
-            ).with_desync_detection_mode(DesyncDetection.on(desync_interval))
+            b = two_peer_builder(clock, base + 3 + 7 * k, 0, f"P{k}")
+            if desync_interval:
+                b = b.with_desync_detection_mode(
+                    DesyncDetection.on(desync_interval)
+                )
             if mid == spectate_match:
                 for v, vname in enumerate(viewer_names):
                     b = b.add_player(Spectator(vname), 2 + v)
@@ -691,11 +695,14 @@ def drive_fleet_chaos(
             state_template=0,
             shard="s0" if k < matches_per_shard else "s1",
         )
-        peers[mid] = two_peer_builder(
+        pb = two_peer_builder(
             clock, base + 4 + 7 * k, 1, f"H{k}", other_handle=0
-        ).with_desync_detection_mode(
-            DesyncDetection.on(desync_interval)
-        ).start_p2p_session(net.socket(f"P{k}"))
+        )
+        if desync_interval:
+            pb = pb.with_desync_detection_mode(
+                DesyncDetection.on(desync_interval)
+            )
+        peers[mid] = pb.start_p2p_session(net.socket(f"P{k}"))
         games[mid] = CrcGame()
         peer_games[mid] = CrcGame()
     k_spec = match_ids.index(spectate_match) if n_spectators else None
@@ -786,6 +793,7 @@ def drive_proc_fleet(
     capacity: int = 64,
     tick_sleep_s: float = 0.0,
     metrics: Optional[Registry] = None,
+    tracer=None,
 ) -> Dict[str, Any]:
     """The out-of-process sibling of :func:`drive_fleet_chaos`
     (DESIGN.md §17): a two-shard ``ShardSupervisor`` where ``s0`` is
@@ -839,6 +847,7 @@ def drive_proc_fleet(
         proc_shards=("s1",) if backend == "proc" else (),
         proc_clock=lambda: clock[0],
         tuning=tuning,
+        tracer=tracer,
     )
     n = 2 * matches_per_shard
     match_ids = [f"m{k}" for k in range(n)]
@@ -885,12 +894,15 @@ def drive_proc_fleet(
                 state_template=0, game_factory=CrcGame, shard=pin,
             )
             assert sup.shards[pin].match_port(mid) == host_port
-            peers[mid] = two_peer_builder(
+            pb = two_peer_builder(
                 clock, base + 4 + 7 * k, 1, ("127.0.0.1", host_port),
                 other_handle=0,
-            ).with_desync_detection_mode(
-                DesyncDetection.on(desync_interval)
-            ).start_p2p_session(peer_sock)
+            )
+            if desync_interval:
+                pb = pb.with_desync_detection_mode(
+                    DesyncDetection.on(desync_interval)
+                )
+            peers[mid] = pb.start_p2p_session(peer_sock)
             games[mid] = CrcGame()
             peer_games[mid] = CrcGame()
 

@@ -57,7 +57,9 @@ from pathlib import Path
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..core.errors import InvalidRequest
-from ..obs.registry import Registry, default_registry
+from ..obs.fleet_obs import FleetObs, RegistryCollector
+from ..obs.registry import DEFAULT, Registry, default_registry
+from ..obs.trace import NULL_TRACER, Tracer
 from ..utils.tracing import get_logger
 from .rpc import (
     FrameError,
@@ -211,6 +213,13 @@ class ShardRunner:
         self.tuning = FleetTuning()
         self._games: Dict[str, Any] = {}
         self._exit_after_reply: Optional[str] = None
+        # fleet observability plane (DESIGN.md §18): armed by hello
+        self.tracer: Tracer = NULL_TRACER
+        self.collector: Optional[RegistryCollector] = None
+        self._spans_shipped = 0
+        # snapshots drained into a heartbeat whose send then failed:
+        # re-shipped (in seq order, bounded) ahead of the next fresh one
+        self._unsent_snaps: List[Dict[str, Any]] = []
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -245,13 +254,21 @@ class ShardRunner:
                 # select, not busy-spin); send only once serving
                 hb_next = now + self.tuning.heartbeat_interval_s
                 if self.shard is not None:
+                    # the harvest piggyback: metric deltas (and any
+                    # ferried forensics) ride the heartbeat too, so an
+                    # idle or rarely-ticked shard still exports (§18)
+                    payload = self._obs_payload(include_spans=False)
                     try:
                         self.conn.send(KIND_HEARTBEAT, dict(
                             ticks=self.shard.ticks,
                             matches=self.shard.live_matches(),
+                            obs=payload,
                         ), timeout=5.0)
                     except RpcTimeout:
-                        pass  # supervisor slow to drain; ticks prove life
+                        # supervisor slow to drain; ticks prove life —
+                        # but the drained payload is one-shot state:
+                        # requeue it for the next ship attempt
+                        self._requeue_obs(payload)
             wait = max(0.0, hb_next - now)
             r, _, _ = select.select([self.conn.fileno()], [], [], wait)
             if not r:
@@ -322,10 +339,15 @@ class ShardRunner:
         if cfg.get("tuning"):
             self.tuning = FleetTuning.from_dict(cfg["tuning"])
             self.conn.max_frame = self.tuning.max_frame_bytes
+        if cfg.get("trace"):
+            # the supervisor is tracing: arm a local ring whose spans
+            # ship back in tick replies (fleet trace correlation, §18)
+            self.tracer = Tracer(capacity=4096)
         self.shard = PoolShard(
             cfg["shard_id"],
             capacity=cfg.get("capacity", 64),
             metrics=Registry(),
+            tracer=self.tracer if self.tracer.enabled else None,
             checkpoint_every=cfg.get("checkpoint_every", 32),
             p99_budget_ms=cfg.get("p99_budget_ms"),
             stale_after_s=cfg.get("stale_after_s"),
@@ -333,12 +355,89 @@ class ShardRunner:
             retire_dead_matches=cfg.get("retire_dead_matches", False),
             tuning=self.tuning,
         )
+        if self.tuning.obs_harvest:
+            # the shard's private registry PLUS the process-wide DEFAULT
+            # (protocol drops, socket errors) — everything this child
+            # measures becomes harvestable
+            self.collector = RegistryCollector(
+                self.shard.metrics, DEFAULT, gen=os.getpid(),
+            )
         return dict(pid=os.getpid(), shard_id=self.shard.shard_id)
+
+    def _obs_payload(self, include_spans: bool,
+                     req_ns: Optional[int] = None
+                     ) -> Optional[Dict[str, Any]]:
+        """The piggybacked obs payload for one reply/heartbeat: metric
+        deltas, ferried forensics, new trace spans, and the runner's
+        clock samples for the offset estimate (``req_ns`` = request
+        receipt, ``now_ns`` = reply build — the NTP T2/T3 pair).  None
+        when the harvest is off or nothing happened — idle shards cost
+        nothing."""
+        if self.collector is None and not self.tracer.enabled:
+            return None
+        payload: Dict[str, Any] = {}
+        snaps = self._unsent_snaps
+        self._unsent_snaps = []
+        if self.collector is not None:
+            snap = self.collector.collect()
+            if snap is not None:
+                snaps = snaps + [snap]
+        if snaps:
+            payload["metrics"] = snaps[0] if len(snaps) == 1 else snaps
+        if self.shard is not None:
+            forensics = self.shard.drain_forensics()
+            if forensics:
+                payload["forensics"] = forensics
+        if include_spans and self.tracer.enabled:
+            spans = self._new_spans()
+            if spans:
+                payload["spans"] = spans
+        if not payload:
+            return None
+        if req_ns is not None:
+            payload["req_ns"] = req_ns
+        payload["now_ns"] = time.perf_counter_ns()
+        return payload
+
+    def _requeue_obs(self, payload: Optional[Dict[str, Any]]) -> None:
+        """A drained-but-unsent payload's one-shot pieces go back in the
+        queue: forensics to the shard's ferry buffer (its 32-item bound
+        still applies), metric snapshots to ``_unsent_snaps`` (bounded;
+        a dropped snapshot surfaces as a seq gap at the supervisor)."""
+        if not payload:
+            return
+        forensics = payload.get("forensics")
+        if forensics and self.shard is not None:
+            self.shard._forensic_items[:0] = forensics
+            del self.shard._forensic_items[:-32]
+        snaps = payload.get("metrics")
+        if snaps:
+            if not isinstance(snaps, list):
+                snaps = [snaps]
+            self._unsent_snaps.extend(snaps)
+            del self._unsent_snaps[:-8]
+
+    def _new_spans(self) -> List[tuple]:
+        """Ring events recorded since the last ship, capped per reply —
+        the OLDEST unshipped first, and the cursor advances only past
+        what actually shipped, so a burst defers to the next reply
+        instead of silently dropping; only spans the ring itself evicted
+        before shipping are lost (the ring's bound caps total lag)."""
+        unshipped = self.tracer.recorded - self._spans_shipped
+        if unshipped <= 0:
+            return []
+        avail = min(unshipped, len(self.tracer))
+        lost = unshipped - avail  # evicted by the ring before shipping
+        cap = max(1, int(self.tuning.obs_max_spans_per_reply))
+        ship = self.tracer.events(last=avail)[:cap]
+        self._spans_shipped += lost + len(ship)
+        return ship
 
     def _op_ping(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         return dict(pid=os.getpid())
 
     def _op_tick(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        t_req = time.perf_counter_ns()  # NTP T2 for the offset estimate
         shard = self._require_shard()
         if msg.get("clock") is not None:
             set_runner_clock(msg["clock"])
@@ -347,15 +446,28 @@ class ShardRunner:
             shard.state = state
         for mid, handle, value in msg.get("inputs", ()):
             shard.add_local_input(mid, handle, value)
-        out = shard.advance_all()
-        n_requests = {}
-        for mid, reqs in out.items():
-            game = self._games.get(mid)
-            if game is not None:
-                game.fulfill(reqs)
-            else:
-                _fulfill_default(reqs)
-            n_requests[mid] = len(reqs)
+        # the fleet tick id threads through the RPC: the runner's tick
+        # span carries it, so one Perfetto export correlates this
+        # crossing with the supervisor's fleet.tick span (§18)
+        with self.tracer.span("runner.tick", cat="fleet",
+                              tick=msg.get("fleet_tick"),
+                              shard=shard.shard_id):
+            out = shard.advance_all()
+            n_requests = {}
+            for mid, reqs in out.items():
+                game = self._games.get(mid)
+                if game is not None:
+                    game.fulfill(reqs)
+                else:
+                    _fulfill_default(reqs)
+                n_requests[mid] = len(reqs)
+        if self.tuning.obs_scrape_every and shard.ticks and (
+            shard.ticks % self.tuning.obs_scrape_every == 0
+        ):
+            try:
+                shard.scrape()  # refresh ggrs_io_* / per-slot gauges
+            except Exception:
+                pass
         mids = shard.match_ids()
         events = {mid: shard.events(mid) for mid in mids}
         frames: Dict[str, int] = {}
@@ -375,6 +487,7 @@ class ShardRunner:
             healthz=shard.healthz(),
             refusal=shard.admission_refusal(),
             journal_failed=shard.journal_failed_matches(),
+            obs=self._obs_payload(include_spans=True, req_ns=t_req),
         )
 
     def _open_journal(self, spec: Optional[Dict[str, Any]]):
@@ -446,6 +559,26 @@ class ShardRunner:
 
     def _op_healthz(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         return self._require_shard().healthz()
+
+    def _op_metrics(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """Direct registry query (debug/verification — the steady-state
+        harvest rides the tick/heartbeat piggyback, never this op): the
+        runner's full registries as JSON snapshots."""
+        from ..obs.exporters import json_snapshot
+
+        shard = self._require_shard()
+        return dict(
+            shard=json_snapshot(shard.metrics),
+            default=json_snapshot(DEFAULT),
+        )
+
+    def _op_inject(self, msg: Dict[str, Any]) -> Dict[str, Any]:
+        """Chaos/test seam: native slot fault injection into one match
+        (exercises quarantine → forensics ferry end-to-end)."""
+        self._require_shard().inject_match_error(
+            msg["match_id"], msg.get("code")
+        )
+        return {}
 
     def _op_retire(self, msg: Dict[str, Any]) -> Dict[str, Any]:
         self._require_shard().retire()
@@ -532,11 +665,18 @@ class ProcShard:
         retire_dead_matches: bool = False,
         spawn: bool = True,
         uds_path: Optional[str] = None,
+        fleet_obs: Optional[FleetObs] = None,
     ) -> None:
         self.shard_id = shard_id
         self.capacity = capacity
         self.metrics = metrics if metrics is not None else default_registry()
         self.tuning = tuning if tuning is not None else FleetTuning.from_env()
+        # the fleet observability sink (DESIGN.md §18): shared when the
+        # supervisor owns one (one merged view for the whole fleet),
+        # private for a standalone ProcShard
+        self.obs = fleet_obs if fleet_obs is not None else FleetObs(
+            metrics=self.metrics,
+        )
         self.state = SHARD_ACTIVE
         self.killed = False
         self.ticks = 0
@@ -550,7 +690,14 @@ class ProcShard:
             p99_budget_ms=p99_budget_ms, stale_after_s=stale_after_s,
             native_io=native_io, retire_dead_matches=retire_dead_matches,
             tuning=self.tuning.as_dict(),
+            trace=bool(self.obs.tracer.enabled),
         )
+        self._fleet_tick: Optional[int] = None
+        # RTT-estimated clock offset between this process's and the
+        # runner's perf_counter clocks (runner_ns - supervisor_ns),
+        # refined toward the lowest-RTT sample; reset on respawn
+        self._clock_offset_ns = 0
+        self._offset_rtt_ns: Optional[int] = None
         self._uds_path = uds_path
         self._proc: Optional[subprocess.Popen] = None
         self._conn: Optional[RpcConn] = None
@@ -629,6 +776,10 @@ class ProcShard:
         self._hung_reason = None
         self._term_deadline = None
         self._expected_exit = False
+        # a fresh incarnation = a fresh runner clock: forget the offset
+        self._clock_offset_ns = 0
+        self._offset_rtt_ns = None
+        self._conn.on_heartbeat = self._on_heartbeat
 
     def _teardown_proc(self, expect_exit: bool) -> None:
         """Close the conn and reap the child (SIGKILL if still alive) —
@@ -796,27 +947,68 @@ class ProcShard:
             return  # dead/unknown matches swallow inputs, like dead slots
         self._inputs.append((match_id, handle, value))
 
+    def set_fleet_tick(self, tick: Optional[int]) -> None:
+        """The supervisor's tick id, threaded through the next tick RPC
+        so one Perfetto export correlates both processes (§18)."""
+        self._fleet_tick = tick
+
+    def _on_heartbeat(self, obj: Any) -> None:
+        """Heartbeat payloads carry the idle-path harvest (no RTT pair
+        here, so the last tick RPC's offset estimate stands)."""
+        if isinstance(obj, dict):
+            self._ingest_obs(obj.get("obs"))
+
+    def _ingest_obs(self, payload: Optional[Dict[str, Any]],
+                    t0_ns: Optional[int] = None,
+                    t1_ns: Optional[int] = None) -> None:
+        if not payload:
+            return
+        now_ns = payload.get("now_ns")
+        req_ns = payload.get("req_ns", now_ns)
+        if (t0_ns is not None and t1_ns is not None
+                and isinstance(now_ns, int) and isinstance(req_ns, int)):
+            # the NTP 4-timestamp offset: T1=t0 (call sent), T2=req_ns
+            # (runner received), T3=now_ns (reply built), T4=t1 (reply
+            # received) — offset = ((T2-T1)+(T3-T4))/2.  The runner's
+            # processing time cancels out, so the error bound is the
+            # NETWORK asymmetry (sub-µs on a socketpair), not RTT/2.
+            # Kept only when this sample's network delay beats the best
+            # so far; reset on respawn (a new process, a new clock).
+            net_ns = (t1_ns - t0_ns) - (now_ns - req_ns)
+            if self._offset_rtt_ns is None or net_ns <= self._offset_rtt_ns:
+                self._offset_rtt_ns = net_ns
+                self._clock_offset_ns = (
+                    (req_ns - t0_ns) + (now_ns - t1_ns)
+                ) // 2
+        self.obs.ingest(self.shard_id, payload, backend="proc",
+                        offset_ns=self._clock_offset_ns)
+
     def advance_all(self) -> Dict[str, List[Any]]:
         """One shard tick over RPC: ships the clock + staged inputs,
         returns ``{match_id: []}`` (requests are fulfilled in-runner —
         they cannot cross the process boundary).  A hung/dead runner
-        returns {} immediately; the control plane escalates."""
+        returns {} immediately; the control plane escalates.  The reply
+        piggybacks the runner's obs payload — metric deltas, span ring,
+        ferried forensics — at zero extra round trips (§18)."""
         if (self.killed or self.state in (SHARD_RETIRED, SHARD_DEAD)
                 or self._hung_reason is not None or not self._alive()):
             self._inputs = []
             return {}
+        t0_ns = time.perf_counter_ns()
         try:
             r = self._call(
                 "tick",
                 clock=None if self._clock is None else self._clock(),
                 inputs=self._inputs,
                 state=self.state,
+                fleet_tick=self._fleet_tick,
             )
         except RpcError:
             self._inputs = []
             return {}  # poll_lifecycle owns the consequence
         self._inputs = []
         self.ticks += 1
+        self._ingest_obs(r.get("obs"), t0_ns, time.perf_counter_ns())
         self._healthz_inner = r.get("healthz") or self._healthz_inner
         self._refusal_inner = r.get("refusal")
         self._journal_failed = list(r.get("journal_failed", ()))
@@ -840,6 +1032,13 @@ class ProcShard:
         if ident is not None:
             return ident
         return self._call("identity", match_id=match_id)
+
+    def inject_match_error(self, match_id: str,
+                          code: Optional[int] = None) -> None:
+        """Chaos/test seam mirroring ``PoolShard.inject_match_error`` —
+        the fault lands in the RUNNER's native bank; the resulting
+        quarantine forensics ferry back on the next tick reply."""
+        self._call("inject", match_id=match_id, code=code)
 
     # ------------------------------------------------------------------
     # the PoolShard surface (admission + migration)
@@ -1081,6 +1280,20 @@ class ProcShard:
                     self._send_signal(signal.SIGTERM)
         self._teardown_proc(expect_exit=True)
 
+    def watchdog_stage(self) -> str:
+        """Where the liveness state machine stands: ``ok`` (running,
+        no suspicion), ``suspect`` (hang-marked, SIGTERM not yet sent),
+        ``terminating`` (SIGTERM sent, drain deadline armed), or
+        ``exited`` — surfaced into ``healthz`` aggregates so a stale
+        runner pages BEFORE it is confirmed dead (§18)."""
+        if self._status == PROC_EXITED:
+            return "exited"
+        if self._status == PROC_TERMINATING:
+            return "terminating"
+        if self._hung_reason is not None:
+            return "suspect"
+        return "ok"
+
     def healthz(self) -> Dict[str, Any]:
         alive = self._alive()
         hb_age = self.heartbeat_age_s()
@@ -1102,6 +1315,7 @@ class ProcShard:
             pid=self.pid,
             alive=alive,
             hung=self._hung_reason,
+            watchdog=self.watchdog_stage(),
             heartbeat_age_s=hb_age,
             restarts=self.restarts,
             exit=self.last_exit,

@@ -130,6 +130,12 @@ class RpcConn:
         self._poisoned: Optional[str] = None
         self.last_frame_at: float = time.monotonic()
         self.goodbye: Optional[Any] = None  # payload of a received GOODBYE
+        # observer for heartbeat payloads (the fleet obs harvest rides
+        # them, DESIGN.md §18): called for every parsed HEARTBEAT frame
+        # whether it arrives mid-call, in recv, or in poll_frames —
+        # without this hook, call() would consume and DROP the payload.
+        # Exceptions are swallowed: telemetry must never poison a stream.
+        self.on_heartbeat: Optional[Any] = None
 
     def fileno(self) -> int:
         return self._sock.fileno()
@@ -270,6 +276,11 @@ class RpcConn:
         self.last_frame_at = time.monotonic()
         if kind == KIND_GOODBYE:
             self.goodbye = obj
+        elif kind == KIND_HEARTBEAT and self.on_heartbeat is not None:
+            try:
+                self.on_heartbeat(obj)
+            except Exception:
+                pass
         return kind, obj
 
     # ------------------------------------------------------------------

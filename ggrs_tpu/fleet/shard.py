@@ -146,6 +146,13 @@ class PoolShard:
         # serving them, but failover must treat them as journal-less —
         # the durable tip stopped tracking what the match acks (§17)
         self._journal_failed: set = set()
+        # the forensics ferry (DESIGN.md §18): flight-recorder dumps and
+        # DesyncReports captured the moment a slot quarantines/evicts/
+        # dies, held until drain_forensics() ships them — on a
+        # process-backed shard that ship rides the next tick/heartbeat
+        # reply, so the artifact outlives the child that produced it
+        self._forensic_items: List[Dict[str, Any]] = []
+        self._slot_last_state: Dict[str, str] = {}
         m = self.metrics
         self._g_matches = m.gauge(
             "ggrs_shard_matches", "matches served per shard, by tier",
@@ -368,6 +375,7 @@ class PoolShard:
         lists = self.pool.advance_all()
         for match_id, slot in self._matches.items():
             out[match_id] = lists[slot]
+        self._sweep_slot_forensics()
         for match_id in list(self._adopted):
             out[match_id] = self._tick_adopted(match_id)
             am = self._adopted.get(match_id)
@@ -378,6 +386,61 @@ class PoolShard:
         self._tick_ms.append((time.perf_counter() - t0) * 1000.0)
         self._g_p99.labels(shard=self.shard_id).set(self.tick_p99_ms())
         return out
+
+    def _sweep_slot_forensics(self) -> None:
+        """Capture the post-mortem the instant a bank slot leaves native
+        (quarantined / evicted / dead): flight-recorder dump, fault log
+        tail, and any DesyncReport — into the ferry buffer
+        ``drain_forensics`` ships (DESIGN.md §18)."""
+        for match_id, slot in self._matches.items():
+            try:
+                state = self.pool.slot_state(slot)
+            except Exception:
+                continue
+            prev = self._slot_last_state.get(match_id)
+            self._slot_last_state[match_id] = state
+            if state == prev or state not in (
+                "quarantined", "evicted", "dead"
+            ):
+                continue
+            item: Dict[str, Any] = dict(
+                kind="slot", match=match_id, slot=slot, state=state,
+                tick=self.ticks,
+            )
+            try:
+                item["dump"] = self.pool.flight_dump(slot, 32)
+            except Exception:
+                pass
+            try:
+                item["faults"] = [
+                    dict(tick=f.tick, code=f.code, detail=f.detail)
+                    for f in self.pool.fault_log(slot)[-8:]
+                ]
+            except Exception:
+                pass
+            try:
+                report = self.pool.desync_report(slot)
+                if report is not None:
+                    item["desync_report"] = report.to_dict()
+            except Exception:
+                pass
+            self._record_forensic(item)
+
+    def _record_forensic(self, item: Dict[str, Any]) -> None:
+        self._forensic_items.append(item)
+        del self._forensic_items[:-32]  # bounded while undrained
+
+    def drain_forensics(self) -> List[Dict[str, Any]]:
+        """Ship-and-clear the ferry buffer (plain JSON-safe dicts)."""
+        out = self._forensic_items
+        self._forensic_items = []
+        return out
+
+    def scrape(self):
+        """One stats scrape of the underlying pool (refreshes the
+        ``ggrs_io_*`` / per-slot gauges the obs snapshot then exports);
+        the runner drives this on ``FleetTuning.obs_scrape_every``."""
+        return self.pool.scrape()
 
     def _tick_adopted(self, match_id: str) -> List[GgrsRequest]:
         am = self._adopted[match_id]
@@ -399,6 +462,10 @@ class PoolShard:
             self._dead_matches[match_id] = reason
             del self._adopted[match_id]
             self._update_match_gauges()
+            self._record_forensic(dict(
+                kind="adopted", match=match_id, reason=reason,
+                tick=self.ticks,
+            ))
             _logger.error("shard %s match %s marked dead: %s",
                           self.shard_id, match_id, reason)
             return []
@@ -492,6 +559,7 @@ class PoolShard:
             slot, detail=f"migrated off shard {self.shard_id}"
         )
         del self._matches[match_id]
+        self._slot_last_state.pop(match_id, None)
         self._close_journal(match_id)
         self._update_match_gauges()
         return bundle
@@ -506,6 +574,7 @@ class PoolShard:
             except Exception:
                 pass
         self._adopted.pop(match_id, None)
+        self._slot_last_state.pop(match_id, None)
         self._close_journal(match_id)
         self._update_match_gauges()
 
@@ -674,6 +743,20 @@ class PoolShard:
         shard stops ticking instantly; nothing is flushed or released —
         recovery must come from the durable journals alone."""
         self.killed = True
+
+    def inject_match_error(self, match_id: str,
+                           code: Optional[int] = None) -> None:
+        """Chaos/test seam: inject a native slot fault into one BANK
+        match (the ctrl-op channel the §9 chaos harness drives) —
+        reachable over the runner RPC so the forensics ferry can be
+        exercised end-to-end on a process-backed shard."""
+        slot = self._matches.get(match_id)
+        if slot is None:
+            raise InvalidRequest(
+                f"match {match_id!r} is not a bank match on this shard"
+            )
+        self._ensure_started()
+        self.pool.inject_slot_error(slot, code)
 
     def retire(self) -> None:
         self.state = SHARD_RETIRED

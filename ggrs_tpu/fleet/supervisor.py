@@ -53,7 +53,9 @@ from ..core.types import (
     LoadGameState,
     SaveGameState,
 )
-from ..obs.registry import Registry, default_registry
+from ..obs.fleet_obs import FleetObs
+from ..obs.registry import MultiRegistry, Registry, default_registry
+from ..obs.trace import NULL_TRACER
 from ..utils.tracing import get_logger
 from .placement import HashRing
 from .rpc import FrameError, RpcError, RpcTimeout
@@ -168,6 +170,11 @@ class ShardSupervisor:
     ) -> None:
         self.metrics = metrics if metrics is not None else default_registry()
         self.tuning = tuning if tuning is not None else FleetTuning.from_env()
+        self.tracer = tracer if tracer is not None else NULL_TRACER
+        # the fleet observability plane (DESIGN.md §18): one sink merges
+        # every runner's harvested metrics/spans/forensics; proc shards
+        # share it so one scrape serves the whole fleet
+        self.fleet_obs = FleetObs(metrics=self.metrics, tracer=self.tracer)
         self.journal_dir = (
             os.fspath(journal_dir) if journal_dir is not None else None
         )
@@ -191,6 +198,7 @@ class ShardSupervisor:
                     p99_budget_ms=p99_budget_ms,
                     stale_after_s=stale_after_s, native_io=native_io,
                     retire_dead_matches=retire_dead_matches,
+                    fleet_obs=self.fleet_obs,
                 )
             else:
                 self.shards[sid] = PoolShard(
@@ -471,22 +479,45 @@ class ShardSupervisor:
         """One fleet tick: every serving shard's tick (each pool still one
         native crossing), then the control plane — drain steps, health
         checks + failover, admission retries.  Returns ``{match_id:
-        request_list}`` over every match that ticked."""
+        request_list}`` over every match that ticked.  Wrapped in a
+        ``fleet.tick`` tracer span carrying the tick id: the runners'
+        shipped spans (offset-adjusted) nest inside it, so one Perfetto
+        export shows the whole fleet's tick structure (§18)."""
         self._tick += 1
         out: Dict[str, List[GgrsRequest]] = {}
-        for sid in sorted(self.shards):
-            out.update(self.shards[sid].advance_all())
-        self._drive_procs()
-        self._check_journal_failures()
-        self._drive_drains()
-        self._health_check()
-        self._retry_pending()
-        if self.identity_refresh_every and (
-            self._tick % self.identity_refresh_every == 0
-        ):
-            self._refresh_identities()
+        with self.tracer.span("fleet.tick", cat="fleet", tick=self._tick):
+            for sid in sorted(self.shards):
+                shard = self.shards[sid]
+                if shard.backend == "proc":
+                    shard.set_fleet_tick(self._tick)
+                out.update(shard.advance_all())
+            self._ferry_inproc_forensics()
+            self._drive_procs()
+            self._check_journal_failures()
+            self._drive_drains()
+            self._health_check()
+            self._retry_pending()
+            if self.identity_refresh_every and (
+                self._tick % self.identity_refresh_every == 0
+            ):
+                self._refresh_identities()
         self.last_tick_at = time.monotonic()
         return out
+
+    def _ferry_inproc_forensics(self) -> None:
+        """In-process shards feed the same forensics ring the runners
+        ferry into — one place to look, whatever the backend."""
+        for sid in sorted(self.shards):
+            shard = self.shards[sid]
+            if shard.backend != "inproc":
+                continue
+            try:
+                items = shard.drain_forensics()
+            except Exception:
+                continue
+            if items:
+                self.fleet_obs.ingest(sid, {"forensics": items},
+                                      backend="inproc")
 
     def events(self, match_id: str) -> List:
         record = self._records[match_id]
@@ -970,11 +1001,22 @@ class ShardSupervisor:
     # health + gauges
     # ------------------------------------------------------------------
 
+    def merged_registry(self) -> MultiRegistry:
+        """The one-scrape fleet view: the supervisor's own instruments
+        plus every runner's harvested families (``shard``/``backend``
+        labeled) — hand this to ``obs.start_http_server`` and a single
+        ``/metrics`` serves the entire fleet (§18)."""
+        return MultiRegistry(self.metrics, self.fleet_obs.harvest)
+
     def healthz(self) -> Dict[str, Any]:
         """Fleet-wide aggregate for the ``/healthz`` endpoint
         (``start_http_server(health=supervisor.healthz)``): per-shard
         records plus one top-level verdict — ok while every non-retired
-        shard is healthy and at least one shard still admits."""
+        shard is healthy and at least one shard still admits.  For a
+        proc-backed fleet the aggregate carries each runner's heartbeat
+        age and watchdog stage, so a STALE runner pages here before the
+        watchdog confirms it dead (a wedged child is an incident, not a
+        footnote)."""
         shards = {
             sid: shard.healthz() for sid, shard in self.shards.items()
         }
@@ -987,7 +1029,7 @@ class ShardSupervisor:
             None if self.last_tick_at is None
             else max(0.0, time.monotonic() - self.last_tick_at)
         )
-        return dict(
+        out = dict(
             ok=ok,
             tick=self._tick,
             last_tick_age_s=age,
@@ -996,6 +1038,23 @@ class ShardSupervisor:
             pending_admissions=len(self._pending),
             lost_matches=len(self.lost_matches()),
         )
+        proc: Dict[str, Any] = {}
+        for sid, shard in self.shards.items():
+            if shard.backend != "proc":
+                continue
+            proc[sid] = dict(
+                heartbeat_age_s=shard.heartbeat_age_s(),
+                watchdog=shard.watchdog_stage(),
+                restarts=shard.restarts,
+            )
+        if proc:
+            ages = [
+                p["heartbeat_age_s"] for p in proc.values()
+                if p["heartbeat_age_s"] is not None
+            ]
+            out["proc"] = proc
+            out["max_proc_heartbeat_age_s"] = max(ages) if ages else None
+        return out
 
     def _update_shard_gauge(self) -> None:
         counts: Dict[str, int] = {}

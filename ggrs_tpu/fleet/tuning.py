@@ -66,6 +66,19 @@ class FleetTuning:
     # checkpoints are the big payloads)
     max_frame_bytes: int = 64 << 20
 
+    # --- fleet observability plane (DESIGN.md §18) ---
+    # 1 = runners piggyback delta-encoded registry snapshots (plus span
+    # rings and ferried forensics) on heartbeat/tick replies; 0 compiles
+    # the runner-side harvest out entirely (the harvest-off leg of the
+    # <5% p99 overhead acceptance)
+    obs_harvest: int = 1
+    # at most this many trace spans ship per tick reply (bounds the
+    # frame size; the runner's ring keeps the rest for the next reply)
+    obs_max_spans_per_reply: int = 512
+    # runner-side pool.scrape() cadence in runner ticks (refreshes the
+    # ggrs_io_* / per-slot gauges the snapshot then exports); 0 = off
+    obs_scrape_every: int = 0
+
     # --- admission retry (mirrors supervisor.READMIT_*) ---
     readmit_backoff_ticks: int = 8
     readmit_max_attempts: int = 6

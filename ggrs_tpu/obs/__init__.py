@@ -42,11 +42,12 @@ from .registry import (
     DEFAULT,
     Gauge,
     Histogram,
+    MultiRegistry,
     Registry,
     default_registry,
 )
 from .recorder import ChecksumHistory, FlightRecorder
-from .trace import NULL_TRACER, Tracer
+from .trace import NULL_TRACER, Tracer, validate_chrome_trace
 from .forensics import (
     DesyncReport,
     build_desync_report,
@@ -58,6 +59,13 @@ from .exporters import (
     json_snapshot,
     prometheus_text,
     start_http_server,
+    validate_exposition,
+)
+from .fleet_obs import (
+    FleetObs,
+    RegistryCollector,
+    fleet_metrics_digest,
+    histogram_quantile,
 )
 
 __all__ = [
@@ -65,18 +73,25 @@ __all__ = [
     "Counter",
     "DEFAULT",
     "DesyncReport",
+    "FleetObs",
     "FlightRecorder",
     "Gauge",
     "Histogram",
     "MetricsHTTPServer",
     "MetricsServer",
+    "MultiRegistry",
     "NULL_TRACER",
     "Registry",
+    "RegistryCollector",
     "Tracer",
     "build_desync_report",
     "default_registry",
     "first_divergent_frame",
+    "fleet_metrics_digest",
+    "histogram_quantile",
     "json_snapshot",
     "prometheus_text",
     "start_http_server",
+    "validate_chrome_trace",
+    "validate_exposition",
 ]

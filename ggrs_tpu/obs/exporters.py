@@ -21,20 +21,27 @@ Endpoints:
 from __future__ import annotations
 
 import json
+import re
 import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from .registry import Registry
 
 __all__ = ["prometheus_text", "json_snapshot", "start_http_server",
-           "MetricsServer", "MetricsHTTPServer"]
+           "validate_exposition", "MetricsServer", "MetricsHTTPServer"]
 
 
 def _escape_label(value: str) -> str:
     return (
         value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
     )
+
+
+def _escape_help(value: str) -> str:
+    # HELP lines escape backslash and newline (not quotes) per the text
+    # exposition format — an unescaped newline would tear the line apart
+    return value.replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _fmt_value(v: float) -> str:
@@ -51,63 +58,296 @@ def _label_str(labels: Dict[str, str], extra: str = "") -> str:
     return "{" + ",".join(parts) + "}" if parts else ""
 
 
-def prometheus_text(registry: Registry) -> str:
-    """The registry in Prometheus text exposition format (version 0.0.4:
-    ``# HELP`` / ``# TYPE`` headers, one sample per line)."""
-    lines = []
+def _grouped_families(registry) -> "List[List[Any]]":
+    """Families grouped by name, preserving first-seen order.  A
+    :class:`~ggrs_tpu.obs.registry.MultiRegistry` view can legitimately
+    yield the same family name from two member registries (local vs
+    fleet-harvested, DESIGN.md §18); the exposition must then emit ONE
+    ``# TYPE`` header with every group's samples under it — duplicate
+    headers are a promtool error."""
+    order: List[str] = []
+    groups: Dict[str, List[Any]] = {}
     for fam in registry.families():
-        if fam.help:
-            lines.append(f"# HELP {fam.name} {fam.help}")
-        lines.append(f"# TYPE {fam.name} {fam.kind}")
-        for labels, child in fam.samples():
-            if fam.kind == "histogram":
-                for upper, cum in child.cumulative():
-                    le = "+Inf" if upper == float("inf") else _fmt_value(upper)
-                    extra = 'le="%s"' % le
+        if fam.name not in groups:
+            order.append(fam.name)
+            groups[fam.name] = []
+        groups[fam.name].append(fam)
+    return [groups[name] for name in order]
+
+
+def prometheus_text(registry) -> str:
+    """The registry (or a ``MultiRegistry`` union view) in Prometheus
+    text exposition format (version 0.0.4: ``# HELP`` / ``# TYPE``
+    headers, one sample per line, label/help values escaped)."""
+    lines = []
+    for group in _grouped_families(registry):
+        first = group[0]
+        if first.help:
+            lines.append(f"# HELP {first.name} {_escape_help(first.help)}")
+        lines.append(f"# TYPE {first.name} {first.kind}")
+        for fam in group:
+            if fam.kind != first.kind:
+                # shape conflict across registries: emitting mixed-kind
+                # samples under one header would be invalid exposition
+                continue
+            for labels, child in fam.samples():
+                if fam.kind == "histogram":
+                    for upper, cum in child.cumulative():
+                        le = ("+Inf" if upper == float("inf")
+                              else _fmt_value(upper))
+                        extra = 'le="%s"' % le
+                        lines.append(
+                            f"{fam.name}_bucket"
+                            f"{_label_str(labels, extra)} {cum}"
+                        )
                     lines.append(
-                        f"{fam.name}_bucket{_label_str(labels, extra)} {cum}"
+                        f"{fam.name}_sum{_label_str(labels)} "
+                        f"{_fmt_value(child.sum)}"
                     )
-                lines.append(
-                    f"{fam.name}_sum{_label_str(labels)} "
-                    f"{_fmt_value(child.sum)}"
-                )
-                lines.append(
-                    f"{fam.name}_count{_label_str(labels)} {child.count}"
-                )
-            else:
-                lines.append(
-                    f"{fam.name}{_label_str(labels)} "
-                    f"{_fmt_value(child.value)}"
-                )
+                    lines.append(
+                        f"{fam.name}_count{_label_str(labels)} {child.count}"
+                    )
+                else:
+                    lines.append(
+                        f"{fam.name}{_label_str(labels)} "
+                        f"{_fmt_value(child.value)}"
+                    )
     return "\n".join(lines) + "\n"
 
 
-def json_snapshot(registry: Registry) -> Dict[str, Any]:
-    """The registry as a JSON-serializable dict — the shape bench.py
-    embeds in its ``bench_out`` records and chaos summaries print."""
+def json_snapshot(registry) -> Dict[str, Any]:
+    """The registry (or a ``MultiRegistry`` view) as a JSON-serializable
+    dict — the shape bench.py embeds in its ``bench_out`` records and
+    chaos summaries print.  Same-name families across member registries
+    merge their sample lists."""
     out: Dict[str, Any] = {}
-    for fam in registry.families():
+    for group in _grouped_families(registry):
+        first = group[0]
         samples = []
-        for labels, child in fam.samples():
-            if fam.kind == "histogram":
-                samples.append({
-                    "labels": labels,
-                    "sum": child.sum,
-                    "count": child.count,
-                    "buckets": [
-                        {"le": upper if upper != float("inf") else "+Inf",
-                         "count": cum}
-                        for upper, cum in child.cumulative()
-                    ],
-                })
-            else:
-                samples.append({"labels": labels, "value": child.value})
-        out[fam.name] = {
-            "type": fam.kind,
-            "help": fam.help,
+        for fam in group:
+            if fam.kind != first.kind:
+                continue
+            for labels, child in fam.samples():
+                if fam.kind == "histogram":
+                    samples.append({
+                        "labels": labels,
+                        "sum": child.sum,
+                        "count": child.count,
+                        "buckets": [
+                            {"le": upper if upper != float("inf")
+                             else "+Inf",
+                             "count": cum}
+                            for upper, cum in child.cumulative()
+                        ],
+                    })
+                else:
+                    samples.append({"labels": labels, "value": child.value})
+        out[first.name] = {
+            "type": first.kind,
+            "help": first.help,
             "samples": samples,
         }
     return out
+
+
+# ----------------------------------------------------------------------
+# promtool-style exposition validation (DESIGN.md §18, run in CI by
+# build_sanitized.sh through tests/test_fleet_obs.py)
+# ----------------------------------------------------------------------
+
+_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*")
+_LABEL_NAME_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*")
+_VALUE_RE = re.compile(
+    r"(?:[+-]?Inf|NaN|[+-]?(?:\d+\.?\d*|\.\d+)(?:[eE][+-]?\d+)?)"
+)
+_SAMPLE_KINDS = {"counter", "gauge", "histogram", "summary", "untyped"}
+
+
+def _parse_labels(s: str, errors: List[str], where: str
+                  ) -> Optional[List[Tuple[str, str]]]:
+    """Parse one ``{k="v",...}`` label block (without the braces);
+    validates names and escape sequences.  Returns None on error."""
+    out: List[Tuple[str, str]] = []
+    i, n = 0, len(s)
+    while i < n:
+        m = _LABEL_NAME_RE.match(s, i)
+        if m is None:
+            errors.append(f"{where}: bad label name at ...{s[i:i+20]!r}")
+            return None
+        name = m.group(0)
+        i = m.end()
+        if i >= n or s[i] != "=":
+            errors.append(f"{where}: expected '=' after label {name!r}")
+            return None
+        i += 1
+        if i >= n or s[i] != '"':
+            errors.append(f"{where}: label {name!r} value not quoted")
+            return None
+        i += 1
+        value = []
+        while i < n and s[i] != '"':
+            if s[i] == "\\":
+                if i + 1 >= n or s[i + 1] not in ('\\', '"', 'n'):
+                    errors.append(
+                        f"{where}: invalid escape in label {name!r}"
+                    )
+                    return None
+                value.append({"\\": "\\", '"': '"', "n": "\n"}[s[i + 1]])
+                i += 2
+            elif s[i] == "\n":
+                errors.append(f"{where}: raw newline in label {name!r}")
+                return None
+            else:
+                value.append(s[i])
+                i += 1
+        if i >= n:
+            errors.append(f"{where}: unterminated label value ({name!r})")
+            return None
+        i += 1  # closing quote
+        out.append((name, "".join(value)))
+        if i < n:
+            if s[i] != ",":
+                errors.append(f"{where}: expected ',' between labels")
+                return None
+            i += 1
+    return out
+
+
+def validate_exposition(text: str) -> List[str]:
+    """Validate Prometheus text exposition the way ``promtool check
+    metrics`` would: line syntax, label escaping, at most one ``# TYPE``
+    per family (before its samples), no duplicate samples, and histogram
+    structure — ``le`` strictly ascending with a terminal ``+Inf``
+    bucket, cumulative counts non-decreasing, ``_count`` equal to the
+    ``+Inf`` bucket, ``_sum``/``_count`` present.  Returns the list of
+    problems (empty = conformant)."""
+    errors: List[str] = []
+    types: Dict[str, str] = {}
+    sampled: set = set()      # family names that already emitted samples
+    seen: set = set()         # (name, frozen labelset) duplicate check
+    # histogram bookkeeping: (base name, base labelset) -> parts
+    hist: Dict[Tuple[str, Tuple], Dict[str, Any]] = {}
+    for lineno, line in enumerate(text.split("\n"), 1):
+        if not line:
+            continue
+        where = f"line {lineno}"
+        if line.startswith("#"):
+            parts = line.split(" ", 3)
+            if len(parts) >= 3 and parts[1] in ("HELP", "TYPE"):
+                name = parts[2]
+                if _NAME_RE.fullmatch(name) is None:
+                    errors.append(f"{where}: bad metric name {name!r}")
+                    continue
+                if parts[1] == "TYPE":
+                    kind = parts[3].strip() if len(parts) > 3 else ""
+                    if kind not in _SAMPLE_KINDS:
+                        errors.append(
+                            f"{where}: unknown TYPE {kind!r} for {name}"
+                        )
+                    if name in types:
+                        errors.append(f"{where}: duplicate TYPE for {name}")
+                    if name in sampled:
+                        errors.append(
+                            f"{where}: TYPE for {name} after its samples"
+                        )
+                    types[name] = kind
+            continue
+        m = _NAME_RE.match(line)
+        if m is None:
+            errors.append(f"{where}: unparseable sample {line[:40]!r}")
+            continue
+        name = m.group(0)
+        rest = line[m.end():]
+        labels: List[Tuple[str, str]] = []
+        if rest.startswith("{"):
+            # a '}' inside a quoted value is legal; scan for the real one
+            depth_in_quote = False
+            close = -1
+            j = 1
+            while j < len(rest):
+                c = rest[j]
+                if depth_in_quote:
+                    if c == "\\":
+                        j += 1
+                    elif c == '"':
+                        depth_in_quote = False
+                elif c == '"':
+                    depth_in_quote = True
+                elif c == "}":
+                    close = j
+                    break
+                j += 1
+            if close < 0:
+                errors.append(f"{where}: unterminated label block")
+                continue
+            parsed = _parse_labels(rest[1:close], errors, where)
+            if parsed is None:
+                continue
+            labels = parsed
+            rest = rest[close + 1:]
+        if not rest.startswith(" "):
+            errors.append(f"{where}: missing space before value")
+            continue
+        fields = rest[1:].split(" ")
+        if not fields or _VALUE_RE.fullmatch(fields[0]) is None:
+            errors.append(f"{where}: bad sample value {rest[1:]!r}")
+            continue
+        value = float(fields[0])
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and types.get(name[: -len(suffix)]) \
+                    == "histogram":
+                base = name[: -len(suffix)]
+                break
+        sampled.add(base)
+        key = (name, tuple(sorted(labels)))
+        if key in seen:
+            errors.append(f"{where}: duplicate sample {name}{labels}")
+        seen.add(key)
+        if base != name or types.get(base) == "histogram":
+            no_le = tuple(sorted(
+                (k, v) for k, v in labels if k != "le"
+            ))
+            h = hist.setdefault((base, no_le),
+                                {"le": [], "sum": None, "count": None})
+            if name == base + "_bucket":
+                le = dict(labels).get("le")
+                if le is None:
+                    errors.append(f"{where}: bucket without le label")
+                    continue
+                try:
+                    le_v = float("inf") if le == "+Inf" else float(le)
+                except ValueError:
+                    errors.append(f"{where}: unparseable le {le!r}")
+                    continue
+                h["le"].append((le_v, value, lineno))
+            elif name == base + "_sum":
+                h["sum"] = value
+            elif name == base + "_count":
+                h["count"] = value
+    for (base, no_le), h in hist.items():
+        where = f"histogram {base}{dict(no_le)}"
+        les = h["le"]
+        if not les:
+            errors.append(f"{where}: no buckets")
+            continue
+        uppers = [u for u, _c, _l in les]
+        if uppers != sorted(uppers) or len(set(uppers)) != len(uppers):
+            errors.append(f"{where}: le not strictly ascending")
+        if uppers[-1] != float("inf"):
+            errors.append(f"{where}: missing terminal +Inf bucket")
+        cums = [c for _u, c, _l in les]
+        if any(b < a for a, b in zip(cums, cums[1:])):
+            errors.append(f"{where}: cumulative counts decrease")
+        if h["count"] is None:
+            errors.append(f"{where}: missing _count")
+        elif uppers[-1] == float("inf") and h["count"] != cums[-1]:
+            errors.append(
+                f"{where}: _count {h['count']} != +Inf bucket {cums[-1]}"
+            )
+        if h["sum"] is None:
+            errors.append(f"{where}: missing _sum")
+    return errors
 
 
 class MetricsServer:

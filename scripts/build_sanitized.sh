@@ -53,5 +53,6 @@ python -m pytest tests/test_session_bank.py tests/test_bank_faults.py \
     tests/test_trace.py tests/test_desync_detection.py \
     tests/test_native_io.py tests/test_socket_datapath.py \
     tests/test_fleet.py tests/test_fleet_rpc.py tests/test_fleet_proc.py \
+    tests/test_fleet_obs.py \
     -q -p no:cacheprovider -m "not slow" \
     -k "not batched_executor and not size_mismatch and not fused_scrub and not scrub_matches" "$@"

@@ -84,7 +84,38 @@ from ggrs_tpu.chaos import (  # noqa: E402
     drive_socket_chaos,
 )
 from ggrs_tpu.net import _native  # noqa: E402
-from ggrs_tpu.obs import json_snapshot  # noqa: E402
+from ggrs_tpu.obs import (  # noqa: E402
+    Tracer,
+    fleet_metrics_digest,
+    json_snapshot,
+    validate_chrome_trace,
+)
+
+
+def _fleet_trace_artifact(artifact_dir, name: str, tracer):
+    """Write one scenario's Perfetto export beside its JSON artifact and
+    return ``{"trace_path":..., "trace_spans":..., "trace_problems":...}``
+    for embedding (DESIGN.md §18).  The export is schema-validated here
+    (eps widened for imported cross-process spans) so a torn trace shows
+    up in CI, not in a ui.perfetto.dev tab weeks later."""
+    if artifact_dir is None or tracer is None:
+        return {}
+    out = Path(artifact_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    path = tracer.write(out / f"{name}.trace.json")
+    trace = tracer.chrome_trace()
+    problems = validate_chrome_trace(trace, eps_us=50.0)
+    if problems:
+        print(f"  trace validation ({name}): {len(problems)} problems, "
+              f"e.g. {problems[0]}")
+    else:
+        print(f"  trace: {path} ({len(trace['traceEvents'])} events, "
+              "schema-valid)")
+    return {
+        "trace_path": str(path),
+        "trace_spans": len(trace["traceEvents"]),
+        "trace_problems": problems[:8],
+    }
 
 
 def _write_artifact(artifact_dir, name: str, payload: dict):
@@ -545,7 +576,8 @@ def verify_fleet_leg(matches_per_shard: int, ticks: int, seed: int,
             ),
         }
 
-    def report(name: str, violations, ctx, extra=None) -> bool:
+    def report(name: str, violations, ctx, extra=None,
+               tracer=None) -> bool:
         digest = fleet_digest(ctx)
         print(f"  [{name}] locations: "
               f"{sum(1 for s in ctx['locations'].values() if s == 's0')} "
@@ -559,7 +591,9 @@ def verify_fleet_leg(matches_per_shard: int, ticks: int, seed: int,
             "ticks": ticks,
             **digest,
             **(extra or {}),
-            "metrics": json_snapshot(ctx["registry"]),
+            "fleet_obs": fleet_metrics_digest(ctx["sup"]),
+            **_fleet_trace_artifact(artifact_dir, name, tracer),
+            "metrics": json_snapshot(ctx["sup"].merged_registry()),
         })
         if violations:
             print(f"  {name.upper()} VIOLATED:")
@@ -577,8 +611,9 @@ def verify_fleet_leg(matches_per_shard: int, ticks: int, seed: int,
         if i == ticks // 2:
             ctx["sup"].kill("s1")
 
+    tr = Tracer(capacity=16384) if artifact_dir is not None else None
     chaos = drive_fleet_chaos(
-        ticks, matches_per_shard=p, seed=seed, inject=kill
+        ticks, matches_per_shard=p, seed=seed, inject=kill, tracer=tr
     )
     violations = fleet_survivor_violations(chaos, control, survivors)
     violations += fleet_recovery_violations(
@@ -594,15 +629,17 @@ def verify_fleet_leg(matches_per_shard: int, ticks: int, seed: int,
     print(f"  [shard_kill] s1 killed @tick {ticks // 2}: {recovered}/{p} "
           f"matches journal-recovered onto s0, max lag {lag} frames")
     ok &= report("shard_kill", violations, chaos,
-                 extra={"recovered": recovered, "max_lag_frames": lag})
+                 extra={"recovered": recovered, "max_lag_frames": lag},
+                 tracer=tr)
 
     # 2. drain-under-load: admission off, migrate all, retire
     def drain(i, ctx):
         if i == ticks // 3:
             ctx["sup"].drain("s1")
 
+    tr = Tracer(capacity=16384) if artifact_dir is not None else None
     chaos = drive_fleet_chaos(
-        ticks, matches_per_shard=p, seed=seed, inject=drain
+        ticks, matches_per_shard=p, seed=seed, inject=drain, tracer=tr
     )
     violations = fleet_survivor_violations(chaos, control, survivors)
     violations += fleet_recovery_violations(chaos, affected)
@@ -613,7 +650,7 @@ def verify_fleet_leg(matches_per_shard: int, ticks: int, seed: int,
           f"{sum(1 for m in affected if chaos['locations'][m] == 's0')}/{p} "
           "matches migrated to s0")
     ok &= report("shard_drain", violations, chaos,
-                 extra={"drained_shard_state": state})
+                 extra={"drained_shard_state": state}, tracer=tr)
 
     # 3. migrate-under-loss: live migration on a lossy wire + spectators
     lossy = dict(latency_ticks=1, loss=0.05, duplicate=0.02, reorder=0.05)
@@ -626,9 +663,10 @@ def verify_fleet_leg(matches_per_shard: int, ticks: int, seed: int,
         if i == ticks // 3:
             ctx["sup"].migrate("m0")
 
+    tr = Tracer(capacity=16384) if artifact_dir is not None else None
     chaos = drive_fleet_chaos(
         ticks, matches_per_shard=p, seed=seed, inject=migrate,
-        fault_cfg=dict(lossy), n_spectators=2,
+        fault_cfg=dict(lossy), n_spectators=2, tracer=tr,
     )
     untouched = [m for m in chaos["match_ids"] if m != "m0"]
     violations = fleet_survivor_violations(chaos, lossy_control, untouched)
@@ -651,7 +689,7 @@ def verify_fleet_leg(matches_per_shard: int, ticks: int, seed: int,
           f"loss/dup/reorder; viewers at {viewer_tips}")
     ok &= report("shard_migrate", violations, chaos,
                  extra={"migrated_to": chaos["locations"]["m0"],
-                        "viewer_tips": viewer_tips})
+                        "viewer_tips": viewer_tips}, tracer=tr)
     if ok:
         print(f"  OK: {p}-per-shard fleet survived kill, drain, and "
               "lossy migration")
@@ -703,7 +741,7 @@ def verify_proc_leg(matches_per_shard: int, ticks: int, seed: int,
     affected = [f"m{k}" for k in range(p, 2 * p)]     # pinned to s1
     ok = True
 
-    def report(name, violations, ctx, extra=None) -> bool:
+    def report(name, violations, ctx, extra=None, tracer=None) -> bool:
         reg = ctx["registry"]
         _write_artifact(artifact_dir, name, {
             "scenario": name,
@@ -727,7 +765,9 @@ def verify_proc_leg(matches_per_shard: int, ticks: int, seed: int,
             "restarts": int(reg.value(
                 "ggrs_fleet_proc_restarts_total", shard="s1") or 0),
             **(extra or {}),
-            "metrics": json_snapshot(reg),
+            "fleet_obs": fleet_metrics_digest(ctx["sup"]),
+            **_fleet_trace_artifact(artifact_dir, name, tracer),
+            "metrics": json_snapshot(ctx["sup"].merged_registry()),
         })
         if violations:
             print(f"  {name.upper()} VIOLATED:")
@@ -758,9 +798,10 @@ def verify_proc_leg(matches_per_shard: int, ticks: int, seed: int,
             if sup.shards["s1"].state == SHARD_DEAD:
                 timing["detected_at"] = time.monotonic()
 
+    tr = Tracer(capacity=16384) if artifact_dir is not None else None
     chaos = drive_proc_fleet(
         ticks, matches_per_shard=p, seed=seed, backend="proc",
-        tuning=tuning, inject=sigkill,
+        tuning=tuning, inject=sigkill, tracer=tr,
     )
     chaos["sup"].close()
     violations = fleet_survivor_violations(chaos, control, survivors)
@@ -790,7 +831,7 @@ def verify_proc_leg(matches_per_shard: int, ticks: int, seed: int,
         "recovered": recovered,
         "detect_seconds": detect_s,
         "orphans": orphans,
-    })
+    }, tracer=tr)
 
     # 2. SIGSTOP: a hang — watchdog escalation, then the same recovery.
     # tick_sleep stretches real time so the (wall-clock) escalation
@@ -827,6 +868,31 @@ def verify_proc_leg(matches_per_shard: int, ticks: int, seed: int,
           f"/{p} matches recovered")
     ok &= report("proc_sigstop", violations, chaos, extra={
         "sigterms": sigterms, "sigkills": sigkills, "orphans": orphans,
+    })
+
+    # 2b. harvest overhead: the SAME topology with the runner-side obs
+    # harvest compiled out (obs_harvest=0) — the runner tick p99 delta
+    # prices the piggyback (<5% target, informational: recorded in the
+    # artifact, not asserted, because CI boxes jitter)
+    from ggrs_tpu.fleet import FleetTuning as _FT
+    off = drive_proc_fleet(
+        ticks, matches_per_shard=p, seed=seed, backend="proc",
+        tuning=_FT.from_dict({**tuning.as_dict(), "obs_harvest": 0}),
+    )
+    off["sup"].close()
+    on_p99 = control["healthz"]["shards"]["s1"].get("tick_p99_ms") or 0.0
+    off_p99 = off["healthz"]["shards"]["s1"].get("tick_p99_ms") or 0.0
+    pct = (100.0 * (on_p99 - off_p99) / off_p99) if off_p99 else None
+    print(f"  [proc_harvest] s1 tick p99: harvest-on {on_p99:.2f} ms vs "
+          f"harvest-off {off_p99:.2f} ms "
+          f"({'n/a' if pct is None else f'{pct:+.1f}%'}, target <5%)")
+    _write_artifact(artifact_dir, "proc_harvest_overhead", {
+        "scenario": "proc_harvest_overhead",
+        "verdict": "INFO",
+        "tick_p99_ms_harvest_on": on_p99,
+        "tick_p99_ms_harvest_off": off_p99,
+        "overhead_pct": pct,
+        "fleet_obs": fleet_metrics_digest(control["sup"]),
     })
 
     # 3. restart storm: kill the same shard 5x fast; the backoff

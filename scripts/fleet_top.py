@@ -1,0 +1,201 @@
+#!/usr/bin/env python
+"""fleet_top: a live per-shard terminal dashboard over the merged fleet
+metrics (DESIGN.md §18).
+
+Points at a supervisor's ``obs.start_http_server`` endpoint — the one
+serving ``supervisor.merged_registry()`` on ``/metrics.json`` and
+``supervisor.healthz`` on ``/healthz`` — and renders, refreshing in
+place:
+
+- the fleet header: tick, overall verdict, matches placed/pending/lost;
+- one row per shard: backend, lifecycle state, matches (bank/adopted),
+  heartbeat age, watchdog stage, restarts, tick p99;
+- per-shard span-phase p99s estimated from the harvested
+  ``ggrs_fleet_span_seconds{shard,name}`` histogram — the "which phase
+  eats the budget" view ROADMAP item 3 wants;
+- the fleet counters (admissions, migrations, failovers, lost) and the
+  harvest plane's own health (snapshots merged, dups, gaps, ferried
+  forensics).
+
+Usage:
+  python scripts/fleet_top.py --url http://127.0.0.1:9464
+  python scripts/fleet_top.py --url http://127.0.0.1:9464 --once  # one frame
+
+``render()`` is a pure function over the two JSON documents, so tests
+drive it from captured snapshots without a server.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+from ggrs_tpu.obs.fleet_obs import histogram_quantile  # noqa: E402
+
+
+def fetch(url: str, timeout: float = 3.0) -> Dict[str, Any]:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read().decode())
+
+
+def _fetch_healthz(base: str, timeout: float = 3.0) -> Dict[str, Any]:
+    # /healthz answers 503 (with the same JSON body) when the fleet is
+    # unhealthy — that is a datum, not a fetch failure
+    try:
+        return fetch(base + "/healthz", timeout)
+    except urllib.error.HTTPError as e:
+        try:
+            return json.loads(e.read().decode())
+        except Exception:
+            return {"ok": False, "error": str(e)}
+
+
+def _fmt_age(age: Optional[float]) -> str:
+    if age is None:
+        return "-"
+    if age < 10:
+        return f"{age * 1000:.0f}ms"
+    return f"{age:.1f}s"
+
+
+def _fmt_ms(v: Optional[float]) -> str:
+    return "-" if v is None else f"{v:.2f}"
+
+
+def _span_p99s(metrics: Dict[str, Any]
+               ) -> Dict[str, List[Tuple[str, float, int]]]:
+    """Per shard: [(span name, p99 ms, count)] from the harvested
+    ``ggrs_fleet_span_seconds`` histogram, largest p99 first."""
+    fam = metrics.get("ggrs_fleet_span_seconds")
+    out: Dict[str, List[Tuple[str, float, int]]] = {}
+    if not fam:
+        return out
+    for sample in fam.get("samples", ()):
+        labels = sample.get("labels", {})
+        shard = labels.get("shard", "?")
+        name = labels.get("name", "?")
+        buckets = sample.get("buckets", ())
+        uppers = [b["le"] for b in buckets if b["le"] != "+Inf"]
+        cums = [b["count"] for b in buckets]
+        p99 = histogram_quantile(0.99, uppers, cums)
+        if p99 is None:
+            continue
+        out.setdefault(shard, []).append(
+            (name, p99 * 1000.0, sample.get("count", 0))
+        )
+    for shard in out:
+        out[shard].sort(key=lambda t: -t[1])
+    return out
+
+
+def _counter_total(metrics: Dict[str, Any], name: str) -> int:
+    fam = metrics.get(name)
+    if not fam:
+        return 0
+    return int(sum(s.get("value", 0) for s in fam.get("samples", ())))
+
+
+def render(healthz: Dict[str, Any], metrics: Dict[str, Any],
+           phases_per_shard: int = 4) -> str:
+    """One dashboard frame as text (pure; no I/O)."""
+    lines: List[str] = []
+    ok = healthz.get("ok")
+    verdict = "OK" if ok else "DEGRADED"
+    lines.append(
+        f"ggrs fleet_top — tick {healthz.get('tick', '?')}  "
+        f"[{verdict}]  matches={healthz.get('matches', '?')} "
+        f"pending={healthz.get('pending_admissions', 0)} "
+        f"lost={healthz.get('lost_matches', 0)}  "
+        f"last_tick={_fmt_age(healthz.get('last_tick_age_s'))}"
+    )
+    lines.append("")
+    header = (
+        f"{'SHARD':<10} {'BACKEND':<8} {'STATE':<9} {'OK':<3} "
+        f"{'MATCHES':<9} {'HB AGE':<8} {'WATCHDOG':<11} {'RST':<4} "
+        f"{'P99 MS':<8}"
+    )
+    lines.append(header)
+    lines.append("-" * len(header))
+    shards = healthz.get("shards", {})
+    proc = healthz.get("proc", {})
+    for sid in sorted(shards):
+        h = shards[sid]
+        p = proc.get(sid, {})
+        matches = f"{h.get('matches', 0)}"
+        if "bank_matches" in h:
+            matches += (f" ({h.get('bank_matches', 0)}b/"
+                        f"{h.get('adopted_matches', 0)}a)")
+        lines.append(
+            f"{sid:<10} {h.get('backend', 'inproc'):<8} "
+            f"{h.get('state', '?'):<9} "
+            f"{'y' if h.get('ok') else 'N':<3} {matches:<9} "
+            f"{_fmt_age(p.get('heartbeat_age_s', h.get('heartbeat_age_s'))):<8} "
+            f"{p.get('watchdog', h.get('watchdog', '-')) or '-':<11} "
+            f"{str(p.get('restarts', h.get('restarts', 0))):<4} "
+            f"{_fmt_ms(h.get('tick_p99_ms')):<8}"
+        )
+    p99s = _span_p99s(metrics)
+    if p99s:
+        lines.append("")
+        lines.append("phase p99 (harvested spans, ms):")
+        for shard in sorted(p99s):
+            tops = ", ".join(
+                f"{name}={p99:.2f}"
+                for name, p99, _count in p99s[shard][:phases_per_shard]
+            )
+            lines.append(f"  {shard:<10} {tops}")
+    lines.append("")
+    lines.append(
+        "fleet: admissions={} migrations={} failovers={} lost={} | "
+        "harvest: snapshots={} dups={} gaps={} forensics={}".format(
+            _counter_total(metrics, "ggrs_fleet_admissions_total"),
+            _counter_total(metrics, "ggrs_fleet_migrations_total"),
+            _counter_total(metrics, "ggrs_fleet_failovers_total"),
+            _counter_total(metrics, "ggrs_fleet_matches_lost_total"),
+            _counter_total(metrics, "ggrs_fleet_obs_snapshots_total"),
+            _counter_total(metrics, "ggrs_fleet_obs_snapshot_dups_total"),
+            _counter_total(metrics, "ggrs_fleet_obs_snapshot_gaps_total"),
+            _counter_total(metrics, "ggrs_fleet_obs_forensics_total"),
+        )
+    )
+    return "\n".join(lines)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--url", default="http://127.0.0.1:9464",
+                    help="base URL of the supervisor's obs HTTP server")
+    ap.add_argument("--interval", type=float, default=1.0)
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit (CI/scripting)")
+    ap.add_argument("--phases", type=int, default=4, metavar="N",
+                    help="top-N phases per shard in the p99 table")
+    args = ap.parse_args()
+    base = args.url.rstrip("/")
+    while True:
+        try:
+            healthz = _fetch_healthz(base)
+            metrics = fetch(base + "/metrics.json")
+        except Exception as e:
+            frame = f"fleet_top: cannot reach {base}: {e}"
+        else:
+            frame = render(healthz, metrics, phases_per_shard=args.phases)
+        if args.once:
+            print(frame)
+            return 0
+        # clear + home, then the frame — refresh in place like top(1)
+        sys.stdout.write("\x1b[2J\x1b[H" + frame + "\n")
+        sys.stdout.flush()
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,678 @@
+"""Fleet-wide observability plane tests (DESIGN.md §18): delta-snapshot
+harvest over the RPC piggyback, cross-process trace correlation,
+forensics ferry, exposition conformance, and cardinality bounds.
+
+The acceptance pins, mirrored by ``scripts/chaos.py --fault proc/shard``
+artifacts:
+
+* One supervisor scrape (``supervisor.merged_registry()`` through one
+  ``MetricsServer``) returns a subprocess runner's counters — e.g. the
+  journal fsync histogram — labeled ``shard=<id>,backend=proc``,
+  value-equal to querying the runner's registry directly under the same
+  seeded traffic.
+* The harvest adds ZERO RPC round trips: only the ops the serving path
+  already makes appear in the RPC latency histogram.
+* One Perfetto export shows a ``fleet.tick`` span with a subprocess
+  runner's ``bank.crossing`` phases nested inside it, and the export
+  passes schema validation.
+* Registry merge is idempotent under re-delivered heartbeat snapshots,
+  and a B=128 pool plus a 4-shard fleet emits a bounded series count
+  with no per-match/per-viewer label explosion.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+
+import pytest
+
+from ggrs_tpu.chaos import drive_chaos, drive_fleet_chaos, drive_proc_fleet
+from ggrs_tpu.fleet import FleetTuning, ProcShard, ShardSupervisor
+from ggrs_tpu.net import _native
+from ggrs_tpu.obs import (
+    FleetObs,
+    MultiRegistry,
+    Registry,
+    RegistryCollector,
+    Tracer,
+    fleet_metrics_digest,
+    histogram_quantile,
+    json_snapshot,
+    prometheus_text,
+    validate_chrome_trace,
+    validate_exposition,
+)
+
+needs_native = pytest.mark.skipif(
+    _native.bank_lib() is None, reason="native session bank unavailable"
+)
+
+TICKS = 48
+PER_SHARD = 2
+
+# fast deadlines, harvest on, tracing via the supervisor tracer;
+# desync detection OFF in the e2e fixture so matches are bank-eligible
+# (the native in-crossing phase spans are what the trace pin needs)
+TUNING = FleetTuning(
+    heartbeat_interval_s=0.05,
+    heartbeat_deadline_s=1.0,
+    rpc_timeout_s=5.0,
+    spawn_timeout_s=120.0,
+    drain_deadline_s=0.5,
+    restart_max=0,
+)
+
+
+# ----------------------------------------------------------------------
+# the snapshot/merge seam, no processes involved
+# ----------------------------------------------------------------------
+
+
+class TestRegistryCollector:
+    def _populated(self):
+        reg = Registry()
+        reg.counter("c_total", "a counter").inc(5)
+        reg.counter("lc_total", "labeled", labels=("kind",)).labels(
+            kind="x").inc(2)
+        reg.gauge("g", "a gauge").set(7)
+        h = reg.histogram("h_seconds", "a histogram", buckets=(1, 2, 4))
+        h.observe(0.5)
+        h.observe(3)
+        h.observe(100)
+        return reg
+
+    def test_deltas_then_merge_reproduce_values(self):
+        reg = self._populated()
+        coll = RegistryCollector(reg, gen=1)
+        obs = FleetObs(metrics=Registry())
+        snap = coll.collect()
+        assert snap is not None and snap["seq"] == 1
+        assert obs.merge_snapshot("s1", snap)
+        # second interval: only the moved samples ship
+        reg.value  # (no-op)
+        reg.counter("c_total").inc(3)
+        snap2 = coll.collect()
+        names = {f["name"] for f in snap2["families"]}
+        assert names == {"c_total"}
+        assert obs.merge_snapshot("s1", snap2)
+        har = obs.harvest
+        assert har.value("c_total", shard="s1", backend="proc") == 8
+        assert har.value("lc_total", kind="x", shard="s1",
+                         backend="proc") == 2
+        assert har.value("g", shard="s1", backend="proc") == 7
+        # histogram: bucket-for-bucket equality with the source
+        fam = {f.name: f for f in har.families()}["h_seconds"]
+        child = fam.labels(shard="s1", backend="proc")
+        src = {f.name: f for f in reg.families()}["h_seconds"]
+        assert child.cumulative() == src.cumulative()
+        assert child.sum == src.sum and child.count == src.count
+
+    def test_idle_collect_returns_none(self):
+        reg = self._populated()
+        coll = RegistryCollector(reg, gen=1)
+        assert coll.collect() is not None
+        assert coll.collect() is None  # nothing moved
+
+    def test_merge_is_idempotent_under_redelivery(self):
+        reg = self._populated()
+        coll = RegistryCollector(reg, gen=9)
+        obs = FleetObs(metrics=Registry())
+        snap = coll.collect()
+        assert obs.merge_snapshot("s1", snap) is True
+        before = obs.harvest.value("c_total", shard="s1", backend="proc")
+        # the same snapshot re-delivered (duplicated heartbeat): dropped
+        assert obs.merge_snapshot("s1", snap) is False
+        assert obs.harvest.value(
+            "c_total", shard="s1", backend="proc") == before
+        # and an OLDER seq after a newer one: dropped too
+        reg.counter("c_total").inc(1)
+        snap2 = coll.collect()
+        assert obs.merge_snapshot("s1", snap2) is True
+        assert obs.merge_snapshot("s1", snap) is False
+
+    def test_new_incarnation_gen_applies_fresh(self):
+        reg = self._populated()
+        obs = FleetObs(metrics=Registry())
+        snap = RegistryCollector(reg, gen=1).collect()
+        assert obs.merge_snapshot("s1", snap)
+        v1 = obs.harvest.value("c_total", shard="s1", backend="proc")
+        # runner restarted: fresh registry, fresh gen, seq starts over —
+        # merged counters keep growing monotonically (no reset dip)
+        reg2 = Registry()
+        reg2.counter("c_total", "a counter").inc(4)
+        snap2 = RegistryCollector(reg2, gen=2).collect()
+        assert snap2["seq"] == 1
+        assert obs.merge_snapshot("s1", snap2) is True
+        assert obs.harvest.value(
+            "c_total", shard="s1", backend="proc") == v1 + 4
+
+    def test_two_shards_share_one_family(self):
+        obs = FleetObs(metrics=Registry())
+        for sid, gen in (("s1", 1), ("s2", 2)):
+            reg = Registry()
+            reg.counter("c_total", "a counter").inc(3)
+            obs.merge_snapshot(sid, RegistryCollector(reg,
+                                                      gen=gen).collect())
+        assert obs.harvest.value("c_total", shard="s1",
+                                 backend="proc") == 3
+        assert obs.harvest.value("c_total", shard="s2",
+                                 backend="proc") == 3
+
+    def test_first_seen_snapshot_with_seq_gt_one_counts_a_gap(self):
+        # a lost FIRST snapshot (discarded tick reply at startup or
+        # right after a respawn) must still be visible as a gap
+        m = Registry()
+        obs = FleetObs(metrics=m)
+        reg = Registry()
+        reg.counter("c_total", "c").inc(1)
+        coll = RegistryCollector(reg, gen=5)
+        coll.collect()  # seq=1, "lost in transit"
+        reg.counter("c_total").inc(1)
+        snap2 = coll.collect()  # seq=2, first to arrive
+        assert obs.merge_snapshot("s1", snap2) is True
+        assert m.value("ggrs_fleet_obs_snapshot_gaps_total",
+                       shard="s1") == 1
+
+    def test_malformed_span_does_not_discard_sibling_forensics(self):
+        # one torn span tuple in a payload must not throw away the
+        # forensics ferried beside it (per-section ingest isolation)
+        obs = FleetObs(metrics=Registry())
+        obs.ingest("s1", {
+            "spans": [("X", "n", "c", 0, "not-a-duration", 1, None)],
+            "forensics": [{"kind": "slot", "match": "m0"}],
+        })
+        assert len(obs.forensics) == 1
+
+    def test_shard_label_is_overridden_not_duplicated(self):
+        # a runner family that ALREADY carries a shard label (e.g.
+        # ggrs_shard_matches) keeps one shard label, set to the
+        # supervisor's id
+        reg = Registry()
+        reg.gauge("sm", "shard matches", labels=("shard", "tier")).labels(
+            shard="whatever", tier="bank").set(4)
+        obs = FleetObs(metrics=Registry())
+        obs.merge_snapshot("s1", RegistryCollector(reg, gen=1).collect())
+        assert obs.harvest.value("sm", shard="s1", tier="bank",
+                                 backend="proc") == 4
+
+
+# ----------------------------------------------------------------------
+# exposition conformance (satellite: promtool-style validation)
+# ----------------------------------------------------------------------
+
+
+class TestExpositionConformance:
+    def test_nasty_label_and_help_values_escape_cleanly(self):
+        reg = Registry()
+        reg.counter('evil_total', 'help with\nnewline and \\backslash',
+                    labels=("why",)).labels(
+            why='a "quoted"\nmulti\\line value').inc(1)
+        h = reg.histogram("lat_seconds", "latency", buckets=(0.1, 1.0),
+                          labels=("op",))
+        h.labels(op='weird"op').observe(0.5)
+        text = prometheus_text(reg)
+        assert validate_exposition(text) == []
+        assert "\\n" in text and '\\"' in text
+
+    def test_merged_view_single_type_header_per_family(self):
+        local = Registry()
+        local.counter("dup_total", "local flavor").inc(1)
+        harvest = Registry()
+        harvest.counter("dup_total", "harvested flavor",
+                        labels=("shard", "backend")).labels(
+            shard="s1", backend="proc").inc(2)
+        text = prometheus_text(MultiRegistry(local, harvest))
+        assert text.count("# TYPE dup_total counter") == 1
+        assert validate_exposition(text) == []
+        snap = json_snapshot(MultiRegistry(local, harvest))
+        assert len(snap["dup_total"]["samples"]) == 2
+
+    def test_validator_catches_histogram_violations(self):
+        bad_order = (
+            "# TYPE h histogram\n"
+            'h_bucket{le="2"} 1\n'
+            'h_bucket{le="1"} 1\n'
+            'h_bucket{le="+Inf"} 2\n'
+            "h_sum 3\nh_count 2\n"
+        )
+        assert any("ascending" in e
+                   for e in validate_exposition(bad_order))
+        no_inf = (
+            "# TYPE h histogram\n"
+            'h_bucket{le="1"} 1\n'
+            "h_sum 1\nh_count 1\n"
+        )
+        assert any("+Inf" in e for e in validate_exposition(no_inf))
+        decreasing = (
+            "# TYPE h histogram\n"
+            'h_bucket{le="1"} 5\n'
+            'h_bucket{le="+Inf"} 3\n'
+            "h_sum 1\nh_count 3\n"
+        )
+        assert any("decrease" in e for e in validate_exposition(decreasing))
+
+    def test_validator_catches_syntax_violations(self):
+        assert any("duplicate sample" in e for e in validate_exposition(
+            "a_total 1\na_total 2\n"))
+        assert any("escape" in e for e in validate_exposition(
+            'a{x="bad\\q"} 1\n'))
+        assert any("bad sample value" in e for e in validate_exposition(
+            "a_total one\n"))
+        # histograms need le strictly ascending even with equal uppers
+        dup_le = (
+            "# TYPE h histogram\n"
+            'h_bucket{le="1"} 1\n'
+            'h_bucket{le="1"} 1\n'
+            'h_bucket{le="+Inf"} 1\n'
+            "h_sum 1\nh_count 1\n"
+        )
+        assert validate_exposition(dup_le)
+
+    def test_fleet_registry_exposition_is_conformant(self):
+        # a real (in-process) fleet's merged view passes the validator
+        ctx = drive_fleet_chaos(24, matches_per_shard=2, seed=5)
+        try:
+            text = prometheus_text(ctx["sup"].merged_registry())
+            assert validate_exposition(text) == []
+        finally:
+            ctx["sup"].close()
+
+
+# ----------------------------------------------------------------------
+# Perfetto export schema validation (satellite: CI-checked traces)
+# ----------------------------------------------------------------------
+
+
+class TestPerfettoValidation:
+    def test_nested_spans_validate(self):
+        tracer = Tracer()
+        with tracer.span("outer"):
+            with tracer.span("inner"):
+                pass
+        tracer.add_instant("mark")
+        assert validate_chrome_trace(tracer.chrome_trace()) == []
+
+    def test_violations_detected(self):
+        assert validate_chrome_trace({"nope": 1})
+        bad_ph = {"traceEvents": [
+            {"name": "x", "ph": "Z", "ts": 0, "pid": 1, "tid": 1}]}
+        assert any("unknown ph" in p for p in validate_chrome_trace(bad_ph))
+        neg_ts = {"traceEvents": [
+            {"name": "x", "ph": "X", "ts": -5, "dur": 1, "pid": 1,
+             "tid": 1}]}
+        assert any("bad ts" in p for p in validate_chrome_trace(neg_ts))
+        overlap = {"traceEvents": [
+            {"name": "a", "ph": "X", "ts": 0, "dur": 10, "pid": 1,
+             "tid": 1},
+            {"name": "b", "ph": "X", "ts": 5, "dur": 10, "pid": 1,
+             "tid": 1},
+        ]}
+        assert any("partially overlaps" in p
+                   for p in validate_chrome_trace(overlap))
+
+    def test_span_ship_cap_defers_instead_of_dropping(self):
+        # a burst beyond the per-reply cap ships oldest-first across
+        # SEVERAL replies; nothing retained by the ring is lost
+        from ggrs_tpu.fleet.proc import ShardRunner
+        from ggrs_tpu.fleet.tuning import FleetTuning
+
+        runner = ShardRunner.__new__(ShardRunner)
+        runner.tracer = Tracer(capacity=64)
+        runner.tuning = FleetTuning(obs_max_spans_per_reply=4)
+        runner._spans_shipped = 0
+        for i in range(10):
+            runner.tracer.add_complete(f"s{i}", i * 100, 10)
+        shipped = []
+        for _ in range(4):
+            shipped.extend(runner._new_spans())
+        assert [e[1] for e in shipped] == [f"s{i}" for i in range(10)]
+        assert runner._new_spans() == []
+
+    def test_import_spans_shift_and_tag(self):
+        tracer = Tracer()
+        events = [("X", "remote.span", "native", 1_000_000, 500_000,
+                   42, {"k": 1})]
+        n = tracer.import_spans(events, offset_ns=1_000_000,
+                                extra_args={"shard": "s9"})
+        assert n == 1
+        (ph, name, _cat, start, dur, _tid, args) = tracer.events()[0]
+        assert (ph, name, start, dur) == ("X", "remote.span", 0, 500_000)
+        assert args["shard"] == "s9" and args["src_tid"] == 42
+        # malformed entries are skipped, not raised
+        assert tracer.import_spans([("X", "torn")]) == 0
+
+
+# ----------------------------------------------------------------------
+# cardinality bounds (satellite: no per-match label explosion)
+# ----------------------------------------------------------------------
+
+
+def _series_stats(registry):
+    series = 0
+    per_slotish = 0
+    label_values = set()
+    for fam in registry.families():
+        n = len(fam.children)
+        series += n
+        if any(ln in ("slot", "endpoint") for ln in fam.labelnames):
+            per_slotish += n
+        for values in fam.children:
+            label_values.update(values)
+    return series, per_slotish, label_values
+
+
+@needs_native
+class TestCardinalityBounds:
+    def test_b128_pool_series_bounded(self):
+        # B = 2*63 + 1 = 127 slots plus the ext target's peer = a
+        # 128-session world; scrape materializes the per-slot gauges
+        n_matches = 63
+        ctx = drive_chaos(4, n_matches=n_matches, seed=2)
+        B = 2 * n_matches + 1
+        series, per_slot, values = _series_stats(ctx["registry"])
+        # per-slot families scale with B (bounded by design); everything
+        # else must stay O(1): pin total <= per_slot + a fixed budget
+        assert per_slot <= 16 * B
+        assert series - per_slot < 128, (
+            f"{series - per_slot} non-slot series for a B={B} pool"
+        )
+
+    def test_fleet_plus_pool_no_match_or_viewer_labels(self):
+        ctx = drive_fleet_chaos(24, matches_per_shard=2, seed=3,
+                                n_spectators=2)
+        sup = ctx["sup"]
+        try:
+            # grow to 4 shards' worth of harvest: merge two synthetic
+            # runner snapshots beside the two real shards
+            for sid in ("s2", "s3"):
+                reg = Registry()
+                reg.counter("ggrs_pool_ticks_total", "ticks").inc(10)
+                sup.fleet_obs.merge_snapshot(
+                    sid, RegistryCollector(reg, gen=99).collect())
+            merged = sup.merged_registry()
+            series, _per_slot, values = _series_stats(merged)
+            match_ids = set(ctx["match_ids"])
+            viewer_ids = {"V0", "V1"}
+            leaked = (match_ids | viewer_ids) & values
+            assert not leaked, f"per-match/per-viewer labels: {leaked}"
+            assert series < 400, f"series count {series} unbounded?"
+        finally:
+            sup.close()
+
+
+# ----------------------------------------------------------------------
+# the tentpole, end to end: harvest + traces over a real subprocess
+# ----------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def traced_proc_fleet():
+    tracer = Tracer(capacity=16384)
+    ctx = drive_proc_fleet(
+        TICKS, matches_per_shard=PER_SHARD, seed=7, backend="proc",
+        tuning=TUNING, tracer=tracer, desync_interval=0,
+    )
+    ctx["tracer"] = tracer
+    # the direct-query control: the runner's registries, fetched over an
+    # explicit debug RPC AFTER the run (all prior frames drain first, so
+    # the harvest and the query observe the same final state)
+    sup = ctx["sup"]
+    rpc_ops_before_query = {
+        labels["op"]
+        for fam in sup.metrics.families()
+        if fam.name == "ggrs_fleet_proc_rpc_seconds"
+        for labels, _child in fam.samples()
+    }
+    ctx["rpc_ops"] = rpc_ops_before_query
+    ctx["direct"] = sup.shards["s1"]._call("metrics")
+    yield ctx
+    ctx["sup"].close()
+
+
+@needs_native
+class TestFleetHarvestE2E:
+    def test_one_scrape_serves_runner_counters_by_shard(
+            self, traced_proc_fleet):
+        """The acceptance pin: the merged view carries the subprocess
+        runner's counters (journal family, fsync histogram, pool ticks)
+        labeled shard=s1,backend=proc — and they are VALUE-EQUAL to
+        querying the runner's registry directly."""
+        ctx = traced_proc_fleet
+        har = ctx["sup"].fleet_obs.harvest
+        direct = ctx["direct"]["shard"]
+
+        def direct_value(name, **labels):
+            for s in direct[name]["samples"]:
+                if all(s["labels"].get(k) == v for k, v in labels.items()):
+                    return s.get("value", s.get("count"))
+            return None
+
+        for name in ("ggrs_journal_frames_total",
+                     "ggrs_journal_bytes_total",
+                     "ggrs_pool_ticks_total"):
+            merged = har.value(name, shard="s1", backend="proc")
+            assert merged is not None, f"{name} not harvested"
+            assert merged == direct_value(name), name
+        # the histogram acceptance example: journal fsync, bucket-equal
+        fam = {f.name: f for f in har.families()}[
+            "ggrs_journal_fsync_seconds"]
+        child = fam.labels(shard="s1", backend="proc")
+        dsamp = direct["ggrs_journal_fsync_seconds"]["samples"][0]
+        assert child.count == dsamp["count"]
+        assert child.sum == pytest.approx(dsamp["sum"])
+        assert [c for _u, c in child.cumulative()] == [
+            b["count"] for b in dsamp["buckets"]
+        ]
+
+    def test_harvest_adds_zero_rpc_round_trips(self, traced_proc_fleet):
+        """Only the serving path's ops appear in the RPC histogram — the
+        harvest rides their replies, it never adds a call."""
+        assert traced_proc_fleet["rpc_ops"] <= {
+            "hello", "tick", "admit", "adopt", "evict", "drop",
+            "identity", "healthz", "retire", "shutdown",
+        }
+
+    def test_metrics_server_serves_the_fleet(self, traced_proc_fleet):
+        """One HTTP scrape of the supervisor returns the runner's
+        families, shard-labeled, as conformant exposition."""
+        import urllib.request
+
+        from ggrs_tpu.obs import start_http_server
+
+        sup = traced_proc_fleet["sup"]
+        server = start_http_server(sup.merged_registry(), port=0,
+                                   health=sup.healthz)
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/metrics"
+            ) as r:
+                text = r.read().decode()
+        finally:
+            server.close()
+        assert validate_exposition(text) == []
+        assert 'ggrs_journal_fsync_seconds_bucket{' in text
+        line = next(l for l in text.splitlines()
+                    if l.startswith("ggrs_pool_ticks_total{"))
+        assert 'shard="s1"' in line and 'backend="proc"' in line
+
+    def test_perfetto_export_nests_runner_crossing_in_fleet_tick(
+            self, traced_proc_fleet):
+        """The cross-process trace pin: fleet.tick spans contain the
+        subprocess runner's bank.crossing (offset-adjusted), and the
+        export passes schema validation."""
+        tracer = traced_proc_fleet["tracer"]
+        trace = tracer.chrome_trace()
+        assert validate_chrome_trace(trace, eps_us=50.0) == []
+        evs = trace["traceEvents"]
+        fleet_ticks = [e for e in evs if e["name"] == "fleet.tick"]
+        crossings = [
+            e for e in evs if e["name"] == "bank.crossing"
+            and e.get("args", {}).get("shard") == "s1"
+        ]
+        assert len(fleet_ticks) == TICKS
+        assert crossings, "no runner bank.crossing spans shipped"
+        nested = sum(
+            1 for c in crossings for f in fleet_ticks
+            if f["ts"] <= c["ts"]
+            and c["ts"] + c["dur"] <= f["ts"] + f["dur"]
+        )
+        assert nested == len(crossings)
+        # the runner's tick span carries the fleet tick id (correlation)
+        rt = [e for e in evs if e["name"] == "runner.tick"]
+        assert rt and all(
+            isinstance(e["args"].get("tick"), int) for e in rt
+        )
+
+    def test_healthz_aggregates_runner_liveness(self, traced_proc_fleet):
+        h = traced_proc_fleet["sup"].healthz()
+        assert h["proc"]["s1"]["watchdog"] == "ok"
+        assert h["proc"]["s1"]["heartbeat_age_s"] is not None
+        assert h["max_proc_heartbeat_age_s"] is not None
+        assert h["shards"]["s1"]["watchdog"] == "ok"
+
+    def test_digest_is_json_safe(self, traced_proc_fleet):
+        import json as _json
+
+        d = fleet_metrics_digest(traced_proc_fleet["sup"])
+        _json.dumps(d)
+        assert d["snapshots_merged"] > 0
+        assert d["snapshot_dups"] == 0 and d["samples_dropped"] == 0
+
+
+# ----------------------------------------------------------------------
+# the forensics ferry
+# ----------------------------------------------------------------------
+
+
+@needs_native
+class TestForensicsFerry:
+    def test_runner_fault_forensics_reach_the_supervisor(self):
+        """A native slot fault injected IN the runner quarantines the
+        slot there; the flight-recorder dump and fault log ferry back on
+        the next tick reply instead of dying with the child."""
+
+        def inject(i, ctx):
+            if i == 24:
+                ctx["sup"].shards["s1"].inject_match_error("m1")
+
+        ctx = drive_proc_fleet(
+            TICKS, matches_per_shard=1, seed=13, backend="proc",
+            tuning=TUNING, inject=inject, desync_interval=0,
+        )
+        sup = ctx["sup"]
+        try:
+            items = [f for f in sup.fleet_obs.forensics
+                     if f["shard"] == "s1"]
+            assert items, "no forensics ferried from the runner"
+            item = items[0]
+            assert item["kind"] == "slot" and item["match"] == "m1"
+            assert "fault" in item["dump"]  # the recorder saw the fault
+            assert item["faults"]
+            assert sup.metrics.value(
+                "ggrs_fleet_obs_forensics_total", shard="s1", kind="slot"
+            ) >= 1
+        finally:
+            sup.close()
+
+    def test_inproc_shard_feeds_the_same_ring(self):
+        def inject(i, ctx):
+            if i == 24:
+                ctx["sup"].shards["s0"].inject_match_error("m0")
+
+        ctx = drive_fleet_chaos(TICKS, matches_per_shard=1, seed=13,
+                                inject=inject, desync_interval=0)
+        sup = ctx["sup"]
+        try:
+            items = [f for f in sup.fleet_obs.forensics
+                     if f["shard"] == "s0"]
+            assert items and items[0]["match"] == "m0"
+        finally:
+            sup.close()
+
+
+# ----------------------------------------------------------------------
+# healthz satellite: a STALE runner pages before it is dead
+# ----------------------------------------------------------------------
+
+
+class TestStaleRunnerPages:
+    def test_sigstopped_runner_flips_fleet_healthz(self):
+        """SIGSTOP a runner (alive but silent): within the heartbeat
+        deadline the fleet /healthz aggregate must go not-ok and surface
+        the watchdog stage — paging on staleness, not only on death."""
+        t = FleetTuning(
+            heartbeat_interval_s=0.05, heartbeat_deadline_s=0.3,
+            rpc_timeout_s=0.3, drain_deadline_s=30.0,
+            spawn_timeout_s=120.0, restart_max=0,
+        )
+        sup = ShardSupervisor(("s1",), proc_shards=("s1",), tuning=t,
+                              metrics=Registry())
+        try:
+            s1 = sup.shards["s1"]
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                sup.advance_all()
+                if sup.healthz()["ok"]:
+                    break
+                time.sleep(0.02)
+            assert sup.healthz()["ok"]
+            os.kill(s1.pid, signal.SIGSTOP)
+            paged = None
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                sup.advance_all()
+                h = sup.healthz()
+                if not h["ok"] and s1._child_alive():
+                    paged = h
+                    break
+                time.sleep(0.02)
+            assert paged is not None, "stale runner never paged"
+            assert paged["proc"]["s1"]["watchdog"] in (
+                "suspect", "terminating"
+            )
+            assert s1._child_alive()  # paged while merely wedged
+        finally:
+            sup.close()
+
+
+# ----------------------------------------------------------------------
+# fleet_top rendering
+# ----------------------------------------------------------------------
+
+
+class TestFleetTop:
+    def test_histogram_quantile(self):
+        uppers = [0.001, 0.01, 0.1]
+        # 10 obs <=1ms, 10 in (1,10]ms, none beyond
+        assert histogram_quantile(0.5, uppers, [10, 20, 20, 20]) == \
+            pytest.approx(0.001)
+        q99 = histogram_quantile(0.99, uppers, [10, 20, 20, 20])
+        assert 0.001 < q99 <= 0.01
+        assert histogram_quantile(0.99, uppers, []) is None
+        assert histogram_quantile(0.99, uppers, [0, 0, 0, 0]) is None
+
+    def test_render_from_fleet_snapshots(self):
+        import importlib.util
+        from pathlib import Path
+
+        spec = importlib.util.spec_from_file_location(
+            "fleet_top",
+            Path(__file__).resolve().parents[1] / "scripts"
+            / "fleet_top.py",
+        )
+        fleet_top = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(fleet_top)
+
+        ctx = drive_fleet_chaos(24, matches_per_shard=2, seed=5)
+        sup = ctx["sup"]
+        try:
+            healthz = sup.healthz()
+            metrics = json_snapshot(sup.merged_registry())
+        finally:
+            sup.close()
+        frame = fleet_top.render(healthz, metrics)
+        assert "s0" in frame and "s1" in frame
+        assert "SHARD" in frame and "WATCHDOG" in frame
+        assert "admissions=" in frame and "harvest:" in frame
