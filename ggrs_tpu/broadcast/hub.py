@@ -282,7 +282,18 @@ class SpectatorHub:
         pool = self.pool
         lines = []
         total_viewers = 0
-        for i in range(len(pool)):
+        # incremental walk (DESIGN.md §19): only slots that actually have
+        # fan-out endpoints are visited — a 256-slot pool with 3 spectated
+        # matches does 3 state reads, not 256
+        mirrors = getattr(pool, "_mirrors", None)
+        if mirrors and pool.native_active:
+            candidates = [
+                i for i, m in enumerate(mirrors)
+                if m.spectators or i in pool._evicted
+            ]
+        else:
+            candidates = range(len(pool))
+        for i in candidates:
             states = pool.spectator_states(i)
             if not states:
                 continue

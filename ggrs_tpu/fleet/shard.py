@@ -391,7 +391,28 @@ class PoolShard:
         """Capture the post-mortem the instant a bank slot leaves native
         (quarantined / evicted / dead): flight-recorder dump, fault log
         tail, and any DesyncReport — into the ferry buffer
-        ``drain_forensics`` ships (DESIGN.md §18)."""
+        ``drain_forensics`` ships (DESIGN.md §18).
+
+        Incremental (DESIGN.md §19): driven by the pool's supervision
+        transition feed instead of polling every match's slot state every
+        tick — on the quiet steady state this is one empty-list drain.
+        Pools without the feed (user-supplied stand-ins) keep the legacy
+        full walk."""
+        drain = getattr(self.pool, "drain_state_transitions", None)
+        if drain is not None:
+            transitions = drain()
+            if not transitions:
+                return  # the quiet steady state: one empty-list drain
+            slot_to_match = {s: m for m, s in self._matches.items()}
+            for slot, _old, state, _tick in transitions:
+                match_id = slot_to_match.get(slot)
+                if match_id is None:
+                    continue
+                self._slot_last_state[match_id] = state
+                if state not in ("quarantined", "evicted", "dead"):
+                    continue
+                self._capture_slot_forensic(match_id, slot, state)
+            return
         for match_id, slot in self._matches.items():
             try:
                 state = self.pool.slot_state(slot)
@@ -403,28 +424,33 @@ class PoolShard:
                 "quarantined", "evicted", "dead"
             ):
                 continue
-            item: Dict[str, Any] = dict(
-                kind="slot", match=match_id, slot=slot, state=state,
-                tick=self.ticks,
-            )
-            try:
-                item["dump"] = self.pool.flight_dump(slot, 32)
-            except Exception:
-                pass
-            try:
-                item["faults"] = [
-                    dict(tick=f.tick, code=f.code, detail=f.detail)
-                    for f in self.pool.fault_log(slot)[-8:]
-                ]
-            except Exception:
-                pass
-            try:
-                report = self.pool.desync_report(slot)
-                if report is not None:
-                    item["desync_report"] = report.to_dict()
-            except Exception:
-                pass
-            self._record_forensic(item)
+            self._capture_slot_forensic(match_id, slot, state)
+
+    def _capture_slot_forensic(self, match_id: str, slot: int,
+                               state: str) -> None:
+        """Build one slot post-mortem item into the ferry buffer."""
+        item: Dict[str, Any] = dict(
+            kind="slot", match=match_id, slot=slot, state=state,
+            tick=self.ticks,
+        )
+        try:
+            item["dump"] = self.pool.flight_dump(slot, 32)
+        except Exception:
+            pass
+        try:
+            item["faults"] = [
+                dict(tick=f.tick, code=f.code, detail=f.detail)
+                for f in self.pool.fault_log(slot)[-8:]
+            ]
+        except Exception:
+            pass
+        try:
+            report = self.pool.desync_report(slot)
+            if report is not None:
+                item["desync_report"] = report.to_dict()
+        except Exception:
+            pass
+        self._record_forensic(item)
 
     def _record_forensic(self, item: Dict[str, Any]) -> None:
         self._forensic_items.append(item)

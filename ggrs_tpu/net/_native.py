@@ -471,6 +471,12 @@ def _load() -> Optional[ctypes.CDLL]:
                 lib.ggrs_bank_set_timing.argtypes = [
                     ctypes.c_void_p, ctypes.c_int,
                 ]
+            if hasattr(lib, "ggrs_bank_hdr_stride"):
+                # packed per-tick output header (DESIGN.md §19); absent on
+                # a prebuilt pre-header .so — pools then parse the legacy
+                # body-only tick output and skip the vectorized fast path
+                lib.ggrs_bank_hdr_stride.restype = ctypes.c_int
+                lib.ggrs_bank_hdr_stride.argtypes = []
             if hasattr(lib, "ggrs_bank_pump"):
                 # kernel-batched socket datapath (net_batch.cpp + the
                 # bank's pump entry, DESIGN.md §15); absent on a prebuilt
@@ -587,6 +593,31 @@ EP_STAT_FIELDS = (
     "emits", "emit_bytes", "acks", "datagrams", "new_frames", "drops",
     "fallbacks",
 )
+
+# packed per-tick output header (session_bank.cpp kHdr*; DESIGN.md §19):
+# one BANK_HDR_DTYPE-shaped record per session leads the tick output when
+# the library exports ggrs_bank_hdr_stride.  The pool classifies all B
+# slots from this table (NumPy over the output buffer); slots with no
+# events/spectator/consensus/dirty activity take the fast path — ops
+# decoded through pooled request objects, the events/mirror/spectator
+# sections JUMPED via rec_len.  The QUIET bit and save_frame field label
+# the canonical [save, advance] tick shape; they are classification
+# metadata (diagnostics, future specialized decoders) — the current fast
+# path decodes every op shape generically and does not read them.
+BANK_HDR_LIVE = 1        # stepped this tick and err == 0
+BANK_HDR_QUIET = 2       # ops are exactly [save, advance]
+BANK_HDR_EVENTS = 4      # protocol events present
+BANK_HDR_SPEC = 8        # spectator endpoints / streams / events present
+BANK_HDR_CONSENSUS = 16  # disconnect consensus pending
+BANK_HDR_DIRTY = 32      # a status mirror changed this tick
+BANK_HDR_OUT = 64        # outbound datagram sections non-empty
+BANK_HDR_SKIP = 128      # status-only record (slot was skipped)
+BANK_HDR_CONF = 256      # journal-tap confirmed records present
+BANK_HDR_FIELDS = (
+    ("flags", "<u4"), ("rec_len", "<u4"), ("err", "<i4"), ("fa", "<i4"),
+    ("landed", "<i8"), ("current", "<i8"), ("confirmed", "<i8"),
+    ("save_frame", "<i8"),
+)  # itemsize 48 == ggrs_bank_hdr_stride()
 
 # in-crossing phase order (session_bank.cpp BankPhase; the timing tails on
 # the tick and stats outputs carry one u64 of nanoseconds per entry, in

@@ -88,6 +88,8 @@ import zlib
 from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
+import numpy as np
+
 from ..core.errors import (
     BadPlayerHandle,
     GgrsError,
@@ -150,6 +152,54 @@ _EV_INTERRUPTED = 1
 _EV_RESUMED = 2
 _EV_DISCONNECTED = 3
 _EV_CHECKSUM = 4
+
+# ---- vectorized policy plane (DESIGN.md §19) -----------------------------
+# Packed per-tick output header: one fixed-stride record per slot leads the
+# tick output (session_bank.cpp kHdr*), classified here with a handful of
+# NumPy ops.  Quiet slots — live, no events, no spectator streams, no
+# consensus, no status-mirror changes — take a fast path that refills
+# pooled GgrsRequest objects (per-kind per-slot caches; rollback-resim
+# ticks reuse the same objects too) and jumps over the events / status
+# mirror / spectator-tail sections instead of parsing them positionally.
+_HDR_DTYPE = np.dtype(list(_native.BANK_HDR_FIELDS))
+_HDR_FAST_WANT = _native.BANK_HDR_LIVE
+_HDR_FAST_MASK = (
+    _HDR_FAST_WANT
+    | _native.BANK_HDR_EVENTS
+    | _native.BANK_HDR_SPEC
+    | _native.BANK_HDR_CONSENSUS
+    | _native.BANK_HDR_DIRTY
+    | _native.BANK_HDR_SKIP
+)
+
+# Lazy event decoding: the policy section stages cheap tagged tuples in the
+# mirror's event queue; real GgrsEvent objects are constructed only when a
+# consumer actually drains them (``events()``, eviction's pending_events,
+# the export bundle).  Tags deliberately unhashable-free plain strings.
+_LZ_INTERRUPTED = "i"
+_LZ_RESUMED = "r"
+_LZ_DISCONNECTED = "d"
+_LZ_WAIT = "w"
+
+
+def _materialize_events(queue) -> List[Any]:
+    """Construct the public ``GgrsEvent`` objects from a mirror's staged
+    event queue (lazily-decoded tuples; already-constructed events pass
+    through untouched — eviction hand-off re-queues real objects)."""
+    out: List[Any] = []
+    for ev in queue:
+        if type(ev) is not tuple:
+            out.append(ev)
+        elif ev[0] == _LZ_INTERRUPTED:
+            out.append(NetworkInterrupted(addr=ev[1],
+                                          disconnect_timeout=ev[2]))
+        elif ev[0] == _LZ_RESUMED:
+            out.append(NetworkResumed(addr=ev[1]))
+        elif ev[0] == _LZ_DISCONNECTED:
+            out.append(Disconnected(addr=ev[1]))
+        else:  # _LZ_WAIT
+            out.append(WaitRecommendation(skip_frames=ev[1]))
+    return out
 
 # receive staging caps shared with NativeEndpointCore: a session whose
 # worst-case input packet could overflow them must stay on the fallback
@@ -332,6 +382,13 @@ class _SessionMirror:
         "local_disc", "local_last", "event_queue", "next_recommended_sleep",
         "staged_inputs", "pending_ctrl",
         "spectators", "addr_to_spec", "next_spec_frame", "send_raw",
+        # vectorized policy plane (DESIGN.md §19): the byte length of this
+        # slot's status-mirror section (to jump to the broadcast tail
+        # without parsing) and the pooled request-object caches the fast
+        # path refills in place — valid until the next advance_all, like
+        # the scrape records
+        "mirror_len", "pooled_list", "pool_saves", "pool_loads",
+        "pool_advs",
     )
 
     def __init__(self, config, socket, num_players, max_prediction,
@@ -370,8 +427,19 @@ class _SessionMirror:
                 RawMessage(data), addr
             )
         self.send_raw = send
+        # vectorized policy plane: filled by _finalize on the native path.
+        # The pools grow to the deepest tick seen (rollback resims append
+        # extra save/advance pairs) and are reused in place from then on.
+        self.mirror_len = 0
+        self.pooled_list: List[Any] = []
+        self.pool_saves: List[SaveGameState] = []
+        self.pool_loads: List[LoadGameState] = []
+        self.pool_advs: List[AdvanceFrame] = []
 
     def push_event(self, event) -> None:
+        """Queue one event — either a real GgrsEvent or a lazily-decoded
+        tag tuple (``_materialize_events`` constructs the public objects
+        when a consumer drains the queue)."""
         self.event_queue.append(event)
         while len(self.event_queue) > MAX_EVENT_QUEUE_SIZE:
             self.event_queue.popleft()
@@ -425,6 +493,8 @@ class HostSessionPool:
         self._use_pump = False
         self._net_handles: List[Optional[int]] = []
         self._io_attached: List[bool] = []
+        self._io_live: List[int] = []  # attached slot indices (the io-delta
+        # walk is driven by this list, not range(B) — DESIGN.md §19)
         self._io_prev: Dict[Tuple[int, int], int] = {}  # (slot, word) deltas
         # final counter snapshots of detached/evicted slots: io_stats()
         # totals must never regress when a NetBatch is released
@@ -443,6 +513,19 @@ class HostSessionPool:
         self.crossings = 0  # ggrs_bank_tick invocations (the count test)
         self.harvests = 0   # eviction harvest crossings (one-off per fault)
         self.stat_crossings = 0  # ggrs_bank_stats invocations (scrapes)
+        # ---- vectorized policy plane (DESIGN.md §19) ----
+        # _has_hdr: the loaded library leads the tick output with the
+        # packed per-slot header table (and appends peer mirrors to the
+        # harvest); _vectorized: classify slots from that table and
+        # fast-path the quiet ones (GGRS_TPU_NO_FASTPATH=1 forces the
+        # legacy per-slot parse — the parity fuzz's reference leg).
+        # Tracing uses the legacy parse too: the per-slot spans ARE the
+        # point of a traced tick.
+        self._has_hdr = False
+        self._hdr_stride = 0
+        self._vectorized = False
+        self.fast_slot_ticks = 0  # slots served by the fast path (counter)
+        self.fast_ticks = 0       # ticks where every live slot was fast
         # ---- observability (DESIGN.md §12) ----
         # metrics: explicit Registry for isolation (tests, multi-pool
         # processes) or the process-wide default; Registry(enabled=False)
@@ -547,9 +630,17 @@ class HostSessionPool:
         self._m_io_sendmmsg = self._m_io_syscalls.labels(kind="sendmmsg")
         self._m_io_dgrams_in = self._m_io_dgrams.labels(dir="in")
         self._m_io_dgrams_out = self._m_io_dgrams.labels(dir="out")
+        self._m_fast_slots = m.counter(
+            "ggrs_pool_fastpath_slots_total",
+            "slot ticks served by the vectorized quiet path (no per-slot "
+            "body parse)")
         self._quarantined_at: Dict[int, int] = {}  # index -> quarantine tick
         self._stats_cache: Optional[Tuple[int, List[Dict[str, Any]]]] = None
         self._setter_cache: Dict[int, Any] = {}  # slot -> prebound gauge sets
+        # slot -> prebound spectator catchup-lag Gauge.set list: label
+        # resolution (str() + dict walk) off the scrape loop, like
+        # _setter_cache — part of the B=256 allocation-free scrape pin
+        self._spec_setter_cache: Dict[int, List[Any]] = {}
         # slot -> prebound (datagrams.inc, bytes.inc): label resolution off
         # the per-tick fan-out send loop, like _setter_cache for scrapes
         self._fanout_counters: Dict[int, Tuple[Any, Any]] = {}
@@ -593,6 +684,16 @@ class HostSessionPool:
         self.retire_dead_matches = retire_dead_matches
         self._tick_no = 0
         self._slot_state: List[str] = []
+        # incremental supervision (DESIGN.md §19): the post-tick walk is
+        # driven by the slots that actually need attention — quarantined
+        # (eviction pending) and evicted (their Python session must tick)
+        # — instead of range(B).  Maintained by _set_slot_state; dead /
+        # migrated slots leave the set (nothing here ticks for them).
+        self._attention: set = set()
+        # state-transition feed for incremental consumers (fleet shards'
+        # forensics sweep): (slot, old, new, tick), bounded, drained via
+        # drain_state_transitions()
+        self._state_transitions: List[Tuple[int, str, str, int]] = []
         self._fault_log: List[List[SlotFault]] = []
         self._evicted: Dict[int, Any] = {}       # index -> P2PSession
         self._pending_load: Dict[int, GgrsRequest] = {}
@@ -645,6 +746,18 @@ class HostSessionPool:
         lib = None if os.environ.get("GGRS_TPU_NO_NATIVE") else (
             _native.bank_lib()
         )
+        if lib is not None and hasattr(lib, "ggrs_bank_hdr_stride"):
+            if int(lib.ggrs_bank_hdr_stride()) != _HDR_DTYPE.itemsize:
+                # library/driver layout skew (a newer .so than this
+                # driver): we cannot parse its header table, so degrade
+                # like every other layout mismatch — per-session Python
+                # sessions, never a half-initialized bank
+                _logger.warning(
+                    "bank header stride %d != %d (library/driver skew); "
+                    "pool falls back to per-session Python sessions",
+                    int(lib.ggrs_bank_hdr_stride()), _HDR_DTYPE.itemsize,
+                )
+                lib = None
         # The bank runs every session's timers off ONE clock read per tick
         # (builder 0's clock) — that is the pool's contract.  Builders whose
         # clocks are visibly on a different timebase (a frozen test clock
@@ -690,6 +803,15 @@ class HostSessionPool:
         # a library built with the batched datapath emits a per-slot io
         # tail on every stats dump (u8 flag + counters when attached)
         self._has_io_layout = hasattr(lib, "ggrs_bank_pump")
+        # packed per-tick header (DESIGN.md §19): presence-probed like the
+        # other layout extensions; a prebuilt pre-header library emits the
+        # body-only output and the pool keeps the legacy parse throughout.
+        # (A stride MISMATCH was already rejected above, before the bank
+        # committed to the native path.)
+        self._has_hdr = hasattr(lib, "ggrs_bank_hdr_stride")
+        if self._has_hdr:
+            self._hdr_stride = int(lib.ggrs_bank_hdr_stride())
+            self._vectorized = not os.environ.get("GGRS_TPU_NO_FASTPATH")
         # arm the in-crossing phase timers only when someone is tracing:
         # disarmed, the tick performs zero clock reads and emits the exact
         # pre-timing output layout (the on/off wire pin rides on this)
@@ -783,6 +905,15 @@ class HostSessionPool:
                 self._m_spectators.labels(slot=str(idx)).set(
                     len(mirror.spectators)
                 )
+            # fast-path geometry: the status-mirror section's byte length
+            # (u8 n_eps + per-endpoint u8 state + players*(u8,i64) + the
+            # local players*(u8,i64) tail) — the jump from the outbound
+            # sections to the broadcast tail without a positional parse
+            mirror.mirror_len = (
+                1
+                + len(mirror.endpoints) * (1 + 9 * mirror.num_players)
+                + 9 * mirror.num_players
+            )
             self._mirrors.append(mirror)
         self._clock = self._builders[0][0]._clock
         # output buffer sized to the worst realistic tick (rollback resim
@@ -800,7 +931,8 @@ class HostSessionPool:
                 + (m.max_prediction + 4) * (16 + adv_bytes),  # journal tap
             )
         self._out_buf = ctypes.create_string_buffer(
-            max(1 << 16, per_session * len(self._mirrors))
+            max(1 << 16, per_session * len(self._mirrors)
+                + self._hdr_stride * len(self._mirrors))
         )
         # ---- batched socket datapath (DESIGN.md §15) ----
         # opt-in, per-slot, and failure is always a clean per-slot fallback
@@ -866,6 +998,7 @@ class HostSessionPool:
             lib.ggrs_bank_map_addr(self._bank, index, 1, idx, ip, port)
         self._net_handles[index] = handle
         self._io_attached[index] = True
+        self._io_live.append(index)
 
     @staticmethod
     def _io_words_to_dict(words) -> Dict[str, Any]:
@@ -888,6 +1021,8 @@ class HostSessionPool:
             return
         self._lib.ggrs_bank_detach_socket(self._bank, index)
         self._io_attached[index] = False
+        if index in self._io_live:
+            self._io_live.remove(index)
         handle = self._net_handles[index]
         self._net_handles[index] = None
         if handle:
@@ -1074,8 +1209,16 @@ class HostSessionPool:
             # (a bug in THIS builder, no per-session blame possible)
             self._invalid = f"ggrs_bank_tick failed: {rc}"
             raise RuntimeError(self._invalid)
-        request_lists = self._parse_output(ticked)
-        self._supervise(request_lists)
+        # decode: the vectorized header-classified path by default
+        # (DESIGN.md §19); the legacy sequential parse under tracing (the
+        # per-slot spans ARE the point), on pre-header libraries, and
+        # under GGRS_TPU_NO_FASTPATH (the parity fuzz's reference leg)
+        if self._vectorized and not tracing:
+            request_lists, retire_mask = self._parse_output_fast(ticked)
+        else:
+            request_lists = self._parse_output(ticked)
+            retire_mask = None
+        self._supervise(request_lists, retire_mask)
         if tracing:
             tracer.add_complete("pool.tick", t_tick,
                                 tracer.now_ns() - t_tick, cat="py")
@@ -1094,303 +1237,545 @@ class HostSessionPool:
         return list(zip(_phase_names(n_ph), vals))
 
     def _parse_output(self, ticked: List[bool]) -> List[List[GgrsRequest]]:
+        """Legacy sequential parse: every slot's body record, in order.
+        The reference decoder (the vectorized path is pinned
+        bit-identical to it by tests/test_policy_plane.py) and the
+        tracing-mode parse — per-slot spans are the point of a traced
+        tick."""
         buf = memoryview(self._out_buf).cast("B")[: self._out_len.value]
-        unpack_from = struct.unpack_from
-        pos = 0
+        pos = len(self._mirrors) * self._hdr_stride if self._has_hdr else 0
         request_lists: List[List[GgrsRequest]] = []
         tracer = self.tracer
         tracing = tracer.enabled
-        for idx, m in enumerate(self._mirrors):
+        for idx in range(len(self._mirrors)):
             t_slot = tracer.now_ns() if tracing else 0
-            players, isize = m.num_players, m.input_size
-            err, landed, frames_ahead, current, confirmed, consensus, n_ops = (
-                unpack_from("<iqiqqBH", buf, pos)
+            requests, pos, current = self._parse_slot(
+                buf, pos, idx, ticked[idx]
             )
-            pos += 35
-            # live: the bank actually stepped this slot and it didn't fault.
-            # A faulted slot's record is status-only (its ops/outbound/events
-            # were suppressed natively); parse positionally either way.
-            live = ticked[idx] and err == 0
-            if ticked[idx] and err != 0:
-                self._on_slot_fault(idx, err)
-            requests: List[GgrsRequest] = []
-            advanced = False
-            decode = m.config.input_decode
-            rec = self._recorders[idx] if self._recorders else None
-            for _ in range(n_ops):
-                kind = buf[pos]
-                pos += 1
-                if kind == 2:
-                    statuses = bytes(buf[pos : pos + players])
-                    pos += players
-                    blob = bytes(buf[pos : pos + players * isize])
-                    pos += players * isize
-                    requests.append(AdvanceFrame(inputs=[
-                        (decode(blob[p * isize : (p + 1) * isize]),
-                         _STATUS[statuses[p]])
-                        for p in range(players)
-                    ]))
-                    advanced = True
-                    self._m_req_advance.inc()
-                else:
-                    (frame,) = unpack_from("<q", buf, pos)
-                    pos += 8
-                    cell = m.saved_states.get_cell(frame)
-                    if kind == 0:
-                        requests.append(SaveGameState(cell=cell, frame=frame))
-                        advanced = False
-                        self._m_req_save.inc()
-                    else:
-                        assert cell.frame == frame, (
-                            f"rollback loads frame {frame} but its cell "
-                            f"holds {cell.frame} — was the save fulfilled?"
-                        )
-                        requests.append(LoadGameState(cell=cell, frame=frame))
-                        advanced = False
-                        self._m_req_load.inc()
-                        self._m_rollbacks.inc()
-                        if rec is not None:
-                            rec.record(
-                                self._tick_no, EV_ROLLBACK,
-                                f"load frame {frame} (was at "
-                                f"{m.current_frame})",
+            request_lists.append(requests)
+            if tracing:
+                tracer.add_complete(
+                    "pool.slot", t_slot, tracer.now_ns() - t_slot,
+                    cat="py", args={"slot": idx, "frame": current},
+                )
+        return request_lists
+
+    def _parse_output_fast(self, ticked: List[bool]):
+        """Vectorized tick decode (DESIGN.md §19): classify all B slots
+        from the packed header table with a handful of NumPy ops, then
+        fast-path every QUIET slot — live, ops exactly [save, advance], no
+        events / spectator streams / consensus / status changes.  A fast
+        slot's pooled ``SaveGameState``/``AdvanceFrame`` objects are
+        refilled in place (valid until the next ``advance_all``, like the
+        scrape records) and its body record is jumped over via the
+        header's rec_len; everything else goes through ``_parse_slot``,
+        the reference decoder, at its header-derived offset.
+
+        Returns ``(request_lists, retire_mask)`` — retire_mask[i] is True
+        when slot i's endpoint liveness may have changed this tick (the
+        ``retire_dead_matches`` walk only looks at those), or None when
+        retirement is off."""
+        mirrors = self._mirrors
+        n = len(mirrors)
+        if n == 0:
+            return [], None
+        hdr = np.frombuffer(self._out_buf, dtype=_HDR_DTYPE, count=n)
+        flags = hdr["flags"]
+        fast = (flags & _HDR_FAST_MASK) == _HDR_FAST_WANT
+        n_fast = int(np.count_nonzero(fast))
+        base = n * self._hdr_stride
+        rec_len = hdr["rec_len"]
+        offs = np.empty(n, np.int64)
+        offs[0] = base
+        if n > 1:
+            offs[1:] = base + np.cumsum(rec_len[:-1], dtype=np.int64)
+        if n_fast == 0:
+            # nothing quiet this tick: sequential reference parse (cheaper
+            # than per-slot dispatch when every slot is slow anyway)
+            request_lists = self._parse_output(ticked)
+        else:
+            buf = memoryview(self._out_buf).cast("B")[: self._out_len.value]
+            fast_l = fast.tolist()
+            offs_l = offs.tolist()
+            fa_l = hdr["fa"].tolist()
+            cur_l = hdr["current"].tolist()
+            conf_l = hdr["confirmed"].tolist()
+            flags_l = flags.tolist()
+            CONF = _native.BANK_HDR_CONF
+            unpack_from = struct.unpack_from
+            request_lists = []
+            recorders = self._recorders
+            n_save = n_load = n_adv = 0
+            for idx in range(n):
+                if not fast_l[idx]:
+                    requests, _, _ = self._parse_slot(
+                        buf, offs_l[idx], idx, ticked[idx]
+                    )
+                    request_lists.append(requests)
+                    continue
+                m = mirrors[idx]
+                off = offs_l[idx]
+                hf = flags_l[idx]
+                players, isize = m.num_players, m.input_size
+                decode = m.config.input_decode
+                rec = recorders[idx] if recorders else None
+                get_cell = m.saved_states.get_cell
+                # ---- ops: pooled per-kind request objects, refilled in
+                # place (rollback-resim ticks grow the pools once, then
+                # reuse) — no fresh dataclass/list per op ----
+                (n_ops,) = unpack_from("<H", buf, off + 33)
+                pos = off + 35
+                requests = m.pooled_list
+                requests.clear()
+                saves, loads, advs = (
+                    m.pool_saves, m.pool_loads, m.pool_advs
+                )
+                si = li = ai = 0
+                advanced = False
+                blob_len = players * isize
+                for _ in range(n_ops):
+                    kind = buf[pos]
+                    pos += 1
+                    if kind == 2:
+                        if ai == len(advs):
+                            advs.append(AdvanceFrame(
+                                inputs=[None] * players
+                            ))
+                        adv = advs[ai]
+                        ai += 1
+                        inputs = adv.inputs
+                        bo = pos + players
+                        for p in range(players):
+                            inputs[p] = (
+                                decode(bytes(
+                                    buf[bo + p * isize:
+                                        bo + (p + 1) * isize]
+                                )),
+                                _STATUS[buf[pos + p]],
                             )
-            # outbound.  Broadcast layout (has_spec): the poll-phase remote
-            # datagrams send immediately; the adv-phase (input) sends wait
-            # until the spectator queues — LAST tick's deferred fan-out plus
-            # this tick's spectator poll messages — have gone out, which is
-            # the Python session's exact per-socket order (poll's
-            # send_all_messages flushes remotes then spectators, then
-            # advance_frame sends the remote input messages inline; the
-            # fan-out messages it queues flush at the NEXT tick's poll).
-            has_spec = self._has_spec
-            send_raw = m.send_raw  # socket.send_datagram (raw bytes, no
-            # RawMessage wrapper / re-encode) or the send_to shim
-            send_failed: Optional[str] = None
-            (n_out_poll,) = unpack_from("<H", buf, pos)
+                        pos = bo + blob_len
+                        requests.append(adv)
+                        advanced = True
+                    else:
+                        (frame,) = unpack_from("<q", buf, pos)
+                        pos += 8
+                        cell = get_cell(frame)
+                        if kind == 0:
+                            if si == len(saves):
+                                saves.append(SaveGameState(
+                                    cell=None, frame=NULL_FRAME
+                                ))
+                            req = saves[si]
+                            si += 1
+                            n_save += 1
+                        else:
+                            assert cell.frame == frame, (
+                                f"rollback loads frame {frame} but its "
+                                f"cell holds {cell.frame} — was the save "
+                                "fulfilled?"
+                            )
+                            if li == len(loads):
+                                loads.append(LoadGameState(
+                                    cell=None, frame=NULL_FRAME
+                                ))
+                            req = loads[li]
+                            li += 1
+                            n_load += 1
+                            self._m_rollbacks.inc()
+                            if rec is not None:
+                                rec.record(
+                                    self._tick_no, EV_ROLLBACK,
+                                    f"load frame {frame} (was at "
+                                    f"{m.current_frame})",
+                                )
+                        req.cell = cell
+                        req.frame = frame
+                        requests.append(req)
+                        advanced = False
+                n_adv += ai
+                # ---- outbound sends: same loop as the reference decoder
+                # (the two sections are 4 zero bytes on io/attached or
+                # sendless ticks) ----
+                send_failed: Optional[str] = None
+                send_raw = m.send_raw
+                endpoints = m.endpoints
+                for _section in (0, 1):
+                    (n_out,) = unpack_from("<H", buf, pos)
+                    pos += 2
+                    for _ in range(n_out):
+                        ep_idx, dlen = unpack_from("<HI", buf, pos)
+                        pos += 6
+                        if send_failed is not None:
+                            pos += dlen
+                            continue
+                        data = bytes(buf[pos : pos + dlen])
+                        pos += dlen
+                        if rec is not None:
+                            rec.record(self._tick_no, EV_WIRE,
+                                       (ep_idx, dlen, zlib.crc32(data)))
+                        try:
+                            send_raw(data, endpoints[ep_idx].addr)
+                        except Exception as e:
+                            send_failed = f"socket send failed: {e!r}"
+                if hf & CONF:
+                    # journal tap: read the confirmed-record section
+                    # directly (no spectators on a fast slot, so the
+                    # intervening sections are fixed-size)
+                    pos += 2 + m.mirror_len  # n_events(=0) + status mirrors
+                    (next_spec,) = unpack_from("<q", buf, pos)
+                    m.next_spec_frame = next_spec
+                    pos += 9 + 4  # + n_specs(=0) + n_spec_out/evts(=0)
+                    (n_conf,) = unpack_from("<H", buf, pos)
+                    pos += 2
+                    (conf_start,) = unpack_from("<q", buf, pos)
+                    pos += 8
+                    conf_records = []
+                    for _ in range(n_conf):
+                        cflags = bytes(buf[pos : pos + players])
+                        pos += players
+                        conf_records.append(
+                            (cflags, bytes(buf[pos : pos + blob_len]))
+                        )
+                        pos += blob_len
+                    sink = self._journal_sinks.get(idx)
+                    if sink is not None:
+                        sink.append_frames(conf_start, conf_records)
+                # ---- policy (the quiet-slot subset: no events, no
+                # consensus — just the wait recommendation) ----
+                current = cur_l[idx]
+                if send_failed is not None:
+                    self._on_slot_fault(idx, 0, send_failed)
+                    requests = []
+                else:
+                    fa = fa_l[idx]
+                    m.frames_ahead = fa
+                    pre_current = current - (1 if advanced else 0)
+                    if (
+                        pre_current > m.next_recommended_sleep
+                        and fa >= MIN_RECOMMENDATION
+                    ):
+                        m.next_recommended_sleep = (
+                            pre_current + RECOMMENDATION_INTERVAL
+                        )
+                        m.push_event((_LZ_WAIT, fa))
+                    if advanced:
+                        m.staged_inputs.clear()
+                m.current_frame = current
+                m.last_confirmed = conf_l[idx]
+                request_lists.append(requests)
+            self.fast_slot_ticks += n_fast
+            self._m_fast_slots.inc(n_fast)
+            if n_save:
+                self._m_req_save.inc(n_save)
+            if n_load:
+                self._m_req_load.inc(n_load)
+            if n_adv:
+                self._m_req_advance.inc(n_adv)
+            # "every LIVE slot was fast": skip records (quarantined /
+            # evicted / dead slots) are never fast and must not pin this
+            # counter at zero for the rest of a degraded pool's life
+            n_skip = int(np.count_nonzero(
+                (flags & _native.BANK_HDR_SKIP) != 0
+            ))
+            if n_fast == n - n_skip:
+                self.fast_ticks += 1
+        retire_mask = None
+        if self.retire_dead_matches:
+            # endpoint liveness can only have changed on a dirty or
+            # slow-parsed slot — the retirement walk skips the rest
+            retire_mask = (
+                ((flags & _native.BANK_HDR_DIRTY) != 0) | ~fast
+            ).tolist()
+        return request_lists, retire_mask
+
+    def _parse_slot(self, buf, pos, idx, ticked_slot):
+        """Positional parse of ONE slot's body record starting at
+        ``pos`` — the reference decoder for a single slot, shared by the
+        sequential legacy parse and the vectorized path's slow slots.
+        Returns ``(requests, end_pos, current_frame)``."""
+        m = self._mirrors[idx]
+        unpack_from = struct.unpack_from
+        players, isize = m.num_players, m.input_size
+        err, landed, frames_ahead, current, confirmed, consensus, n_ops = (
+            unpack_from("<iqiqqBH", buf, pos)
+        )
+        pos += 35
+        # live: the bank actually stepped this slot and it didn't fault.
+        # A faulted slot's record is status-only (its ops/outbound/events
+        # were suppressed natively); parse positionally either way.
+        live = ticked_slot and err == 0
+        if ticked_slot and err != 0:
+            self._on_slot_fault(idx, err)
+        requests: List[GgrsRequest] = []
+        advanced = False
+        decode = m.config.input_decode
+        rec = self._recorders[idx] if self._recorders else None
+        for _ in range(n_ops):
+            kind = buf[pos]
+            pos += 1
+            if kind == 2:
+                statuses = bytes(buf[pos : pos + players])
+                pos += players
+                blob = bytes(buf[pos : pos + players * isize])
+                pos += players * isize
+                requests.append(AdvanceFrame(inputs=[
+                    (decode(blob[p * isize : (p + 1) * isize]),
+                     _STATUS[statuses[p]])
+                    for p in range(players)
+                ]))
+                advanced = True
+                self._m_req_advance.inc()
+            else:
+                (frame,) = unpack_from("<q", buf, pos)
+                pos += 8
+                cell = m.saved_states.get_cell(frame)
+                if kind == 0:
+                    requests.append(SaveGameState(cell=cell, frame=frame))
+                    advanced = False
+                    self._m_req_save.inc()
+                else:
+                    assert cell.frame == frame, (
+                        f"rollback loads frame {frame} but its cell "
+                        f"holds {cell.frame} — was the save fulfilled?"
+                    )
+                    requests.append(LoadGameState(cell=cell, frame=frame))
+                    advanced = False
+                    self._m_req_load.inc()
+                    self._m_rollbacks.inc()
+                    if rec is not None:
+                        rec.record(
+                            self._tick_no, EV_ROLLBACK,
+                            f"load frame {frame} (was at "
+                            f"{m.current_frame})",
+                        )
+        # outbound.  Broadcast layout (has_spec): the poll-phase remote
+        # datagrams send immediately; the adv-phase (input) sends wait
+        # until the spectator queues — LAST tick's deferred fan-out plus
+        # this tick's spectator poll messages — have gone out, which is
+        # the Python session's exact per-socket order (poll's
+        # send_all_messages flushes remotes then spectators, then
+        # advance_frame sends the remote input messages inline; the
+        # fan-out messages it queues flush at the NEXT tick's poll).
+        has_spec = self._has_spec
+        send_raw = m.send_raw  # socket.send_datagram (raw bytes, no
+        # RawMessage wrapper / re-encode) or the send_to shim
+        send_failed: Optional[str] = None
+        (n_out_poll,) = unpack_from("<H", buf, pos)
+        pos += 2
+        for _ in range(n_out_poll):
+            ep_idx, dlen = unpack_from("<HI", buf, pos)
+            pos += 6
+            data = bytes(buf[pos : pos + dlen])
+            pos += dlen
+            if send_failed is not None:
+                continue  # slot already faulted; keep consuming bytes
+            if rec is not None:
+                # wire digest: a tuple of scalars, formatted lazily by
+                # dump() — cheap enough to leave on for healthy slots
+                rec.record(self._tick_no, EV_WIRE,
+                           (ep_idx, dlen, zlib.crc32(data)))
+            try:
+                send_raw(data, m.endpoints[ep_idx].addr)
+            except Exception as e:  # a send fault is THIS slot's fault
+                send_failed = f"socket send failed: {e!r}"
+        adv_out: List[Tuple[int, bytes]] = []
+        if has_spec:
+            (n_out_adv,) = unpack_from("<H", buf, pos)
             pos += 2
-            for _ in range(n_out_poll):
+            for _ in range(n_out_adv):
                 ep_idx, dlen = unpack_from("<HI", buf, pos)
                 pos += 6
-                data = bytes(buf[pos : pos + dlen])
+                adv_out.append((ep_idx, bytes(buf[pos : pos + dlen])))
                 pos += dlen
-                if send_failed is not None:
-                    continue  # slot already faulted; keep consuming bytes
-                if rec is not None:
-                    # wire digest: a tuple of scalars, formatted lazily by
-                    # dump() — cheap enough to leave on for healthy slots
-                    rec.record(self._tick_no, EV_WIRE,
-                               (ep_idx, dlen, zlib.crc32(data)))
-                try:
-                    send_raw(data, m.endpoints[ep_idx].addr)
-                except Exception as e:  # a send fault is THIS slot's fault
-                    send_failed = f"socket send failed: {e!r}"
-            adv_out: List[Tuple[int, bytes]] = []
-            if has_spec:
-                (n_out_adv,) = unpack_from("<H", buf, pos)
-                pos += 2
-                for _ in range(n_out_adv):
-                    ep_idx, dlen = unpack_from("<HI", buf, pos)
-                    pos += 6
-                    adv_out.append((ep_idx, bytes(buf[pos : pos + dlen])))
-                    pos += dlen
-            # stage event records; dispatch AFTER the status mirrors below
-            # are parsed — _on_protocol_disconnected reads m.local_last, and
-            # p2p.py's _handle_event sees the status as updated by this
-            # tick's EvInputs, not last tick's
-            (n_events,) = unpack_from("<H", buf, pos)
-            pos += 2
-            staged_events = []
-            for _ in range(n_events):
-                kind, ep_idx = unpack_from("<BH", buf, pos)
-                pos += 3
-                if kind == _EV_INTERRUPTED:
-                    (remaining,) = unpack_from("<q", buf, pos)
-                    pos += 8
-                    staged_events.append((kind, ep_idx, remaining))
-                elif kind == _EV_CHECKSUM:
-                    frame, lo, hi = unpack_from("<qQQ", buf, pos)
-                    pos += 24
-                    staged_events.append((kind, ep_idx, (frame, lo, hi)))
-                else:
-                    staged_events.append((kind, ep_idx, None))
-            (n_eps,) = unpack_from("<B", buf, pos)
+        # stage event records; dispatch AFTER the status mirrors below
+        # are parsed — _on_protocol_disconnected reads m.local_last, and
+        # p2p.py's _handle_event sees the status as updated by this
+        # tick's EvInputs, not last tick's
+        (n_events,) = unpack_from("<H", buf, pos)
+        pos += 2
+        staged_events = []
+        for _ in range(n_events):
+            kind, ep_idx = unpack_from("<BH", buf, pos)
+            pos += 3
+            if kind == _EV_INTERRUPTED:
+                (remaining,) = unpack_from("<q", buf, pos)
+                pos += 8
+                staged_events.append((kind, ep_idx, remaining))
+            elif kind == _EV_CHECKSUM:
+                frame, lo, hi = unpack_from("<qQQ", buf, pos)
+                pos += 24
+                staged_events.append((kind, ep_idx, (frame, lo, hi)))
+            else:
+                staged_events.append((kind, ep_idx, None))
+        (n_eps,) = unpack_from("<B", buf, pos)
+        pos += 1
+        for e in range(n_eps):
+            ep = m.endpoints[e]
+            ep.running = buf[pos] == 0
             pos += 1
-            for e in range(n_eps):
-                ep = m.endpoints[e]
-                ep.running = buf[pos] == 0
-                pos += 1
-                for h in range(players):
-                    disc, lf = unpack_from("<Bq", buf, pos)
-                    pos += 9
-                    ep.peer_disc[h] = bool(disc)
-                    ep.peer_last[h] = lf
             for h in range(players):
                 disc, lf = unpack_from("<Bq", buf, pos)
                 pos += 9
-                m.local_disc[h] = bool(disc)
-                m.local_last[h] = lf
+                ep.peer_disc[h] = bool(disc)
+                ep.peer_last[h] = lf
+        for h in range(players):
+            disc, lf = unpack_from("<Bq", buf, pos)
+            pos += 9
+            m.local_disc[h] = bool(disc)
+            m.local_last[h] = lf
 
-            # ---- broadcast tail (DESIGN.md §13): spectator mirror, the
-            # phase-tagged fan-out streams, hub events, journal tap ----
-            if has_spec:
-                next_spec, n_specs = unpack_from("<qB", buf, pos)
+        # ---- broadcast tail (DESIGN.md §13): spectator mirror, the
+        # phase-tagged fan-out streams, hub events, journal tap ----
+        if has_spec:
+            next_spec, n_specs = unpack_from("<qB", buf, pos)
+            pos += 9
+            m.next_spec_frame = next_spec
+            for e in range(n_specs):
+                st, la = unpack_from("<Bq", buf, pos)
                 pos += 9
-                m.next_spec_frame = next_spec
-                for e in range(n_specs):
-                    st, la = unpack_from("<Bq", buf, pos)
-                    pos += 9
-                    sp = m.spectators[e]
-                    sp.running = st == 0
-                    sp.last_acked = la
-                (n_spec_out,) = unpack_from("<H", buf, pos)
-                pos += 2
-                spec_poll: List[List[bytes]] = [[] for _ in range(n_specs)]
-                spec_adv: List[List[bytes]] = [[] for _ in range(n_specs)]
-                for _ in range(n_spec_out):
-                    sp_idx, phase, dlen = unpack_from("<HBI", buf, pos)
-                    pos += 7
-                    (spec_adv if phase else spec_poll)[sp_idx].append(
-                        bytes(buf[pos : pos + dlen])
-                    )
-                    pos += dlen
-                (n_spec_events,) = unpack_from("<H", buf, pos)
-                pos += 2
-                spec_events: List[Tuple[int, int, Any]] = []
-                for _ in range(n_spec_events):
-                    kind, sp_idx = unpack_from("<BH", buf, pos)
-                    pos += 3
-                    payload = None
-                    if kind == _EV_INTERRUPTED:
-                        (payload,) = unpack_from("<q", buf, pos)
-                        pos += 8
-                    spec_events.append((kind, sp_idx, payload))
-                (n_conf,) = unpack_from("<H", buf, pos)
-                pos += 2
-                conf_start: Frame = NULL_FRAME
-                conf_records: List[Tuple[bytes, bytes]] = []
-                if n_conf:
-                    (conf_start,) = unpack_from("<q", buf, pos)
-                    pos += 8
-                    blob_len = players * isize
-                    for _ in range(n_conf):
-                        flags = bytes(buf[pos : pos + players])
-                        pos += players
-                        conf_records.append((
-                            flags, bytes(buf[pos : pos + blob_len]),
-                        ))
-                        pos += blob_len
-                if live and m.spectators:
-                    # spectator sends: per viewer, last tick's deferred
-                    # fan-out datagrams then this tick's poll messages —
-                    # then the remote input messages, then stash this
-                    # tick's fan-out for the next (the Python flush order)
-                    fan = self._fanout_counters.get(idx)
-                    if fan is None:
-                        fan = (
-                            self._m_fanout_dgrams.labels(slot=str(idx)).inc,
-                            self._m_fanout_bytes.labels(slot=str(idx)).inc,
-                        )
-                        self._fanout_counters[idx] = fan
-                    fan_d, fan_b = fan
-                    for e, sp in enumerate(m.spectators):
-                        to_send = sp.deferred
-                        sp.deferred = []
-                        if e < n_specs:
-                            to_send = to_send + spec_poll[e]
-                        for data in to_send:
-                            if send_failed is not None:
-                                continue
-                            if rec is not None:
-                                rec.record(
-                                    self._tick_no, EV_WIRE,
-                                    (f"spec{e}", len(data),
-                                     zlib.crc32(data)),
-                                )
-                            try:
-                                send_raw(data, sp.addr)
-                                fan_d()
-                                fan_b(len(data))
-                            except Exception as exc:
-                                send_failed = f"socket send failed: {exc!r}"
-                elif not live:
-                    # a faulted/skipped slot's deferred stream is stale: the
-                    # fan-out window lives in the harvest's pending dumps
-                    # and is re-emitted by the evicted relay's retry timer
-                    for sp in m.spectators:
-                        sp.deferred = []
-            for ep_idx, data in adv_out:
-                if send_failed is not None:
-                    continue
-                if rec is not None:
-                    rec.record(self._tick_no, EV_WIRE,
-                               (ep_idx, len(data), zlib.crc32(data)))
-                try:
-                    send_raw(data, m.endpoints[ep_idx].addr)
-                except Exception as e:
-                    send_failed = f"socket send failed: {e!r}"
-            if has_spec and live and m.spectators:
-                for e, sp in enumerate(m.spectators):
-                    if e < n_specs:
-                        sp.deferred.extend(spec_adv[e])
-                hub = self._spectator_hub
-                if hub is not None and spec_events:
-                    for kind, sp_idx, payload in spec_events:
-                        hub._on_native_event(idx, sp_idx, kind, payload)
-            if has_spec and live and n_conf:
-                sink = self._journal_sinks.get(idx)
-                if sink is not None:
-                    sink.append_frames(conf_start, conf_records)
-            if send_failed is not None:
-                self._on_slot_fault(idx, 0, send_failed)
-                live = False
-
-            # ---- policy (Python): events, wait recommendation, consensus ----
-            # applied only for live slots; a faulted/skipped record carries
-            # no events and its policy state is frozen pending supervision
-            if live:
-                for kind, ep_idx, payload in staged_events:
-                    ep = m.endpoints[ep_idx]
-                    if kind == _EV_INTERRUPTED:
-                        m.push_event(NetworkInterrupted(
-                            addr=ep.addr, disconnect_timeout=payload
-                        ))
-                    elif kind == _EV_RESUMED:
-                        m.push_event(NetworkResumed(addr=ep.addr))
-                    elif kind == _EV_DISCONNECTED:
-                        self._on_protocol_disconnected(m, ep_idx)
-                    elif kind == _EV_CHECKSUM:
-                        frame, lo, hi = payload
-                        self._store_checksum(ep, frame, lo | (hi << 64))
-                pre_current = current - (1 if advanced else 0)
-                m.frames_ahead = frames_ahead
-                if (
-                    pre_current > m.next_recommended_sleep
-                    and frames_ahead >= MIN_RECOMMENDATION
-                ):
-                    m.next_recommended_sleep = (
-                        pre_current + RECOMMENDATION_INTERVAL
-                    )
-                    m.push_event(WaitRecommendation(skip_frames=frames_ahead))
-                if advanced:
-                    m.staged_inputs.clear()
-                if consensus:
-                    self._run_consensus(m)
-            if ticked[idx]:
-                m.current_frame = current
-                m.last_confirmed = confirmed
-            if not live:
-                requests = []
-            request_lists.append(requests)
-            if tracing:
-                # the Python half of this slot's tick: record parse, sends,
-                # event/consensus policy (nests under pool.tick, after the
-                # crossing span)
-                tracer.add_complete(
-                    "pool.slot", t_slot, tracer.now_ns() - t_slot, cat="py",
-                    args={"slot": idx, "frame": current},
+                sp = m.spectators[e]
+                sp.running = st == 0
+                sp.last_acked = la
+            (n_spec_out,) = unpack_from("<H", buf, pos)
+            pos += 2
+            spec_poll: List[List[bytes]] = [[] for _ in range(n_specs)]
+            spec_adv: List[List[bytes]] = [[] for _ in range(n_specs)]
+            for _ in range(n_spec_out):
+                sp_idx, phase, dlen = unpack_from("<HBI", buf, pos)
+                pos += 7
+                (spec_adv if phase else spec_poll)[sp_idx].append(
+                    bytes(buf[pos : pos + dlen])
                 )
-        return request_lists
+                pos += dlen
+            (n_spec_events,) = unpack_from("<H", buf, pos)
+            pos += 2
+            spec_events: List[Tuple[int, int, Any]] = []
+            for _ in range(n_spec_events):
+                kind, sp_idx = unpack_from("<BH", buf, pos)
+                pos += 3
+                payload = None
+                if kind == _EV_INTERRUPTED:
+                    (payload,) = unpack_from("<q", buf, pos)
+                    pos += 8
+                spec_events.append((kind, sp_idx, payload))
+            (n_conf,) = unpack_from("<H", buf, pos)
+            pos += 2
+            conf_start: Frame = NULL_FRAME
+            conf_records: List[Tuple[bytes, bytes]] = []
+            if n_conf:
+                (conf_start,) = unpack_from("<q", buf, pos)
+                pos += 8
+                blob_len = players * isize
+                for _ in range(n_conf):
+                    flags = bytes(buf[pos : pos + players])
+                    pos += players
+                    conf_records.append((
+                        flags, bytes(buf[pos : pos + blob_len]),
+                    ))
+                    pos += blob_len
+            if live and m.spectators:
+                # spectator sends: per viewer, last tick's deferred
+                # fan-out datagrams then this tick's poll messages —
+                # then the remote input messages, then stash this
+                # tick's fan-out for the next (the Python flush order)
+                fan = self._fanout_counters.get(idx)
+                if fan is None:
+                    fan = (
+                        self._m_fanout_dgrams.labels(slot=str(idx)).inc,
+                        self._m_fanout_bytes.labels(slot=str(idx)).inc,
+                    )
+                    self._fanout_counters[idx] = fan
+                fan_d, fan_b = fan
+                for e, sp in enumerate(m.spectators):
+                    to_send = sp.deferred
+                    sp.deferred = []
+                    if e < n_specs:
+                        to_send = to_send + spec_poll[e]
+                    for data in to_send:
+                        if send_failed is not None:
+                            continue
+                        if rec is not None:
+                            rec.record(
+                                self._tick_no, EV_WIRE,
+                                (f"spec{e}", len(data),
+                                 zlib.crc32(data)),
+                            )
+                        try:
+                            send_raw(data, sp.addr)
+                            fan_d()
+                            fan_b(len(data))
+                        except Exception as exc:
+                            send_failed = f"socket send failed: {exc!r}"
+            elif not live:
+                # a faulted/skipped slot's deferred stream is stale: the
+                # fan-out window lives in the harvest's pending dumps
+                # and is re-emitted by the evicted relay's retry timer
+                for sp in m.spectators:
+                    sp.deferred = []
+        for ep_idx, data in adv_out:
+            if send_failed is not None:
+                continue
+            if rec is not None:
+                rec.record(self._tick_no, EV_WIRE,
+                           (ep_idx, len(data), zlib.crc32(data)))
+            try:
+                send_raw(data, m.endpoints[ep_idx].addr)
+            except Exception as e:
+                send_failed = f"socket send failed: {e!r}"
+        if has_spec and live and m.spectators:
+            for e, sp in enumerate(m.spectators):
+                if e < n_specs:
+                    sp.deferred.extend(spec_adv[e])
+            hub = self._spectator_hub
+            if hub is not None and spec_events:
+                for kind, sp_idx, payload in spec_events:
+                    hub._on_native_event(idx, sp_idx, kind, payload)
+        if has_spec and live and n_conf:
+            sink = self._journal_sinks.get(idx)
+            if sink is not None:
+                sink.append_frames(conf_start, conf_records)
+        if send_failed is not None:
+            self._on_slot_fault(idx, 0, send_failed)
+            live = False
+
+        # ---- policy (Python): events, wait recommendation, consensus ----
+        # applied only for live slots; a faulted/skipped record carries
+        # no events and its policy state is frozen pending supervision
+        if live:
+            # events stage as lazy tag tuples (decoded on drain —
+            # _materialize_events); only the checksum/disconnect kinds do
+            # policy work here
+            for kind, ep_idx, payload in staged_events:
+                ep = m.endpoints[ep_idx]
+                if kind == _EV_INTERRUPTED:
+                    m.push_event((_LZ_INTERRUPTED, ep.addr, payload))
+                elif kind == _EV_RESUMED:
+                    m.push_event((_LZ_RESUMED, ep.addr))
+                elif kind == _EV_DISCONNECTED:
+                    self._on_protocol_disconnected(m, ep_idx)
+                elif kind == _EV_CHECKSUM:
+                    frame, lo, hi = payload
+                    self._store_checksum(ep, frame, lo | (hi << 64))
+            pre_current = current - (1 if advanced else 0)
+            m.frames_ahead = frames_ahead
+            if (
+                pre_current > m.next_recommended_sleep
+                and frames_ahead >= MIN_RECOMMENDATION
+            ):
+                m.next_recommended_sleep = (
+                    pre_current + RECOMMENDATION_INTERVAL
+                )
+                m.push_event((_LZ_WAIT, frames_ahead))
+            if advanced:
+                m.staged_inputs.clear()
+            if consensus:
+                self._run_consensus(m)
+        if ticked_slot:
+            m.current_frame = current
+            m.last_confirmed = confirmed
+        if not live:
+            requests = []
+        return requests, pos, current
 
     # ------------------------------------------------------------------
     # supervision: quarantine, eviction, retirement (fault isolation)
@@ -1464,19 +1849,33 @@ class HostSessionPool:
             ))
             self._set_slot_state(index, SLOT_DEAD)
 
-    def _supervise(self, request_lists: List[List[GgrsRequest]]) -> None:
+    def _supervise(self, request_lists: List[List[GgrsRequest]],
+                   retire_mask: Optional[List[bool]] = None) -> None:
         """Post-tick supervision pass: retire dead matches, drive pending
         evictions, and tick evicted sessions — filling their slots of
-        ``request_lists`` in place."""
+        ``request_lists`` in place.
+
+        Incremental (DESIGN.md §19): the walk is driven by ``_attention``
+        — the quarantined/evicted slots — instead of range(B); on the
+        quiet steady state this loop touches nothing.  The optional
+        ``retire_mask`` (from the header's dirty bits) bounds the
+        ``retire_dead_matches`` liveness check the same way: endpoint
+        liveness only changes on dirty or slow-parsed ticks."""
+        if self.retire_dead_matches:
+            for i, state in enumerate(self._slot_state):
+                if state != SLOT_NATIVE:
+                    continue
+                if retire_mask is not None and not retire_mask[i]:
+                    continue
+                m = self._mirrors[i]
+                self._maybe_retire(i, m.endpoints and all(
+                    not ep.running for ep in m.endpoints
+                ))
+        if not self._attention:
+            return
         evictions_this_tick = 0
-        for i, state in enumerate(self._slot_state):
-            if state == SLOT_NATIVE:
-                if self.retire_dead_matches:
-                    m = self._mirrors[i]
-                    self._maybe_retire(i, m.endpoints and all(
-                        not ep.running for ep in m.endpoints
-                    ))
-                continue
+        for i in sorted(self._attention):
+            state = self._slot_state[i]
             if state == SLOT_QUARANTINED:
                 # retry-storm clamp: a shard-wide failure quarantines many
                 # slots on one tick; at most EVICT_MAX_PER_TICK eviction
@@ -1521,6 +1920,21 @@ class HostSessionPool:
         if old == new_state:
             return
         self._slot_state[index] = new_state
+        # incremental supervision: only quarantined/evicted slots need the
+        # post-tick walk; dead/migrated slots need nothing and native
+        # slots are the bank's business
+        if new_state in (SLOT_QUARANTINED, SLOT_EVICTED):
+            self._attention.add(index)
+        else:
+            self._attention.discard(index)
+        # transition feed for incremental consumers (fleet shards): bounded
+        # — an undrained feed must never grow without bound, but the bound
+        # must hold a whole shard-wide failure (every slot transitioning
+        # on one tick) or the forensics sweep silently loses post-mortems
+        self._state_transitions.append(
+            (index, old, new_state, self._tick_no)
+        )
+        del self._state_transitions[:-max(256, 2 * len(self._slot_state))]
         if new_state != SLOT_NATIVE and self._io_attached[index]:
             # a slot leaving the bank leaves the batched datapath with it:
             # the evicted session owns the socket (per-datagram Python
@@ -1694,10 +2108,17 @@ class HostSessionPool:
         endpoint_states = {}
         for e, ep in enumerate(m.endpoints):
             he = h["endpoints"][e]
+            # peer mirrors: the harvest copy is authoritative (the
+            # vectorized pool's Python mirrors may be quiet-tick stale);
+            # journal-synthesized harvests lack them — fall back to the
+            # mirror, which was fresh as of the fault tick's slow parse
             endpoint_states[ep.addr] = dict(
                 magic=ep.magic,
                 running=he["state"] == 0,
-                peer_connect_status=list(zip(ep.peer_disc, ep.peer_last)),
+                peer_connect_status=list(zip(
+                    he.get("peer_disc") or ep.peer_disc,
+                    he.get("peer_last") or ep.peer_last,
+                )),
                 last_recv_frame=he["last_recv"],
                 recv_entries=he["recv_entries"],
                 last_acked_frame=he["last_acked_frame"],
@@ -1713,7 +2134,7 @@ class HostSessionPool:
             player_inputs=h["player_inputs"],
             endpoint_states=endpoint_states,
             next_recommended_sleep=m.next_recommended_sleep,
-            pending_events=list(m.event_queue),
+            pending_events=_materialize_events(m.event_queue),
             next_spectator_frame=h.get("next_spectator_frame", 0),
         )
         m.event_queue.clear()
@@ -1739,6 +2160,12 @@ class HostSessionPool:
             if blob is not None:
                 session.add_local_input(handle, decode(blob))
         m.staged_inputs.clear()
+        # the evicted session routes through the same pooled-request /
+        # lazy-event decode economics as the vectorized bank path: the
+        # pool consumes its request list tick-synchronously (DESIGN.md
+        # §19; the degraded-mode gap this narrows is priced by
+        # bench host_bank_degraded)
+        session.enable_request_pooling()
         # forensic continuity: the evicted session keeps tracing into the
         # pool's ring, recording into the slot's flight recorder, and
         # citing the slot's journal tail in any future DesyncReport
@@ -1795,6 +2222,18 @@ class HostSessionPool:
         for _ in range(n_eps):
             (state,) = unpack_from("<B", b, pos)
             pos += 1
+            # harvest v2 (header-capable library): per-endpoint peer
+            # status mirrors follow the state byte — authoritative for
+            # eviction/export since the vectorized pool's Python mirrors
+            # skip quiet-tick refreshes
+            peer_disc: List[bool] = []
+            peer_last: List[Frame] = []
+            if self._has_hdr:
+                for _p in range(players):
+                    d, lf = unpack_from("<Bq", b, pos)
+                    pos += 9
+                    peer_disc.append(bool(d))
+                    peer_last.append(lf)
             last_acked, base_len = unpack_from("<qI", b, pos)
             pos += 12
             send_base = b[pos : pos + base_len]
@@ -1819,6 +2258,7 @@ class HostSessionPool:
                 state=state, last_acked_frame=last_acked,
                 send_base=send_base, pending=pending,
                 last_recv=last_recv, recv_entries=recv_entries,
+                peer_disc=peer_disc, peer_last=peer_last,
             ))
         next_spec: Frame = 0
         spectators: List[Dict[str, Any]] = []
@@ -1927,15 +2367,32 @@ class HostSessionPool:
             state_blob=pickle.dumps((cell.data(), cell.checksum)),
             harvest=h,
             next_recommended_sleep=m.next_recommended_sleep,
-            pending_events=list(m.event_queue),
+            # materialize: the queue holds lazy tag tuples; the bundle's
+            # consumer extends a real session's event queue verbatim
+            pending_events=_materialize_events(m.event_queue),
             endpoints=[
+                # identity from the mirror; liveness + peer mirrors from
+                # the harvest when it carries them (authoritative under
+                # the vectorized parse — the Python mirrors may be
+                # quiet-tick stale), mirror fallback otherwise
                 dict(
                     addr=ep.addr, handles=list(ep.handles), magic=ep.magic,
-                    running=ep.running, peer_disc=list(ep.peer_disc),
-                    peer_last=list(ep.peer_last),
+                    running=(
+                        h["endpoints"][e]["state"] == 0
+                        if e < len(h["endpoints"]) and "state" in h["endpoints"][e]
+                        else ep.running
+                    ),
+                    peer_disc=list(
+                        h["endpoints"][e].get("peer_disc") or ep.peer_disc
+                        if e < len(h["endpoints"]) else ep.peer_disc
+                    ),
+                    peer_last=list(
+                        h["endpoints"][e].get("peer_last") or ep.peer_last
+                        if e < len(h["endpoints"]) else ep.peer_last
+                    ),
                     pending_checksums=dict(ep.pending_checksums),
                 )
-                for ep in m.endpoints
+                for e, ep in enumerate(m.endpoints)
             ],
             spectators=[
                 dict(addr=sp.addr, magic=sp.magic, handles=list(sp.handles),
@@ -2153,34 +2610,49 @@ class HostSessionPool:
         child.sum += sum_delta
 
     def _apply_io_metrics(self, stats: List[Dict[str, Any]]) -> None:
-        """Refresh the io instruments from the scrape's per-slot NetBatch
-        tails — the batched datapath's observability rides the SAME
-        one-crossing stats harvest (zero packet-path cost)."""
+        """Refresh the io instruments from per-slot NetBatch records (the
+        detach path's final-snapshot flush; the per-scrape walk uses
+        :meth:`_apply_io_metrics_live`, driven by the attached-slot list
+        instead of range(B))."""
         if not self._obs_on:
             return
         for s in stats:
             io = s.get("io")
-            if not io:
-                continue
-            slot = s["index"]
-            recv_d = self._io_delta(slot, "recv_datagrams",
-                                    io["recv_datagrams"])
-            send_d = self._io_delta(slot, "send_datagrams",
-                                    io["send_datagrams"])
-            self._m_io_recvmmsg.inc(
-                self._io_delta(slot, "recv_calls", io["recv_calls"]))
-            self._m_io_sendmmsg.inc(
-                self._io_delta(slot, "send_calls", io["send_calls"]))
-            self._m_io_dgrams_in.inc(recv_d)
-            self._m_io_dgrams_out.inc(send_d)
-            self._m_io_send_errors.inc(
-                self._io_delta(slot, "send_errors", io["send_errors"]))
-            self._m_io_oversized.inc(
-                self._io_delta(slot, "oversized", io["oversized"]))
-            self._bump_io_hist(self._m_io_recv_batch, slot, "rb",
-                               io["recv_batches"], recv_d)
-            self._bump_io_hist(self._m_io_send_batch, slot, "sb",
-                               io["send_batches"], send_d)
+            if io:
+                self._apply_io_record(s["index"], io)
+
+    def _apply_io_metrics_live(self, stats: List[Dict[str, Any]]) -> None:
+        """The per-scrape io-delta walk, incremental: only the slots with
+        a live NetBatch attachment are visited (``self._io_live``) — at
+        B=256 with no native io this is a no-op, not 256 dict probes."""
+        if not self._obs_on or not self._io_live:
+            return
+        for slot in self._io_live:
+            io = stats[slot].get("io")
+            if io:
+                self._apply_io_record(slot, io)
+
+    def _apply_io_record(self, slot: int, io: Dict[str, Any]) -> None:
+        """Fold one slot's cumulative NetBatch counters into the registry
+        instruments (delta-encoded: the native counters are totals)."""
+        recv_d = self._io_delta(slot, "recv_datagrams",
+                                io["recv_datagrams"])
+        send_d = self._io_delta(slot, "send_datagrams",
+                                io["send_datagrams"])
+        self._m_io_recvmmsg.inc(
+            self._io_delta(slot, "recv_calls", io["recv_calls"]))
+        self._m_io_sendmmsg.inc(
+            self._io_delta(slot, "send_calls", io["send_calls"]))
+        self._m_io_dgrams_in.inc(recv_d)
+        self._m_io_dgrams_out.inc(send_d)
+        self._m_io_send_errors.inc(
+            self._io_delta(slot, "send_errors", io["send_errors"]))
+        self._m_io_oversized.inc(
+            self._io_delta(slot, "oversized", io["oversized"]))
+        self._bump_io_hist(self._m_io_recv_batch, slot, "rb",
+                           io["recv_batches"], recv_d)
+        self._bump_io_hist(self._m_io_send_batch, slot, "sb",
+                           io["send_batches"], send_d)
 
     @property
     def native_io_active(self) -> bool:
@@ -2343,6 +2815,17 @@ class HostSessionPool:
             self._finalize()
         return list(self._fault_log[index])
 
+    def drain_state_transitions(self) -> List[Tuple[int, str, str, int]]:
+        """Ship-and-clear the supervision transition feed: ``(slot, old,
+        new, tick)`` per transition since the last drain (bounded at
+        ``max(256, 2 * B)`` while undrained — sized to hold a whole
+        shard-wide failure).  Incremental consumers — the fleet shard's
+        forensics sweep — react to exactly these instead of polling every
+        slot's state every tick."""
+        out = self._state_transitions
+        self._state_transitions = []
+        return out
+
     # ------------------------------------------------------------------
     # observability: the one-crossing stat harvest (DESIGN.md §12)
     # ------------------------------------------------------------------
@@ -2430,7 +2913,7 @@ class HostSessionPool:
                     raise RuntimeError(f"ggrs_bank_stats failed: {rc}")
                 break
             stats = self._refresh_bank_records(out_len.value)
-            self._apply_io_metrics(stats)
+            self._apply_io_metrics_live(stats)
         # evicted (and dead-after-eviction) slots: the bank record froze at
         # fault time; the live numbers are the Python session's
         for i, session in self._evicted.items():
@@ -2700,17 +3183,27 @@ class HostSessionPool:
             specs = s.get("spectators")
             if specs:
                 # broadcast gauges: how far each viewer's ack trails the
-                # broadcast tip (the stream stall detector)
+                # broadcast tip (the stream stall detector).  Setters are
+                # prebound per (slot, spectator) — zero label resolution
+                # or str() allocation on the steady-state scrape.
                 tip = s.get("next_spectator_frame", 0) - 1
-                slot = str(s["index"])
+                idx = s["index"]
+                spec_set = self._spec_setter_cache.get(idx)
+                if spec_set is None or len(spec_set) < len(specs):
+                    slot = str(idx)
+                    spec_set = [
+                        self._m_spec_lag.labels(
+                            slot=slot, spectator=str(e)
+                        ).set
+                        for e in range(len(specs))
+                    ]
+                    self._spec_setter_cache[idx] = spec_set
                 for e, ss in enumerate(specs):
                     lag = (
                         max(0, tip - ss["last_acked_frame"])
                         if ss["state"] == 0 else 0
                     )
-                    self._m_spec_lag.labels(
-                        slot=slot, spectator=str(e)
-                    ).set(lag)
+                    spec_set[e](lag)
 
     def _now_ms(self) -> int:
         clock = self._clock
@@ -2786,7 +3279,7 @@ class HostSessionPool:
             m.pending_ctrl.append((1, ep_idx, m.local_last[handle]))
             m.local_disc[handle] = True  # mirror eagerly for the policy reads
         ep.running = False
-        m.push_event(Disconnected(addr=ep.addr))
+        m.push_event((_LZ_DISCONNECTED, ep.addr))
 
     def _run_consensus(self, m: _SessionMirror) -> None:
         """``P2PSession._update_player_disconnects`` over the mirrors; the
@@ -2843,7 +3336,9 @@ class HostSessionPool:
         if index in self._evicted:  # evicted (or dead after eviction)
             return self._evicted[index].events()
         m = self._mirrors[index]
-        out = list(m.event_queue)
+        # lazy decode (DESIGN.md §19): the queue holds tag tuples; the
+        # public GgrsEvent objects are constructed only here, on drain
+        out = _materialize_events(m.event_queue)
         m.event_queue.clear()
         return out
 
@@ -2977,4 +3472,8 @@ def adopt_resume_bundle(builder, socket, bundle: Dict[str, Any], *,
     decode = builder._config.input_decode
     for handle, blob in (bundle.get("staged_inputs") or {}).items():
         session.add_local_input(int(handle), decode(blob))
+    # bundle-adopted sessions are pool/fleet-owned by definition: their
+    # request lists are consumed tick-synchronously, so they take the
+    # pooled-request path too (DESIGN.md §19)
+    session.enable_request_pooling()
     return session, LoadGameState(cell=cell, frame=resume)

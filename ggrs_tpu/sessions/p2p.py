@@ -32,6 +32,7 @@ from ..core.types import (
     Frame,
     GgrsEvent,
     GgrsRequest,
+    SaveGameState,
     Local,
     NetworkInterrupted,
     NetworkResumed,
@@ -191,6 +192,15 @@ class P2PSession(ThreadOwned, Generic[I, S, A]):
         self._stat_rollback_frames = 0
         self._stat_max_rollback = 0
 
+        # pooled requests (DESIGN.md §19, off by default): pool-owned
+        # sessions — evicted bank slots, fleet-adopted matches — reuse one
+        # SaveGameState/AdvanceFrame/list per tick instead of allocating
+        # them, the per-session twin of the host bank's vectorized quiet
+        # path.  See enable_request_pooling for the validity contract.
+        self._pooled_save: Optional[SaveGameState] = None
+        self._pooled_adv: Optional[AdvanceFrame] = None
+        self._pooled_list: Optional[List[GgrsRequest]] = None
+
         # the registry is fixed once the session exists (players are added
         # through the builder only), so cache the per-tick iteration targets
         self._local_handles = players.local_player_handles()
@@ -237,6 +247,21 @@ class P2PSession(ThreadOwned, Generic[I, S, A]):
                     "advance_frame()."
                 )
 
+    def enable_request_pooling(self) -> None:
+        """Reuse one ``SaveGameState``/``AdvanceFrame``/list across ticks
+        instead of allocating them per ``advance_frame`` — the per-session
+        twin of the host bank's vectorized quiet path (DESIGN.md §19).
+
+        Contract change: the returned request list and its pooled objects
+        are then valid only until the NEXT ``advance_frame`` call; fulfill
+        them before ticking again.  Off by default — only pool drivers
+        that already consume requests tick-synchronously (evicted bank
+        slots, fleet-adopted matches) opt in.  Request VALUES are pinned
+        identical to the unpooled path by tests/test_policy_plane.py."""
+        self._pooled_save = SaveGameState(cell=None, frame=NULL_FRAME)
+        self._pooled_adv = AdvanceFrame(inputs=[])
+        self._pooled_list = []
+
     def advance_frame(self) -> List[GgrsRequest]:
         """The main entry point; see the reference call stack
         (p2p_session.rs:265-426).  Returns the ordered request list."""
@@ -261,7 +286,13 @@ class P2PSession(ThreadOwned, Generic[I, S, A]):
             self._check_checksum_send_interval()
             self._compare_local_checksums_against_peers()
 
-        requests: List[GgrsRequest] = []
+        if self._pooled_list is not None:
+            # pooled mode: the list (and the pooled save/advance refilled
+            # below) are valid until the next advance_frame
+            requests = self._pooled_list
+            requests.clear()
+        else:
+            requests = []
 
         # In lockstep mode we only advance on fully-confirmed frames; no
         # rollback, hence no saving at all.
@@ -302,7 +333,12 @@ class P2PSession(ThreadOwned, Generic[I, S, A]):
             if self._sparse_saving:
                 self._check_last_saved_state(last_saved, confirmed_frame, requests)
             else:
-                requests.append(self._sync_layer.save_current_state())
+                # the steady-state save: refilled in place when pooled
+                # (_pooled_save appears at most once per list — the frame-0
+                # and rollback-resim saves above stay freshly allocated)
+                requests.append(
+                    self._sync_layer.save_current_state(self._pooled_save)
+                )
 
         # send confirmed inputs to spectators before discarding them
         self._send_confirmed_inputs_to_spectators(confirmed_frame)
@@ -353,7 +389,11 @@ class P2PSession(ThreadOwned, Generic[I, S, A]):
             inputs = sync.synchronized_inputs(connect_status)
             sync.advance_frame()
             local_inputs.clear()
-            requests.append(AdvanceFrame(inputs=inputs))
+            if self._pooled_adv is not None:
+                self._pooled_adv.inputs = inputs
+                requests.append(self._pooled_adv)
+            else:
+                requests.append(AdvanceFrame(inputs=inputs))
         else:
             logger.debug(
                 "Prediction threshold reached, skipping on frame %d", current

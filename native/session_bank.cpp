@@ -150,6 +150,53 @@ constexpr uint8_t kFlagInputs = 1;  // local inputs present -> advance runs
 constexpr uint8_t kFlagSkip = 2;    // slot quarantined/evicted: no fields
                                     // follow; emit a status-only record
 
+// ---- packed per-tick output header (DESIGN.md §19) ----------------------
+// The tick output now LEADS with one fixed-stride record per session — a
+// flat little-endian table the pool reads with a handful of NumPy ops to
+// classify all B slots before parsing any body bytes.  A slot whose flags
+// say "live, nothing dirty, no events/spectators/consensus" takes the
+// pool's vectorized fast path: pooled request objects refilled from the
+// ops section, the events/mirror/spectator sections jumped via rec_len.
+// kHdrQuiet + save_frame label the canonical [save, advance] tick shape —
+// classification metadata for diagnostics and future specialized
+// decoders; the current fast path decodes op shapes generically.  Stride
+// and flag values are mirrored by _native.BANK_HDR_*;
+// ggrs_bank_hdr_stride() is the presence/version probe (absent symbol =
+// pre-header layout).
+constexpr size_t kHdrStride = 48;
+constexpr uint32_t kHdrLive = 1;        // stepped this tick and err == 0
+constexpr uint32_t kHdrQuiet = 2;       // ops are exactly [save, advance]
+constexpr uint32_t kHdrEvents = 4;      // n_events > 0
+constexpr uint32_t kHdrSpec = 8;        // spectator endpoints / streams /
+                                        // events present on this record
+constexpr uint32_t kHdrConsensus = 16;  // consensus_pending
+constexpr uint32_t kHdrDirty = 32;      // a status mirror changed this tick
+                                        // (endpoint state, peer/local disc)
+constexpr uint32_t kHdrOut = 64;        // outbound sections non-empty
+constexpr uint32_t kHdrSkip = 128;      // cmd said skip (status-only record)
+constexpr uint32_t kHdrConf = 256;      // journal-tap records present
+
+inline void hdr_patch(std::vector<uint8_t>* o, size_t off, uint32_t flags,
+                      uint32_t rec_len, int32_t err, int32_t frames_ahead,
+                      int64_t landed, int64_t current, int64_t confirmed,
+                      int64_t save_frame) {
+  uint8_t* p = o->data() + off;
+  auto w32 = [&p](size_t at, uint32_t v) {
+    for (int i = 0; i < 4; ++i) p[at + i] = (v >> (8 * i)) & 0xFF;
+  };
+  auto w64 = [&p](size_t at, uint64_t v) {
+    for (int i = 0; i < 8; ++i) p[at + i] = (v >> (8 * i)) & 0xFF;
+  };
+  w32(0, flags);
+  w32(4, rec_len);
+  w32(8, static_cast<uint32_t>(err));
+  w32(12, static_cast<uint32_t>(frames_ahead));
+  w64(16, static_cast<uint64_t>(landed));
+  w64(24, static_cast<uint64_t>(current));
+  w64(32, static_cast<uint64_t>(confirmed));
+  w64(40, static_cast<uint64_t>(save_frame));
+}
+
 // ---- in-crossing phase timers (tracing, DESIGN.md §14) ----------------
 // When ggrs_bank_set_timing(1) is armed, the tick accumulates per-phase
 // wall time (steady_clock, never the session clock) and appends a timing
@@ -304,6 +351,14 @@ struct BankSession {
   std::vector<uint64_t> ep_keys;
   std::vector<uint64_t> spec_keys;
   int pending_io_err = 0;  // fatal recv errno from the pump's pre-drain
+  // status-mirror dirtiness (the header's kHdrDirty bit): set whenever an
+  // endpoint/spectator STATE or a disc flag changes — the pool's fast path
+  // skips the positional mirror parse only while this stays clear.
+  // peer_last/local_last ratchets are deliberately NOT dirty: the policy
+  // reads them only on event/consensus/fault ticks (always slow-parsed),
+  // and the harvest carries the authoritative copy for eviction/export.
+  // Starts true so the pool's first parse initializes its mirrors.
+  bool dirty = true;
   // scratch
   std::vector<uint8_t> sync_buf;     // players * input_size
   std::vector<int32_t> status_buf;   // players
@@ -532,7 +587,10 @@ void process_datagram(Bank* bank, BankSession* s, BankEndpoint* ep,
       } else {
         if (n_status != s->num_players) return;  // malformed: drop
         for (int32_t i = 0; i < n_status; ++i) {
-          if (disc[i]) ep->peer_disc[i] = 1;
+          if (disc[i] && !ep->peer_disc[i]) {
+            ep->peer_disc[i] = 1;
+            s->dirty = true;  // the consensus policy reads this mirror
+          }
           if (frames[i] > ep->peer_last[i]) ep->peer_last[i] = frames[i];
         }
       }
@@ -674,7 +732,10 @@ void poll_timers(Bank* bank, BankSession* s, BankEndpoint* ep, int64_t now) {
       ep->disconnect_event_sent = true;
     }
   } else if (ep->state == kDisconnected) {
-    if (ep->shutdown_at < now) ep->state = kShutdown;
+    if (ep->shutdown_at < now) {
+      ep->state = kShutdown;
+      s->dirty = true;
+    }
   }
 }
 
@@ -687,6 +748,7 @@ void disconnect_endpoint(BankSession* s, BankEndpoint* ep, int64_t now,
     ep->state = kDisconnected;
     ep->shutdown_at = now + kShutdownTimerMs;
   }
+  s->dirty = true;  // local_disc + endpoint state changed
   if (s->current_frame > last_frame) s->disconnect_frame = last_frame + 1;
 }
 
@@ -1277,6 +1339,7 @@ int ggrs_bank_detach_spectator(void* ptr, int64_t session, int64_t spec) {
   }
   BankEndpoint& sp = s->spectators[static_cast<size_t>(spec)];
   sp.state = kShutdown;
+  s->dirty = true;
   // drop the batched-I/O deferral too: the shuttle clears sp.deferred on
   // detach, and a stale tick of fan-out must not chase a departed viewer
   sp.deferred.clear();
@@ -1327,7 +1390,14 @@ int ggrs_bank_set_timing(void* ptr, int enabled) {
 //     op 3 = disconnect spectator `ep` (hub policy, applied next tick)
 //   u16 n_datagrams;  per datagram: u16 ep, u32 len, bytes
 //   u16 n_spec_datagrams;  per datagram: u16 spectator, u32 len, bytes
-// Output stream, per session in order:
+// Output stream: FIRST a packed header table (DESIGN.md §19) — per session,
+// kHdrStride (48) bytes:
+//   u32 flags (kHdr* bits: live/quiet/events/spec/consensus/dirty/out/
+//              skip/conf), u32 rec_len (byte length of this session's body
+//              record), i32 err, i32 frames_ahead, i64 landed_frame,
+//              i64 current_frame, i64 last_confirmed, i64 save_frame (the
+//              quiet tick's save op frame, kNullFrame otherwise)
+// — then the body records, per session in order:
 //   i32 err  (0 = ok; negative kBankErr* = THIS SLOT faulted this tick —
 //             its ops/outbound/events are suppressed, only the status
 //             mirrors below are live; the rest of the bank is unaffected)
@@ -1373,6 +1443,12 @@ static int bank_tick_impl(Bank* bank, int64_t now, const uint8_t* cmd,
                           size_t* out_len, bool io) {
   CmdReader r{cmd, cmd_len};
   bank->out.clear();
+  // packed per-tick header (DESIGN.md §19): one kHdrStride record per
+  // session, patched as each body record closes.  The header leads the
+  // output so the pool can classify all B slots (NumPy over this table)
+  // before touching any body bytes.
+  bank->out.resize(bank->sessions.size() * kHdrStride, 0);
+  size_t hdr_off = 0;
   std::vector<uint8_t> ops;
   std::vector<EpEvent> staged_events;
   std::vector<int32_t> staged_eps;
@@ -1425,6 +1501,9 @@ static int bank_tick_impl(Bank* bank, int64_t now, const uint8_t* cmd,
     uint8_t flags = r.u8();
     if (!r.ok) return kBankErrCmd;
     std::vector<uint8_t>* o = &bank->out;
+    const size_t rec_start = o->size();
+    const size_t my_hdr = hdr_off;
+    hdr_off += kHdrStride;
     if (flags & kFlagSkip) {
       // quarantined/evicted slot: nothing runs, emit a status-only record
       // so the output stream stays positionally aligned.  The stale
@@ -1444,6 +1523,13 @@ static int bank_tick_impl(Bank* bank, int64_t now, const uint8_t* cmd,
       put_u16(o, 0);  // n_events
       emit_status_mirrors(o, s);
       emit_spectator_tail(o, s, false);
+      uint32_t hflags = kHdrSkip;
+      if (s->dirty) hflags |= kHdrDirty;
+      if (!s->spectators.empty()) hflags |= kHdrSpec;
+      hdr_patch(o, my_hdr, hflags,
+                static_cast<uint32_t>(o->size() - rec_start), 0, 0,
+                kNullFrame, s->current_frame, s->last_confirmed, kNullFrame);
+      s->dirty = false;
       continue;
     }
     int err = kBankOk;  // per-SLOT fault accumulator; never fails the tick
@@ -1490,6 +1576,7 @@ static int bank_tick_impl(Bank* bank, int64_t now, const uint8_t* cmd,
         if (sp.state != kShutdown) {
           sp.state = kDisconnected;
           sp.shutdown_at = now + kShutdownTimerMs;
+          s->dirty = true;
         }
       }
     }
@@ -1751,10 +1838,17 @@ static int bank_tick_impl(Bank* bank, int64_t now, const uint8_t* cmd,
     // pool needs the phase boundary to reproduce that order exactly.
     // Attached-socket slots already sent everything through the NetBatch:
     // their sections are empty and the packet path never re-enters Python.
+    bool any_out = false;
     if (io_slot) {
       put_u16(o, 0);  // n_out_poll
       put_u16(o, 0);  // n_out_adv
     } else {
+      for (const BankEndpoint& ep : s->endpoints) {
+        if (!ep.out_poll.empty() || !ep.out_adv.empty()) {
+          any_out = true;
+          break;
+        }
+      }
       emit_out_section(o, s->endpoints, 0);
       emit_out_section(o, s->endpoints, 1);
     }
@@ -1762,6 +1856,38 @@ static int bank_tick_impl(Bank* bank, int64_t now, const uint8_t* cmd,
     put_raw(o, out_events.data(), out_events.size());
     emit_status_mirrors(o, s);
     emit_spectator_tail(o, s, true, &spec_events, n_spec_events, io_slot);
+    // ---- header classification (the pool's fast-path contract) ----
+    // QUIET = the ops are exactly [save(frame), advance]: the shape every
+    // healthy in-window tick produces.  The save frame rides the header so
+    // the fast path never reads the op bytes for it; the advance op's
+    // statuses/blob sit at a fixed offset (35 + 9 + 1) inside the record.
+    int64_t save_frame = kNullFrame;
+    bool quiet = false;
+    if (err == kBankOk && n_ops == 2 && ops.size() > 10 && ops[0] == 0 &&
+        ops[9] == 2 &&
+        ops.size() == 10 + static_cast<size_t>(s->num_players) *
+                               (1 + static_cast<size_t>(s->input_size))) {
+      quiet = true;
+      uint64_t u = 0;
+      for (int i = 0; i < 8; ++i) {
+        u |= static_cast<uint64_t>(ops[1 + i]) << (8 * i);
+      }
+      save_frame = static_cast<int64_t>(u);
+    }
+    uint32_t hflags = 0;
+    if (err == kBankOk) hflags |= kHdrLive;
+    if (quiet) hflags |= kHdrQuiet;
+    if (n_out_events) hflags |= kHdrEvents;
+    if (!s->spectators.empty() || n_spec_events) hflags |= kHdrSpec;
+    if (pending_consensus) hflags |= kHdrConsensus;
+    if (s->dirty) hflags |= kHdrDirty;
+    if (any_out) hflags |= kHdrOut;
+    if (s->conf_count) hflags |= kHdrConf;
+    hdr_patch(o, my_hdr, hflags,
+              static_cast<uint32_t>(o->size() - rec_start),
+              static_cast<int32_t>(err), static_cast<int32_t>(frames_ahead),
+              landed, s->current_frame, s->last_confirmed, save_frame);
+    s->dirty = false;
     pt.lap(kPhEmit);
   }
 
@@ -1878,6 +2004,12 @@ int64_t ggrs_bank_session_count(void* ptr) {
   return static_cast<int64_t>(static_cast<Bank*>(ptr)->sessions.size());
 }
 
+// Presence/version probe for the packed per-tick output header (DESIGN.md
+// §19): a library exporting this symbol (a) leads every tick output with
+// one kHdrStride-byte record per session and (b) extends each harvest
+// endpoint record with the peer status mirrors.  Returns the stride.
+int ggrs_bank_hdr_stride(void) { return static_cast<int>(kHdrStride); }
+
 // Harvest one session's resumable state for Python-fallback eviction — the
 // read-only dump host_bank.py turns into a mid-stream P2PSession via the
 // adoption seam (P2PSession.adopt_resume_state).  Little-endian layout:
@@ -1889,6 +2021,9 @@ int64_t ggrs_bank_session_count(void* ptr) {
 //     count * input_size input bytes          [frames start..start+count)
 //   u8 n_endpoints; per endpoint:
 //     u8 state
+//     num_players * (u8 peer_disc, i64 peer_last)   [peer status mirror —
+//       authoritative for eviction/export: the vectorized pool's Python
+//       mirrors may be quiet-tick stale]
 //     send dump  (ggrs_ep_dump_send: last_acked_frame, base, pending window)
 //     recv dump  (ggrs_ep_dump_recv: last_recv_frame, ring window)
 //   i64 next_spectator_frame
@@ -1946,6 +2081,14 @@ int ggrs_bank_harvest(void* ptr, int64_t session, uint8_t* out, size_t cap,
   std::vector<uint8_t> scratch(size_t{1} << 14);
   for (BankEndpoint& ep : s->endpoints) {
     put_u8(&h, ep.state);
+    // peer status mirrors (what this peer last reported about every
+    // player): the vectorized pool skips the per-tick mirror parse on
+    // quiet ticks, so eviction/export read the authoritative copy HERE
+    // instead of trusting a possibly-stale Python-side mirror
+    for (int p = 0; p < s->num_players; ++p) {
+      put_u8(&h, ep.peer_disc[p]);
+      put_i64(&h, ep.peer_last[p]);
+    }
     for (int which = 0; which < 2; ++which) {
       size_t need = 0;
       while (true) {

@@ -48,7 +48,8 @@ LD_PRELOAD="$asan_rt" \
 ASAN_OPTIONS="detect_leaks=0:abort_on_error=1" \
 GGRS_NATIVE_SANITIZE=1 \
 JAX_PLATFORMS=cpu \
-python -m pytest tests/test_session_bank.py tests/test_bank_faults.py \
+python -m pytest tests/test_session_bank.py tests/test_policy_plane.py \
+    tests/test_bank_faults.py \
     tests/test_obs.py tests/test_broadcast.py tests/test_replay_journal.py \
     tests/test_trace.py tests/test_desync_detection.py \
     tests/test_native_io.py tests/test_socket_datapath.py \

@@ -247,7 +247,8 @@ def verify_leg(name: str, matches: int, ticks: int, seed: int,
     for f in pool.fault_log(target):
         print(f"    fault@tick {f.tick}: code={f.code} {f.detail}")
     print(f"  crossings={pool.crossings} harvests={pool.harvests} "
-          f"stat_crossings={pool.stat_crossings}")
+          f"stat_crossings={pool.stat_crossings} "
+          f"fastpath_slot_ticks={pool.fast_slot_ticks}")
     print(_metrics_summary(chaos))
     dump = pool.flight_dump(target, last=32)
     print(f"  flight recorder (target slot {target}, last 32 events):")
@@ -278,6 +279,11 @@ def verify_leg(name: str, matches: int, ticks: int, seed: int,
         ],
         "crossings": {"tick": pool.crossings, "harvest": pool.harvests,
                       "stats": pool.stat_crossings},
+        # vectorized policy plane (DESIGN.md §19): how much of the run the
+        # quiet fast path served — fault ticks and their neighbors must
+        # take the slow reference decoder, survivors stay fast
+        "fastpath": {"slot_ticks": pool.fast_slot_ticks,
+                     "all_fast_ticks": pool.fast_ticks},
         "desync_report": str(report_path) if report_path else None,
         "metrics": json_snapshot(chaos["registry"]),
     })

@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""One-shot, flamegraph-style phase breakdown of a host-bank pool tick.
+
+Builds a B-match pool (the bench's standard 2-peer match population over an
+in-memory network), drives it with the PR 5 trace ring armed — Python spans
+plus the native in-crossing phase timers, zero extra crossings — and prints
+a text flamegraph: where a pool tick's time goes, top-down, from
+``pool.tick`` through ``bank.crossing`` into the eight native phases, with
+the per-slot Python remainder attributed explicitly.
+
+    python scripts/profile_tick.py                   # B=64, 200 ticks
+    python scripts/profile_tick.py --matches 256 --ticks 100
+    python scripts/profile_tick.py --legacy          # force the legacy
+                                                     # per-slot parse
+    python scripts/profile_tick.py --trace tick.perfetto.json
+                                                     # + full Perfetto dump
+
+Notes: a TRACED pool uses the legacy sequential decode by design (per-slot
+spans are the point of tracing), so the Python-side numbers here price the
+reference decoder; pass ``--fast-sample`` to append an untraced
+vectorized-vs-legacy host-tick A/B measured with plain perf_counter.
+(DESIGN.md §19; bench.py host_bank_capacity is the acceptance sweep.)
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import numpy as np  # noqa: E402
+
+
+def build_pool(n_matches: int, tracer=None, fastpath=True):
+    from ggrs_tpu.core import Local, Remote
+    from ggrs_tpu.games import boxgame_config
+    from ggrs_tpu.net import InMemoryNetwork
+    from ggrs_tpu.parallel.host_bank import HostSessionPool
+    from ggrs_tpu.sessions import SessionBuilder
+
+    prev = os.environ.pop("GGRS_TPU_NO_FASTPATH", None)
+    if not fastpath:
+        os.environ["GGRS_TPU_NO_FASTPATH"] = "1"
+    try:
+        net = InMemoryNetwork()
+        pool = HostSessionPool(tracer=tracer)
+        schedules = []
+        for m in range(n_matches):
+            names = (f"A{m}", f"B{m}")
+            for me in (0, 1):
+                b = (
+                    SessionBuilder(boxgame_config())
+                    .with_clock(lambda: 0)
+                    .with_rng(random.Random(3 + 5 * m + me))
+                    .add_player(Local(), me)
+                    .add_player(Remote(names[1 - me]), 1 - me)
+                )
+                pool.add_session(b, net.socket(names[me]))
+                schedules.append(
+                    lambda i, m=m, me=me:
+                    ((i + 2 * m + me) // (2 + m % 3)) % 16
+                )
+        if not pool.native_active:
+            raise SystemExit("native bank did not engage (no toolchain?)")
+    finally:
+        os.environ.pop("GGRS_TPU_NO_FASTPATH", None)
+        if prev is not None:
+            os.environ["GGRS_TPU_NO_FASTPATH"] = prev
+    return pool, schedules, net
+
+
+def drive(pool, schedules, net, ticks, base=0):
+    n = len(pool)
+    times = np.empty(ticks)
+    for i in range(ticks):
+        t0 = time.perf_counter()
+        for h in range(n):
+            pool.add_local_input(h, h % 2, schedules[h](base + i))
+        for reqs in pool.advance_all():
+            for r in reqs:
+                if type(r).__name__ == "SaveGameState":
+                    r.cell.save(r.frame, None, None)
+        times[i] = (time.perf_counter() - t0) * 1e3
+        net.tick()
+    return times
+
+
+def bar(us, full_us, width=42):
+    n = 0 if full_us <= 0 else int(round(width * us / full_us))
+    return "█" * max(0, min(width, n))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--matches", type=int, default=64, metavar="B",
+                    help="matches (2 sessions each; default 64)")
+    ap.add_argument("--ticks", type=int, default=200)
+    ap.add_argument("--legacy", action="store_true",
+                    help="(documentational; traced pools already use the "
+                         "legacy parse)")
+    ap.add_argument("--fast-sample", action="store_true",
+                    help="append an untraced vectorized-vs-legacy host "
+                         "tick A/B")
+    ap.add_argument("--trace", default=None, metavar="OUT.json",
+                    help="also write the full Perfetto export")
+    args = ap.parse_args()
+
+    from ggrs_tpu.obs import Tracer
+
+    tracer = Tracer(capacity=1 << 16)
+    pool, schedules, net = build_pool(args.matches, tracer=tracer)
+    drive(pool, schedules, net, 16)  # warm
+    tracer.clear()
+    times = drive(pool, schedules, net, args.ticks, base=16)
+    pool.scrape()
+
+    T = args.ticks
+    summary = tracer.summary()
+    totals = pool.native_phase_totals()
+    tick_us = summary.get("pool.tick", {}).get("total_us", 0.0) / T
+    cross_us = summary.get("bank.crossing", {}).get("total_us", 0.0) / T
+    slot = summary.get("pool.slot", {})
+    slot_us = slot.get("total_us", 0.0) / T
+
+    print(f"# host-bank tick profile: B={args.matches} matches "
+          f"({2 * args.matches} sessions), {T} ticks, traced "
+          f"(legacy decode)")
+    print(f"# wall: p50 {np.percentile(times, 50):.2f} ms  "
+          f"p99 {np.percentile(times, 99):.2f} ms per tick\n")
+    print(f"pool.tick                {tick_us:9.0f} us/tick  "
+          f"{bar(tick_us, tick_us)}")
+    print(f"  bank.crossing          {cross_us:9.0f} us/tick  "
+          f"{bar(cross_us, tick_us)}")
+    if totals:
+        timed_ticks, phases = totals
+        for name, ns in sorted(phases.items(), key=lambda kv: -kv[1]):
+            us = ns / max(1, timed_ticks) / 1000.0
+            print(f"    bank.{name:<18} {us:9.0f} us/tick  "
+                  f"{bar(us, tick_us)}")
+    print(f"  pool.slot (decode+send){slot_us:9.0f} us/tick  "
+          f"{bar(slot_us, tick_us)}"
+          f"   ({slot.get('count', 0) / T:.0f} slots/tick)")
+    other = tick_us - cross_us - slot_us
+    print(f"  other (staging, superv){max(0.0, other):9.0f} us/tick  "
+          f"{bar(max(0.0, other), tick_us)}")
+
+    if args.trace:
+        path = tracer.write(args.trace)
+        print(f"\nPerfetto export: {path} (load in chrome://tracing)")
+
+    if args.fast_sample:
+        print("\n# untraced A/B (plain perf_counter, same population):")
+        for fast in (False, True):
+            p, s, n2 = build_pool(args.matches, fastpath=fast)
+            drive(p, s, n2, 16)
+            xs = drive(p, s, n2, args.ticks, base=16)
+            cov = p.fast_slot_ticks
+            print(f"  {'vectorized' if fast else 'legacy    '}: "
+                  f"p50 {np.percentile(xs, 50):6.2f} ms  "
+                  f"p99 {np.percentile(xs, 99):6.2f} ms  "
+                  f"(fast-path slot ticks {cov})")
+            del p, s, n2
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
