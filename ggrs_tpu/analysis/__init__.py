@@ -1,0 +1,64 @@
+"""ggrs-verify: the static-analysis plane (DESIGN.md §20).
+
+Three pillars, all source-level — they read the tree, not the process:
+
+- :mod:`.layout` — the cross-language ABI/layout checker.  Parses the
+  packed-format constants out of the native sources (``native/*.cpp``,
+  ``native/wire_common.h``) and the Python decoders
+  (``net/_native.py``, ``net/messages.py``, ``net/sockets.py``,
+  ``parallel/host_bank.py``, ``fleet/rpc.py``) and proves the mirrored
+  offsets/widths/flag bits/error codes agree — so layout drift fails
+  lint, not a B=512 fleet.  The static table is additionally pinned
+  equal to the runtime probes (``ggrs_bank_hdr_stride()``) by
+  tests/test_verify_layout.py.
+- :mod:`.determinism` — an AST lint over rollback-visible code for the
+  bit-identical-resimulation invariant: wall-clock reads, unseeded RNG,
+  unordered-set iteration, salted ``hash()``, float-reduction hazards
+  inside jitted sim code, unpinned pickles on the migration-bundle
+  paths.  Violations carry rule ids; a committed baseline
+  (``determinism_baseline.json``) lets legacy findings burn down while
+  new ones fail.
+- :mod:`.ownership` — a static companion to
+  ``utils.ownership.ThreadOwned``: every mixin user must declare its
+  driving methods (``_DRIVING_METHODS``) and every declared method must
+  actually guard with ``_check_owner()`` (and vice versa), so the
+  thread-affinity contract is visible to review and checkable without
+  running the race.
+
+``scripts/ggrs_verify.py`` fronts all three (plus tree-hygiene checks)
+with baseline handling and a non-zero exit on new violations;
+``scripts/build_sanitized.sh`` runs it before the sanitizer legs.
+"""
+
+from .baseline import Baseline, load_baseline, write_baseline
+from .cpp import parse_cpp_constants
+from .determinism import DETERMINISM_RULES, lint_determinism
+from .layout import (
+    LAYOUT_HEADER_FIELDS,
+    check_layout,
+    static_bank_header,
+)
+from .ownership import lint_ownership
+from .pysrc import (
+    parse_py_constants,
+    parse_py_field_tuples,
+    parse_py_struct_formats,
+)
+from .report import Finding
+
+__all__ = [
+    "Baseline",
+    "DETERMINISM_RULES",
+    "Finding",
+    "LAYOUT_HEADER_FIELDS",
+    "check_layout",
+    "lint_determinism",
+    "lint_ownership",
+    "load_baseline",
+    "parse_cpp_constants",
+    "parse_py_constants",
+    "parse_py_field_tuples",
+    "parse_py_struct_formats",
+    "static_bank_header",
+    "write_baseline",
+]

@@ -1,0 +1,80 @@
+"""Committed-baseline handling for ggrs-verify.
+
+The determinism lint (and any future rule set) runs against a baseline
+file checked into the tree: findings present in the baseline are
+``legacy`` — reported but non-fatal — while anything new fails the run.
+``scripts/ggrs_verify.py --baseline-update`` rewrites the file from the
+current tree, the reviewed way to bless or burn down entries.
+
+Format: JSON, a sorted list of ``{"key": ..., "count": N}`` records —
+line-number free (see report.Finding.key) so the baseline survives
+unrelated edits, with a count so *additional* occurrences of an
+already-baselined finding in the same file still fail.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from pathlib import Path
+from typing import Dict, Iterable, List, Tuple
+
+from .report import Finding
+
+BASELINE_VERSION = 1
+
+
+class Baseline:
+    """An allowance multiset over finding keys."""
+
+    def __init__(self, counts: Dict[str, int] | None = None) -> None:
+        self.counts: Dict[str, int] = dict(counts or {})
+
+    def split(
+        self, findings: Iterable[Finding]
+    ) -> Tuple[List[Finding], List[Finding]]:
+        """Partition into ``(new, legacy)``.  Each baseline entry absorbs
+        up to ``count`` occurrences of its key; the rest are new."""
+        budget = Counter(self.counts)
+        new: List[Finding] = []
+        legacy: List[Finding] = []
+        for f in findings:
+            k = f.key()
+            if budget.get(k, 0) > 0:
+                budget[k] -= 1
+                legacy.append(f)
+            else:
+                new.append(f)
+        return new, legacy
+
+    @staticmethod
+    def from_findings(findings: Iterable[Finding]) -> "Baseline":
+        return Baseline(Counter(f.key() for f in findings))
+
+
+def load_baseline(path: Path) -> Baseline:
+    """Missing file == empty baseline: a fresh checkout (or a rule set
+    with nothing legacy) needs no committed file to run strict."""
+    if not Path(path).exists():
+        return Baseline()
+    data = json.loads(Path(path).read_text())
+    if data.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"baseline {path} has version {data.get('version')!r}, "
+            f"this tool reads {BASELINE_VERSION}"
+        )
+    return Baseline({e["key"]: int(e["count"]) for e in data["entries"]})
+
+
+def write_baseline(path: Path, baseline: Baseline) -> None:
+    entries = [
+        {"key": k, "count": n}
+        for k, n in sorted(baseline.counts.items())
+        if n > 0
+    ]
+    Path(path).write_text(
+        json.dumps(
+            {"version": BASELINE_VERSION, "entries": entries}, indent=2
+        )
+        + "\n"
+    )

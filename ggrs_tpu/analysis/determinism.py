@@ -1,0 +1,391 @@
+"""AST determinism lint (pillar 2 of ggrs-verify).
+
+Rollback netcode's core invariant is bit-identical resimulation: every
+peer must derive the same state from the same confirmed inputs, and a
+migrated/failed-over incarnation must derive the same state from the
+same bundle.  Anything nondeterministic that leaks into that derivation
+— wall-clock reads, process-salted hashes, unordered-set iteration,
+unseeded RNG, interpreter-dependent pickle encodings — desyncs a fleet
+in ways no unit test reliably catches (the chaos ``shard_migrate`` leg
+needed a specific loss seed to expose one).  This lint rejects the
+whole class at the source level.
+
+Scopes (``DET_SCOPE``):
+
+- ``sim`` — rollback-visible code: ``core/``, ``games/``, ``ops/``,
+  ``sessions/``, plus the journal/checkpoint modules whose bytes feed
+  recovery.  All rules apply.
+- ``bundle`` — the migration/resume-bundle and RPC seams.  The
+  pickle-stability and set-iteration rules apply (their outputs cross
+  process/host boundaries); wall-clock is allowed (watchdogs and
+  metrics legitimately read real time there).
+
+Suppression: a line comment ``# ggrs-verify: allow(<rule>[, <rule>])``
+acknowledges a reviewed exception in place; the committed baseline
+(``determinism_baseline.json``) carries the legacy remainder so new
+violations fail while old ones burn down.
+
+Rules:
+
+====================  =====================================================
+det/wall-clock        ``time.time()``/``monotonic``/``perf_counter``/
+                      ``*_ns`` variants, ``datetime.now/utcnow/today``
+det/unseeded-rng      module-level ``random.*`` calls, no-arg
+                      ``random.Random()``, ``np.random.*``,
+                      ``os.urandom``, ``uuid.uuid1/uuid4``, ``secrets.*``
+det/set-iteration     iterating a set/frozenset (for/comprehension/
+                      ``list``/``tuple``/``join``/``enumerate``) without
+                      a ``sorted(...)`` wrapper
+det/hash-order        builtin ``hash()`` (PYTHONHASHSEED-salted for
+                      str/bytes) and ``sorted(key=id)`` /
+                      ``.sort(key=id)``
+det/jit-float-reduce  builtin ``sum()`` inside a jit-decorated function
+                      (unspecified reduction order over floats)
+det/pickle-protocol   ``pickle.dumps`` without an explicit fixed
+                      ``protocol=`` (or with ``HIGHEST_PROTOCOL``, which
+                      is interpreter-dependent) on the bundle/RPC seams
+====================  =====================================================
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from .report import Finding, allow_pragmas, is_allowed
+
+# rule id -> one-line catalog entry (DESIGN.md §20 renders this)
+DETERMINISM_RULES: Dict[str, str] = {
+    "det/wall-clock": "wall-clock read in rollback-visible code",
+    "det/unseeded-rng": "unseeded / process-global RNG",
+    "det/set-iteration": "iteration over an unordered set",
+    "det/hash-order": "process-salted hash() or id()-keyed ordering",
+    "det/jit-float-reduce": "builtin sum() inside jitted sim code",
+    "det/pickle-protocol": "unpinned pickle protocol on a bundle seam",
+}
+
+# (scope, repo-relative prefix or exact file)
+DET_SCOPE: Tuple[Tuple[str, str], ...] = (
+    ("sim", "ggrs_tpu/core/"),
+    ("sim", "ggrs_tpu/games/"),
+    ("sim", "ggrs_tpu/ops/"),
+    ("sim", "ggrs_tpu/sessions/"),
+    ("sim", "ggrs_tpu/broadcast/journal.py"),
+    ("sim", "ggrs_tpu/utils/checkpoint.py"),
+    ("bundle", "ggrs_tpu/parallel/host_bank.py"),
+    ("bundle", "ggrs_tpu/fleet/rpc.py"),
+    ("bundle", "ggrs_tpu/fleet/shard.py"),
+    ("bundle", "ggrs_tpu/fleet/supervisor.py"),
+    ("bundle", "ggrs_tpu/fleet/proc.py"),
+)
+
+# rules active per scope
+_SCOPE_RULES = {
+    "sim": (
+        "det/wall-clock", "det/unseeded-rng", "det/set-iteration",
+        "det/hash-order", "det/jit-float-reduce", "det/pickle-protocol",
+    ),
+    "bundle": ("det/set-iteration", "det/pickle-protocol"),
+}
+
+_WALL_CLOCK_TIME = {
+    "time", "time_ns", "monotonic", "monotonic_ns", "perf_counter",
+    "perf_counter_ns", "process_time", "process_time_ns", "localtime",
+    "gmtime",
+}
+_WALL_CLOCK_DATETIME = {"now", "utcnow", "today"}
+_RANDOM_MODULE_FNS = {
+    "random", "randint", "randrange", "uniform", "choice", "choices",
+    "shuffle", "sample", "getrandbits", "gauss", "normalvariate",
+    "betavariate", "expovariate", "seed",
+}
+_UUID_NONDET = {"uuid1", "uuid4"}
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for a Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_set_expr(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        return node.func.id in ("set", "frozenset")
+    return False
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    """jax.jit / jit / partial(jax.jit, ...) / jax.pmap / pl.pallas_call
+    shapes — anything that compiles the body for the device."""
+    target = dec.func if isinstance(dec, ast.Call) else dec
+    name = _dotted(target)
+    if name in ("jit", "jax.jit", "jax.pmap", "pmap", "pjit",
+                "jax.experimental.pjit.pjit", "pl.pallas_call",
+                "pallas_call"):
+        return True
+    if isinstance(dec, ast.Call) and _dotted(dec.func) in (
+        "functools.partial", "partial"
+    ):
+        return any(
+            _dotted(a) in ("jit", "jax.jit", "jax.pmap") for a in dec.args
+        )
+    return False
+
+
+# modules whose from-imports must resolve back to dotted form so
+# `from time import monotonic; monotonic()` is as visible to the rules
+# as `time.monotonic()`
+_TRACKED_MODULES = (
+    "time", "random", "datetime", "os", "uuid", "secrets", "pickle",
+)
+
+
+def _from_import_aliases(tree: ast.Module) -> Dict[str, str]:
+    """``{local_name: "module.attr"}`` for from-imports of the tracked
+    nondeterminism modules (one level; star imports are out of reach)."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module in \
+                _TRACKED_MODULES:
+            for a in node.names:
+                if a.name != "*":
+                    out[a.asname or a.name] = f"{node.module}.{a.name}"
+        elif isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname and a.name in _TRACKED_MODULES:
+                    out[a.asname] = a.name  # import time as t -> t.*
+    return out
+
+
+class _DetVisitor(ast.NodeVisitor):
+    def __init__(
+        self,
+        path: str,
+        rules: Iterable[str],
+        aliases: Optional[Dict[str, str]] = None,
+    ) -> None:
+        self.path = path
+        self.rules = set(rules)
+        self.aliases = aliases or {}
+        self.findings: List[Finding] = []
+        self._jit_depth = 0
+
+    def _resolve(self, node: ast.AST) -> Optional[str]:
+        """Dotted name of a call target, with from-import aliases
+        resolved ('monotonic' -> 'time.monotonic', 't.monotonic' ->
+        'time.monotonic' for 'import time as t')."""
+        name = _dotted(node)
+        if name is None:
+            return None
+        head, _, rest = name.partition(".")
+        if head in self.aliases:
+            return self.aliases[head] + ("." + rest if rest else "")
+        return name
+
+    # -- helpers --------------------------------------------------------
+    def _hit(self, rule: str, node: ast.AST, detail: str) -> None:
+        if rule in self.rules:
+            self.findings.append(
+                Finding(rule, self.path, getattr(node, "lineno", 0), detail)
+            )
+
+    # -- function bodies (jit tracking) ---------------------------------
+    def _visit_func(self, node) -> None:
+        jitted = any(_is_jit_decorator(d) for d in node.decorator_list)
+        if jitted:
+            self._jit_depth += 1
+        self.generic_visit(node)
+        if jitted:
+            self._jit_depth -= 1
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    # -- iteration forms -------------------------------------------------
+    def visit_For(self, node: ast.For) -> None:
+        if _is_set_expr(node.iter):
+            self._hit(
+                "det/set-iteration", node,
+                "for-loop over a set: iteration order is unordered",
+            )
+        self.generic_visit(node)
+
+    def _visit_comp(self, node) -> None:
+        for gen in node.generators:
+            if _is_set_expr(gen.iter):
+                self._hit(
+                    "det/set-iteration", node,
+                    "comprehension over a set: iteration order is "
+                    "unordered",
+                )
+        self.generic_visit(node)
+
+    visit_ListComp = _visit_comp
+    visit_GeneratorExp = _visit_comp
+    visit_DictComp = _visit_comp
+    visit_SetComp = _visit_comp
+
+    # -- calls ------------------------------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        name = self._resolve(node.func)
+
+        # wall clock
+        if name is not None:
+            mod, _, attr = name.rpartition(".")
+            if mod == "time" and attr in _WALL_CLOCK_TIME:
+                self._hit("det/wall-clock", node,
+                          f"{name}() reads the wall clock")
+            elif attr in _WALL_CLOCK_DATETIME and mod.endswith("datetime"):
+                self._hit("det/wall-clock", node,
+                          f"{name}() reads the wall clock")
+            # unseeded / process-global RNG
+            elif mod == "random" and attr in _RANDOM_MODULE_FNS:
+                self._hit("det/unseeded-rng", node,
+                          f"{name}() uses the process-global RNG")
+            elif name == "random.Random" and not node.args and not \
+                    node.keywords:
+                self._hit("det/unseeded-rng", node,
+                          "random.Random() without a seed")
+            elif mod.split(".")[-1] == "random" and \
+                    mod.split(".")[0] in ("np", "numpy"):
+                # np.random.* is the process-global legacy RNG.
+                # jax.random is deliberately NOT here: it is functional
+                # and explicitly keyed.
+                self._hit("det/unseeded-rng", node,
+                          f"{name}() uses a process-global RNG")
+            elif name == "os.urandom" or mod == "secrets":
+                self._hit("det/unseeded-rng", node,
+                          f"{name}() is entropy, not simulation state")
+            elif mod == "uuid" and attr in _UUID_NONDET:
+                self._hit("det/unseeded-rng", node,
+                          f"{name}() is host/time-dependent")
+            # pickle stability
+            elif name in ("pickle.dumps", "pickle.dump"):
+                # positional protocol: dumps(obj, protocol) is args[1],
+                # dump(obj, file, protocol) is args[2]
+                pos = 1 if name == "pickle.dumps" else 2
+                proto = next(
+                    (k.value for k in node.keywords if k.arg == "protocol"),
+                    node.args[pos] if len(node.args) > pos else None,
+                )
+                if proto is None or (
+                    isinstance(proto, ast.Constant)
+                    and proto.value is None
+                ):
+                    self._hit(
+                        "det/pickle-protocol", node,
+                        "pickle without an explicit protocol: the "
+                        "default differs across interpreters",
+                    )
+                elif _dotted(proto) in (
+                    "pickle.HIGHEST_PROTOCOL", "HIGHEST_PROTOCOL",
+                    "pickle.DEFAULT_PROTOCOL", "DEFAULT_PROTOCOL",
+                ):
+                    self._hit(
+                        "det/pickle-protocol", node,
+                        f"{_dotted(proto)} is interpreter-dependent; "
+                        "pin a numeric protocol",
+                    )
+                elif isinstance(proto, ast.UnaryOp) and isinstance(
+                    proto.op, ast.USub
+                ):
+                    self._hit(
+                        "det/pickle-protocol", node,
+                        "protocol=-1 means highest-available: "
+                        "interpreter-dependent; pin a numeric protocol",
+                    )
+
+        # builtin hash()/sum()/list(set)/...
+        if isinstance(node.func, ast.Name):
+            fid = node.func.id
+            if fid == "hash":
+                self._hit(
+                    "det/hash-order", node,
+                    "builtin hash() is PYTHONHASHSEED-salted for "
+                    "str/bytes",
+                )
+            elif fid == "sum" and self._jit_depth > 0:
+                self._hit(
+                    "det/jit-float-reduce", node,
+                    "builtin sum() inside jitted code: reduction order "
+                    "over floats is unspecified",
+                )
+            elif fid in ("list", "tuple", "enumerate") and \
+                    node.args and _is_set_expr(node.args[0]):
+                self._hit(
+                    "det/set-iteration", node,
+                    f"{fid}() over a set: materialization order is "
+                    "unordered (wrap in sorted())",
+                )
+            elif fid == "sorted":
+                for k in node.keywords:
+                    if k.arg == "key" and _dotted(k.value) == "id":
+                        self._hit(
+                            "det/hash-order", node,
+                            "sorted(key=id): address order varies per "
+                            "process",
+                        )
+        elif isinstance(node.func, ast.Attribute):
+            if node.func.attr in ("join", "sort"):
+                if node.func.attr == "join" and node.args and \
+                        _is_set_expr(node.args[0]):
+                    self._hit(
+                        "det/set-iteration", node,
+                        "join() over a set: order is unordered",
+                    )
+                if node.func.attr == "sort":
+                    for k in node.keywords:
+                        if k.arg == "key" and _dotted(k.value) == "id":
+                            self._hit(
+                                "det/hash-order", node,
+                                ".sort(key=id): address order varies "
+                                "per process",
+                            )
+        self.generic_visit(node)
+
+
+def lint_source(
+    source: str, rel_path: str, scope: str = "sim"
+) -> List[Finding]:
+    """Lint one file's source text under the given scope's rule set,
+    honoring ``# ggrs-verify: allow(...)`` line pragmas."""
+    tree = ast.parse(source)
+    visitor = _DetVisitor(
+        rel_path, _SCOPE_RULES[scope], _from_import_aliases(tree)
+    )
+    visitor.visit(tree)
+    allows = allow_pragmas(source.splitlines())
+    return [
+        f for f in visitor.findings
+        if not is_allowed(f.rule, allows.get(f.line, set()))
+    ]
+
+
+def lint_determinism(
+    root: Path, scope_map: Sequence[Tuple[str, str]] = DET_SCOPE
+) -> List[Finding]:
+    """Lint every in-scope file under ``root``; sorted findings."""
+    root = Path(root)
+    findings: List[Finding] = []
+    seen = set()
+    for scope, prefix in scope_map:
+        target = root / prefix
+        files = (
+            sorted(target.rglob("*.py")) if target.is_dir() else [target]
+        )
+        for path in files:
+            if not path.exists() or path in seen:
+                continue
+            seen.add(path)
+            rel = path.relative_to(root).as_posix()
+            findings.extend(lint_source(path.read_text(), rel, scope))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings

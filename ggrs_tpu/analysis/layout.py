@@ -1,0 +1,476 @@
+"""Cross-language ABI/layout checker (pillar 1 of ggrs-verify).
+
+The native crossing and the Python decoders agree on a packed contract:
+the 48-byte tick-output header, the body-record prefix and its jump
+offsets, the command-stream flag bytes, the RPC frame header, the
+message tags, and a few dozen mirrored error codes and resource caps.
+Today that agreement is enforced at runtime (``ggrs_bank_hdr_stride()``
+probes, parity fuzzes); this module proves the same facts from the
+*source text* so drift fails lint before anything runs.
+
+Everything here is static: C++ constants come from
+:func:`..cpp.parse_cpp_constants` over the native sources, Python
+constants/formats from the AST extractors in :mod:`..pysrc`.  The
+checker never imports the modules it judges.
+"""
+
+from __future__ import annotations
+
+import re
+import struct
+from pathlib import Path
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .cpp import parse_cpp_constants
+from .pysrc import (
+    parse_py_constants,
+    parse_py_field_tuples,
+    parse_py_struct_formats,
+)
+from .report import Finding
+
+# ---------------------------------------------------------------------------
+# the canonical contract
+# ---------------------------------------------------------------------------
+
+# The packed per-tick output header (session_bank.cpp kHdr*/kHdrStride;
+# DESIGN.md §19).  THIS table is the contract both sides are checked
+# against: the C++ side must declare the same stride, the Python side
+# (net/_native.py BANK_HDR_FIELDS) must build the same dtype.
+LAYOUT_HEADER_FIELDS: Tuple[Tuple[str, str, int], ...] = (
+    # (field, little-endian numpy format, byte offset)
+    ("flags", "<u4", 0),
+    ("rec_len", "<u4", 4),
+    ("err", "<i4", 8),
+    ("fa", "<i4", 12),
+    ("landed", "<i8", 16),
+    ("current", "<i8", 24),
+    ("confirmed", "<i8", 32),
+    ("save_frame", "<i8", 40),
+)
+LAYOUT_HEADER_STRIDE = 48
+
+# Body-record prefix (bank_tick_impl output stream): i32 err, i64
+# landed_frame, i32 frames_ahead, i64 current, i64 last_confirmed,
+# u8 consensus_pending, u16 n_ops.  The vectorized fast path jumps
+# straight to n_ops / the first op with literal offsets derived from it.
+BODY_PREFIX_FMT = "<iqiqqBH"
+BODY_N_OPS_OFFSET = struct.calcsize("<iqiqqB")   # 33
+BODY_OPS_OFFSET = struct.calcsize(BODY_PREFIX_FMT)  # 35
+
+# Supervisor<->runner RPC frame header (fleet/rpc.py): magic, version,
+# kind, payload length, crc32 over header[:CRC_COVERS]+payload.
+RPC_HEADER_FMT = "<2sBBII"
+RPC_HEADER_PREFIX_FMT = "<2sBBI"  # what _encode_frame packs before the crc
+RPC_CRC_COVERS = struct.calcsize(RPC_HEADER_PREFIX_FMT)  # 8
+
+# Harvest prefix (ggrs_bank_harvest): i64 current, i64 last_confirmed,
+# i64 disconnect_frame.
+HARVEST_PREFIX_FMT = "<qqq"
+
+_NP_WIDTH = {"u4": 4, "i4": 4, "u8": 8, "i8": 8, "u2": 2, "i2": 2,
+             "u1": 1, "i1": 1}
+
+# mirrored scalar constants: (cpp file, cpp symbol, py file, py symbol)
+MIRRORED_CONSTANTS: Tuple[Tuple[str, str, str, str], ...] = (
+    # wire_common.h <-> codec/compression caps and shared error codes
+    ("native/wire_common.h", "kMaxDecodedBytes",
+     "ggrs_tpu/net/compression.py", "MAX_DECODED_BYTES"),
+    ("native/wire_common.h", "kMaxPlayersOnWire",
+     "ggrs_tpu/net/_native.py", "_MAX_PLAYERS_ON_WIRE"),
+    ("native/wire_common.h", "kErrBufferTooSmall",
+     "ggrs_tpu/net/_native.py", "EP_ERR_BUFFER_TOO_SMALL"),
+    ("native/wire_common.h", "kErrTooManyInputs",
+     "ggrs_tpu/net/_native.py", "EP_ERR_TOO_MANY_INPUTS"),
+    # message tags (wire_common.h MsgTag <-> messages.py)
+    ("native/wire_common.h", "kTagInput",
+     "ggrs_tpu/net/messages.py", "_TAG_INPUT"),
+    ("native/wire_common.h", "kTagInputAck",
+     "ggrs_tpu/net/messages.py", "_TAG_INPUT_ACK"),
+    ("native/wire_common.h", "kTagQualityReport",
+     "ggrs_tpu/net/messages.py", "_TAG_QUALITY_REPORT"),
+    ("native/wire_common.h", "kTagQualityReply",
+     "ggrs_tpu/net/messages.py", "_TAG_QUALITY_REPLY"),
+    ("native/wire_common.h", "kTagChecksumReport",
+     "ggrs_tpu/net/messages.py", "_TAG_CHECKSUM_REPORT"),
+    ("native/wire_common.h", "kTagKeepAlive",
+     "ggrs_tpu/net/messages.py", "_TAG_KEEP_ALIVE"),
+    ("native/wire_common.h", "kTagSyncRequest",
+     "ggrs_tpu/net/messages.py", "_TAG_SYNC_REQUEST"),
+    ("native/wire_common.h", "kTagSyncReply",
+     "ggrs_tpu/net/messages.py", "_TAG_SYNC_REPLY"),
+    # endpoint core verdicts
+    ("native/endpoint.cpp", "kEpDrop",
+     "ggrs_tpu/net/_native.py", "EP_DROP"),
+    ("native/endpoint.cpp", "kEpFallback",
+     "ggrs_tpu/net/_native.py", "EP_FALLBACK"),
+    ("native/endpoint.cpp", "kEpBadPendingHead",
+     "ggrs_tpu/net/_native.py", "EP_BAD_PENDING_HEAD"),
+    ("native/endpoint.cpp", "kNullFrame",
+     "ggrs_tpu/core/types.py", "NULL_FRAME"),
+    # sync core error codes + ring capacity
+    ("native/sync_core.cpp", "kSyncOk",
+     "ggrs_tpu/net/_native.py", "SYNC_OK"),
+    ("native/sync_core.cpp", "kSyncErrPredictionPending",
+     "ggrs_tpu/net/_native.py", "SYNC_ERR_PREDICTION_PENDING"),
+    ("native/sync_core.cpp", "kSyncErrBeforeTail",
+     "ggrs_tpu/net/_native.py", "SYNC_ERR_BEFORE_TAIL"),
+    ("native/sync_core.cpp", "kSyncErrNoConfirmed",
+     "ggrs_tpu/net/_native.py", "SYNC_ERR_NO_CONFIRMED"),
+    ("native/sync_core.cpp", "kSyncErrNonSequential",
+     "ggrs_tpu/net/_native.py", "SYNC_ERR_NON_SEQUENTIAL"),
+    ("native/sync_core.cpp", "kSyncErrConfirmPastIncorrect",
+     "ggrs_tpu/net/_native.py", "SYNC_ERR_CONFIRM_PAST_INCORRECT"),
+    ("native/sync_core.cpp", "kSyncErrBadArgs",
+     "ggrs_tpu/net/_native.py", "SYNC_ERR_BAD_ARGS"),
+    ("native/sync_core.cpp", "kSyncErrQueueFull",
+     "ggrs_tpu/net/_native.py", "SYNC_ERR_QUEUE_FULL"),
+    ("native/sync_core.cpp", "kQueueLen",
+     "ggrs_tpu/core/input_queue.py", "INPUT_QUEUE_LENGTH"),
+    # session bank: slot fault codes, header flag bits, cmd flags
+    ("native/session_bank.cpp", "kBankOk",
+     "ggrs_tpu/net/_native.py", "BANK_OK"),
+    ("native/session_bank.cpp", "kBankErrCmd",
+     "ggrs_tpu/net/_native.py", "BANK_ERR_CMD"),
+    ("native/session_bank.cpp", "kBankErrLandedSplit",
+     "ggrs_tpu/net/_native.py", "BANK_ERR_LANDED_SPLIT"),
+    ("native/session_bank.cpp", "kBankErrSync",
+     "ggrs_tpu/net/_native.py", "BANK_ERR_SYNC"),
+    ("native/session_bank.cpp", "kBankErrSyncInputs",
+     "ggrs_tpu/net/_native.py", "BANK_ERR_SYNC_INPUTS"),
+    ("native/session_bank.cpp", "kBankErrConfirm",
+     "ggrs_tpu/net/_native.py", "BANK_ERR_CONFIRM"),
+    ("native/session_bank.cpp", "kBankErrNoPlayers",
+     "ggrs_tpu/net/_native.py", "BANK_ERR_NO_PLAYERS"),
+    ("native/session_bank.cpp", "kBankErrSequence",
+     "ggrs_tpu/net/_native.py", "BANK_ERR_SEQUENCE"),
+    ("native/session_bank.cpp", "kBankErrInjected",
+     "ggrs_tpu/net/_native.py", "BANK_ERR_INJECTED"),
+    ("native/session_bank.cpp", "kBankErrSpecStream",
+     "ggrs_tpu/net/_native.py", "BANK_ERR_SPEC_STREAM"),
+    ("native/session_bank.cpp", "kBankErrIo",
+     "ggrs_tpu/net/_native.py", "BANK_ERR_IO"),
+    ("native/session_bank.cpp", "kHdrLive",
+     "ggrs_tpu/net/_native.py", "BANK_HDR_LIVE"),
+    ("native/session_bank.cpp", "kHdrQuiet",
+     "ggrs_tpu/net/_native.py", "BANK_HDR_QUIET"),
+    ("native/session_bank.cpp", "kHdrEvents",
+     "ggrs_tpu/net/_native.py", "BANK_HDR_EVENTS"),
+    ("native/session_bank.cpp", "kHdrSpec",
+     "ggrs_tpu/net/_native.py", "BANK_HDR_SPEC"),
+    ("native/session_bank.cpp", "kHdrConsensus",
+     "ggrs_tpu/net/_native.py", "BANK_HDR_CONSENSUS"),
+    ("native/session_bank.cpp", "kHdrDirty",
+     "ggrs_tpu/net/_native.py", "BANK_HDR_DIRTY"),
+    ("native/session_bank.cpp", "kHdrOut",
+     "ggrs_tpu/net/_native.py", "BANK_HDR_OUT"),
+    ("native/session_bank.cpp", "kHdrSkip",
+     "ggrs_tpu/net/_native.py", "BANK_HDR_SKIP"),
+    ("native/session_bank.cpp", "kHdrConf",
+     "ggrs_tpu/net/_native.py", "BANK_HDR_CONF"),
+    ("native/session_bank.cpp", "kFlagInputs",
+     "ggrs_tpu/net/_native.py", "CMD_FLAG_INPUTS"),
+    ("native/session_bank.cpp", "kFlagSkip",
+     "ggrs_tpu/net/_native.py", "CMD_FLAG_SKIP"),
+    ("native/session_bank.cpp", "kFrameWindow",
+     "ggrs_tpu/core/time_sync.py", "FRAME_WINDOW_SIZE"),
+    # kernel-batched datapath verdicts + socket caps
+    ("native/net_batch.cpp", "kNetOk",
+     "ggrs_tpu/net/_native.py", "NET_OK"),
+    ("native/net_batch.cpp", "kNetErrUnsupported",
+     "ggrs_tpu/net/_native.py", "NET_ERR_UNSUPPORTED"),
+    ("native/net_batch.cpp", "kNetErrFatal",
+     "ggrs_tpu/net/_native.py", "NET_ERR_FATAL"),
+    ("native/net_batch.cpp", "kNetErrBadArgs",
+     "ggrs_tpu/net/_native.py", "NET_ERR_BAD_ARGS"),
+    ("native/net_batch.cpp", "kRecvBufSize",
+     "ggrs_tpu/net/sockets.py", "RECV_BUFFER_SIZE"),
+    ("native/net_batch.cpp", "kIdealMaxUdp",
+     "ggrs_tpu/net/sockets.py", "IDEAL_MAX_UDP_PACKET_SIZE"),
+)
+
+# Python<->Python mirrored constants: values duplicated across layers
+# that cannot import each other (layering), pinned equal here instead.
+PY_MIRRORED_CONSTANTS: Tuple[Tuple[str, str, str, str], ...] = (
+    # the bundle seam's pickle protocol: host_bank (parallel layer)
+    # cannot import fleet, so it re-declares fleet.rpc.PICKLE_PROTOCOL
+    ("ggrs_tpu/fleet/rpc.py", "PICKLE_PROTOCOL",
+     "ggrs_tpu/parallel/host_bank.py", "_BUNDLE_PICKLE_PROTOCOL"),
+)
+
+
+def static_bank_header() -> Dict[str, object]:
+    """The checker's own header contract in probe-comparable form:
+    ``{"stride": 48, "fields": ((name, fmt, offset), ...)}`` — what
+    tests pin equal to ``ggrs_bank_hdr_stride()`` and the live
+    ``np.dtype(BANK_HDR_FIELDS)``."""
+    return {
+        "stride": LAYOUT_HEADER_STRIDE,
+        "fields": LAYOUT_HEADER_FIELDS,
+    }
+
+
+def _field_width(fmt: str) -> Optional[int]:
+    return _NP_WIDTH.get(fmt.lstrip("<>=|"))
+
+
+# ---------------------------------------------------------------------------
+# individual checks (each returns a list of findings)
+# ---------------------------------------------------------------------------
+
+
+def _check_mirrors(
+    root: Path,
+    mirrors: Sequence[Tuple[str, str, str, str]],
+) -> List[Finding]:
+    out: List[Finding] = []
+    cpp_cache: Dict[str, Dict[str, int]] = {}
+    py_cache: Dict[str, Dict[str, int]] = {}
+    for cpp_file, cpp_name, py_file, py_name in mirrors:
+        if cpp_file not in cpp_cache:
+            cpp_cache[cpp_file] = parse_cpp_constants(root / cpp_file)
+        if py_file not in py_cache:
+            py_cache[py_file] = parse_py_constants(root / py_file)
+        cv = cpp_cache[cpp_file].get(cpp_name)
+        pv = py_cache[py_file].get(py_name)
+        if cv is None:
+            out.append(Finding(
+                "layout/mirror-missing", cpp_file, 0,
+                f"constant {cpp_name} not found (mirror of "
+                f"{py_file}:{py_name})",
+            ))
+            continue
+        if pv is None:
+            out.append(Finding(
+                "layout/mirror-missing", py_file, 0,
+                f"constant {py_name} not found (mirror of "
+                f"{cpp_file}:{cpp_name} = {cv})",
+            ))
+            continue
+        if cv != pv:
+            out.append(Finding(
+                "layout/mirror-mismatch", py_file, 0,
+                f"{py_name} = {pv} but {cpp_file}:{cpp_name} = {cv}",
+            ))
+    return out
+
+
+def _check_py_mirrors(
+    root: Path,
+    mirrors: Sequence[Tuple[str, str, str, str]] = PY_MIRRORED_CONSTANTS,
+) -> List[Finding]:
+    out: List[Finding] = []
+    cache: Dict[str, Dict[str, int]] = {}
+    for file_a, name_a, file_b, name_b in mirrors:
+        for f in (file_a, file_b):
+            if f not in cache:
+                cache[f] = parse_py_constants(root / f)
+        va, vb = cache[file_a].get(name_a), cache[file_b].get(name_b)
+        if va is None or vb is None:
+            missing = (
+                f"{file_a}:{name_a}" if va is None else f"{file_b}:{name_b}"
+            )
+            out.append(Finding(
+                "layout/mirror-missing", missing.split(":")[0], 0,
+                f"constant {missing} not found (py<->py mirror)",
+            ))
+        elif va != vb:
+            out.append(Finding(
+                "layout/mirror-mismatch", file_b, 0,
+                f"{name_b} = {vb} but {file_a}:{name_a} = {va}",
+            ))
+    return out
+
+
+def _check_header(root: Path) -> List[Finding]:
+    out: List[Finding] = []
+    native = parse_cpp_constants(root / "native/session_bank.cpp")
+    stride = native.get("kHdrStride")
+    if stride != LAYOUT_HEADER_STRIDE:
+        out.append(Finding(
+            "layout/header-stride", "native/session_bank.cpp", 0,
+            f"kHdrStride = {stride}, contract says "
+            f"{LAYOUT_HEADER_STRIDE}",
+        ))
+    fields = parse_py_field_tuples(
+        root / "ggrs_tpu/net/_native.py"
+    ).get("BANK_HDR_FIELDS")
+    if fields is None:
+        out.append(Finding(
+            "layout/header-fields", "ggrs_tpu/net/_native.py", 0,
+            "BANK_HDR_FIELDS not found / not statically parseable",
+        ))
+        return out
+    offset = 0
+    declared = []
+    for row in fields:
+        if len(row) != 2:
+            out.append(Finding(
+                "layout/header-fields", "ggrs_tpu/net/_native.py", 0,
+                f"BANK_HDR_FIELDS row {row!r} is not (name, fmt)",
+            ))
+            return out
+        name, fmt = row
+        width = _field_width(fmt)
+        if width is None or not fmt.startswith("<"):
+            out.append(Finding(
+                "layout/header-endian", "ggrs_tpu/net/_native.py", 0,
+                f"BANK_HDR_FIELDS field {name!r} has format {fmt!r}; "
+                "the header contract is little-endian fixed-width only",
+            ))
+            return out
+        declared.append((name, fmt, offset))
+        offset += width
+    if offset != LAYOUT_HEADER_STRIDE:
+        out.append(Finding(
+            "layout/header-stride", "ggrs_tpu/net/_native.py", 0,
+            f"BANK_HDR_FIELDS itemsize {offset} != contract stride "
+            f"{LAYOUT_HEADER_STRIDE}",
+        ))
+    if tuple(declared) != LAYOUT_HEADER_FIELDS:
+        out.append(Finding(
+            "layout/header-fields", "ggrs_tpu/net/_native.py", 0,
+            f"BANK_HDR_FIELDS layout {tuple(declared)} != contract "
+            f"{LAYOUT_HEADER_FIELDS}",
+        ))
+    return out
+
+
+def _check_body_prefix(root: Path) -> List[Finding]:
+    """The body-record prefix format must be what the reference decoder
+    unpacks, and the vectorized fast path's literal jump offsets must be
+    the calcsize-derived ones."""
+    out: List[Finding] = []
+    hb = root / "ggrs_tpu/parallel/host_bank.py"
+    fmts = {f.fmt for f in parse_py_struct_formats(hb)}
+    if BODY_PREFIX_FMT not in fmts:
+        out.append(Finding(
+            "layout/body-prefix", "ggrs_tpu/parallel/host_bank.py", 0,
+            f"body-record prefix {BODY_PREFIX_FMT!r} is not unpacked "
+            "anywhere (reference decoder drifted?)",
+        ))
+    text = hb.read_text()
+    for label, off in (("n_ops", BODY_N_OPS_OFFSET),
+                       ("first op", BODY_OPS_OFFSET)):
+        if not re.search(rf"off\s*\+\s*{off}\b", text):
+            out.append(Finding(
+                "layout/body-jump", "ggrs_tpu/parallel/host_bank.py", 0,
+                f"fast path lacks the literal jump 'off + {off}' "
+                f"({label}; derived from {BODY_PREFIX_FMT!r})",
+            ))
+    if HARVEST_PREFIX_FMT not in fmts:
+        out.append(Finding(
+            "layout/harvest-prefix", "ggrs_tpu/parallel/host_bank.py", 0,
+            f"harvest prefix {HARVEST_PREFIX_FMT!r} is not unpacked "
+            "anywhere (harvest decoder drifted?)",
+        ))
+    return out
+
+
+def _check_rpc_framing(root: Path) -> List[Finding]:
+    out: List[Finding] = []
+    rpc = root / "ggrs_tpu/fleet/rpc.py"
+    fmts = {f.fmt for f in parse_py_struct_formats(rpc)}
+    if RPC_HEADER_FMT not in fmts:
+        out.append(Finding(
+            "layout/rpc-header", "ggrs_tpu/fleet/rpc.py", 0,
+            f"RPC frame header {RPC_HEADER_FMT!r} not found",
+        ))
+    if RPC_HEADER_PREFIX_FMT not in fmts:
+        out.append(Finding(
+            "layout/rpc-header", "ggrs_tpu/fleet/rpc.py", 0,
+            f"RPC pre-crc header {RPC_HEADER_PREFIX_FMT!r} not found "
+            "(encode path drifted from the Struct declaration?)",
+        ))
+    if struct.calcsize(RPC_HEADER_FMT) != RPC_CRC_COVERS + 4:
+        out.append(Finding(
+            "layout/rpc-header", "ggrs_tpu/fleet/rpc.py", 0,
+            f"header {RPC_HEADER_FMT!r} is not pre-crc "
+            f"({RPC_CRC_COVERS}) + u32 crc",
+        ))
+    text = rpc.read_text()
+    consts = parse_py_constants(rpc)
+    if consts.get("VERSION") is None:
+        out.append(Finding(
+            "layout/rpc-header", "ggrs_tpu/fleet/rpc.py", 0,
+            "VERSION constant not statically visible",
+        ))
+    # the crc must cover exactly the pre-crc header bytes + payload
+    if not re.search(rf"\[:\s*{RPC_CRC_COVERS}\s*\]", text):
+        out.append(Finding(
+            "layout/rpc-crc", "ggrs_tpu/fleet/rpc.py", 0,
+            f"no '[:{RPC_CRC_COVERS}]' header slice near the crc check "
+            "(crc coverage drifted from the header prefix?)",
+        ))
+    return out
+
+
+def _check_stat_tables(root: Path) -> List[Finding]:
+    out: List[Finding] = []
+    native_py = root / "ggrs_tpu/net/_native.py"
+    tables = parse_py_field_tuples(native_py)
+    bank = parse_cpp_constants(root / "native/session_bank.cpp")
+    net = parse_cpp_constants(root / "native/net_batch.cpp")
+    ep_stats = tables.get("EP_STAT_FIELDS")
+    if ep_stats is None:
+        out.append(Finding(
+            "layout/stat-table", "ggrs_tpu/net/_native.py", 0,
+            "EP_STAT_FIELDS not statically parseable",
+        ))
+    else:
+        # the per-endpoint stats tail rides a "<B10q{n}Q" record in
+        # host_bank.py; its trailing u64 count is the EP stat arity
+        fmts = {
+            f.fmt
+            for f in parse_py_struct_formats(
+                root / "ggrs_tpu/parallel/host_bank.py"
+            )
+        }
+        want = f"<B10q{len(ep_stats)}Q"
+        if want not in fmts:
+            out.append(Finding(
+                "layout/stat-table", "ggrs_tpu/parallel/host_bank.py", 0,
+                f"per-endpoint stats record {want!r} (B, 10×i64, "
+                f"len(EP_STAT_FIELDS)×u64) not unpacked anywhere",
+            ))
+    io_fields = tables.get("IO_STAT_FIELDS")
+    io_buckets = tables.get("IO_BATCH_BUCKETS")
+    n_stats = bank.get("kNumNetStats")
+    n_stats_nb = net.get("kNumNetStats")
+    if n_stats != n_stats_nb:
+        out.append(Finding(
+            "layout/stat-table", "native/net_batch.cpp", 0,
+            f"kNumNetStats disagrees across native TUs: "
+            f"session_bank={n_stats} net_batch={n_stats_nb}",
+        ))
+    if io_fields is None or io_buckets is None:
+        out.append(Finding(
+            "layout/stat-table", "ggrs_tpu/net/_native.py", 0,
+            "IO_STAT_FIELDS / IO_BATCH_BUCKETS not statically parseable",
+        ))
+    elif n_stats is not None:
+        words = len(io_fields) + 2 * (len(io_buckets) + 1)
+        if words != n_stats:
+            out.append(Finding(
+                "layout/stat-table", "ggrs_tpu/net/_native.py", 0,
+                f"IO stat words {words} (fields + 2×(buckets+inf)) != "
+                f"native kNumNetStats {n_stats}",
+            ))
+    return out
+
+
+def check_layout(
+    root: Path,
+    mirrors: Sequence[Tuple[str, str, str, str]] = MIRRORED_CONSTANTS,
+) -> List[Finding]:
+    """Run every layout check over the tree at ``root``; returns the
+    (ideally empty) finding list."""
+    root = Path(root)
+    findings: List[Finding] = []
+    findings += _check_mirrors(root, mirrors)
+    findings += _check_py_mirrors(root)
+    findings += _check_header(root)
+    findings += _check_body_prefix(root)
+    findings += _check_rpc_framing(root)
+    findings += _check_stat_tables(root)
+    return findings

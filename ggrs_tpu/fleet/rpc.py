@@ -51,6 +51,16 @@ from ..core.errors import GgrsError
 MAGIC = b"GR"
 VERSION = 1
 
+# Pinned pickle protocol for every fleet serialization seam (RPC
+# payloads, migration bundles): the runner may be a different
+# interpreter build than the supervisor, and a cross-host fleet may mix
+# Python versions, so HIGHEST_PROTOCOL (interpreter-dependent) and the
+# version-dependent default are both wire hazards — ggrs-verify's
+# det/pickle-protocol rule rejects them.  Protocol 4 is supported
+# everywhere ≥ 3.4 and is the newest one whose frames every supported
+# peer can read.
+PICKLE_PROTOCOL = 4
+
 # frame kinds
 KIND_CALL = 1       # supervisor → runner: {op: ..., **args}
 KIND_REPLY = 2      # runner → supervisor: the op's result
@@ -149,7 +159,7 @@ class RpcConn:
         """Pickle + frame + sendall.  A send timeout raises
         :class:`RpcTimeout` — a SIGSTOPped peer with a full socket
         buffer must wedge the WATCHDOG path, not the supervisor."""
-        payload = pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+        payload = pickle.dumps(obj, protocol=PICKLE_PROTOCOL)
         frame = encode_frame(kind, payload, self.max_frame)
         self._check_usable()
         self._sock.settimeout(timeout)

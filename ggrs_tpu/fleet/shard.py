@@ -371,6 +371,16 @@ class PoolShard:
                     "journal-less for failover until re-incarnated",
                     self.shard_id, match_id, journal.failed,
                 )
+        # Checkpoint BEFORE this tick steps, from last tick's fully
+        # fulfilled state.  Checkpointing after the step would read save
+        # cells whose corrective rollback re-saves are still unfulfilled
+        # in the just-returned request lists: a rollback that fixes frame
+        # F ≤ the new watermark leaves cell F stale (with cell.frame == F,
+        # so the two-candidate rule cannot tell) until the caller fulfills
+        # it — a checkpoint taken in that window captures mispredicted
+        # state, and a journal-path migration/failover that resumes from
+        # it desyncs permanently (the chaos shard_migrate desync).
+        self._maybe_checkpoint()
         out: Dict[str, List[GgrsRequest]] = {}
         lists = self.pool.advance_all()
         for match_id, slot in self._matches.items():
@@ -381,7 +391,6 @@ class PoolShard:
             am = self._adopted.get(match_id)
             if am is not None:
                 self._journal_adopted(match_id, am)
-        self._maybe_checkpoint()
         self.ticks += 1
         self._tick_ms.append((time.perf_counter() - t0) * 1000.0)
         self._g_p99.labels(shard=self.shard_id).set(self.tick_p99_ms())

@@ -58,7 +58,7 @@ from ..obs.registry import MultiRegistry, Registry, default_registry
 from ..obs.trace import NULL_TRACER
 from ..utils.tracing import get_logger
 from .placement import HashRing
-from .rpc import FrameError, RpcError, RpcTimeout
+from .rpc import PICKLE_PROTOCOL, FrameError, RpcError, RpcTimeout
 from .shard import (
     PoolShard,
     SHARD_ACTIVE,
@@ -677,7 +677,9 @@ class ShardSupervisor:
             try:
                 # the process-portability contract, enforced on every
                 # migration: the bundle must survive leaving this process
-                bundle = pickle.loads(pickle.dumps(bundle))
+                bundle = pickle.loads(
+                    pickle.dumps(bundle, protocol=PICKLE_PROTOCOL)
+                )
                 self._adopt_on(dst, record, bundle)
             except Exception as e:
                 # the source slot is already released — never leave the

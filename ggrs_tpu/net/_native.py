@@ -24,11 +24,16 @@ from typing import List, Optional, Sequence
 from .wire import WireError
 
 _LIB_NAME = "_ggrs_codec.so"
-# GGRS_NATIVE_SANITIZE=1 (scripts/build_sanitized.sh) loads/builds a separate
-# ASan+UBSan-instrumented library so the parity and fault fuzzes can run
-# under sanitizers without touching the production .so
-_SANITIZE = bool(os.environ.get("GGRS_NATIVE_SANITIZE"))
-if _SANITIZE:
+# GGRS_NATIVE_SANITIZE (scripts/build_sanitized.sh) loads/builds a separate
+# sanitizer-instrumented library so the parity and fault fuzzes can run
+# under sanitizers without touching the production .so:
+#   "1" / "address" -> ASan+UBSan (_ggrs_codec_san.so)
+#   "thread"        -> TSan (_ggrs_codec_tsan.so), for the GIL-released
+#                      native I/O threads (ggrs_bank_pump / NetBatch)
+_SANITIZE = os.environ.get("GGRS_NATIVE_SANITIZE") or None
+if _SANITIZE == "thread":
+    _LIB_NAME = "_ggrs_codec_tsan.so"
+elif _SANITIZE:
     _LIB_NAME = "_ggrs_codec_san.so"
 # Resource caps for the fast path.  Real packets sit under the ~508-byte UDP
 # budget with at most the 128-input pending window; anything bigger (but
@@ -153,12 +158,13 @@ def _build(lib_path: Path) -> bool:
         except OSError:
             pass  # raced with the owning process: leave it alone
     tmp = lib_path.with_name(f"{lib_path.name}.build.{os.getpid()}")
-    flags = (
-        ["-O1", "-g", "-fsanitize=address,undefined",
-         "-fno-sanitize-recover=all"]
-        if _SANITIZE
-        else ["-O2"]
-    )
+    if _SANITIZE == "thread":
+        flags = ["-O1", "-g", "-fsanitize=thread"]
+    elif _SANITIZE:
+        flags = ["-O1", "-g", "-fsanitize=address,undefined",
+                 "-fno-sanitize-recover=all"]
+    else:
+        flags = ["-O2"]
     cmd = [
         "g++",
         *flags,
@@ -555,6 +561,7 @@ SYNC_ERR_NO_CONFIRMED = -42
 SYNC_ERR_NON_SEQUENTIAL = -43
 SYNC_ERR_CONFIRM_PAST_INCORRECT = -44
 SYNC_ERR_BAD_ARGS = -45
+SYNC_ERR_QUEUE_FULL = -46  # kSyncErrQueueFull: 128-slot ring exhausted
 
 # session-bank return codes (mirror native/session_bank.cpp; the buffer
 # code is wire_common.h's kErrBufferTooSmall, shared with the codec)
@@ -593,6 +600,12 @@ EP_STAT_FIELDS = (
     "emits", "emit_bytes", "acks", "datagrams", "new_frames", "drops",
     "fallbacks",
 )
+
+# per-session command-stream flag byte (session_bank.cpp kFlag*): bit 0 =
+# local inputs present (advance runs), bit 1 = skip (slot quarantined or
+# evicted, no further fields follow for this session)
+CMD_FLAG_INPUTS = 1
+CMD_FLAG_SKIP = 2
 
 # packed per-tick output header (session_bank.cpp kHdr*; DESIGN.md §19):
 # one BANK_HDR_DTYPE-shaped record per session leads the tick output when

@@ -162,6 +162,16 @@ _EV_CHECKSUM = 4
 # ticks reuse the same objects too) and jumps over the events / status
 # mirror / spectator-tail sections instead of parsing them positionally.
 _HDR_DTYPE = np.dtype(list(_native.BANK_HDR_FIELDS))
+# per-session command flag bytes (session_bank.cpp kFlag*, mirrored as
+# _native.CMD_FLAG_*; ggrs-verify pins the pairs equal)
+_CMD_INPUTS = bytes([_native.CMD_FLAG_INPUTS])
+_CMD_SKIP = bytes([_native.CMD_FLAG_SKIP])
+# resume bundles cross process (and, with the fleet layer, host)
+# boundaries: pin the pickle protocol so a mixed-version fleet reads
+# every bundle.  This layer cannot import fleet, so the value re-declares
+# fleet.rpc.PICKLE_PROTOCOL — ggrs-verify's py<->py mirror check pins
+# the pair equal.
+_BUNDLE_PICKLE_PROTOCOL = 4
 _HDR_FAST_WANT = _native.BANK_HDR_LIVE
 _HDR_FAST_MASK = (
     _HDR_FAST_WANT
@@ -1121,9 +1131,9 @@ class HostSessionPool:
         cmd_parts: List[bytes] = []
         for i, m in enumerate(self._mirrors):
             if not ticked[i]:
-                cmd_parts.append(b"\x02")  # kFlagSkip: no fields follow
+                cmd_parts.append(_CMD_SKIP)  # no fields follow
                 continue
-            cmd_parts.append(b"\x01")
+            cmd_parts.append(_CMD_INPUTS)
             cmd_parts.extend(m.staged_inputs[h] for h in m.local_handles)
             ctrl = m.pending_ctrl
             m.pending_ctrl = []
@@ -2364,7 +2374,10 @@ class HostSessionPool:
             max_prediction=m.max_prediction,
             local_handles=list(m.local_handles),
             resume_frame=resume,
-            state_blob=pickle.dumps((cell.data(), cell.checksum)),
+            state_blob=pickle.dumps(
+                (cell.data(), cell.checksum),
+                protocol=_BUNDLE_PICKLE_PROTOCOL,
+            ),
             harvest=h,
             next_recommended_sleep=m.next_recommended_sleep,
             # materialize: the queue holds lazy tag tuples; the bundle's

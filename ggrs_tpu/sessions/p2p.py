@@ -124,6 +124,17 @@ class PlayerRegistry(Generic[I, A]):
 
 
 class P2PSession(ThreadOwned, Generic[I, S, A]):
+    # the thread-affinity surface (ggrs-verify own/* lint): exactly the
+    # methods that drive session state and therefore pin the owning
+    # thread.  The public advance/poll wrappers delegate to the _impl
+    # methods, which carry the guard.
+    _DRIVING_METHODS = (
+        "add_local_input",
+        "_advance_frame_impl",
+        "_poll_remote_clients_impl",
+        "events",
+    )
+
     def __init__(
         self,
         config: Config,

@@ -59,6 +59,9 @@ MAX_EVENT_QUEUE_SIZE = 100
 
 
 class SpectatorSession(ThreadOwned, Generic[I, A]):
+    # the thread-affinity surface (ggrs-verify own/* lint)
+    _DRIVING_METHODS = ("events", "advance_frame", "poll_remote_clients")
+
     def __init__(
         self,
         config: Config,

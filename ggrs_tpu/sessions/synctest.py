@@ -28,6 +28,9 @@ S = TypeVar("S")
 
 
 class SyncTestSession(ThreadOwned, Generic[I, S]):
+    # the thread-affinity surface (ggrs-verify own/* lint)
+    _DRIVING_METHODS = ("add_local_input", "advance_frame")
+
     def __init__(
         self,
         config: Config,

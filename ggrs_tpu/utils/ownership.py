@@ -29,8 +29,20 @@ _pin_lock = threading.Lock()
 
 
 class ThreadOwned:
-    """Mixin: pin driving calls to one thread at a time."""
+    """Mixin: pin driving calls to one thread at a time.
 
+    Subclasses DECLARE their thread-affinity surface in
+    ``_DRIVING_METHODS`` — the tuple of method names that drive session
+    state and therefore guard with :meth:`_check_owner`.  The static
+    ownership lint (``ggrs_tpu.analysis.ownership``, run by
+    ``scripts/ggrs_verify.py``) keeps the declaration closed both ways:
+    every declared method must guard, every guarded method must be
+    declared, and no driving bound method may be handed to
+    ``threading.Thread(target=...)`` — use :meth:`transfer_ownership`
+    from the new thread instead.
+    """
+
+    _DRIVING_METHODS: tuple = ()
     _owner_ident: Optional[int] = None
 
     def _check_owner(self) -> None:

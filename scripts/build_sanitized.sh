@@ -1,17 +1,34 @@
 #!/usr/bin/env bash
-# Build the native cores with AddressSanitizer + UBSan and run the session
-# bank's parity and fault fuzzes under them.
+# Static analysis + sanitized native legs — the correctness gate for the
+# crossing (DESIGN.md §20 for the static plane, §9/§15 for the dynamic).
 #
-# The sanitized library lives beside the production one as
-# _ggrs_codec_san.so; GGRS_NATIVE_SANITIZE=1 makes ggrs_tpu.net._native load
-# (and, when stale, rebuild) that library with
-# -fsanitize=address,undefined -fno-sanitize-recover=all, so any native
-# heap/UB bug aborts the test run loudly instead of corrupting the bank.
-# ASan must be loaded before Python, hence the LD_PRELOAD.
+# 1. ggrs-verify: the static-analysis plane (cross-language layout
+#    checker, determinism lint vs its committed baseline, ownership
+#    lint, tree hygiene).  Runs first and cheapest; layout drift or a
+#    new determinism violation fails the build before anything compiles.
+# 2. ASan+UBSan leg: builds _ggrs_codec_san.so
+#    (-fsanitize=address,undefined -fno-sanitize-recover=all) and runs
+#    the bank parity/fault fuzzes under it, so any native heap/UB bug
+#    aborts the run loudly instead of corrupting the bank.  ASan must be
+#    loaded before Python, hence the LD_PRELOAD.
+# 3. TSan leg: builds _ggrs_codec_tsan.so (-fsanitize=thread) and runs
+#    the tests that drive the GIL-released native I/O threads
+#    (ggrs_bank_pump's recvmmsg/sendmmsg ring, the out-of-process
+#    runner's serving loop).  Only the native library is instrumented,
+#    so reports are races in OUR code, not CPython noise.
 #
 # Usage: scripts/build_sanitized.sh [extra pytest args]
+#   GGRS_SKIP_VERIFY=1  skip the static gate (sanitizers only)
+#   GGRS_SKIP_TSAN=1    skip the TSan leg (ASan only)
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+echo "=== ggrs-verify (static analysis plane) ==="
+if [ -z "${GGRS_SKIP_VERIFY:-}" ]; then
+    JAX_PLATFORMS=cpu python scripts/ggrs_verify.py
+else
+    echo "skipped (GGRS_SKIP_VERIFY)"
+fi
 
 if ! command -v g++ >/dev/null; then
     echo "skip: no g++ toolchain" >&2
@@ -24,7 +41,7 @@ if [ ! -e "$asan_rt" ]; then
 fi
 
 out=ggrs_tpu/net/_ggrs_codec_san.so
-echo "building sanitized native cores -> $out"
+echo "=== ASan+UBSan leg: building $out ==="
 g++ -O1 -g -shared -fPIC -std=c++17 \
     -fsanitize=address,undefined -fno-sanitize-recover=all \
     -o "$out" \
@@ -57,3 +74,37 @@ python -m pytest tests/test_session_bank.py tests/test_policy_plane.py \
     tests/test_fleet_obs.py \
     -q -p no:cacheprovider -m "not slow" \
     -k "not batched_executor and not size_mismatch and not fused_scrub and not scrub_matches" "$@"
+
+if [ -n "${GGRS_SKIP_TSAN:-}" ]; then
+    echo "TSan leg skipped (GGRS_SKIP_TSAN)"
+    exit 0
+fi
+tsan_rt="$(g++ -print-file-name=libtsan.so)"
+if [ ! -e "$tsan_rt" ]; then
+    echo "skip: g++ has no libtsan runtime" >&2
+    exit 0
+fi
+
+out=ggrs_tpu/net/_ggrs_codec_tsan.so
+echo "=== TSan leg: building $out ==="
+g++ -O1 -g -shared -fPIC -std=c++17 -fsanitize=thread \
+    -o "$out" \
+    native/codec.cpp native/endpoint.cpp native/sync_core.cpp \
+    native/session_bank.cpp native/net_batch.cpp
+
+# The TSan leg targets the concurrency surface: the kernel-batched
+# socket datapath (GIL released around recvmmsg/sendmmsg), the
+# thread-ownership guard, and the subprocess shard runner (children
+# inherit the preload and GGRS_NATIVE_SANITIZE=thread, so the runner's
+# serving loop drives the TSan bank too).  halt_on_error aborts the
+# run on the first race; second_deadlock_stack improves lock reports.
+LD_PRELOAD="$tsan_rt" \
+TSAN_OPTIONS="halt_on_error=1:second_deadlock_stack=1" \
+GGRS_NATIVE_SANITIZE=thread \
+JAX_PLATFORMS=cpu \
+python -m pytest tests/test_native_io.py tests/test_socket_datapath.py \
+    tests/test_thread_ownership.py tests/test_fleet_proc.py \
+    -q -p no:cacheprovider -m "not slow" \
+    -k "not batched_executor and not size_mismatch" "$@"
+
+echo "sanitized legs green (ASan+UBSan, TSan) + ggrs-verify"

@@ -812,3 +812,96 @@ class TestPoolShard:
         bf2, sf2, _, _ = _mk_match(clock, 97, "m1")
         assert shard.admit("m1", bf2(), sf2()) == "standalone"
         assert shard.live_matches() == 2
+
+
+# ----------------------------------------------------------------------
+# checkpoint timing: a rollback pending in the just-returned request
+# list must never leak into a journal checkpoint (the chaos
+# shard_migrate desync, ROADMAP item 5's named precondition)
+# ----------------------------------------------------------------------
+
+
+def _journal_chain_violations(jpath) -> list:
+    """Recompute the CrcGame chain from a first-incarnation journal's
+    own confirmed-input records and check every embedded checkpoint
+    state lies ON that chain.  A checkpoint written from a save cell
+    whose corrective rollback re-save had not been fulfilled yet holds a
+    MISPREDICTED chain value — off-chain, and a permanent desync for any
+    incarnation that resumes from it."""
+    import zlib
+
+    from ggrs_tpu.utils.checkpoint import loads_pytree
+
+    parsed = read_journal(jpath)
+    frames = parsed["frames"]
+    if not frames or frames[0][0] != 0:
+        return []  # later incarnation: chain base not in this file
+    chain, chain_at = 0, {}
+    for f, statuses, blob in frames:
+        isize = len(blob) // len(statuses)
+        vals = tuple(
+            int.from_bytes(blob[p * isize:(p + 1) * isize], "little")
+            for p in range(len(statuses))
+        )
+        chain = zlib.crc32(repr(vals).encode(), chain)
+        chain_at[f] = chain
+    out = []
+    for cf, blob in parsed["checkpoints"]:
+        state = int(loads_pytree(blob, 0)[0])
+        if state not in (chain_at.get(cf), chain_at.get(cf - 1), 0):
+            out.append(f"checkpoint@{cf}: state {state} is off-chain")
+    return out
+
+
+class TestCheckpointNotPoisonedByPendingRollback:
+    def test_lossy_migration_stays_desync_free(self, tmp_path):
+        """Seed 6 reproduces the pre-fix failure shape: under seeded
+        loss, a rollback corrects a frame at a checkpoint boundary in
+        the same tick the checkpoint fires, the stale cell is embedded,
+        and the tick-50 journal-path migration resumes the session-
+        backed match (spectated, hubless => not bank-resident) from the
+        poisoned state — every post-migration checksum compare then
+        desyncs.  With checkpointing moved ahead of the tick (previous
+        tick fully fulfilled), both observables below must stay clean
+        for every seed; this one is pinned because it fails loudest."""
+
+        def migrate(i, ctx):
+            if i == 50:
+                ctx["sup"].migrate("m0")
+
+        ctx = drive_fleet_chaos(
+            150, matches_per_shard=1, seed=6, inject=migrate,
+            fault_cfg=dict(LOSSY), n_spectators=1,
+            journal_dir=str(tmp_path),
+        )
+        desyncs = [
+            e
+            for e in ctx["host_events"]["m0"] + ctx["peer_events"]["m0"]
+            if type(e).__name__ == "DesyncDetected"
+        ]
+        assert desyncs == [], desyncs[:4]
+        assert ctx["locations"]["m0"] == "s1"  # the migration happened
+        violations = _journal_chain_violations(tmp_path / "m0.000.ggjl")
+        assert violations == [], violations
+
+    def test_checkpoint_states_on_chain_across_seeds(self, tmp_path):
+        """The chain invariant alone, three more seeds: poisoning is
+        seed-dependent (it needs a rollback to straddle a checkpoint
+        boundary), so pin a spread — pre-fix, seeds 2 and 5 poison
+        without ever desyncing in-run, the silent variant that bites
+        only on a LATER failover."""
+        for seed in (2, 5, 7):
+            jdir = tmp_path / f"s{seed}"
+            jdir.mkdir()
+
+            def migrate(i, ctx):
+                if i == 50:
+                    ctx["sup"].migrate("m0")
+
+            drive_fleet_chaos(
+                150, matches_per_shard=1, seed=seed, inject=migrate,
+                fault_cfg=dict(LOSSY), n_spectators=1,
+                journal_dir=str(jdir),
+            )
+            violations = _journal_chain_violations(jdir / "m0.000.ggjl")
+            assert violations == [], (seed, violations)

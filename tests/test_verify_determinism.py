@@ -1,0 +1,366 @@
+"""ggrs-verify pillar 2: the determinism lint.
+
+Golden fixtures per rule — a snippet that MUST fire and a sibling that
+MUST NOT — plus pragma suppression, baseline split semantics, and the
+self-clean gate (the repo tree passes modulo the committed baseline).
+"""
+
+from pathlib import Path
+
+from ggrs_tpu.analysis import (
+    DETERMINISM_RULES,
+    load_baseline,
+    lint_determinism,
+)
+from ggrs_tpu.analysis.baseline import Baseline, write_baseline
+from ggrs_tpu.analysis.determinism import lint_source
+from ggrs_tpu.analysis.report import Finding
+
+REPO = Path(__file__).resolve().parents[1]
+BASELINE = REPO / "ggrs_tpu/analysis/determinism_baseline.json"
+
+
+def rules_of(src: str, scope: str = "sim"):
+    return sorted({f.rule for f in lint_source(src, "x.py", scope)})
+
+
+# ----------------------------------------------------------------------
+# one firing + one non-firing golden per rule
+# ----------------------------------------------------------------------
+
+
+class TestWallClock:
+    def test_fires(self):
+        assert rules_of(
+            "import time\n"
+            "def f():\n"
+            "    return time.monotonic()\n"
+        ) == ["det/wall-clock"]
+        assert rules_of(
+            "import datetime\n"
+            "def f():\n"
+            "    return datetime.datetime.now()\n"
+        ) == ["det/wall-clock"]
+
+    def test_injected_clock_does_not_fire(self):
+        assert rules_of(
+            "def f(clock):\n"
+            "    return clock()\n"
+            "def g(self):\n"
+            "    return self._clock()\n"
+        ) == []
+
+    def test_time_ns_variants_fire(self):
+        assert rules_of(
+            "import time\n"
+            "def f():\n"
+            "    return time.perf_counter_ns() + time.time_ns()\n"
+        ) == ["det/wall-clock"]
+
+
+class TestUnseededRng:
+    def test_module_level_rng_fires(self):
+        assert rules_of(
+            "import random\n"
+            "def f():\n"
+            "    return random.randint(0, 3)\n"
+        ) == ["det/unseeded-rng"]
+
+    def test_noarg_random_fires(self):
+        assert rules_of(
+            "import random\n"
+            "def f():\n"
+            "    return random.Random()\n"
+        ) == ["det/unseeded-rng"]
+
+    def test_seeded_random_does_not_fire(self):
+        assert rules_of(
+            "import random\n"
+            "def f(seed):\n"
+            "    rng = random.Random(seed)\n"
+            "    return rng.randint(0, 3)\n"
+        ) == []
+
+    def test_entropy_sources_fire(self):
+        assert rules_of(
+            "import os, uuid\n"
+            "def f():\n"
+            "    return os.urandom(8), uuid.uuid4()\n"
+        ) == ["det/unseeded-rng"]
+        assert rules_of(
+            "import numpy as np\n"
+            "def f():\n"
+            "    return np.random.rand(3)\n"
+        ) == ["det/unseeded-rng"]
+
+
+class TestSetIteration:
+    def test_for_over_set_fires(self):
+        assert rules_of(
+            "def f(xs):\n"
+            "    for x in set(xs):\n"
+            "        yield x\n"
+        ) == ["det/set-iteration"]
+
+    def test_comprehension_and_list_fire(self):
+        assert rules_of(
+            "def f(xs):\n"
+            "    return [x for x in {1, 2}] + list(frozenset(xs))\n"
+        ) == ["det/set-iteration"]
+
+    def test_sorted_set_does_not_fire(self):
+        assert rules_of(
+            "def f(xs):\n"
+            "    for x in sorted(set(xs)):\n"
+            "        yield x\n"
+            "    return sorted({1, 2})\n"
+        ) == []
+
+    def test_membership_does_not_fire(self):
+        assert rules_of(
+            "def f(xs, x):\n"
+            "    s = set(xs)\n"
+            "    return x in s\n"
+        ) == []
+
+
+class TestHashOrder:
+    def test_builtin_hash_fires(self):
+        assert rules_of(
+            "def f(s):\n"
+            "    return hash(s)\n"
+        ) == ["det/hash-order"]
+
+    def test_sort_key_id_fires(self):
+        assert rules_of(
+            "def f(xs):\n"
+            "    xs.sort(key=id)\n"
+            "    return sorted(xs, key=id)\n"
+        ) == ["det/hash-order"]
+
+    def test_crc_does_not_fire(self):
+        assert rules_of(
+            "import zlib\n"
+            "def f(b):\n"
+            "    return zlib.crc32(b)\n"
+        ) == []
+
+
+class TestJitFloatReduce:
+    def test_sum_in_jit_fires(self):
+        assert rules_of(
+            "import jax\n"
+            "@jax.jit\n"
+            "def f(xs):\n"
+            "    return sum(xs)\n"
+        ) == ["det/jit-float-reduce"]
+
+    def test_sum_outside_jit_does_not_fire(self):
+        assert rules_of(
+            "def f(xs):\n"
+            "    return sum(xs)\n"
+        ) == []
+
+    def test_jnp_sum_in_jit_does_not_fire(self):
+        assert rules_of(
+            "import jax, jax.numpy as jnp\n"
+            "@jax.jit\n"
+            "def f(xs):\n"
+            "    return jnp.sum(xs)\n"
+        ) == []
+
+
+class TestPickleProtocol:
+    def test_unpinned_fires(self):
+        assert rules_of(
+            "import pickle\n"
+            "def f(x):\n"
+            "    return pickle.dumps(x)\n",
+            scope="bundle",
+        ) == ["det/pickle-protocol"]
+
+    def test_highest_protocol_fires(self):
+        assert rules_of(
+            "import pickle\n"
+            "def f(x):\n"
+            "    return pickle.dumps(x, protocol=pickle.HIGHEST_PROTOCOL)\n",
+            scope="bundle",
+        ) == ["det/pickle-protocol"]
+
+    def test_pinned_does_not_fire(self):
+        assert rules_of(
+            "import pickle\n"
+            "PROTO = 4\n"
+            "def f(x):\n"
+            "    return pickle.dumps(x, protocol=4), "
+            "pickle.dumps(x, protocol=PROTO)\n",
+            scope="bundle",
+        ) == []
+
+    def test_loads_does_not_fire(self):
+        assert rules_of(
+            "import pickle\n"
+            "def f(b):\n"
+            "    return pickle.loads(b)\n",
+            scope="bundle",
+        ) == []
+
+
+class TestScopesAndPragmas:
+    def test_bundle_scope_allows_wall_clock(self):
+        src = "import time\ndef f():\n    return time.monotonic()\n"
+        assert rules_of(src, scope="sim") == ["det/wall-clock"]
+        assert rules_of(src, scope="bundle") == []
+
+    def test_allow_pragma_suppresses(self):
+        assert rules_of(
+            "def f(s):\n"
+            "    return hash(s)  # ggrs-verify: allow(det/hash-order)\n"
+        ) == []
+
+    def test_allow_pragma_is_rule_specific(self):
+        assert rules_of(
+            "def f(s):\n"
+            "    return hash(s)  # ggrs-verify: allow(det/wall-clock)\n"
+        ) == ["det/hash-order"]
+
+
+# ----------------------------------------------------------------------
+# baseline semantics + the self-clean gate
+# ----------------------------------------------------------------------
+
+
+def F(rule, path, line, detail):
+    return Finding(rule, path, line, detail)
+
+
+class TestBaseline:
+    def test_split_absorbs_up_to_count(self):
+        f1 = F("det/wall-clock", "a.py", 10, "time.time() ...")
+        f2 = F("det/wall-clock", "a.py", 20, "time.time() ...")
+        f3 = F("det/wall-clock", "a.py", 30, "time.time() ...")
+        base = Baseline({f1.key(): 2})
+        new, legacy = base.split([f1, f2, f3])
+        assert len(legacy) == 2 and len(new) == 1
+
+    def test_line_moves_do_not_invalidate(self):
+        f_old = F("det/hash-order", "a.py", 5, "builtin hash() ...")
+        f_moved = F("det/hash-order", "a.py", 99, "builtin hash() ...")
+        base = Baseline.from_findings([f_old])
+        new, legacy = base.split([f_moved])
+        assert new == [] and legacy == [f_moved]
+
+    def test_roundtrip(self, tmp_path):
+        base = Baseline({"k1": 2, "k2": 1, "gone": 0})
+        path = tmp_path / "b.json"
+        write_baseline(path, base)
+        loaded = load_baseline(path)
+        assert loaded.counts == {"k1": 2, "k2": 1}
+
+    def test_missing_file_is_empty(self, tmp_path):
+        assert load_baseline(tmp_path / "nope.json").counts == {}
+
+
+class TestTreeIsClean:
+    def test_repo_has_no_new_determinism_findings(self):
+        findings = lint_determinism(REPO)
+        new, _legacy = load_baseline(BASELINE).split(findings)
+        assert new == [], "\n".join(f.render() for f in new)
+
+    def test_baseline_is_not_stale(self):
+        """Every baseline entry still matches a real finding — burned-
+        down violations must leave the baseline too (run
+        scripts/ggrs_verify.py --baseline-update)."""
+        findings = lint_determinism(REPO)
+        live = Baseline.from_findings(findings).counts
+        base = load_baseline(BASELINE).counts
+        stale = {
+            k: n for k, n in base.items() if live.get(k, 0) < n
+        }
+        assert not stale, f"stale baseline entries: {stale}"
+
+    def test_rule_catalog_matches_emitted_rules(self):
+        assert set(DETERMINISM_RULES) >= {
+            f.rule for f in lint_determinism(REPO)
+        }
+
+
+class TestJaxRandomIsFunctional:
+    def test_keyed_jax_random_does_not_fire(self):
+        assert rules_of(
+            "import jax\n"
+            "def f(key):\n"
+            "    return jax.random.uniform(key, (3,))\n"
+        ) == []
+
+
+class TestReviewRegressions:
+    def test_pickle_dump_positional_protocol_not_flagged(self):
+        assert rules_of(
+            "import pickle\n"
+            "def f(x, fh):\n"
+            "    pickle.dump(x, fh, 4)\n",
+            scope="bundle",
+        ) == []
+
+    def test_pickle_dumps_positional_protocol_not_flagged(self):
+        assert rules_of(
+            "import pickle\n"
+            "def f(x):\n"
+            "    return pickle.dumps(x, 4)\n",
+            scope="bundle",
+        ) == []
+
+    def test_pickle_dump_without_protocol_fires(self):
+        assert rules_of(
+            "import pickle\n"
+            "def f(x, fh):\n"
+            "    pickle.dump(x, fh)\n",
+            scope="bundle",
+        ) == ["det/pickle-protocol"]
+
+    def test_from_imported_nondeterminism_fires(self):
+        assert rules_of(
+            "from time import perf_counter, monotonic as mono\n"
+            "from random import random\n"
+            "def f():\n"
+            "    return perf_counter() + mono() + random()\n"
+        ) == ["det/unseeded-rng", "det/wall-clock"]
+
+    def test_module_alias_import_fires(self):
+        assert rules_of(
+            "import time as t\n"
+            "def f():\n"
+            "    return t.monotonic()\n"
+        ) == ["det/wall-clock"]
+
+    def test_from_import_of_datetime_fires(self):
+        assert rules_of(
+            "from datetime import datetime\n"
+            "def f():\n"
+            "    return datetime.now()\n"
+        ) == ["det/wall-clock"]
+
+    def test_default_protocol_fires(self):
+        assert rules_of(
+            "import pickle\n"
+            "def f(x):\n"
+            "    return pickle.dumps(x, protocol=pickle.DEFAULT_PROTOCOL)\n",
+            scope="bundle",
+        ) == ["det/pickle-protocol"]
+
+    def test_protocol_minus_one_fires(self):
+        assert rules_of(
+            "import pickle\n"
+            "def f(x):\n"
+            "    return pickle.dumps(x, -1)\n",
+            scope="bundle",
+        ) == ["det/pickle-protocol"]
+
+    def test_protocol_none_fires(self):
+        assert rules_of(
+            "import pickle\n"
+            "def f(x):\n"
+            "    return pickle.dumps(x, protocol=None)\n",
+            scope="bundle",
+        ) == ["det/pickle-protocol"]

@@ -1,0 +1,286 @@
+"""ggrs-verify pillar 1: the cross-language layout checker.
+
+Three layers of pinning (ISSUE: the static-analysis plane):
+
+* parser goldens — the C++/Python extractors read the exact constant
+  shapes the native sources use (constexpr casts, enums with implicit
+  increments, struct-format aliases);
+* deliberate-skew fixtures — a 1-value mirror drift, a 1-byte header
+  drift, and a jump-offset drift each FIRE (the tree is currently
+  clean, so the fixtures are what prove the checker catches what it
+  exists to catch);
+* self-clean + runtime parity — the repo tree passes, and the static
+  header table equals both the live ``np.dtype`` and the runtime
+  ``ggrs_bank_hdr_stride()`` probe.
+"""
+
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+from ggrs_tpu.analysis import (
+    LAYOUT_HEADER_FIELDS,
+    check_layout,
+    parse_cpp_constants,
+    parse_py_constants,
+    parse_py_struct_formats,
+    static_bank_header,
+)
+from ggrs_tpu.analysis.layout import (
+    MIRRORED_CONSTANTS,
+    _check_header,
+    _check_mirrors,
+)
+from ggrs_tpu.net import _native
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+# ----------------------------------------------------------------------
+# parser goldens
+# ----------------------------------------------------------------------
+
+
+class TestCppParser:
+    def test_constexpr_forms(self):
+        src = """
+        constexpr int kPlain = 42;
+        constexpr int64_t kNeg = -70;
+        constexpr size_t kShift = size_t{1} << 22;
+        constexpr uint64_t kAllOnes = ~uint64_t{0};
+        constexpr int64_t kNegShift = -(int64_t{1} << 62);
+        constexpr uint8_t kHex = 0x80;
+        static constexpr int kStatic = 7;
+        """
+        c = parse_cpp_constants(src)
+        assert c["kPlain"] == 42
+        assert c["kNeg"] == -70
+        assert c["kShift"] == 1 << 22
+        assert c["kAllOnes"] == (1 << 64) - 1
+        assert c["kNegShift"] == -(1 << 62)
+        assert c["kHex"] == 0x80
+        assert c["kStatic"] == 7
+
+    def test_enum_implicit_increment(self):
+        src = """
+        enum MsgTag : uint8_t {
+          kTagA = 0,
+          kTagB,      // implicit 1
+          kTagC = 5,
+          kTagD,      // implicit 6
+        };
+        enum class Verdict { kOk = 0, kErr = -3 };
+        """
+        c = parse_cpp_constants(src)
+        assert (c["kTagA"], c["kTagB"], c["kTagC"], c["kTagD"]) == \
+            (0, 1, 5, 6)
+        assert c["kErr"] == -3
+
+    def test_comments_do_not_confuse(self):
+        src = """
+        // constexpr int kCommented = 9;
+        /* constexpr int kBlock = 10; */
+        constexpr int kReal = 1;  // trailing = 2 garbage
+        """
+        c = parse_cpp_constants(src)
+        assert c == {"kReal": 1}
+
+    def test_non_integer_skipped(self):
+        c = parse_cpp_constants(
+            'constexpr char kName[] = "x";\n'
+            "constexpr double kF = 1.5;\n"
+            "constexpr int kOk = 3;\n"
+        )
+        assert c == {"kOk": 3}
+
+
+class TestPySourceParser:
+    def test_constants_and_folding(self):
+        src = "A = 48\nB = 1 << 22\nC = -70\nD = A\n_E = 0x80\n"
+        c = parse_py_constants(src)
+        assert c == {"A": 48, "B": 1 << 22, "C": -70, "_E": 0x80}
+
+    def test_struct_formats_direct_and_aliased(self):
+        src = (
+            "import struct\n"
+            "from struct import unpack_from as uf\n"
+            "pack = struct.pack\n"
+            "H = struct.Struct('<2sBBII')\n"
+            "def f(buf):\n"
+            "    pack('<HI', 1, 2)\n"
+            "    uf('<iqiqqBH', buf, 0)\n"
+            "    struct.unpack('<qqq', buf)\n"
+        )
+        fmts = {(s.func, s.fmt) for s in parse_py_struct_formats(src)}
+        assert ("Struct", "<2sBBII") in fmts
+        assert ("pack", "<HI") in fmts
+        assert ("unpack_from", "<iqiqqBH") in fmts
+        assert ("unpack", "<qqq") in fmts
+
+
+# ----------------------------------------------------------------------
+# deliberate-skew fixtures: the checker must FIRE on drift
+# ----------------------------------------------------------------------
+
+
+def _mini_tree(tmp_path, native_py_text: str) -> Path:
+    """A minimal fake repo holding just the files _check_header reads."""
+    (tmp_path / "native").mkdir()
+    (tmp_path / "ggrs_tpu/net").mkdir(parents=True)
+    (tmp_path / "native/session_bank.cpp").write_text(
+        "constexpr size_t kHdrStride = 48;\n"
+    )
+    (tmp_path / "ggrs_tpu/net/_native.py").write_text(native_py_text)
+    return tmp_path
+
+
+GOOD_FIELDS = (
+    'BANK_HDR_FIELDS = (\n'
+    '    ("flags", "<u4"), ("rec_len", "<u4"), ("err", "<i4"),\n'
+    '    ("fa", "<i4"), ("landed", "<i8"), ("current", "<i8"),\n'
+    '    ("confirmed", "<i8"), ("save_frame", "<i8"),\n'
+    ')\n'
+)
+
+
+class TestDeliberateSkew:
+    def test_clean_fixture_passes(self, tmp_path):
+        root = _mini_tree(tmp_path, GOOD_FIELDS)
+        assert _check_header(root) == []
+
+    def test_one_byte_header_drift_fires(self, tmp_path):
+        # err shrinks i4 -> i2: every later offset shifts, stride 46
+        root = _mini_tree(
+            tmp_path, GOOD_FIELDS.replace('("err", "<i4")',
+                                          '("err", "<i2")')
+        )
+        findings = _check_header(root)
+        assert findings, "1-byte field drift must fail lint"
+        assert any("stride" in f.rule or "fields" in f.rule
+                   for f in findings)
+
+    def test_big_endian_field_fires(self, tmp_path):
+        root = _mini_tree(
+            tmp_path, GOOD_FIELDS.replace('("landed", "<i8")',
+                                          '("landed", ">i8")')
+        )
+        assert any(
+            f.rule == "layout/header-endian" for f in _check_header(root)
+        )
+
+    def test_native_stride_drift_fires(self, tmp_path):
+        root = _mini_tree(tmp_path, GOOD_FIELDS)
+        (root / "native/session_bank.cpp").write_text(
+            "constexpr size_t kHdrStride = 56;\n"
+        )
+        assert any(
+            f.rule == "layout/header-stride" for f in _check_header(root)
+        )
+
+    def test_mirror_value_drift_fires(self, tmp_path):
+        (tmp_path / "a.cpp").write_text("constexpr int kX = -70;\n")
+        (tmp_path / "b.py").write_text("X = -71\n")
+        findings = _check_mirrors(
+            tmp_path, [("a.cpp", "kX", "b.py", "X")]
+        )
+        assert [f.rule for f in findings] == ["layout/mirror-mismatch"]
+
+    def test_mirror_missing_side_fires(self, tmp_path):
+        (tmp_path / "a.cpp").write_text("constexpr int kX = -70;\n")
+        (tmp_path / "b.py").write_text("OTHER = 1\n")
+        findings = _check_mirrors(
+            tmp_path, [("a.cpp", "kX", "b.py", "X")]
+        )
+        assert [f.rule for f in findings] == ["layout/mirror-missing"]
+
+
+# ----------------------------------------------------------------------
+# the tree itself + runtime parity
+# ----------------------------------------------------------------------
+
+
+class TestTreeIsClean:
+    def test_repo_layout_clean(self):
+        findings = check_layout(REPO)
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_mirror_table_covers_all_bank_errors(self):
+        """Every kBankErr*/kHdr* the native source declares is in the
+        mirror table — a NEW native constant without a declared mirror
+        fails here, which is how the table stays complete."""
+        native = parse_cpp_constants(REPO / "native/session_bank.cpp")
+        mirrored = {
+            c for f, c, _, _ in MIRRORED_CONSTANTS
+            if f == "native/session_bank.cpp"
+        }
+        declared = {
+            k for k in native
+            if k.startswith("kBankErr") or k.startswith("kHdr")
+            or k.startswith("kFlag")
+        } - {"kHdrStride"}  # stride is pinned by the header check
+        assert declared <= mirrored, (
+            f"unmirrored native constants: {sorted(declared - mirrored)}"
+        )
+
+    def test_static_header_matches_live_dtype(self):
+        header = static_bank_header()
+        dtype = np.dtype(list(_native.BANK_HDR_FIELDS))
+        assert header["stride"] == dtype.itemsize
+        for name, fmt, offset in header["fields"]:
+            assert dtype.fields[name][1] == offset
+            assert np.dtype(fmt) == dtype.fields[name][0]
+        assert tuple(dtype.names) == tuple(
+            n for n, _, _ in LAYOUT_HEADER_FIELDS
+        )
+
+    def test_static_header_matches_runtime_probe(self):
+        lib = _native.bank_lib()
+        if lib is None or not hasattr(lib, "ggrs_bank_hdr_stride"):
+            pytest.skip("no native bank library on this platform")
+        assert int(lib.ggrs_bank_hdr_stride()) == \
+            static_bank_header()["stride"]
+
+    def test_cmd_flags_match_native_literals(self):
+        native = parse_cpp_constants(REPO / "native/session_bank.cpp")
+        assert _native.CMD_FLAG_INPUTS == native["kFlagInputs"]
+        assert _native.CMD_FLAG_SKIP == native["kFlagSkip"]
+
+
+class TestReviewRegressions:
+    def test_enum_implicit_poisoned_after_unevaluable_entry(self):
+        # B's true value is sizeof(int)+1, unknown statically: emitting
+        # an implicit guess could mask (or fabricate) ABI drift
+        c = parse_cpp_constants(
+            "enum { kA = sizeof(int), kB, kC, kD = 9, kE };"
+        )
+        assert "kB" not in c and "kC" not in c
+        assert c["kD"] == 9 and c["kE"] == 10
+
+    def test_py_mirror_pair_drift_fires(self, tmp_path):
+        from ggrs_tpu.analysis.layout import _check_py_mirrors
+
+        (tmp_path / "a.py").write_text("P = 4\n")
+        (tmp_path / "b.py").write_text("_P = 5\n")
+        findings = _check_py_mirrors(
+            tmp_path, [("a.py", "P", "b.py", "_P")]
+        )
+        assert [f.rule for f in findings] == ["layout/mirror-mismatch"]
+
+    def test_pickle_protocol_pair_is_checked_on_tree(self):
+        from ggrs_tpu.analysis.layout import PY_MIRRORED_CONSTANTS
+
+        pairs = {(a, b) for a, _, b, _ in PY_MIRRORED_CONSTANTS}
+        assert (
+            "ggrs_tpu/fleet/rpc.py", "ggrs_tpu/parallel/host_bank.py"
+        ) in pairs
+
+    def test_unsigned_complement_uses_cast_width(self):
+        c = parse_cpp_constants(
+            "constexpr uint32_t kMask32 = ~uint32_t{0};\n"
+            "constexpr uint64_t kMask64 = ~uint64_t{0};\n"
+            "constexpr uint8_t kMask8 = ~uint8_t{0};\n"
+        )
+        assert c["kMask32"] == 0xFFFFFFFF
+        assert c["kMask64"] == (1 << 64) - 1
+        assert c["kMask8"] == 0xFF

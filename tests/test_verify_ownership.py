@@ -1,0 +1,159 @@
+"""ggrs-verify pillar 3 (static half): the thread-ownership lint.
+
+Golden fixtures for each own/* rule plus the self-clean gate: the
+session classes declare exactly the driving surface they guard.
+"""
+
+from pathlib import Path
+
+from ggrs_tpu.analysis import lint_ownership
+from ggrs_tpu.sessions import P2PSession, SpectatorSession, SyncTestSession
+from ggrs_tpu.utils.ownership import ThreadOwned
+
+REPO = Path(__file__).resolve().parents[1]
+
+
+def lint_src(tmp_path, src: str):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(src)
+    return lint_ownership(tmp_path, scope=("pkg/",))
+
+
+OK_CLASS = """
+class ThreadOwned:
+    pass
+
+class Session(ThreadOwned):
+    _DRIVING_METHODS = ("advance",)
+
+    def advance(self):
+        self._check_owner()
+        return 1
+
+    def read_only(self):
+        return 2
+"""
+
+
+class TestGoldenFixtures:
+    def test_clean_class_passes(self, tmp_path):
+        assert lint_src(tmp_path, OK_CLASS) == []
+
+    def test_undeclared_fires(self, tmp_path):
+        src = OK_CLASS.replace(
+            '    _DRIVING_METHODS = ("advance",)\n', ""
+        )
+        findings = lint_src(tmp_path, src)
+        assert [f.rule for f in findings] == ["own/undeclared"]
+
+    def test_missing_guard_fires_on_unguarded_method(self, tmp_path):
+        src = OK_CLASS.replace(
+            "    def advance(self):\n        self._check_owner()\n",
+            "    def advance(self):\n",
+        )
+        findings = lint_src(tmp_path, src)
+        assert [f.rule for f in findings] == ["own/missing-guard"]
+
+    def test_missing_guard_fires_on_phantom_method(self, tmp_path):
+        src = OK_CLASS.replace(
+            '_DRIVING_METHODS = ("advance",)',
+            '_DRIVING_METHODS = ("advance", "phantom")',
+        )
+        findings = lint_src(tmp_path, src)
+        assert [f.rule for f in findings] == ["own/missing-guard"]
+
+    def test_unlisted_guard_fires(self, tmp_path):
+        src = OK_CLASS.replace(
+            "    def read_only(self):\n        return 2\n",
+            "    def read_only(self):\n"
+            "        self._check_owner()\n"
+            "        return 2\n",
+        )
+        findings = lint_src(tmp_path, src)
+        assert [f.rule for f in findings] == ["own/unlisted-guard"]
+
+    def test_thread_target_fires(self, tmp_path):
+        src = OK_CLASS + (
+            "\n"
+            "import threading\n"
+            "def spawn(s):\n"
+            "    return threading.Thread(target=s.advance)\n"
+        )
+        findings = lint_src(tmp_path, src)
+        assert [f.rule for f in findings] == ["own/thread-target"]
+
+    def test_subclass_inherits_declaration(self, tmp_path):
+        src = OK_CLASS + (
+            "\n"
+            "class Derived(Session):\n"
+            "    def helper(self):\n"
+            "        return 3\n"
+        )
+        assert lint_src(tmp_path, src) == []
+
+    def test_subclass_with_new_guard_must_declare(self, tmp_path):
+        src = OK_CLASS + (
+            "\n"
+            "class Derived(Session):\n"
+            "    def extra(self):\n"
+            "        self._check_owner()\n"
+            "        return 3\n"
+        )
+        findings = lint_src(tmp_path, src)
+        assert [f.rule for f in findings] == ["own/undeclared"]
+
+
+class TestTreeIsClean:
+    def test_repo_ownership_clean(self):
+        findings = lint_ownership(REPO)
+        assert findings == [], "\n".join(f.render() for f in findings)
+
+    def test_declarations_exist_and_are_live(self):
+        """The runtime classes carry the declarations the lint reads,
+        and every declared name is a real attribute."""
+        for cls in (P2PSession, SpectatorSession, SyncTestSession):
+            declared = cls._DRIVING_METHODS
+            assert declared, f"{cls.__name__} declares no driving methods"
+            for name in declared:
+                assert callable(getattr(cls, name)), (cls, name)
+        assert ThreadOwned._DRIVING_METHODS == ()
+
+
+class TestReviewRegressions:
+    def test_inheritance_resolution_is_name_order_independent(self, tmp_path):
+        """A subclass sorting alphabetically BEFORE its declaring base
+        must still inherit the declaration (bases resolve first)."""
+        src = """
+class ThreadOwned:
+    pass
+
+class ZBase(ThreadOwned):
+    _DRIVING_METHODS = ("step",)
+
+    def step(self):
+        self._check_owner()
+        return 1
+
+class ASub(ZBase):
+    _DRIVING_METHODS = ("step",)
+
+    def step(self):
+        self._check_owner()
+        return 2
+
+class AQuiet(ZBase):
+    def helper(self):
+        return 3
+"""
+        assert lint_src(tmp_path, src) == []
+
+    def test_thread_target_pragma_suppresses(self, tmp_path):
+        src = OK_CLASS + (
+            "\n"
+            "import threading\n"
+            "def spawn(s):\n"
+            "    return threading.Thread(target=s.advance)"
+            "  # ggrs-verify: allow(own/thread-target)\n"
+        )
+        assert lint_src(tmp_path, src) == []
