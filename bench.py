@@ -1517,30 +1517,46 @@ def _bank_matches_setup(n_matches: int, metrics=None, tracer=None):
         game.advance, game.init_state(), to_arr,
         batch_size=len(host), ring_length=10, max_burst=9,
         with_checksums=False,
+        # descriptor plane (DESIGN.md §21): bulk twin of to_arr — the
+        # encoded blobs' first byte IS the value for small uint inputs,
+        # so quiet slots convert in one NumPy slice
+        raw_inputs_to_array=lambda blobs, statuses: blobs[:, :, 0],
     )
     pool.warmup(np.zeros((2,), np.uint8))
     return host, schedules, pool
 
 
-def _bank_tick_fn(host, schedules, pool, scrape_each_tick=False):
+def _bank_tick_fn(host, schedules, pool, scrape_each_tick=False,
+                  staged=False, split=None):
     """One strict-fence pool tick (host crossing + device fulfillment),
     returning (host_ms, device_ms) — the shared harness of the host_bank
     capacity ramp and the degraded config.  ``scrape_each_tick`` adds the
     obs stat harvest (one extra ctypes crossing) inside the host window —
-    the scrape-budget measurement of DESIGN.md §12."""
+    the scrape-budget measurement of DESIGN.md §12.  ``staged`` routes
+    the local inputs through the batched ``stage_inputs`` crossing
+    (descriptor plane, §21) instead of B ``add_local_input`` calls;
+    ``split``, when a list, collects per-tick ``(staging_ms,
+    advance_ms)`` host sub-phases (the §21 staging/decode attribution)."""
     n = len(host)
     counter = [0]
+    stage = getattr(host, "stage_inputs", None) if staged else None
 
     def tick():
         i = counter[0]
         counter[0] = i + 1
         t0 = time.perf_counter()
-        for h in range(n):
-            host.add_local_input(h, h % 2, schedules[h](i))
+        if stage is not None:
+            stage([(h, h % 2, schedules[h](i)) for h in range(n)])
+        else:
+            for h in range(n):
+                host.add_local_input(h, h % 2, schedules[h](i))
+        ts = time.perf_counter() if split is not None else 0.0
         reqs = host.advance_all()
         if scrape_each_tick:
             host.scrape()
         t1 = time.perf_counter()
+        if split is not None:
+            split.append(((ts - t0) * 1e3, (t1 - ts) * 1e3))
         pool.run(reqs)
         pool.block_until_ready()
         t2 = time.perf_counter()
@@ -1835,11 +1851,14 @@ def run_host_bank_degraded() -> None:
 
 
 def run_host_bank_capacity() -> None:
-    """ISSUE 10 acceptance sweep (DESIGN.md §19): the capacity ramp after
-    the vectorized policy plane — B in 64/128/256/512 MATCHES (2 sessions
-    each), strict-fence host+device tick, knee detection, fast-path
-    coverage, a vectorized-vs-legacy host p99 A/B at the old knee, and
-    per-phase attribution from the PR 5 in-crossing timers.
+    """ISSUE 12 acceptance sweep (DESIGN.md §21): the capacity ramp on
+    the descriptor plane — B in 64/128/256/512/1024 MATCHES (2 sessions
+    each) with batched input staging + lazy request plans, strict-fence
+    host+device tick, knee detection, fast-path coverage, a
+    staging+decode A/B at the BENCH_r07 knee (B=512, legacy per-call
+    staging + reference parse vs the descriptor plane; target >= 2x),
+    and per-phase attribution — including the §21 `staging` phase — from
+    the PR 5 in-crossing timers.
 
     GC posture: the headline p99 is measured with the collector FROZEN
     after warmup (``gc.collect()`` + ``gc.freeze()`` — the standard
@@ -1878,51 +1897,91 @@ def run_host_bank_capacity() -> None:
                 best = (p50, p99, host_frac, host_p99)
         return best
 
-    # ---- legacy-decode A/B at the PR 1 knee (B=128): what the
-    # vectorized path is worth on its own, same matches, same fence ----
-    def host_p99(B, fastpath):
+    # ---- descriptor-plane A/B at the BENCH_r07 knee (B=512): staging +
+    # decode host time, reference posture (per-call add_local_input +
+    # the GGRS_TPU_NO_FASTPATH per-slot reference parse — NOT r07's §19
+    # vectorized decode, which the plan path replaced and which cannot
+    # be A/B'd in-tree; the r07 comparison is the recorded 23.7 ms
+    # B=512 host p99 vs this sweep's number) vs the descriptor plane
+    # (stage_inputs + RequestPlan) — the §21 acceptance ratio ----
+    def staging_decode(B, descriptor):
         prev = os.environ.pop("GGRS_TPU_NO_FASTPATH", None)
-        if not fastpath:
+        if not descriptor:
             os.environ["GGRS_TPU_NO_FASTPATH"] = "1"
         try:
             host, schedules, pool = _bank_matches_setup(B)
             if not host.native_active:
                 return None
-            tick = _bank_tick_fn(host, schedules, pool)
+            split = []
+            tick = _bank_tick_fn(host, schedules, pool,
+                                 staged=descriptor, split=split)
             for _ in range(16):
                 tick()
-            p = _best_tick_percentiles(tick, T)
+            enter_honest_timing_mode()
+            best = None
+            gc.collect()
+            gc.freeze()  # the serving posture, like the sweep below: the
+            # A/B prices the CODE paths, not default-GC full-heap spikes
+            try:
+                dev = []
+                for _ in range(REPEATS):
+                    del split[:]
+                    del dev[:]
+                    for _ in range(min(T, 100)):
+                        dev.append(tick()[1])
+                    arr = np.asarray(split)
+                    sd50 = float(np.percentile(arr.sum(axis=1), 50))
+                    if best is None or sd50 < best[0]:
+                        best = (
+                            sd50,
+                            float(np.percentile(arr.sum(axis=1), 99)),
+                            float(np.percentile(arr[:, 0], 50)),
+                            float(np.percentile(arr[:, 1], 50)),
+                            float(np.percentile(dev, 50)),
+                        )
+            finally:
+                gc.unfreeze()
+                gc.collect()
             cov = host.fast_slot_ticks
             del host, schedules, pool
-            return p, cov
+            return best + (cov,)
         finally:
             os.environ.pop("GGRS_TPU_NO_FASTPATH", None)
             if prev is not None:
                 os.environ["GGRS_TPU_NO_FASTPATH"] = prev
 
-    legacy = host_p99(128, fastpath=False)
-    vector = host_p99(128, fastpath=True)
-    if legacy is None or vector is None:
+    legacy = staging_decode(512, descriptor=False)
+    desc = staging_decode(512, descriptor=True)
+    if legacy is None or desc is None:
         print("# skip: host_bank_capacity pool did not engage the native "
               "bank", flush=True)
         return
     emit(
-        "host_bank_capacity_b128_vectorized_vs_legacy_p99", vector[0][1],
-        f"ms/tick p99 with the vectorized decode (legacy per-slot parse "
-        f"{legacy[0][1]:.2f} ms; {vector[1]} fast-path slot ticks vs "
-        f"{legacy[1]}; strict fence, default GC)",
-        legacy[0][1] / vector[0][1] if vector[0][1] else 0.0,
+        "host_bank_capacity_b512_staging_decode_ms_p50", desc[0],
+        f"ms/tick staging+advance_all HOST p50 at B=512 on the "
+        f"descriptor plane, GC frozen, best of {REPEATS} "
+        f"(staging {desc[2]:.2f} + advance_all {desc[3]:.2f}, p99 "
+        f"{desc[1]:.2f}, device window p50 {desc[4]:.2f}; SAME-DAY "
+        f"reference leg = per-call staging + NO_FASTPATH per-slot "
+        f"parse, NOT r07's since-replaced vectorized decode: "
+        f"{legacy[0]:.2f} = {legacy[2]:.2f} + {legacy[3]:.2f}, p99 "
+        f"{legacy[1]:.2f}, device p50 {legacy[4]:.2f}; "
+        f"{desc[5]} fast-path slot ticks vs {legacy[5]}; the r07 "
+        f"cross-reference is its recorded 23.7 ms B=512 host p99 vs "
+        f"this sweep's b512_host_ms_p99)",
+        legacy[0] / desc[0] if desc[0] else 0.0,
     )
 
-    # ---- the sweep: default-GC and frozen-GC p99 per B, knee detect ----
+    # ---- the sweep: default-GC and frozen-GC p99 per B, knee detect,
+    # batched staging (the production driver posture, §21) ----
     max_ok = 0
     knee = None
-    for B in (64, 128, 256, 512):
+    for B in (64, 128, 256, 512, 1024):
         host, schedules, pool = _bank_matches_setup(B)
         if not host.native_active:
             print("# skip: pool fell back at B=%d" % B, flush=True)
             return
-        tick = _bank_tick_fn(host, schedules, pool)
+        tick = _bank_tick_fn(host, schedules, pool, staged=True)
         for _ in range(16):
             tick()
         p50_d, p99_d, _, hp99_d = percentiles(tick, min(T, 100))
@@ -1951,24 +2010,27 @@ def run_host_bank_capacity() -> None:
             f"frozen p50 {p50:.2f}; host fraction {host_frac:.2f})",
             frame_budget_ms / p99,
         )
-        if h_p99 <= frame_budget_ms:
+        if h_p99 <= frame_budget_ms and knee is None:
+            # largest PASSING PREFIX: a noisy post-knee rung that squeaks
+            # under budget must not overwrite the capacity headline
             max_ok = B
-        else:
+        elif h_p99 > frame_budget_ms and knee is None:
             knee = (B, host_frac)
         del host, schedules, pool
-        if knee is not None:
-            break
+        # no early break: the B=1024 rung is part of the ISSUE 12
+        # acceptance record even when the knee lands below it
 
-    # ---- per-phase attribution at B=256 (PR 5 in-crossing timers; the
-    # traced pool uses the legacy parse by design, the native phase split
-    # is decode-independent) ----
+    # ---- per-phase attribution at B=512 (PR 5 in-crossing timers plus
+    # the §21 `staging` phase: stage_inputs time accrued outside the tick
+    # window rides the same trace tail; the traced pool uses the legacy
+    # parse by design, the native phase split is decode-independent) ----
     from ggrs_tpu.obs import Tracer
 
     host, schedules, pool = _bank_matches_setup(
-        256, tracer=Tracer(capacity=1 << 14)
+        512, tracer=Tracer(capacity=1 << 14)
     )
     if host.native_active and host._trace_native:
-        tick = _bank_tick_fn(host, schedules, pool)
+        tick = _bank_tick_fn(host, schedules, pool, staged=True)
         for _ in range(60):
             tick()
         host.scrape()
@@ -1980,10 +2042,10 @@ def run_host_bank_capacity() -> None:
             }
             top = sorted(per_tick.items(), key=lambda kv: -kv[1])
             emit(
-                "host_bank_capacity_b256_crossing_phase_us", sum(
+                "host_bank_capacity_b512_crossing_phase_us", sum(
                     per_tick.values()
                 ),
-                "us/tick in-crossing total at B=256 matches ("
+                "us/tick in-crossing + staging total at B=512 matches ("
                 + " ".join(f"{k}={v:.0f}" for k, v in top)
                 + ")",
                 1.0,
@@ -2001,8 +2063,9 @@ def run_host_bank_capacity() -> None:
     emit(
         "host_bank_capacity_max_60hz_matches_per_chip", float(max_ok),
         f"matches (2 sessions each) with HOST p99 tick <= 16.7 ms, "
-        f"vectorized policy plane, GC frozen after warmup{regime}",
-        max_ok / 128.0 if max_ok else 0.0,  # vs the PR 1-6 era knee
+        f"descriptor plane (batched staging + lazy request plans), GC "
+        f"frozen after warmup{regime}",
+        max_ok / 512.0 if max_ok else 0.0,  # vs the BENCH_r07 knee
     )
 
 

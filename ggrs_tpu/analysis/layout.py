@@ -68,6 +68,43 @@ RPC_CRC_COVERS = struct.calcsize(RPC_HEADER_PREFIX_FMT)  # 8
 # i64 disconnect_frame.
 HARVEST_PREFIX_FMT = "<qqq"
 
+# ---- descriptor plane (DESIGN.md §21) -----------------------------------
+# Batched input-staging record (ggrs_bank_stage_inputs / kStageStride ↔
+# _native.BANK_STAGE_FIELDS): the contract both sides are checked against.
+LAYOUT_STAGE_FIELDS: Tuple[Tuple[str, str, int], ...] = (
+    ("slot", "<u4", 0),
+    ("handle", "<i4", 4),
+    ("frame", "<i8", 8),
+    ("off", "<u4", 16),
+    ("len", "<u4", 20),
+)
+LAYOUT_STAGE_STRIDE = 24
+
+# Per-slot request descriptor record (the second fixed-stride table of
+# every tick output; kReqStride ↔ _native.BANK_REQ_FIELDS).
+LAYOUT_REQ_FIELDS: Tuple[Tuple[str, str, int], ...] = (
+    ("pattern", "<u1", 0),
+    ("rflags", "<u1", 1),
+    ("n_adv", "<u2", 2),
+    ("adv_off", "<u4", 4),
+    ("adv_stride", "<u4", 8),
+    ("ops_end", "<u4", 12),
+    ("frame", "<i8", 16),
+)
+LAYOUT_REQ_STRIDE = 24
+
+# Batched outbound send record (net_batch.cpp ggrs_net_send_table /
+# kSendStride ↔ _native.NET_SEND_FIELDS).
+LAYOUT_SEND_FIELDS: Tuple[Tuple[str, str, int], ...] = (
+    ("fd", "<i4", 0),
+    ("ip", "<u4", 4),
+    ("port", "<u2", 8),
+    ("pad", "<u2", 10),
+    ("off", "<u4", 12),
+    ("len", "<u4", 16),
+)
+LAYOUT_SEND_STRIDE = 20
+
 _NP_WIDTH = {"u4": 4, "i4": 4, "u8": 8, "i8": 8, "u2": 2, "i2": 2,
              "u1": 1, "i1": 1}
 
@@ -172,6 +209,28 @@ MIRRORED_CONSTANTS: Tuple[Tuple[str, str, str, str], ...] = (
      "ggrs_tpu/net/_native.py", "CMD_FLAG_INPUTS"),
     ("native/session_bank.cpp", "kFlagSkip",
      "ggrs_tpu/net/_native.py", "CMD_FLAG_SKIP"),
+    ("native/session_bank.cpp", "kFlagStaged",
+     "ggrs_tpu/net/_native.py", "CMD_FLAG_STAGED"),
+    # descriptor plane (§21): staging / request-descriptor / send strides
+    # and the request pattern codes
+    ("native/session_bank.cpp", "kStageStride",
+     "ggrs_tpu/net/_native.py", "BANK_STAGE_STRIDE"),
+    ("native/session_bank.cpp", "kReqStride",
+     "ggrs_tpu/net/_native.py", "BANK_REQ_STRIDE"),
+    ("native/session_bank.cpp", "kReqOther",
+     "ggrs_tpu/net/_native.py", "REQ_OTHER"),
+    ("native/session_bank.cpp", "kReqQuiet",
+     "ggrs_tpu/net/_native.py", "REQ_QUIET"),
+    ("native/session_bank.cpp", "kReqResim",
+     "ggrs_tpu/net/_native.py", "REQ_RESIM"),
+    ("native/session_bank.cpp", "kReqSaveOnly",
+     "ggrs_tpu/net/_native.py", "REQ_SAVE_ONLY"),
+    ("native/session_bank.cpp", "kReqEmpty",
+     "ggrs_tpu/net/_native.py", "REQ_EMPTY"),
+    ("native/session_bank.cpp", "kReqFlagTrailingAdv",
+     "ggrs_tpu/net/_native.py", "REQ_FLAG_TRAILING_ADV"),
+    ("native/net_batch.cpp", "kSendStride",
+     "ggrs_tpu/net/_native.py", "NET_SEND_STRIDE"),
     ("native/session_bank.cpp", "kFrameWindow",
      "ggrs_tpu/core/time_sync.py", "FRAME_WINDOW_SIZE"),
     # kernel-batched datapath verdicts + socket caps
@@ -336,6 +395,76 @@ def _check_header(root: Path) -> List[Finding]:
     return out
 
 
+def _check_field_table(
+    root: Path,
+    py_name: str,
+    contract: Sequence[Tuple[str, str, int]],
+    stride: int,
+    py_file: str = "ggrs_tpu/net/_native.py",
+) -> List[Finding]:
+    """Generic fixed-stride table check (the header check's shape, reused
+    by the §21 descriptor-plane structs): the named Python field tuple
+    must rebuild exactly the contract's (name, little-endian fmt, offset)
+    rows and itemsize."""
+    out: List[Finding] = []
+    fields = parse_py_field_tuples(root / py_file).get(py_name)
+    if fields is None:
+        out.append(Finding(
+            "layout/table-fields", py_file, 0,
+            f"{py_name} not found / not statically parseable",
+        ))
+        return out
+    offset = 0
+    declared = []
+    for row in fields:
+        if len(row) != 2:
+            out.append(Finding(
+                "layout/table-fields", py_file, 0,
+                f"{py_name} row {row!r} is not (name, fmt)",
+            ))
+            return out
+        name, fmt = row
+        width = _field_width(fmt)
+        if width is None or not fmt.startswith("<"):
+            out.append(Finding(
+                "layout/table-endian", py_file, 0,
+                f"{py_name} field {name!r} has format {fmt!r}; the "
+                "contract is little-endian fixed-width only",
+            ))
+            return out
+        declared.append((name, fmt, offset))
+        offset += width
+    if offset != stride:
+        out.append(Finding(
+            "layout/table-stride", py_file, 0,
+            f"{py_name} itemsize {offset} != contract stride {stride}",
+        ))
+    if tuple(declared) != tuple(contract):
+        out.append(Finding(
+            "layout/table-fields", py_file, 0,
+            f"{py_name} layout {tuple(declared)} != contract "
+            f"{tuple(contract)}",
+        ))
+    return out
+
+
+def _check_descriptor_plane(root: Path) -> List[Finding]:
+    """The §21 structs: staging record, request descriptor record, send
+    record — Python dtypes vs the contract (the C++ strides and pattern
+    codes are pinned by MIRRORED_CONSTANTS)."""
+    out: List[Finding] = []
+    out += _check_field_table(
+        root, "BANK_STAGE_FIELDS", LAYOUT_STAGE_FIELDS, LAYOUT_STAGE_STRIDE
+    )
+    out += _check_field_table(
+        root, "BANK_REQ_FIELDS", LAYOUT_REQ_FIELDS, LAYOUT_REQ_STRIDE
+    )
+    out += _check_field_table(
+        root, "NET_SEND_FIELDS", LAYOUT_SEND_FIELDS, LAYOUT_SEND_STRIDE
+    )
+    return out
+
+
 def _check_body_prefix(root: Path) -> List[Finding]:
     """The body-record prefix format must be what the reference decoder
     unpacks, and the vectorized fast path's literal jump offsets must be
@@ -470,6 +599,7 @@ def check_layout(
     findings += _check_mirrors(root, mirrors)
     findings += _check_py_mirrors(root)
     findings += _check_header(root)
+    findings += _check_descriptor_plane(root)
     findings += _check_body_prefix(root)
     findings += _check_rpc_framing(root)
     findings += _check_stat_tables(root)

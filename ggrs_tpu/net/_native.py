@@ -483,6 +483,30 @@ def _load() -> Optional[ctypes.CDLL]:
                 # body-only tick output and skip the vectorized fast path
                 lib.ggrs_bank_hdr_stride.restype = ctypes.c_int
                 lib.ggrs_bank_hdr_stride.argtypes = []
+            if hasattr(lib, "ggrs_bank_req_stride"):
+                # descriptor plane (DESIGN.md §21): batched input staging,
+                # the per-slot request descriptor table, and the harvest
+                # staged tail; absent on a prebuilt pre-descriptor .so —
+                # pools then keep the legacy parse and per-call staging
+                lib.ggrs_bank_req_stride.restype = ctypes.c_int
+                lib.ggrs_bank_req_stride.argtypes = []
+                lib.ggrs_bank_stage_stride.restype = ctypes.c_int
+                lib.ggrs_bank_stage_stride.argtypes = []
+                lib.ggrs_bank_stage_inputs.restype = ctypes.c_int64
+                lib.ggrs_bank_stage_inputs.argtypes = [
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64,
+                    ctypes.c_char_p, ctypes.c_size_t,
+                ]
+            if hasattr(lib, "ggrs_net_send_table"):
+                # one-shot batched outbound over arbitrary fds (§21);
+                # shares the non-Linux stub policy of the NetBatch surface
+                lib.ggrs_net_send_table.restype = ctypes.c_int
+                lib.ggrs_net_send_table.argtypes = [
+                    ctypes.c_void_p, ctypes.c_int64,
+                    ctypes.c_char_p, ctypes.c_size_t,
+                    ctypes.POINTER(ctypes.c_uint64),
+                    ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+                ]
             if hasattr(lib, "ggrs_bank_pump"):
                 # kernel-batched socket datapath (net_batch.cpp + the
                 # bank's pump entry, DESIGN.md §15); absent on a prebuilt
@@ -603,9 +627,52 @@ EP_STAT_FIELDS = (
 
 # per-session command-stream flag byte (session_bank.cpp kFlag*): bit 0 =
 # local inputs present (advance runs), bit 1 = skip (slot quarantined or
-# evicted, no further fields follow for this session)
+# evicted, no further fields follow for this session), bit 2 = staged
+# (inputs were staged natively via ggrs_bank_stage_inputs — no inline
+# input bytes follow the flag byte)
 CMD_FLAG_INPUTS = 1
 CMD_FLAG_SKIP = 2
+CMD_FLAG_STAGED = 4
+
+# ---- descriptor plane (session_bank.cpp §21 structs) --------------------
+# Batched input staging record (ggrs_bank_stage_inputs): one fixed-stride
+# descriptor per staged input, jumping into a shared payload blob — the
+# PR 10 packed-header/jump-table idiom applied to the INBOUND direction.
+# `frame` is reserved (must be NULL_FRAME today: "this tick"); `len` is
+# the variable-size seam and must equal the slot's input_size for now.
+BANK_STAGE_FIELDS = (
+    ("slot", "<u4"), ("handle", "<i4"), ("frame", "<i8"),
+    ("off", "<u4"), ("len", "<u4"),
+)  # itemsize 24 == ggrs_bank_stage_stride()
+BANK_STAGE_STRIDE = 24
+
+# Per-slot request descriptor record (the SECOND fixed-stride table of
+# every tick output, after the header table): the tick's request program
+# as flat data — pattern, advance count/offsets, and the save/load frame —
+# so the pool's decode and BatchedRequestExecutor's device dispatch read
+# NumPy columns instead of parsing op bytes per slot.
+BANK_REQ_FIELDS = (
+    ("pattern", "<u1"), ("rflags", "<u1"), ("n_adv", "<u2"),
+    ("adv_off", "<u4"), ("adv_stride", "<u4"), ("ops_end", "<u4"),
+    ("frame", "<i8"),
+)  # itemsize 24 == ggrs_bank_req_stride()
+BANK_REQ_STRIDE = 24
+REQ_OTHER = 0       # unclassified shape: use the generic op decoder
+REQ_QUIET = 1       # ops are exactly [save frame, advance]
+REQ_RESIM = 2       # [load frame, adv, (save, adv)*, save] (+ trailing adv)
+REQ_SAVE_ONLY = 3   # [save frame] — the prediction-limit tick
+REQ_EMPTY = 4       # no ops (skip / faulted records)
+REQ_FLAG_TRAILING_ADV = 1  # the tick's last op was an advance ("advanced")
+
+# Batched outbound send record (net_batch.cpp ggrs_net_send_table): per
+# datagram fd + wire address + a jump into the shared payload (usually the
+# tick output buffer itself).  Records for one fd must form one contiguous
+# run.
+NET_SEND_FIELDS = (
+    ("fd", "<i4"), ("ip", "<u4"), ("port", "<u2"), ("pad", "<u2"),
+    ("off", "<u4"), ("len", "<u4"),
+)  # itemsize 20 == net_batch.cpp kSendStride
+NET_SEND_STRIDE = 20
 
 # packed per-tick output header (session_bank.cpp kHdr*; DESIGN.md §19):
 # one BANK_HDR_DTYPE-shaped record per session leads the tick output when
@@ -637,8 +704,13 @@ BANK_HDR_FIELDS = (
 # this order, with the count byte last)
 BANK_PHASES = (
     "inbound", "timers", "commit", "rollback", "outbound", "fanout",
-    "emit", "other",
+    "emit", "other", "staging",
 )
+# "staging" is special: it accumulates OUTSIDE the tick window (the
+# ggrs_bank_stage_inputs crossings since the last tick) and rides the next
+# tick's tail — it is never part of the in-crossing sum that "other"
+# closes, and the tracer emits it as a sibling span of the crossing, not a
+# child.
 
 BANK_ERR_NAMES = {
     BANK_ERR_CMD: "malformed command stream",

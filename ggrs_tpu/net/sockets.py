@@ -89,6 +89,13 @@ class NonBlockingSocket(Protocol[A]):
 
     def receive_all_messages(self) -> List[Tuple[A, Message]]: ...
 
+    # Optional: ``send_datagram_batch(items)`` — one call flushing a whole
+    # tick's ``(data, addr)`` datagrams in order (data may be any
+    # bytes-like, including memoryview slices of a decode buffer).
+    # Implementations that provide it unlock the pool's batched outbound
+    # (DESIGN.md §21): one Python call per socket per tick instead of one
+    # per datagram.  Semantics per datagram are exactly send_datagram's.
+
 
 class UdpNonBlockingSocket:
     """Non-blocking UDP socket bound to 0.0.0.0:port
@@ -162,6 +169,19 @@ class UdpNonBlockingSocket:
             self.stats.send_errors += 1
             _OBS_SEND_ERRORS.inc()
             logger.debug("UDP send to %s failed transiently: %s", addr, e)
+
+    def send_datagram_batch(
+        self, items: List[Tuple[bytes, Tuple[str, int]]]
+    ) -> None:
+        """Flush many raw datagrams in one call (DESIGN.md §21): the
+        per-datagram semantics are exactly ``send_datagram``'s — transient
+        errnos count as loss and the flush continues, anything else
+        raises after the datagrams already sent (the same partial-send
+        window).  (Pools with an fd prefer ``ggrs_net_send_table``, which
+        skips this path entirely; this is the portable fallback.)"""
+        send = self.send_datagram
+        for data, addr in items:
+            send(bytes(data), addr)
 
     def receive_all_messages(self) -> List[Tuple[Tuple[str, int], Message]]:
         received: List[Tuple[Tuple[str, int], Message]] = []
@@ -323,6 +343,15 @@ class FakeSocket:
         """Raw sibling of ``send_to`` (same fault injection, no Message
         wrapper) — protocol parity with ``UdpNonBlockingSocket``."""
         self._network._send(self.addr, addr, bytes(data))
+
+    def send_datagram_batch(self, items) -> None:
+        """One call per tick flushing ``(data, addr)`` datagrams in order
+        (DESIGN.md §21) — same fault-injection path per datagram, so the
+        seeded rng stream is identical to per-datagram sends."""
+        send = self._network._send
+        me = self.addr
+        for data, addr in items:
+            send(me, addr, bytes(data))
 
     def receive_all_messages(self) -> List[Tuple[Hashable, Message]]:
         return self._network._receive(self.addr)

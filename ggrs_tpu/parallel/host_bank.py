@@ -59,6 +59,22 @@ datagram.  Fallback is per-slot and automatic: unattachable sockets
 (in-memory networks, wrappers without fileno, unresolvable addresses,
 non-Linux, GGRS_TPU_NO_NATIVE_IO) keep the exact Python shuttle below.
 
+DESCRIPTOR PLANE (DESIGN.md §21): the quiet tick's remaining per-slot
+Python is gone on both sides of the crossing.  ``stage_inputs`` stages
+all B local inputs through ONE ``ggrs_bank_stage_inputs`` crossing (a
+packed jump-table of staging records; the cmd stream then carries a
+flag byte per slot instead of inline input bytes); ``advance_all``
+returns a lazy :class:`RequestPlan` built from the tick output's two
+leading fixed-stride tables (the §19 header + a per-slot request
+descriptor), materializing a slot's pooled ``GgrsRequest`` objects only
+when indexed — ``BatchedRequestExecutor`` consumes the flat columns
+directly and builds its device dispatch with NumPy; and fast slots'
+outbound datagrams flush through one ``ggrs_net_send_table`` crossing
+(fd-backed sockets, zero-copy out of the tick output buffer) or one
+``send_datagram_batch`` call per socket.  Parity with the reference
+decoder (``GGRS_TPU_NO_FASTPATH=1``) is pinned by
+tests/test_descriptor_plane.py.
+
 OBSERVABILITY (PR 3, DESIGN.md §12): the pool is the obs subsystem's main
 instrumented surface.  Counters/gauges land in a ``ggrs_tpu.obs.Registry``
 (constructor argument; the process-wide default when omitted), a per-slot
@@ -162,10 +178,19 @@ _EV_CHECKSUM = 4
 # ticks reuse the same objects too) and jumps over the events / status
 # mirror / spectator-tail sections instead of parsing them positionally.
 _HDR_DTYPE = np.dtype(list(_native.BANK_HDR_FIELDS))
+# ---- descriptor plane (DESIGN.md §21) -----------------------------------
+# The request descriptor table (one fixed-stride record per slot, after the
+# header table), the batched input-staging record, and the batched outbound
+# send record — all mirrored from session_bank.cpp / net_batch.cpp and
+# pinned by the ggrs-verify layout contract.
+_REQ_DTYPE = np.dtype(list(_native.BANK_REQ_FIELDS))
+_STAGE_DTYPE = np.dtype(list(_native.BANK_STAGE_FIELDS))
+_SEND_DTYPE = np.dtype(list(_native.NET_SEND_FIELDS))
 # per-session command flag bytes (session_bank.cpp kFlag*, mirrored as
 # _native.CMD_FLAG_*; ggrs-verify pins the pairs equal)
 _CMD_INPUTS = bytes([_native.CMD_FLAG_INPUTS])
 _CMD_SKIP = bytes([_native.CMD_FLAG_SKIP])
+_CMD_STAGED = bytes([_native.CMD_FLAG_INPUTS | _native.CMD_FLAG_STAGED])
 # resume bundles cross process (and, with the fleet layer, host)
 # boundaries: pin the pickle protocol so a mixed-version fleet reads
 # every bundle.  This layer cannot import fleet, so the value re-declares
@@ -345,6 +370,99 @@ def _bank_eligible(builder, hub_active: bool = False) -> bool:
     return True
 
 
+class RequestPlan:
+    """One tick's request lists as a lazily-materializing sequence
+    (descriptor plane, DESIGN.md §21).
+
+    ``advance_all()`` returns this on the descriptor path.  It behaves
+    like the ``List[List[GgrsRequest]]`` it replaces — ``len``, indexing,
+    iteration, and in-place assignment all work — but a fast-path slot's
+    pooled ``GgrsRequest`` objects are only constructed when someone
+    actually indexes that slot (``plan[i]`` / ``pool.requests_for(i)``).
+    ``BatchedRequestExecutor`` never does: it consumes the flat descriptor
+    columns below directly and builds its device dispatch with NumPy,
+    constructing zero request objects for quiet slots.
+
+    Lifetime: like the pooled request lists before it, a plan is valid
+    until the NEXT ``advance_all`` on its pool (the columns view the
+    pool's reused output buffer).  Materializing a stale plan raises.
+
+    Executor-facing columns (all referring to the tick output buffer):
+
+    ``quiet_rows``/``quiet_frames``  slot indices whose tick is exactly
+        [save f, advance], and f per row;
+    ``resim_rows``  ``(slot, load_frame, n_adv, trailing, adv_off,
+        adv_stride)`` per rollback-resim slot (absolute buffer offsets);
+    ``save_only_rows``  ``(slot, frame)`` per prediction-limit slot;
+    ``eager_rows``  slots whose lists were materialized at build time
+        (slow/other/skip slots) — consume via ``plan[i]``;
+    ``gather_quiet()``  the quiet rows' advance payloads as
+        ``(statuses [k, players] u8, blobs [k, players, isize] u8)``,
+        one fancy-index gather, uniform pools only.
+    """
+
+    __slots__ = (
+        "pool", "tick_no", "lists", "buffer", "players", "input_size",
+        "uniform", "quiet_rows", "quiet_frames", "quiet_offs",
+        "quiet_adv_off", "resim_rows", "save_only_rows", "eager_rows",
+        "offs_l", "live_l",
+    )
+
+    def __init__(self, pool, n: int):
+        self.pool = pool
+        self.tick_no = pool._tick_no
+        self.lists: List[Optional[List[GgrsRequest]]] = [None] * n
+        self.buffer: Optional[np.ndarray] = None
+        self.players = 0
+        self.input_size = 0
+        self.uniform = False
+        self.quiet_rows: Optional[np.ndarray] = None
+        self.quiet_frames: Optional[np.ndarray] = None
+        self.quiet_offs: Optional[np.ndarray] = None
+        self.quiet_adv_off: Optional[np.ndarray] = None
+        self.resim_rows: List[Tuple[int, int, int, bool, int, int]] = []
+        self.save_only_rows: List[Tuple[int, int]] = []
+        self.eager_rows: List[int] = []
+        self.offs_l: List[int] = []
+        self.live_l: List[bool] = []
+
+    def __len__(self) -> int:
+        return len(self.lists)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            # list parity: a slice of request lists, members materialized
+            return [self[k] for k in range(*i.indices(len(self.lists)))]
+        lst = self.lists[i]
+        if lst is None:
+            lst = self.lists[i] = self.pool._materialize_slot(self, i)
+        return lst
+
+    def __setitem__(self, i: int, value: List[GgrsRequest]) -> None:
+        self.lists[i] = value
+
+    def __iter__(self):
+        for i in range(len(self.lists)):
+            yield self[i]
+
+    def saved_states(self, i: int):
+        """Slot ``i``'s ``SavedStates`` ring — where the executor's
+        descriptor path fulfills save cells without request objects."""
+        return self.pool._mirrors[i].saved_states
+
+    def gather_quiet(self) -> Tuple[np.ndarray, np.ndarray]:
+        """All quiet rows' advance payloads in one fancy-index gather."""
+        rows = self.quiet_rows
+        k = int(rows.size)
+        players, isize = self.players, self.input_size
+        base = self.quiet_offs + self.quiet_adv_off
+        span = players * (1 + isize)
+        flat = self.buffer[base[:, None] + np.arange(span)]
+        statuses = flat[:, :players]
+        blobs = flat[:, players:].reshape(k, players, isize)
+        return statuses, blobs
+
+
 class _EndpointMirror:
     """Python-side view of one bank endpoint: identity plus the state the
     consensus / event policy reads."""
@@ -399,6 +517,11 @@ class _SessionMirror:
         # the scrape records
         "mirror_len", "pooled_list", "pool_saves", "pool_loads",
         "pool_advs",
+        # descriptor plane (DESIGN.md §21): the set of handles staged
+        # NATIVELY this tick (ggrs_bank_stage_inputs — the blobs live in
+        # the bank, only membership is tracked here), the socket's batched
+        # raw-send entry when it has one, and the cached input encoder
+        "staged_native", "send_batch", "encode",
     )
 
     def __init__(self, config, socket, num_players, max_prediction,
@@ -437,6 +560,13 @@ class _SessionMirror:
                 RawMessage(data), addr
             )
         self.send_raw = send
+        # batched outbound (§21): one send_datagram_batch call per slot
+        # per tick when the socket offers it; None keeps the per-datagram
+        # send_raw path (wrapped/recording sockets — the reference leg)
+        self.send_batch = getattr(socket, "send_datagram_batch", None)
+        # batched staging (§21)
+        self.staged_native: set = set()
+        self.encode = config.input_encode
         # vectorized policy plane: filled by _finalize on the native path.
         # The pools grow to the deepest tick seen (rollback resims append
         # extra save/advance pairs) and are reused in place from then on.
@@ -536,6 +666,35 @@ class HostSessionPool:
         self._vectorized = False
         self.fast_slot_ticks = 0  # slots served by the fast path (counter)
         self.fast_ticks = 0       # ticks where every live slot was fast
+        # ---- descriptor plane (DESIGN.md §21) ----
+        # _has_req: the library emits the per-slot request descriptor
+        # table (and the vectorized decode returns a lazy RequestPlan);
+        # _has_stage: ggrs_bank_stage_inputs + the kFlagStaged cmd flag +
+        # the harvest staged tail are available (the stage_inputs batched
+        # staging API goes native).  Both probed like the header.
+        self._has_req = False
+        self._req_stride = 0
+        self._has_stage = False
+        self._uniform = False  # all mirrors share (players, input_size) —
+        # the executor's bulk input gather requires it
+        self.plan_ticks = 0        # advance_all calls decoded via a plan
+        self.desc_slow_slots = 0   # plan-tick slots that needed the eager
+        # per-slot reference decoder (slow/other/skip records)
+        # per-slot input stagers: add_local_input dispatches through this
+        # table (one bound callable per slot, rebuilt on supervision
+        # transitions) instead of re-validating slot state and handle
+        # membership on every call — the B-proportional staging walk fix
+        self._stagers: List[Any] = []
+        # the most recent descriptor-plane tick's RequestPlan (also what
+        # advance_all returned); requests_for() and the staleness guard
+        # read it
+        self._plan: Optional[RequestPlan] = None
+        # per-slot native outbound eligibility (§21c): a non-attached but
+        # fd-backed socket whose endpoint addresses resolve rides the
+        # one-crossing ggrs_net_send_table flush; everything else batches
+        # per slot (send_datagram_batch) or keeps the per-datagram path
+        self._send_fds: List[Optional[int]] = []
+        self._ep_wire: List[Optional[List[Tuple[int, int]]]] = []
         # ---- observability (DESIGN.md §12) ----
         # metrics: explicit Registry for isolation (tests, multi-pool
         # processes) or the process-wide default; Registry(enabled=False)
@@ -768,6 +927,23 @@ class HostSessionPool:
                     int(lib.ggrs_bank_hdr_stride()), _HDR_DTYPE.itemsize,
                 )
                 lib = None
+        if lib is not None and hasattr(lib, "ggrs_bank_req_stride"):
+            # the request descriptor table is emitted unconditionally by a
+            # descriptor-plane library, so a stride mismatch shifts EVERY
+            # body offset — same degradation as a header skew
+            if (
+                int(lib.ggrs_bank_req_stride()) != _REQ_DTYPE.itemsize
+                or int(lib.ggrs_bank_stage_stride()) != _STAGE_DTYPE.itemsize
+            ):
+                _logger.warning(
+                    "bank descriptor strides (req %d, stage %d) != driver "
+                    "(%d, %d) (library/driver skew); pool falls back to "
+                    "per-session Python sessions",
+                    int(lib.ggrs_bank_req_stride()),
+                    int(lib.ggrs_bank_stage_stride()),
+                    _REQ_DTYPE.itemsize, _STAGE_DTYPE.itemsize,
+                )
+                lib = None
         # The bank runs every session's timers off ONE clock read per tick
         # (builder 0's clock) — that is the pool's contract.  Builders whose
         # clocks are visibly on a different timebase (a frozen test clock
@@ -800,6 +976,9 @@ class HostSessionPool:
         if not eligible:
             for builder, socket in self._builders:
                 self._sessions.append(builder.start_p2p_session(socket))
+            self._stagers = [
+                self._make_stager(i) for i in range(len(self._builders))
+            ]
             return
 
         self._lib = lib
@@ -822,6 +1001,12 @@ class HostSessionPool:
         if self._has_hdr:
             self._hdr_stride = int(lib.ggrs_bank_hdr_stride())
             self._vectorized = not os.environ.get("GGRS_TPU_NO_FASTPATH")
+        # descriptor plane (§21): request descriptor table + batched
+        # staging + harvest staged tail (strides already skew-checked)
+        self._has_req = hasattr(lib, "ggrs_bank_req_stride")
+        if self._has_req:
+            self._req_stride = int(lib.ggrs_bank_req_stride())
+            self._has_stage = True
         # arm the in-crossing phase timers only when someone is tracing:
         # disarmed, the tick performs zero clock reads and emits the exact
         # pre-timing output layout (the on/off wire pin rides on this)
@@ -942,8 +1127,17 @@ class HostSessionPool:
             )
         self._out_buf = ctypes.create_string_buffer(
             max(1 << 16, per_session * len(self._mirrors)
-                + self._hdr_stride * len(self._mirrors))
+                + (self._hdr_stride + self._req_stride)
+                * len(self._mirrors))
         )
+        # uniform pools (every mirror shares (players, input_size)) unlock
+        # the executor's bulk input gather over the quiet rows
+        self._uniform = len({
+            (m.num_players, m.input_size) for m in self._mirrors
+        }) == 1
+        self._stagers = [
+            self._make_stager(i) for i in range(len(self._mirrors))
+        ]
         # ---- batched socket datapath (DESIGN.md §15) ----
         # opt-in, per-slot, and failure is always a clean per-slot fallback
         # to the Python shuttle — never an error.  net_lib() is None when
@@ -956,6 +1150,54 @@ class HostSessionPool:
             # slots the pump is semantically the tick but pays a per-tick
             # cmd re-parse for its pre-drain scan
             self._use_pump = any(self._io_attached)
+        # batched outbound eligibility (§21c) — after the io attach pass,
+        # so NetBatch-attached slots (whose sends never re-enter Python)
+        # are excluded
+        self._send_fds = [None] * len(self._mirrors)
+        self._ep_wire = [None] * len(self._mirrors)
+        for i in range(len(self._mirrors)):
+            self._refresh_send_fd(i)
+
+    def _refresh_send_fd(self, index: int) -> None:
+        """(Re)compute slot ``index``'s native batched-outbound
+        eligibility: an fd-backed, non-NetBatch-attached socket whose
+        endpoint addresses resolve to (ipv4, port) sends through the
+        one-crossing ``ggrs_net_send_table`` flush (§21c).  Everything
+        else — in-memory networks, wrapped sockets, unresolvable
+        addresses, non-Linux, GGRS_TPU_NO_NATIVE_IO — keeps the Python
+        batch/per-datagram paths."""
+        if not self._send_fds:
+            return
+        self._send_fds[index] = None
+        self._ep_wire[index] = None
+        m = self._mirrors[index]
+        lib = self._lib
+        if (
+            lib is None
+            or not hasattr(lib, "ggrs_net_send_table")
+            or not hasattr(lib, "ggrs_net_supported")
+            or not lib.ggrs_net_supported()
+            or os.environ.get("GGRS_TPU_NO_NATIVE_IO")
+            or self._io_attached[index]
+        ):
+            return
+        fileno = getattr(m.socket, "fileno", None)
+        if fileno is None:
+            return
+        try:
+            fd = fileno()
+        except Exception:
+            return
+        if not isinstance(fd, int) or fd < 0:
+            return
+        try:
+            wire = [
+                self._resolve_wire_addr(ep.addr) for ep in m.endpoints
+            ]
+        except (TypeError, ValueError, OSError):
+            return
+        self._send_fds[index] = fd
+        self._ep_wire[index] = wire
 
     @staticmethod
     def _resolve_wire_addr(addr) -> Tuple[int, int]:
@@ -1054,6 +1296,9 @@ class HostSessionPool:
             # last attached slot gone: drop back to the plain tick entry
             # (the pump's pre-drain scan would walk the cmd for nothing)
             self._use_pump = False
+        # the slot is back on the Python shuttle: it may now qualify for
+        # the batched one-crossing outbound flush instead
+        self._refresh_send_fd(index)
 
     # ------------------------------------------------------------------
     # per-tick API
@@ -1068,25 +1313,126 @@ class HostSessionPool:
     def __len__(self) -> int:
         return len(self._builders)
 
+    def _make_stager(self, index: int):
+        """One slot's input-staging dispatch (the B-proportional staging
+        walk fix, §21 satellite): the slot-state branch and the
+        handle→slot validation are resolved HERE, once per supervision
+        transition, instead of on every ``add_local_input`` call.  The
+        returned callable is what ``add_local_input`` (and the per-item
+        fallback of ``stage_inputs``) invokes."""
+        state = self._slot_state[index]
+        if state in (SLOT_DEAD, SLOT_MIGRATED):
+            def drop(handle, value):
+                return  # dead/migrated: accept and drop (nothing ticks)
+            return drop
+        if not self._native_active:
+            return self._sessions[index].add_local_input
+        if state == SLOT_EVICTED:
+            return self._evicted[index].add_local_input
+        m = self._mirrors[index]
+        local_set = m.local_handle_set
+        staged = m.staged_inputs
+        encode = m.encode
+
+        def stage(handle, value):
+            if handle not in local_set:
+                raise InvalidRequest(
+                    "The player handle you provided is not referring to a "
+                    "local player."
+                )
+            staged[handle] = encode(value)
+
+        return stage
+
     def add_local_input(self, index: int, handle: int, value) -> None:
         if not self._finalized:
             self._finalize()
-        state = self._slot_state[index]
-        if state in (SLOT_DEAD, SLOT_MIGRATED):
-            return  # dead/migrated slots accept and drop (nothing here ticks)
-        if not self._native_active:
-            self._sessions[index].add_local_input(handle, value)
+        self._stagers[index](handle, value)
+
+    def stage_inputs(self, items) -> None:
+        """Batched input staging (descriptor plane, DESIGN.md §21): stage
+        many ``(session_index, handle, value)`` local inputs in ONE native
+        crossing per pool tick instead of B ``add_local_input`` calls.
+
+        On the native descriptor path the encoded blobs go straight into
+        the bank via ``ggrs_bank_stage_inputs`` — one packed fixed-stride
+        table plus a joined payload (the PR 10 jump-table idiom) — and the
+        tick's command stream carries a flag byte per slot instead of the
+        inline input bytes.  Slots that are not bank-resident (evicted,
+        dead, the whole-pool Python fallback) route through their per-slot
+        stager, so the call is always semantically ``add_local_input`` per
+        item.  Per slot per tick, inputs must come entirely through ONE
+        mechanism — ``add_local_input`` staging after ``stage_inputs`` for
+        the same slot makes the inline path win and drops the native
+        staging for that slot (both sides discard it in lockstep)."""
+        if not self._finalized:
+            self._finalize()
+        if not (self._native_active and self._has_stage):
+            stagers = self._stagers
+            for index, handle, value in items:
+                stagers[index](handle, value)
             return
-        if state == SLOT_EVICTED:
-            self._evicted[index].add_local_input(handle, value)
+        mirrors = self._mirrors
+        slot_state = self._slot_state
+        slots: List[int] = []
+        handles: List[int] = []
+        blobs: List[bytes] = []
+        lens: List[int] = []
+        # pass 1: validate + encode EVERYTHING before any state mutates —
+        # a bad item mid-list must leave the pool exactly as it was (a
+        # partially-updated staged_native set would make the next
+        # advance_all emit kFlagStaged for a slot the bank never staged,
+        # poisoning the whole pool with kBankErrCmd)
+        for index, handle, value in items:
+            if slot_state[index] != SLOT_NATIVE:
+                self._stagers[index](handle, value)
+                continue
+            m = mirrors[index]
+            if handle not in m.local_handle_set:
+                raise InvalidRequest(
+                    "The player handle you provided is not referring to a "
+                    "local player."
+                )
+            blob = m.encode(value)
+            if len(blob) != m.input_size:
+                raise InvalidRequest(
+                    f"encoded input is {len(blob)} bytes but slot "
+                    f"{index}'s input size is {m.input_size}"
+                )
+            slots.append(index)
+            handles.append(handle)
+            blobs.append(blob)
+            lens.append(len(blob))
+        n = len(slots)
+        if not n:
             return
-        m = self._mirrors[index]
-        if handle not in m.local_handle_set:
+        desc = np.empty(n, _STAGE_DTYPE)
+        desc["slot"] = slots
+        desc["handle"] = handles
+        desc["frame"] = NULL_FRAME
+        lens_arr = np.asarray(lens, np.uint32)
+        desc["len"] = lens_arr
+        offs = np.zeros(n, np.uint32)
+        np.cumsum(lens_arr[:-1], out=offs[1:])
+        desc["off"] = offs
+        payload = b"".join(blobs)
+        rc = self._lib.ggrs_bank_stage_inputs(
+            self._bank, desc.ctypes.data, n, payload, len(payload)
+        )
+        if rc < 0:
+            # should be unreachable after the validation above (a native
+            # reject means this builder drifted from the bank): drop the
+            # Python-side membership so the next tick takes the inline
+            # path — the bank discards its partial staging on
+            # !kFlagStaged — instead of a poisoned kFlagStaged cmd
+            for index in slots:
+                mirrors[index].staged_native.clear()
             raise InvalidRequest(
-                "The player handle you provided is not referring to a local "
-                "player."
+                f"ggrs_bank_stage_inputs rejected the staging table "
+                f"({rc}): slot/handle/length mismatch"
             )
-        m.staged_inputs[handle] = m.config.input_encode(value)
+        for index, handle in zip(slots, handles):
+            mirrors[index].staged_native.add(handle)
 
     def advance_all(self) -> List[List[GgrsRequest]]:
         """Run every session's tick (poll + advance); returns the B request
@@ -1109,6 +1455,10 @@ class HostSessionPool:
         # destructive step (ctrl-op swap, socket drain): raising mid-build
         # would silently lose pending disconnect ops and drained datagrams
         # on a caller retry.  (Evicted sessions enforce their own contract.)
+        # A slot's inputs come through ONE mechanism per tick: the inline
+        # staged dict (add_local_input) when non-empty, else the native
+        # staging set (stage_inputs, §21) when complete.
+        use_staged: List[bool] = [False] * len(self._mirrors)
         for i, m in enumerate(self._mirrors):
             state = self._slot_state[i]
             if state == SLOT_EVICTED:
@@ -1119,12 +1469,34 @@ class HostSessionPool:
                 continue
             if state not in (SLOT_NATIVE, SLOT_QUARANTINED):
                 continue
-            for handle in m.local_handles:
-                if handle not in m.staged_inputs:
-                    raise InvalidRequest(
-                        f"Missing local input for handle {handle} while "
-                        "calling advance_frame()."
-                    )
+            if not m.local_handles:
+                continue  # nothing to stage: the inline path sends the
+                # plain flag byte with zero input bytes, as always
+            if m.staged_inputs:
+                for handle in m.local_handles:
+                    if handle not in m.staged_inputs:
+                        raise InvalidRequest(
+                            f"Missing local input for handle {handle} "
+                            "while calling advance_frame()."
+                        )
+                if m.staged_native:
+                    # inline wins: the native copy is stale and the bank
+                    # drops it at slot-tick start on the !kFlagStaged path
+                    m.staged_native.clear()
+            elif (
+                self._has_stage
+                and len(m.staged_native) == len(m.local_handles)
+            ):
+                use_staged[i] = True
+            else:
+                missing = next(
+                    h for h in m.local_handles
+                    if h not in m.staged_native
+                )
+                raise InvalidRequest(
+                    f"Missing local input for handle {missing} while "
+                    "calling advance_frame()."
+                )
         # snapshot which slots the bank steps this tick: the parse below
         # must use the build-time view even if new faults land mid-parse
         ticked = [s == SLOT_NATIVE for s in self._slot_state]
@@ -1133,8 +1505,15 @@ class HostSessionPool:
             if not ticked[i]:
                 cmd_parts.append(_CMD_SKIP)  # no fields follow
                 continue
-            cmd_parts.append(_CMD_INPUTS)
-            cmd_parts.extend(m.staged_inputs[h] for h in m.local_handles)
+            if use_staged[i]:
+                # batched staging (§21): the bank already holds this
+                # slot's input bytes — the cmd carries only the flag
+                cmd_parts.append(_CMD_STAGED)
+            else:
+                cmd_parts.append(_CMD_INPUTS)
+                cmd_parts.extend(
+                    m.staged_inputs[h] for h in m.local_handles
+                )
             ctrl = m.pending_ctrl
             m.pending_ctrl = []
             inj = self._inject_err.pop(i, None)
@@ -1208,6 +1587,18 @@ class HostSessionPool:
                 off = t_cross
                 phases = self._parse_timing_tail()
                 for name, ns in phases:
+                    if name == "staging":
+                        # staging accrued OUTSIDE the tick window (the
+                        # stage_inputs crossings since the last tick): a
+                        # sibling span ending at the crossing start, never
+                        # nested inside it — the in-crossing phases still
+                        # sum to the measured crossing time
+                        if ns:
+                            tracer.add_complete(
+                                "bank.staging", t_cross - ns, ns,
+                                cat="native",
+                            )
+                        continue
                     if ns:
                         tracer.add_complete(
                             f"bank.{name}", off, ns, cat="native"
@@ -1219,15 +1610,19 @@ class HostSessionPool:
             # (a bug in THIS builder, no per-session blame possible)
             self._invalid = f"ggrs_bank_tick failed: {rc}"
             raise RuntimeError(self._invalid)
-        # decode: the vectorized header-classified path by default
-        # (DESIGN.md §19); the legacy sequential parse under tracing (the
-        # per-slot spans ARE the point), on pre-header libraries, and
+        # decode: the descriptor plane's lazy RequestPlan by default
+        # (DESIGN.md §21 — classification AND request programs read from
+        # the two flat tables, request objects only materialized on
+        # demand); the legacy sequential parse under tracing (the
+        # per-slot spans ARE the point), on pre-descriptor libraries, and
         # under GGRS_TPU_NO_FASTPATH (the parity fuzz's reference leg)
-        if self._vectorized and not tracing:
-            request_lists, retire_mask = self._parse_output_fast(ticked)
+        if self._vectorized and self._has_req and not tracing:
+            request_lists, retire_mask = self._parse_output_plan(ticked)
+            self._plan = request_lists
         else:
             request_lists = self._parse_output(ticked)
             retire_mask = None
+            self._plan = None
         self._supervise(request_lists, retire_mask)
         if tracing:
             tracer.add_complete("pool.tick", t_tick,
@@ -1253,7 +1648,9 @@ class HostSessionPool:
         tracing-mode parse — per-slot spans are the point of a traced
         tick."""
         buf = memoryview(self._out_buf).cast("B")[: self._out_len.value]
-        pos = len(self._mirrors) * self._hdr_stride if self._has_hdr else 0
+        pos = len(self._mirrors) * (
+            self._hdr_stride + self._req_stride
+        ) if self._has_hdr else 0
         request_lists: List[List[GgrsRequest]] = []
         tracer = self.tracer
         tracing = tracer.enabled
@@ -1270,225 +1667,384 @@ class HostSessionPool:
                 )
         return request_lists
 
-    def _parse_output_fast(self, ticked: List[bool]):
-        """Vectorized tick decode (DESIGN.md §19): classify all B slots
-        from the packed header table with a handful of NumPy ops, then
-        fast-path every QUIET slot — live, ops exactly [save, advance], no
-        events / spectator streams / consensus / status changes.  A fast
-        slot's pooled ``SaveGameState``/``AdvanceFrame`` objects are
-        refilled in place (valid until the next ``advance_all``, like the
-        scrape records) and its body record is jumped over via the
-        header's rec_len; everything else goes through ``_parse_slot``,
-        the reference decoder, at its header-derived offset.
+    def _parse_output_plan(self, ticked: List[bool]):
+        """Descriptor-plane tick decode (DESIGN.md §21): classify all B
+        slots from the packed header table AND read their request
+        programs from the request descriptor table — both flat NumPy
+        views — then run only the irreducible per-slot work (outbound
+        sends, journal taps, the wait-recommendation policy, frame
+        mirrors) for fast slots, constructing ZERO request objects for
+        them.  The returned :class:`RequestPlan` materializes a slot's
+        pooled ``GgrsRequest`` list only when indexed;
+        ``BatchedRequestExecutor`` consumes the descriptor columns
+        directly instead.
 
-        Returns ``(request_lists, retire_mask)`` — retire_mask[i] is True
-        when slot i's endpoint liveness may have changed this tick (the
-        ``retire_dead_matches`` walk only looks at those), or None when
-        retirement is off."""
+        Outbound is batched (§21c): fast slots' datagrams go out through
+        one ``send_datagram_batch`` call per slot (in-memory / batchable
+        sockets), or ride ONE ``ggrs_net_send_table`` crossing for the
+        whole tick (fd-backed sockets that are not NetBatch-attached) —
+        the send-table payload is the tick output buffer itself, zero
+        copies.  Per-socket send order is unchanged (records stay in slot
+        order); slow slots keep the reference per-datagram path.
+
+        Returns ``(plan, retire_mask)`` like the legacy fast path."""
         mirrors = self._mirrors
         n = len(mirrors)
+        plan = RequestPlan(self, n)
         if n == 0:
-            return [], None
+            return plan, None
         hdr = np.frombuffer(self._out_buf, dtype=_HDR_DTYPE, count=n)
+        req = np.frombuffer(self._out_buf, dtype=_REQ_DTYPE, count=n,
+                            offset=n * self._hdr_stride)
         flags = hdr["flags"]
+        pattern = req["pattern"]
         fast = (flags & _HDR_FAST_MASK) == _HDR_FAST_WANT
-        n_fast = int(np.count_nonzero(fast))
-        base = n * self._hdr_stride
+        # a fast slot must also carry a CLASSIFIED request program —
+        # kReqOther (frame-0 double save, future shapes) takes the
+        # reference decoder so a wrong descriptor can never be consumed
+        fast &= pattern != _native.REQ_OTHER
+        base = n * (self._hdr_stride + self._req_stride)
         rec_len = hdr["rec_len"]
         offs = np.empty(n, np.int64)
         offs[0] = base
         if n > 1:
             offs[1:] = base + np.cumsum(rec_len[:-1], dtype=np.int64)
+        out_len = self._out_len.value
+        plan.buffer = np.frombuffer(self._out_buf, np.uint8, count=out_len)
+        plan.uniform = self._uniform
+        m0 = mirrors[0]
+        plan.players = m0.num_players
+        plan.input_size = m0.input_size
+        # the plan retains the per-slot offsets/liveness until the next
+        # advance_all: keep them as the numpy arrays (compact) and take
+        # throwaway int lists only for the hot loops below
+        plan.offs_l = offs
+        offs_l = offs.tolist()
+        fast_l = fast.tolist()
+        plan.live_l = fast
+        self.plan_ticks += 1
+        n_fast = int(np.count_nonzero(fast))
         if n_fast == 0:
-            # nothing quiet this tick: sequential reference parse (cheaper
-            # than per-slot dispatch when every slot is slow anyway)
-            request_lists = self._parse_output(ticked)
-        else:
-            buf = memoryview(self._out_buf).cast("B")[: self._out_len.value]
-            fast_l = fast.tolist()
-            offs_l = offs.tolist()
-            fa_l = hdr["fa"].tolist()
-            cur_l = hdr["current"].tolist()
-            conf_l = hdr["confirmed"].tolist()
-            flags_l = flags.tolist()
-            CONF = _native.BANK_HDR_CONF
-            unpack_from = struct.unpack_from
-            request_lists = []
-            recorders = self._recorders
-            n_save = n_load = n_adv = 0
+            # nothing fast this tick (fault storm, first tick's frame-0
+            # shapes): sequential reference parse of every slot — cheaper
+            # than the column extraction + two-pass walk below when every
+            # slot is slow anyway
+            buf = memoryview(self._out_buf).cast("B")[:out_len]
             for idx in range(n):
-                if not fast_l[idx]:
-                    requests, _, _ = self._parse_slot(
-                        buf, offs_l[idx], idx, ticked[idx]
-                    )
-                    request_lists.append(requests)
-                    continue
-                m = mirrors[idx]
-                off = offs_l[idx]
-                hf = flags_l[idx]
-                players, isize = m.num_players, m.input_size
-                decode = m.config.input_decode
-                rec = recorders[idx] if recorders else None
-                get_cell = m.saved_states.get_cell
-                # ---- ops: pooled per-kind request objects, refilled in
-                # place (rollback-resim ticks grow the pools once, then
-                # reuse) — no fresh dataclass/list per op ----
-                (n_ops,) = unpack_from("<H", buf, off + 33)
-                pos = off + 35
-                requests = m.pooled_list
-                requests.clear()
-                saves, loads, advs = (
-                    m.pool_saves, m.pool_loads, m.pool_advs
+                reqs, _, _ = self._parse_slot(
+                    buf, offs_l[idx], idx, ticked[idx]
                 )
-                si = li = ai = 0
-                advanced = False
-                blob_len = players * isize
-                for _ in range(n_ops):
-                    kind = buf[pos]
-                    pos += 1
-                    if kind == 2:
-                        if ai == len(advs):
-                            advs.append(AdvanceFrame(
-                                inputs=[None] * players
-                            ))
-                        adv = advs[ai]
-                        ai += 1
-                        inputs = adv.inputs
-                        bo = pos + players
-                        for p in range(players):
-                            inputs[p] = (
-                                decode(bytes(
-                                    buf[bo + p * isize:
-                                        bo + (p + 1) * isize]
-                                )),
-                                _STATUS[buf[pos + p]],
-                            )
-                        pos = bo + blob_len
-                        requests.append(adv)
-                        advanced = True
-                    else:
-                        (frame,) = unpack_from("<q", buf, pos)
-                        pos += 8
-                        cell = get_cell(frame)
-                        if kind == 0:
-                            if si == len(saves):
-                                saves.append(SaveGameState(
-                                    cell=None, frame=NULL_FRAME
-                                ))
-                            req = saves[si]
-                            si += 1
-                            n_save += 1
-                        else:
-                            assert cell.frame == frame, (
-                                f"rollback loads frame {frame} but its "
-                                f"cell holds {cell.frame} — was the save "
-                                "fulfilled?"
-                            )
-                            if li == len(loads):
-                                loads.append(LoadGameState(
-                                    cell=None, frame=NULL_FRAME
-                                ))
-                            req = loads[li]
-                            li += 1
-                            n_load += 1
-                            self._m_rollbacks.inc()
-                            if rec is not None:
-                                rec.record(
-                                    self._tick_no, EV_ROLLBACK,
-                                    f"load frame {frame} (was at "
-                                    f"{m.current_frame})",
-                                )
-                        req.cell = cell
-                        req.frame = frame
-                        requests.append(req)
-                        advanced = False
-                n_adv += ai
-                # ---- outbound sends: same loop as the reference decoder
-                # (the two sections are 4 zero bytes on io/attached or
-                # sendless ticks) ----
-                send_failed: Optional[str] = None
-                send_raw = m.send_raw
-                endpoints = m.endpoints
-                for _section in (0, 1):
-                    (n_out,) = unpack_from("<H", buf, pos)
-                    pos += 2
-                    for _ in range(n_out):
-                        ep_idx, dlen = unpack_from("<HI", buf, pos)
-                        pos += 6
-                        if send_failed is not None:
-                            pos += dlen
-                            continue
-                        data = bytes(buf[pos : pos + dlen])
+                plan.lists[idx] = reqs
+                plan.eager_rows.append(idx)
+            self.desc_slow_slots += n
+            plan.quiet_rows = np.empty(0, np.int64)
+            plan.quiet_frames = np.empty(0, np.int64)
+            plan.quiet_offs = np.empty(0, np.int64)
+            plan.quiet_adv_off = np.empty(0, np.int64)
+            retire_mask = None
+            if self.retire_dead_matches:
+                retire_mask = [True] * n  # every slot was slow-parsed
+            return plan, retire_mask
+
+        # executor-facing columns (views into this tick's tables — valid,
+        # like the plan itself, until the next advance_all)
+        quiet = fast & (pattern == _native.REQ_QUIET)
+        plan.quiet_rows = np.flatnonzero(quiet)
+        plan.quiet_frames = req["frame"][quiet]
+        plan.quiet_offs = offs[quiet]
+        plan.quiet_adv_off = req["adv_off"][quiet].astype(np.int64)
+
+        # request-kind metrics, vectorized from the descriptor columns
+        # (eager slots count inside _parse_slot as before)
+        resim = fast & (pattern == _native.REQ_RESIM)
+        save_only = fast & (pattern == _native.REQ_SAVE_ONLY)
+        trailing = (req["rflags"] & _native.REQ_FLAG_TRAILING_ADV) != 0
+        n_adv_col = req["n_adv"].astype(np.int64)
+        n_quiet = int(plan.quiet_rows.size)
+        n_resim = int(np.count_nonzero(resim))
+        n_save = n_quiet + int(np.count_nonzero(save_only)) + int(
+            (n_adv_col[resim] - trailing[resim]).sum()
+        )
+        n_adv_total = n_quiet + int(n_adv_col[resim].sum())
+        if n_save:
+            self._m_req_save.inc(n_save)
+        if n_resim:
+            self._m_req_load.inc(n_resim)
+            self._m_rollbacks.inc(n_resim)
+        if n_adv_total:
+            self._m_req_advance.inc(n_adv_total)
+
+        buf = memoryview(self._out_buf).cast("B")[:out_len]
+        fa_l = hdr["fa"].tolist()
+        cur_l = hdr["current"].tolist()
+        conf_l = hdr["confirmed"].tolist()
+        flags_l = flags.tolist()
+        pattern_l = pattern.tolist()
+        trailing_l = trailing.tolist()
+        ops_end_l = req["ops_end"].tolist()
+        # plain-int columns once, not per-row structured indexing (resim
+        # ticks visit hundreds of rows on a rollback-heavy pool)
+        rframe_l = req["frame"].tolist()
+        n_adv_l = req["n_adv"].tolist()
+        adv_off_l = req["adv_off"].tolist()
+        adv_stride_l = req["adv_stride"].tolist()
+        CONF = _native.BANK_HDR_CONF
+        unpack_from = struct.unpack_from
+        recorders = self._recorders
+        lists = plan.lists
+        eager = plan.eager_rows
+
+        # ---- pass 1: eager slots through the reference decoder; fast
+        # slots' outbound staged/sent + per-slot pass-2 work queued ----
+        table_rows: List[Tuple[int, int, int, int, int]] = []  # native tbl
+        table_slots: List[int] = []
+        pass2: List[Tuple[int, int]] = []  # (slot, pos after out sections)
+        flush_failed: Dict[int, str] = {}
+        for idx in range(n):
+            if not fast_l[idx]:
+                requests, _, _ = self._parse_slot(
+                    buf, offs_l[idx], idx, ticked[idx]
+                )
+                lists[idx] = requests
+                eager.append(idx)
+                continue
+            m = mirrors[idx]
+            off = offs_l[idx]
+            pos = off + ops_end_l[idx]
+            rec = recorders[idx] if recorders else None
+            fd = self._send_fds[idx]
+            wire = self._ep_wire[idx]
+            batch: Optional[List[Tuple[Any, Any]]] = (
+                [] if (fd is None and m.send_batch is not None) else None
+            )
+            send_raw = m.send_raw
+            endpoints = m.endpoints
+            failed: Optional[str] = None
+            for _section in (0, 1):
+                (n_out,) = unpack_from("<H", buf, pos)
+                pos += 2
+                for _ in range(n_out):
+                    ep_idx, dlen = unpack_from("<HI", buf, pos)
+                    pos += 6
+                    if failed is not None:
                         pos += dlen
-                        if rec is not None:
-                            rec.record(self._tick_no, EV_WIRE,
-                                       (ep_idx, dlen, zlib.crc32(data)))
+                        continue
+                    if rec is not None:
+                        # forensics caveat: on the BATCHED tiers the
+                        # flush outcome is only known after the whole
+                        # slot staged, so a mid-flush fatal leaves EV_WIRE
+                        # entries for datagrams that never hit the wire —
+                        # always bounded by the EV_FAULT marker the flush
+                        # failure records right after them
+                        rec.record(
+                            self._tick_no, EV_WIRE,
+                            (ep_idx, dlen,
+                             zlib.crc32(buf[pos : pos + dlen])),
+                        )
+                    if fd is not None:
+                        # native send table: the datagram bytes stay in
+                        # the output buffer; only (fd, addr, off, len) is
+                        # recorded — flushed once for the whole tick
+                        ip, port = wire[ep_idx]
+                        table_rows.append((fd, ip, port, pos, dlen))
+                        table_slots.append(idx)
+                    elif batch is not None:
+                        batch.append(
+                            (buf[pos : pos + dlen], endpoints[ep_idx].addr)
+                        )
+                    else:
                         try:
-                            send_raw(data, endpoints[ep_idx].addr)
+                            send_raw(bytes(buf[pos : pos + dlen]),
+                                     endpoints[ep_idx].addr)
                         except Exception as e:
-                            send_failed = f"socket send failed: {e!r}"
-                if hf & CONF:
-                    # journal tap: read the confirmed-record section
-                    # directly (no spectators on a fast slot, so the
-                    # intervening sections are fixed-size)
-                    pos += 2 + m.mirror_len  # n_events(=0) + status mirrors
-                    (next_spec,) = unpack_from("<q", buf, pos)
-                    m.next_spec_frame = next_spec
-                    pos += 9 + 4  # + n_specs(=0) + n_spec_out/evts(=0)
-                    (n_conf,) = unpack_from("<H", buf, pos)
-                    pos += 2
-                    (conf_start,) = unpack_from("<q", buf, pos)
-                    pos += 8
-                    conf_records = []
-                    for _ in range(n_conf):
-                        cflags = bytes(buf[pos : pos + players])
-                        pos += players
-                        conf_records.append(
-                            (cflags, bytes(buf[pos : pos + blob_len]))
+                            failed = f"socket send failed: {e!r}"
+                    pos += dlen
+            if failed is None and batch:
+                # one batched call per slot per tick (§21c): the socket
+                # walks the list internally — same per-socket send order
+                try:
+                    m.send_batch(batch)
+                except Exception as e:
+                    failed = f"socket send failed: {e!r}"
+            if failed is not None:
+                flush_failed[idx] = failed
+            pass2.append((idx, pos))
+
+        # ---- the one native outbound crossing for fd-backed slots ----
+        if table_rows:
+            desc = np.empty(len(table_rows), _SEND_DTYPE)
+            cols = list(zip(*table_rows))
+            desc["fd"] = cols[0]
+            desc["ip"] = cols[1]
+            desc["port"] = cols[2]
+            desc["pad"] = 0
+            desc["off"] = cols[3]
+            desc["len"] = cols[4]
+            stats3 = (ctypes.c_uint64 * 3)()
+            fatal = (ctypes.c_int32 * 32)()
+            rc = self._lib.ggrs_net_send_table(
+                desc.ctypes.data, len(table_rows), self._out_buf, out_len,
+                stats3, fatal, 16,
+            )
+            if rc < 0:
+                # table refused whole (corrupt offsets = builder bug):
+                # fault every participating slot rather than lose sends
+                # silently (dict.fromkeys: deterministic slot order)
+                for idx in dict.fromkeys(table_slots):
+                    flush_failed.setdefault(
+                        idx, f"ggrs_net_send_table failed: {rc}"
+                    )
+            else:
+                for k in range(min(rc, 16)):
+                    slot = table_slots[fatal[2 * k]]
+                    flush_failed.setdefault(
+                        slot,
+                        "socket send failed: batched flush errno "
+                        f"{fatal[2 * k + 1]}",
+                    )
+                if rc > 16:
+                    # more fatal fds than the report buffer holds (a
+                    # host-wide EPERM-class condition): the unreported
+                    # slots' datagrams were abandoned too — fault them
+                    # ALL rather than let ~B-16 slots run policy on
+                    # sends that never happened
+                    for idx in dict.fromkeys(table_slots):
+                        flush_failed.setdefault(
+                            idx,
+                            "socket send failed: batched flush fatal "
+                            f"overflow ({rc} fatal fds)",
                         )
-                        pos += blob_len
-                    sink = self._journal_sinks.get(idx)
-                    if sink is not None:
-                        sink.append_frames(conf_start, conf_records)
-                # ---- policy (the quiet-slot subset: no events, no
+            if self._obs_on and stats3[1]:
+                self._m_io_send_errors.inc(int(stats3[1]))
+            if self._obs_on and stats3[2]:
+                self._m_io_oversized.inc(int(stats3[2]))
+
+        # ---- pass 2: journal taps, policy, frame mirrors, forensics ----
+        for idx, pos in pass2:
+            m = mirrors[idx]
+            failed = idx in flush_failed
+            if failed:
+                # reference-decoder parity (_parse_slot): a send fault
+                # suppresses the slot's requests and policy, but the
+                # journal tap below still appends (the confirmed records
+                # are in hand — dropping them would gap the journal) and
+                # the frame mirrors still update; staged inputs are KEPT
+                # for the eviction path.  Natively-staged inputs were
+                # already consumed by the crossing's trailing advance —
+                # reconstruct them into the inline dict from the advance
+                # payload in the tick output, what eviction will re-feed
+                # (the reference leg keeps its dict the same way).  With
+                # input_delay > 0 the payload carries the DELAYED frame's
+                # value rather than this tick's — a documented
+                # approximation on this fault-within-a-fault corner; it
+                # keeps eviction fed instead of raising, and delay-0
+                # pools (the common case) re-feed the exact reference
+                # bytes.
+                if m.staged_native and trailing_l[idx]:
+                    isize = m.input_size
+                    po = offs_l[idx] + adv_off_l[idx]
+                    if pattern_l[idx] == _native.REQ_RESIM:
+                        po += (n_adv_l[idx] - 1) * adv_stride_l[idx]
+                    bo = po + m.num_players
+                    for h in m.local_handles:
+                        m.staged_inputs[h] = bytes(
+                            buf[bo + h * isize : bo + (h + 1) * isize]
+                        )
+                    m.staged_native.clear()
+                self._on_slot_fault(idx, 0, flush_failed[idx])
+                lists[idx] = []
+            hf = flags_l[idx]
+            players, isize = m.num_players, m.input_size
+            blob_len = players * isize
+            if hf & CONF:
+                # journal tap: read the confirmed-record section directly
+                # (no spectators on a fast slot, so the intervening
+                # sections are fixed-size)
+                pos += 2 + m.mirror_len  # n_events(=0) + status mirrors
+                (next_spec,) = unpack_from("<q", buf, pos)
+                m.next_spec_frame = next_spec
+                pos += 9 + 4  # + n_specs(=0) + n_spec_out/evts(=0)
+                (n_conf,) = unpack_from("<H", buf, pos)
+                pos += 2
+                (conf_start,) = unpack_from("<q", buf, pos)
+                pos += 8
+                conf_records = []
+                for _ in range(n_conf):
+                    cflags = bytes(buf[pos : pos + players])
+                    pos += players
+                    conf_records.append(
+                        (cflags, bytes(buf[pos : pos + blob_len]))
+                    )
+                    pos += blob_len
+                sink = self._journal_sinks.get(idx)
+                if sink is not None:
+                    sink.append_frames(conf_start, conf_records)
+            current = cur_l[idx]
+            if not failed:
+                pat = pattern_l[idx]
+                if pat == _native.REQ_RESIM:
+                    lf = rframe_l[idx]
+                    plan.resim_rows.append((
+                        idx, lf, n_adv_l[idx], trailing_l[idx],
+                        offs_l[idx] + adv_off_l[idx], adv_stride_l[idx],
+                    ))
+                    rec = recorders[idx] if recorders else None
+                    if rec is not None:
+                        rec.record(
+                            self._tick_no, EV_ROLLBACK,
+                            f"load frame {lf} (was at {m.current_frame})",
+                        )
+                elif pat == _native.REQ_SAVE_ONLY:
+                    plan.save_only_rows.append((idx, rframe_l[idx]))
+                # ---- policy (the fast-slot subset: no events, no
                 # consensus — just the wait recommendation) ----
-                current = cur_l[idx]
-                if send_failed is not None:
-                    self._on_slot_fault(idx, 0, send_failed)
-                    requests = []
-                else:
-                    fa = fa_l[idx]
-                    m.frames_ahead = fa
-                    pre_current = current - (1 if advanced else 0)
-                    if (
-                        pre_current > m.next_recommended_sleep
-                        and fa >= MIN_RECOMMENDATION
-                    ):
-                        m.next_recommended_sleep = (
-                            pre_current + RECOMMENDATION_INTERVAL
-                        )
-                        m.push_event((_LZ_WAIT, fa))
-                    if advanced:
+                advanced = trailing_l[idx]
+                fa = fa_l[idx]
+                m.frames_ahead = fa
+                pre_current = current - (1 if advanced else 0)
+                if (
+                    pre_current > m.next_recommended_sleep
+                    and fa >= MIN_RECOMMENDATION
+                ):
+                    m.next_recommended_sleep = (
+                        pre_current + RECOMMENDATION_INTERVAL
+                    )
+                    m.push_event((_LZ_WAIT, fa))
+                if advanced:
+                    if m.staged_inputs:
                         m.staged_inputs.clear()
-                m.current_frame = current
-                m.last_confirmed = conf_l[idx]
-                request_lists.append(requests)
-            self.fast_slot_ticks += n_fast
-            self._m_fast_slots.inc(n_fast)
-            if n_save:
-                self._m_req_save.inc(n_save)
-            if n_load:
-                self._m_req_load.inc(n_load)
-            if n_adv:
-                self._m_req_advance.inc(n_adv)
-            # "every LIVE slot was fast": skip records (quarantined /
-            # evicted / dead slots) are never fast and must not pin this
-            # counter at zero for the rest of a degraded pool's life
-            n_skip = int(np.count_nonzero(
-                (flags & _native.BANK_HDR_SKIP) != 0
-            ))
-            if n_fast == n - n_skip:
-                self.fast_ticks += 1
+                    if m.staged_native:
+                        m.staged_native.clear()
+            m.current_frame = current
+            m.last_confirmed = conf_l[idx]
+
+        if flush_failed:
+            # a faulted fast slot's device program must be suppressed
+            # exactly like its requests: prune it from the executor-facing
+            # quiet columns and route it through the eager rows instead,
+            # so the executor reads plan[idx] — the empty list, or the
+            # evicted session's replacement if _supervise swaps it in
+            # this same tick
+            dead = np.fromiter(flush_failed, np.int64,
+                               count=len(flush_failed))
+            keep = ~np.isin(plan.quiet_rows, dead)
+            plan.quiet_rows = plan.quiet_rows[keep]
+            plan.quiet_frames = plan.quiet_frames[keep]
+            plan.quiet_offs = plan.quiet_offs[keep]
+            plan.quiet_adv_off = plan.quiet_adv_off[keep]
+            plan.eager_rows.extend(flush_failed)
+
+        self.fast_slot_ticks += n_fast
+        self.desc_slow_slots += n - n_fast
+        self._m_fast_slots.inc(n_fast)
+        # "every LIVE slot was fast": skip records (quarantined / evicted
+        # / dead slots) are never fast and must not pin this counter at
+        # zero for the rest of a degraded pool's life
+        n_skip = int(np.count_nonzero(
+            (flags & _native.BANK_HDR_SKIP) != 0
+        ))
+        if n_fast == n - n_skip:
+            self.fast_ticks += 1
         retire_mask = None
         if self.retire_dead_matches:
             # endpoint liveness can only have changed on a dirty or
@@ -1496,7 +2052,94 @@ class HostSessionPool:
             retire_mask = (
                 ((flags & _native.BANK_HDR_DIRTY) != 0) | ~fast
             ).tolist()
-        return request_lists, retire_mask
+        return plan, retire_mask
+
+    def requests_for(self, index: int) -> List[GgrsRequest]:
+        """The most recent tick's request list for slot ``index`` — the
+        lazy-materialization surface of the descriptor plane (§21).
+        Identical to indexing the object ``advance_all`` returned; valid,
+        like that object, until the next ``advance_all``."""
+        plan = self._plan
+        if plan is None:
+            raise InvalidRequest(
+                "no request plan: advance_all has not produced a "
+                "descriptor-plane tick yet"
+            )
+        return plan[index]
+
+    def _materialize_slot(self, plan: RequestPlan,
+                          idx: int) -> List[GgrsRequest]:
+        """Build slot ``idx``'s pooled ``GgrsRequest`` list from its body
+        record — the deferred half of the descriptor plane.  Pooled
+        per-kind objects are refilled in place (valid until the next
+        ``advance_all``, like the scrape records); metrics were already
+        counted from the descriptor columns at plan build."""
+        if plan.tick_no != self._tick_no or plan is not self._plan:
+            raise InvalidRequest(
+                "stale RequestPlan: request lists are only valid until "
+                "the next advance_all"
+            )
+        if not plan.live_l[idx]:
+            return []
+        m = self._mirrors[idx]
+        buf = memoryview(self._out_buf).cast("B")[: len(plan.buffer)]
+        off = plan.offs_l[idx]
+        unpack_from = struct.unpack_from
+        players, isize = m.num_players, m.input_size
+        decode = m.config.input_decode
+        get_cell = m.saved_states.get_cell
+        (n_ops,) = unpack_from("<H", buf, off + 33)
+        pos = off + 35
+        requests = m.pooled_list
+        requests.clear()
+        saves, loads, advs = m.pool_saves, m.pool_loads, m.pool_advs
+        si = li = ai = 0
+        blob_len = players * isize
+        for _ in range(n_ops):
+            kind = buf[pos]
+            pos += 1
+            if kind == 2:
+                if ai == len(advs):
+                    advs.append(AdvanceFrame(inputs=[None] * players))
+                adv = advs[ai]
+                ai += 1
+                inputs = adv.inputs
+                bo = pos + players
+                for p in range(players):
+                    inputs[p] = (
+                        decode(bytes(
+                            buf[bo + p * isize : bo + (p + 1) * isize]
+                        )),
+                        _STATUS[buf[pos + p]],
+                    )
+                pos = bo + blob_len
+                requests.append(adv)
+            else:
+                (frame,) = unpack_from("<q", buf, pos)
+                pos += 8
+                cell = get_cell(frame)
+                if kind == 0:
+                    if si == len(saves):
+                        saves.append(
+                            SaveGameState(cell=None, frame=NULL_FRAME)
+                        )
+                    req = saves[si]
+                    si += 1
+                else:
+                    assert cell.frame == frame, (
+                        f"rollback loads frame {frame} but its cell "
+                        f"holds {cell.frame} — was the save fulfilled?"
+                    )
+                    if li == len(loads):
+                        loads.append(
+                            LoadGameState(cell=None, frame=NULL_FRAME)
+                        )
+                    req = loads[li]
+                    li += 1
+                req.cell = cell
+                req.frame = frame
+                requests.append(req)
+        return requests
 
     def _parse_slot(self, buf, pos, idx, ticked_slot):
         """Positional parse of ONE slot's body record starting at
@@ -1745,6 +2388,22 @@ class HostSessionPool:
             if sink is not None:
                 sink.append_frames(conf_start, conf_records)
         if send_failed is not None:
+            if m.staged_native and advanced:
+                # batched staging (§21): the bank consumed the staged
+                # inputs on the trailing advance before the Python-side
+                # send failed — rebuild the inline dict from the decoded
+                # advance (encode∘decode is the identity for
+                # bank-eligible configs) so eviction re-feeds this
+                # tick's inputs exactly like the inline-staged reference
+                adv = next(
+                    (r for r in reversed(requests)
+                     if type(r) is AdvanceFrame), None,
+                )
+                if adv is not None:
+                    encode = m.encode
+                    for h in m.local_handles:
+                        m.staged_inputs[h] = encode(adv.inputs[h][0])
+                m.staged_native.clear()
             self._on_slot_fault(idx, 0, send_failed)
             live = False
 
@@ -1778,6 +2437,8 @@ class HostSessionPool:
                 m.push_event((_LZ_WAIT, frames_ahead))
             if advanced:
                 m.staged_inputs.clear()
+                if m.staged_native:
+                    m.staged_native.clear()
             if consensus:
                 self._run_consensus(m)
         if ticked_slot:
@@ -1930,6 +2591,10 @@ class HostSessionPool:
         if old == new_state:
             return
         self._slot_state[index] = new_state
+        # the staging router resolves slot state at transition time, not
+        # per call (§21 satellite) — rebuild this slot's dispatch
+        if self._stagers:
+            self._stagers[index] = self._make_stager(index)
         # incremental supervision: only quarantined/evicted slots need the
         # post-tick walk; dead/migrated slots need nothing and native
         # slots are the bank's business
@@ -2165,11 +2830,17 @@ class HostSessionPool:
                 JournalTap.ADDR, JournalTap(sink, m.config)
             )
         decode = m.config.input_decode
+        staged_native = h.get("staged_inputs") or {}
         for handle in m.local_handles:
             blob = m.staged_inputs.get(handle)
+            if blob is None:
+                # batched staging (§21): the blobs live in the bank; the
+                # harvest's staged tail is the authoritative copy
+                blob = staged_native.get(handle)
             if blob is not None:
                 session.add_local_input(handle, decode(blob))
         m.staged_inputs.clear()
+        m.staged_native.clear()
         # the evicted session routes through the same pooled-request /
         # lazy-event decode economics as the vectorized bank path: the
         # pool consumes its request list tick-synchronously (DESIGN.md
@@ -2294,6 +2965,18 @@ class HostSessionPool:
                     state=state, last_acked_frame=last_acked,
                     send_base=send_base, pending=pending,
                 ))
+        staged: Dict[int, bytes] = {}
+        if self._has_stage:
+            # staged-inputs tail (§21): inputs staged natively that no
+            # advance consumed — eviction/export re-feed them exactly
+            # like the Python-side staged dict
+            (n_staged,) = unpack_from("<B", b, pos)
+            pos += 1
+            for _ in range(n_staged):
+                (sh,) = unpack_from("<i", b, pos)
+                pos += 4
+                staged[sh] = b[pos : pos + isize]
+                pos += isize
         if pos != len(b):
             raise RuntimeError("harvest buffer layout mismatch")
         return dict(
@@ -2301,7 +2984,7 @@ class HostSessionPool:
             disconnect_frame=disc_frame, local_disc=local_disc,
             local_last=local_last, player_inputs=player_inputs,
             endpoints=endpoints, next_spectator_frame=next_spec,
-            spectators=spectators,
+            spectators=spectators, staged_inputs=staged,
         )
 
     def _adopt_spectators(self, session, builder, m: _SessionMirror,
@@ -2413,8 +3096,16 @@ class HostSessionPool:
                 for sp in m.spectators
             ],
             staged_inputs={
-                handle: bytes(blob)
-                for handle, blob in m.staged_inputs.items()
+                # native staging first (§21 harvest tail), inline staging
+                # wins on conflict (the same precedence advance_all uses)
+                **{
+                    int(sh): bytes(blob)
+                    for sh, blob in (h.get("staged_inputs") or {}).items()
+                },
+                **{
+                    handle: bytes(blob)
+                    for handle, blob in m.staged_inputs.items()
+                },
             },
         )
 
@@ -2437,6 +3128,7 @@ class HostSessionPool:
         if self._native_active and index < len(self._mirrors):
             m = self._mirrors[index]
             m.staged_inputs.clear()
+            m.staged_native.clear()
             m.event_queue.clear()
             m.pending_ctrl = []
             for sp in m.spectators:

@@ -140,6 +140,19 @@ class BatchedRequestExecutor:
                         collectives and scales linearly over ICI-attached
                         devices.  Descriptor arrays are built host-side and
                         split per-shard by ``shard_map``.
+    ``raw_inputs_to_array``  optional bulk twin of ``inputs_to_array`` for
+                        the descriptor plane (DESIGN.md §21): called as
+                        ``raw(blobs, statuses)`` with the ENCODED input
+                        bytes ``[k, players, input_size]`` (u8) and status
+                        codes ``[k, players]`` (u8) of k advances, it must
+                        return the ``[k, ...]`` array ``advance`` consumes —
+                        the vectorized equivalent of decoding each blob and
+                        calling ``inputs_to_array`` per slot.  With it set,
+                        a ``HostSessionPool`` RequestPlan's quiet slots are
+                        consumed as flat NumPy columns: zero ``GgrsRequest``
+                        objects, zero per-slot ``input_decode`` calls.
+                        Without it, plans still work (per-slot
+                        materialization — the reference semantics).
     """
 
     def __init__(
@@ -152,13 +165,22 @@ class BatchedRequestExecutor:
         max_burst: int,
         with_checksums: bool = True,
         mesh: Optional["jax.sharding.Mesh"] = None,
+        raw_inputs_to_array: Optional[
+            Callable[[np.ndarray, np.ndarray], np.ndarray]
+        ] = None,
     ) -> None:
         assert batch_size >= 1 and ring_length >= 2 and max_burst >= 1
         self.batch_size = batch_size
         self.ring_length = ring_length
         self.max_burst = max_burst
         self._inputs_to_array = inputs_to_array
+        self._raw_inputs = raw_inputs_to_array
         self._with_checksums = with_checksums
+        # descriptor plane (§21): per-(session, ring slot) pooled
+        # _BatchSlotRef/_LazyBatchChecksum pairs so the fast path's cell
+        # fulfillment allocates nothing at steady state.  (Descriptor
+        # buffers are deliberately NOT pooled — see _reset_desc.)
+        self._ref_rings: List[Optional[List[Any]]] = [None] * batch_size
         self.mesh = mesh
         if mesh is not None:
             assert batch_size % mesh.devices.size == 0, (
@@ -468,20 +490,177 @@ class BatchedRequestExecutor:
             )
         )
 
+    def _reset_desc(self) -> Dict[str, np.ndarray]:
+        """Fresh descriptor buffers for one tick.  NOT reused in place:
+        jax may alias host numpy buffers zero-copy (CPU backend) and the
+        dispatch is asynchronous, so mutating last tick's arrays while the
+        program may still read them corrupts the dispatch silently."""
+        return self._blank_desc()
+
+    def _fulfill_fast(self, cells, b: int, frame: Frame) -> None:
+        """Fulfill one save cell WITHOUT a SaveGameState object (the
+        descriptor path): pooled per-(session, ring slot) refs refilled in
+        place.  Refs are pooled per ring slot, not per session — an older
+        cell in a different slot must keep seeing its own frozen frame."""
+        ring = self._ref_rings[b]
+        if ring is None:
+            ring = self._ref_rings[b] = [
+                (_BatchSlotRef(self, b, -1), _LazyBatchChecksum(self, b, -1))
+                for _ in range(self.ring_length)
+            ]
+        slot = frame % self.ring_length
+        ref, cs = ring[slot]
+        ref.frame = frame
+        if self._with_checksums:
+            cs._frame = frame
+            cs._value = None
+        self._host_frames[b, slot] = frame
+        cells.get_cell(frame).save(
+            frame, ref, cs if self._with_checksums else None
+        )
+
+    def _fill_resim(self, plan, desc: Dict[str, np.ndarray], b: int,
+                    lf: int, n_adv: int, trailing: bool, adv_off: int,
+                    adv_stride: int) -> None:
+        """One rollback-resim slot straight from its descriptor row:
+        load→advance^N with interleaved saves, inputs gathered from the
+        tick output buffer — the ``load→advance^N→save`` program selection
+        of DESIGN.md §21, no request objects."""
+        cells = plan.saved_states(b)
+        cell = cells.get_cell(lf)
+        data = cell.data()
+        # the same two guards the request path applies through the
+        # LoadGameState cell: ownership and ring capacity
+        if not (
+            isinstance(data, _BatchSlotRef)
+            and data.owner is self
+            and data.index == b
+            and data.frame == lf
+        ):
+            raise ValueError(
+                f"session {b} loads frame {lf} from a cell this pool did "
+                f"not save ({data!r})"
+            )
+        held = self._host_frames[b, lf % self.ring_length]
+        if held != lf:
+            raise RuntimeError(
+                f"session {b}: rollback to frame {lf} but its ring slot "
+                f"holds frame {held} — ring_length={self.ring_length} is "
+                f"too small for this session's prediction window"
+            )
+        if n_adv > self.max_burst:
+            raise ValueError(
+                f"session {b}: tick carries more than max_burst="
+                f"{self.max_burst} advances"
+            )
+        desc["do_load"][b] = True
+        desc["load_frame"][b] = lf
+        desc["n_adv"][b] = n_adv
+        players, isize = plan.players, plan.input_size
+        span = players * (1 + isize)
+        buf = plan.buffer
+        raw = self._raw_inputs
+        for j in range(n_adv):
+            so = adv_off + j * adv_stride
+            st = buf[so : so + players]
+            blobs = buf[so + players : so + span].reshape(1, players, isize)
+            desc["inputs"][b, j] = raw(blobs, st[None])[0]
+            # every advance except (with a trailing live advance) the last
+            # is followed by a save of the frame it produced: lf + 1 + j
+            if (j < n_adv - 1) if trailing else True:
+                f = lf + 1 + j
+                desc["save_mask"][b, j] = True
+                desc["save_frame"][b, j] = f
+                self._fulfill_fast(cells, b, f)
+
+    def _run_plan(self, plan) -> None:
+        """The descriptor-plane run() (DESIGN.md §21): consume a
+        ``HostSessionPool`` RequestPlan's flat columns directly — quiet
+        slots fill the device descriptor vectorized, resim/save-only slots
+        fill it per row, and only the plan's eager (slow/other) slots go
+        through request materialization and the classic ``_parse``."""
+        pool = plan.pool
+        if plan.tick_no != pool._tick_no or plan is not pool._plan:
+            # the same staleness contract the materialization surface
+            # enforces: the columns view the pool's REUSED output buffer,
+            # so consuming an old plan would dispatch garbage silently
+            raise RuntimeError(
+                "stale RequestPlan: request plans are only valid until "
+                "the next advance_all"
+            )
+        rows = plan.quiet_rows
+        eager = list(plan.eager_rows)
+        vector = self._raw_inputs is not None and plan.uniform
+        desc = self._reset_desc()
+        any_work = bool(
+            rows.size or plan.resim_rows or plan.save_only_rows
+        ) or any(plan.lists[b] for b in eager)
+        if not any_work:
+            _OBS_EMPTY_TICKS.inc()
+            return
+        try:
+            with self.tracer.span("device.dispatch"):
+                if vector and rows.size:
+                    frames = plan.quiet_frames
+                    desc["pre_save"][rows] = True
+                    desc["pre_frame"][rows] = frames
+                    desc["n_adv"][rows] = 1
+                    statuses, blobs = plan.gather_quiet()
+                    desc["inputs"][rows, 0] = self._raw_inputs(
+                        blobs, statuses
+                    )
+                    # _fulfill_fast writes the _host_frames shadow too —
+                    # one writer for the ring tags
+                    for b, f in zip(rows.tolist(), frames.tolist()):
+                        self._fulfill_fast(plan.saved_states(b), b, f)
+                elif rows.size:
+                    # no bulk converter / non-uniform pool: quiet slots
+                    # materialize like any other (reference semantics)
+                    eager.extend(rows.tolist())
+                for b, f in plan.save_only_rows:
+                    desc["pre_save"][b] = True
+                    desc["pre_frame"][b] = f
+                    self._fulfill_fast(plan.saved_states(b), b, f)
+                for (b, lf, n_adv, trailing, adv_off,
+                     adv_stride) in plan.resim_rows:
+                    if vector:
+                        self._fill_resim(plan, desc, b, lf, n_adv,
+                                         trailing, adv_off, adv_stride)
+                    else:
+                        eager.append(b)
+                for b in eager:
+                    reqs = plan[b]
+                    if reqs:
+                        self._parse(b, reqs, desc)
+                _OBS_DISPATCHES.inc()
+                _OBS_ROLLBACK_LOADS.inc(int(desc["do_load"].sum()))
+                _OBS_BURST_DEPTH.observe(int(desc["n_adv"].max()))
+                self._carry = self._tick(self._carry, desc)
+        except BaseException as e:  # incl. KeyboardInterrupt mid-fill
+            self._invalid = f"{type(e).__name__}: {e}"
+            raise
+
     def run(self, request_lists: Sequence[List[GgrsRequest]]) -> None:
         """Fulfill all B sessions' request lists — ONE device dispatch (zero
         if every list is empty).  ``request_lists[b]`` belongs to session
-        ``b``; sessions with nothing to do this tick pass ``[]``."""
+        ``b``; sessions with nothing to do this tick pass ``[]``.
+
+        A ``HostSessionPool`` RequestPlan (the descriptor plane, §21) is
+        consumed through its flat columns — no ``GgrsRequest`` objects are
+        constructed for fast-path slots."""
         self._check_valid()
         if len(request_lists) != self.batch_size:
             raise ValueError(
                 f"run() got {len(request_lists)} request lists for a pool of "
                 f"{self.batch_size} sessions"
             )
+        if getattr(request_lists, "quiet_rows", None) is not None:
+            self._run_plan(request_lists)
+            return
         if all(not reqs for reqs in request_lists):
             _OBS_EMPTY_TICKS.inc()
             return
-        desc = self._blank_desc()
+        desc = self._reset_desc()
         # parse fulfills cells eagerly (the ring-capacity guard needs this
         # tick's pre-saves visible in device order — see _parse); if any
         # session's list fails to parse, or the dispatch itself fails,
@@ -600,10 +779,15 @@ class HostedPool:
 
     def tick(self, local_inputs: Sequence[Tuple[int, int, Any]]) -> None:
         """One pool tick: stage ``(session_index, handle, value)`` local
-        inputs, advance every session, fulfill every request list."""
-        add = self.host.add_local_input
-        for index, handle, value in local_inputs:
-            add(index, handle, value)
+        inputs (ONE batched native call on the descriptor plane, §21),
+        advance every session, fulfill every request list."""
+        stage = getattr(self.host, "stage_inputs", None)
+        if stage is not None:
+            stage(local_inputs)
+        else:
+            add = self.host.add_local_input
+            for index, handle, value in local_inputs:
+                add(index, handle, value)
         self.executor.run(self.host.advance_all())
 
     def block_until_ready(self) -> None:

@@ -59,6 +59,16 @@ constexpr int kNetErrBufferTooSmall = -11;  // wire_common kErrBufferTooSmall
 constexpr size_t kRecvBufSize = 4096;
 constexpr size_t kIdealMaxUdp = 508;
 
+// ---- one-shot batched send table (descriptor plane, DESIGN.md §21) ------
+// ggrs_net_send_table record stride: non-attached sockets (native_io off,
+// or sockets that could not attach) route their whole tick's outbound
+// through ONE crossing — per datagram: i32 fd, u32 ip (sin_addr.s_addr as
+// stored), u16 port (host order), u16 pad, u32 off, u32 len (off/len jump
+// into the shared payload, usually the tick output buffer itself).
+// Records for one fd must be contiguous (the pool emits per-slot runs);
+// stride and field order mirrored by _native.NET_SEND_FIELDS.
+constexpr size_t kSendStride = 20;
+
 // stat slots (mirrored as _native.IO_STAT_FIELDS + two 8-bucket
 // histograms; 22 u64 total, the per-slot io tail of ggrs_bank_stats)
 enum NetStat : int {
@@ -383,6 +393,123 @@ void ggrs_net_inject_send_errno(void* p, int err, int count) {
   nb->inject_count = count;
 }
 
+// One-shot batched send over ARBITRARY fds (descriptor plane, §21): no
+// NetBatch attach, no rings kept — the Python pool hands the whole tick's
+// non-attached outbound as one packed table (`desc`: n records of
+// kSendStride bytes; `payload`: the buffer the off/len fields index,
+// usually the tick output buffer itself, zero copies).  Consecutive
+// same-fd records group into sendmmsg windows, so a pool tick pays one
+// Python→C crossing total and ~one syscall per socket instead of one of
+// each per datagram.
+//
+// Errno semantics mirror UdpNonBlockingSocket.send_datagram exactly:
+// transient errnos count the datagram as lost (stats3[1]) and the flush
+// continues; a fatal errno abandons the REST OF THAT FD's run (the same
+// partial-send window a raising sendto leaves) and is reported as a
+// (record index, errno) pair in `fatal` so the caller can fault exactly
+// the owning slot; other fds keep flushing.  Oversized datagrams are
+// counted (stats3[2]), never blocked.  stats3 = {sent, transient_errors,
+// oversized}, accumulated (callers zero it).
+//
+// Returns the number of fatal pairs written (0 = clean), or
+// kNetErrBadArgs.  The caller must sort records so each fd forms one
+// contiguous run; a fatal fd seen again in a LATER run is retried (the
+// pool never emits split runs).
+int ggrs_net_send_table(const uint8_t* desc, int64_t n,
+                        const uint8_t* payload, size_t payload_len,
+                        uint64_t* stats3, int32_t* fatal, int fatal_cap) {
+  if (n < 0 || (n > 0 && (!desc || !payload || !stats3))) {
+    return kNetErrBadArgs;
+  }
+  constexpr int kWin = 64;
+  static thread_local std::vector<mmsghdr> msgs(kWin);
+  static thread_local std::vector<iovec> iov(kWin);
+  static thread_local std::vector<sockaddr_in> addr(kWin);
+  int n_fatal = 0;
+  int64_t i = 0;
+  auto rec = [&](int64_t k, int32_t* fd, uint32_t* ip, uint16_t* port,
+                 uint32_t* off, uint32_t* len) {
+    const uint8_t* p = desc + static_cast<size_t>(k) * kSendStride;
+    auto r32 = [&p](size_t at) {
+      uint32_t v = 0;
+      for (int b = 0; b < 4; ++b) {
+        v |= static_cast<uint32_t>(p[at + b]) << (8 * b);
+      }
+      return v;
+    };
+    *fd = static_cast<int32_t>(r32(0));
+    *ip = r32(4);
+    *port = static_cast<uint16_t>(p[8] | (p[9] << 8));
+    *off = r32(12);
+    *len = r32(16);
+  };
+  while (i < n) {
+    int32_t fd;
+    uint32_t ip, off, len;
+    uint16_t port;
+    rec(i, &fd, &ip, &port, &off, &len);
+    // the fd's contiguous run [i, run_end)
+    int64_t run_end = i;
+    while (run_end < n) {
+      int32_t fd2;
+      uint32_t ip2, off2, len2;
+      uint16_t port2;
+      rec(run_end, &fd2, &ip2, &port2, &off2, &len2);
+      if (fd2 != fd) break;
+      if (static_cast<size_t>(off2) + len2 > payload_len) {
+        return kNetErrBadArgs;  // corrupt table: refuse whole call
+      }
+      if (len2 > kIdealMaxUdp) stats3[2] += 1;
+      ++run_end;
+    }
+    int64_t j = i;
+    bool fd_fatal = false;
+    while (j < run_end) {
+      size_t win = static_cast<size_t>(run_end - j);
+      if (win > kWin) win = kWin;
+      for (size_t k = 0; k < win; ++k) {
+        int32_t fdk;
+        uint32_t ipk, offk, lenk;
+        uint16_t portk;
+        rec(j + static_cast<int64_t>(k), &fdk, &ipk, &portk, &offk, &lenk);
+        iov[k].iov_base = const_cast<uint8_t*>(payload) + offk;
+        iov[k].iov_len = lenk;
+        std::memset(&addr[k], 0, sizeof(sockaddr_in));
+        addr[k].sin_family = AF_INET;
+        addr[k].sin_addr.s_addr = ipk;
+        addr[k].sin_port = htons(portk);
+        std::memset(&msgs[k], 0, sizeof(mmsghdr));
+        msgs[k].msg_hdr.msg_iov = &iov[k];
+        msgs[k].msg_hdr.msg_iovlen = 1;
+        msgs[k].msg_hdr.msg_name = &addr[k];
+        msgs[k].msg_hdr.msg_namelen = sizeof(sockaddr_in);
+      }
+      int r = sendmmsg(fd, msgs.data(), static_cast<unsigned>(win), 0);
+      if (r < 0) {
+        if (errno == EINTR) continue;  // PEP 475: retry the window
+        if (transient_send_errno(errno)) {
+          stats3[1] += 1;  // the head datagram is lost; keep going
+          j += 1;
+          continue;
+        }
+        if (n_fatal < fatal_cap && fatal) {
+          fatal[2 * n_fatal] = static_cast<int32_t>(j);
+          fatal[2 * n_fatal + 1] = static_cast<int32_t>(errno);
+        }
+        ++n_fatal;
+        fd_fatal = true;
+        break;
+      }
+      stats3[0] += static_cast<uint64_t>(r);
+      j += r;
+      // r < win without errno: retry from the stall point next iteration
+    }
+    (void)fd_fatal;  // the rest of this fd's run was abandoned above
+    i = run_end;
+  }
+  return n_fatal;
+}
+
 }  // extern "C"
 
 #else  // !__linux__ -------------------------------------------------------
@@ -416,6 +543,10 @@ int ggrs_net_drain_capture(void*, uint8_t*, size_t, size_t* out_len) {
   return kNetErrUnsupported;
 }
 void ggrs_net_inject_send_errno(void*, int, int) {}
+int ggrs_net_send_table(const uint8_t*, int64_t, const uint8_t*, size_t,
+                        uint64_t*, int32_t*, int) {
+  return kNetErrUnsupported;
+}
 
 }  // extern "C"
 

@@ -149,6 +149,50 @@ constexpr uint64_t kNoAddr = ~uint64_t{0};
 constexpr uint8_t kFlagInputs = 1;  // local inputs present -> advance runs
 constexpr uint8_t kFlagSkip = 2;    // slot quarantined/evicted: no fields
                                     // follow; emit a status-only record
+constexpr uint8_t kFlagStaged = 4;  // local inputs were staged natively via
+                                    // ggrs_bank_stage_inputs: NO inline
+                                    // input bytes follow the flag byte
+
+// ---- batched input staging (descriptor plane, DESIGN.md §21) ------------
+// ggrs_bank_stage_inputs accepts ONE packed table per pool tick staging
+// every slot's local inputs before the crossing: a fixed-stride descriptor
+// table (the PR 10 packed-header idiom) whose records jump into a shared
+// payload blob — variable-length-ready even though today every record's
+// len must equal the slot's input_size.  Stride and field offsets are
+// mirrored by _native.BANK_STAGE_FIELDS; ggrs_bank_stage_stride() is the
+// presence/version probe for the whole descriptor plane (staging entry,
+// request-descriptor table, harvest staged tail).
+//   u32 slot, i32 handle, i64 frame (reserved; kNullFrame = "this tick"),
+//   u32 off, u32 len
+constexpr size_t kStageStride = 24;
+
+// ---- per-slot request descriptor table (descriptor plane, §21) ----------
+// A SECOND fixed-stride table follows the header table: one kReqStride
+// record per session describing the tick's request program so the pool —
+// and BatchedRequestExecutor — can build the device dispatch (program
+// selection, frames, input offsets) from flat NumPy reads, constructing
+// zero GgrsRequest objects on fast-path slots.  Patterns:
+//   kReqQuiet    ops are exactly [save f, advance]          (the steady state)
+//   kReqResim    ops are [load f, adv, (save, adv)*, save]  (+ trailing adv)
+//                with sequential save frames f+1.. — the rollback resim
+//   kReqSaveOnly ops are exactly [save f]                   (prediction limit)
+//   kReqEmpty    no ops (skip / faulted records)
+//   kReqOther    anything else (frame-0 double save, future shapes):
+//                consumers fall back to the generic op decoder
+// Fields (offsets mirrored by _native.BANK_REQ_FIELDS):
+//   u8 pattern, u8 rflags (bit0 = the tick ended on an advance op),
+//   u16 n_adv, u32 adv_off (record-relative offset of the FIRST advance
+//   op's status bytes), u32 adv_stride (byte distance between consecutive
+//   advances' status bytes), u32 ops_end (record-relative offset just past
+//   the ops section — where the outbound sections start), i64 frame (save
+//   frame for quiet/save-only, load frame for resim, kNullFrame otherwise)
+constexpr size_t kReqStride = 24;
+constexpr uint8_t kReqOther = 0;
+constexpr uint8_t kReqQuiet = 1;
+constexpr uint8_t kReqResim = 2;
+constexpr uint8_t kReqSaveOnly = 3;
+constexpr uint8_t kReqEmpty = 4;
+constexpr uint8_t kReqFlagTrailingAdv = 1;
 
 // ---- packed per-tick output header (DESIGN.md §19) ----------------------
 // The tick output now LEADS with one fixed-stride record per session — a
@@ -197,6 +241,149 @@ inline void hdr_patch(std::vector<uint8_t>* o, size_t off, uint32_t flags,
   w64(40, static_cast<uint64_t>(save_frame));
 }
 
+struct ReqDesc {
+  uint8_t pattern = kReqEmpty;
+  uint8_t rflags = 0;
+  uint16_t n_adv = 0;
+  uint32_t adv_off = 0;     // record-relative (the body prefix is 35 bytes)
+  uint32_t adv_stride = 0;
+  uint32_t ops_end = 35;    // record-relative end of the ops section
+  int64_t frame = kNullFrame;
+};
+
+void req_patch(std::vector<uint8_t>* o, size_t off, const ReqDesc& d) {
+  uint8_t* p = o->data() + off;
+  p[0] = d.pattern;
+  p[1] = d.rflags;
+  p[2] = d.n_adv & 0xFF;
+  p[3] = d.n_adv >> 8;
+  auto w32 = [&p](size_t at, uint32_t v) {
+    for (int i = 0; i < 4; ++i) p[at + i] = (v >> (8 * i)) & 0xFF;
+  };
+  w32(4, d.adv_off);
+  w32(8, d.adv_stride);
+  w32(12, d.ops_end);
+  uint64_t u = static_cast<uint64_t>(d.frame);
+  for (int i = 0; i < 8; ++i) p[16 + i] = (u >> (8 * i)) & 0xFF;
+}
+
+inline int64_t ops_i64_at(const std::vector<uint8_t>& ops, size_t at) {
+  uint64_t u = 0;
+  for (int i = 0; i < 8; ++i) {
+    u |= static_cast<uint64_t>(ops[at + i]) << (8 * i);
+  }
+  return static_cast<int64_t>(u);
+}
+
+// Classify one slot's ops byte stream into its request descriptor (§21).
+// The body prefix is 35 bytes, so record-relative offsets are ops-relative
+// offsets + 35.  Unrecognized shapes (frame-0 double save, anything a
+// future bank emits) land on kReqOther — consumers use the generic op
+// decoder, never a wrong descriptor.
+ReqDesc classify_ops(const std::vector<uint8_t>& ops, uint16_t n_ops,
+                     int players, int isize) {
+  ReqDesc d;
+  d.ops_end = static_cast<uint32_t>(35 + ops.size());
+  const size_t adv_size =
+      1 + static_cast<size_t>(players) * (1 + static_cast<size_t>(isize));
+  if (n_ops == 0) {
+    d.pattern = kReqEmpty;
+    return d;
+  }
+  // allocation-free fast exits for the shapes that dominate every tick
+  // (this runs per slot INSIDE the crossing; the generic walk below uses
+  // reused thread_local scratch and only runs for resim/other shapes)
+  if (n_ops == 1 && ops[0] == 0 && ops.size() == 9) {
+    d.pattern = kReqSaveOnly;  // [save f]: the prediction-limit tick
+    d.frame = ops_i64_at(ops, 1);
+    return d;
+  }
+  if (n_ops == 2 && ops[0] == 0 && ops.size() == 9 + adv_size &&
+      ops[9] == 2) {
+    d.pattern = kReqQuiet;  // [save f, advance]: the quiet steady state
+    d.frame = ops_i64_at(ops, 1);
+    d.n_adv = 1;
+    d.adv_off = 35 + 10;
+    d.rflags |= kReqFlagTrailingAdv;
+    return d;
+  }
+  // generic trailing-advance detection (the "advanced" bit of the Python
+  // reference decoder: the LAST op is an AdvanceFrame) — walk the ops
+  size_t pos = 0;
+  uint8_t last_kind = 255;
+  static thread_local std::vector<std::pair<uint8_t, int64_t>> shape;
+  static thread_local std::vector<size_t> adv_offs;
+  shape.clear();     // (kind, frame|-1)
+  adv_offs.clear();
+  for (uint16_t i = 0; i < n_ops; ++i) {
+    uint8_t kind = ops[pos];
+    pos += 1;
+    if (kind == 2) {
+      adv_offs.push_back(pos);  // status bytes start here
+      shape.emplace_back(kind, kNullFrame);
+      pos += adv_size - 1;
+    } else {
+      shape.emplace_back(kind, ops_i64_at(ops, pos));
+      pos += 8;
+    }
+    last_kind = kind;
+  }
+  if (last_kind == 2) d.rflags |= kReqFlagTrailingAdv;
+  d.n_adv = static_cast<uint16_t>(adv_offs.size());
+  if (!adv_offs.empty()) {
+    d.adv_off = static_cast<uint32_t>(35 + adv_offs[0]);
+    if (adv_offs.size() > 1) {
+      d.adv_stride = static_cast<uint32_t>(adv_offs[1] - adv_offs[0]);
+    }
+  }
+  // [save f]: the prediction-limit tick
+  if (n_ops == 1 && shape[0].first == 0) {
+    d.pattern = kReqSaveOnly;
+    d.frame = shape[0].second;
+    return d;
+  }
+  // [save f, advance]: the quiet steady state
+  if (n_ops == 2 && shape[0].first == 0 && shape[1].first == 2) {
+    d.pattern = kReqQuiet;
+    d.frame = shape[0].second;
+    return d;
+  }
+  // [load f, adv, (save, adv)*, save f+k] (+ optional trailing adv):
+  // the rollback resim.  Saves must carry sequential frames f+1.. and the
+  // advance spacing must be constant, else the shape is kReqOther.
+  if (shape[0].first == 1 && n_ops >= 2 && shape[1].first == 2) {
+    int64_t lf = shape[0].second;
+    int64_t next_save = lf + 1;
+    bool expect_adv = true;  // shape[1] onward alternates adv, save, ...
+    bool ok = true;
+    for (size_t i = 1; i < shape.size(); ++i) {
+      if (expect_adv) {
+        if (shape[i].first != 2) { ok = false; break; }
+      } else {
+        if (shape[i].first != 0 || shape[i].second != next_save) {
+          ok = false;
+          break;
+        }
+        next_save += 1;
+      }
+      expect_adv = !expect_adv;
+    }
+    // constant advance spacing (it is by construction: adv + save pairs)
+    for (size_t i = 2; ok && i < adv_offs.size(); ++i) {
+      if (adv_offs[i] - adv_offs[i - 1] != adv_offs[1] - adv_offs[0]) {
+        ok = false;
+      }
+    }
+    if (ok) {
+      d.pattern = kReqResim;
+      d.frame = lf;
+      return d;
+    }
+  }
+  d.pattern = kReqOther;
+  return d;
+}
+
 // ---- in-crossing phase timers (tracing, DESIGN.md §14) ----------------
 // When ggrs_bank_set_timing(1) is armed, the tick accumulates per-phase
 // wall time (steady_clock, never the session clock) and appends a timing
@@ -213,7 +400,10 @@ enum BankPhase : int {
   kPhFanout = 5,    // spectator fan-out + journal-tap staging
   kPhEmit = 6,      // output-record assembly (ops, sections, mirrors)
   kPhOther = 7,     // total - sum(above): parse, skip slots, bookkeeping
-  kNumPhases = 8,
+  kPhStaging = 8,   // ggrs_bank_stage_inputs time since the LAST tick —
+                    // accumulated outside the tick window, reported on the
+                    // next tick's tail (never part of the in-crossing sum)
+  kNumPhases = 9,
 };
 
 inline uint64_t mono_ns() {
@@ -351,6 +541,18 @@ struct BankSession {
   std::vector<uint64_t> ep_keys;
   std::vector<uint64_t> spec_keys;
   int pending_io_err = 0;  // fatal recv errno from the pump's pre-drain
+  // ---- batched input staging (ggrs_bank_stage_inputs, §21) ----
+  // staged_local holds one input_size blob per local handle (sorted-handle
+  // order, the same layout the inline cmd bytes use); the mask/count track
+  // which handles are staged.  Cleared when the tick's trailing advance
+  // consumes them (the Python reference's `if advanced: staged.clear()`),
+  // or at slot-tick start when the cmd chose the inline path instead
+  // (stale native staging must never leak into a later tick).  A FAULTED
+  // tick keeps them: eviction re-feeds staged inputs to the fallback
+  // session, and the harvest's staged tail is how it reads them.
+  std::vector<uint8_t> staged_local;
+  std::vector<uint8_t> staged_mask;
+  int staged_count = 0;
   // status-mirror dirtiness (the header's kHdrDirty bit): set whenever an
   // endpoint/spectator STATE or a disc flag changes — the pool's fast path
   // skips the positional mirror parse only while this stays clear.
@@ -380,6 +582,10 @@ struct Bank {
   bool timing = false;
   uint64_t timed_ticks = 0;
   uint64_t phase_total[kNumPhases] = {0};
+  // staging wall time accrued by ggrs_bank_stage_inputs since the last
+  // tick (timing armed only); flushed into the next tick's timing tail as
+  // the kPhStaging entry
+  uint64_t staging_pending = 0;
 };
 
 // ---- little-endian put/get over byte vectors -----------------------------
@@ -1223,6 +1429,9 @@ int64_t ggrs_bank_add_session(void* ptr, int num_players, int input_size,
   s->sync_buf.resize(static_cast<size_t>(num_players) * input_size);
   s->status_buf.resize(num_players);
   s->frame_buf.resize(num_players);
+  s->staged_local.assign(
+      static_cast<size_t>(n_local) * static_cast<size_t>(input_size), 0);
+  s->staged_mask.assign(static_cast<size_t>(n_local), 0);
   for (int32_t h : s->local_handles) {
     ggrs_sync_set_frame_delay(s->sync, h, input_delay);
   }
@@ -1381,8 +1590,11 @@ int ggrs_bank_set_timing(void* ptr, int enabled) {
 // THE crossing.  Command stream, little-endian, per session in order:
 //   u8 flags (bit0 = local inputs present -> advance phase runs;
 //             bit1 = skip: slot is quarantined/evicted, NO further fields
-//             follow for this session)
-//   [flags&1] n_local * input_size raw input bytes (sorted-handle order)
+//             follow for this session;
+//             bit2 = staged: inputs were staged natively via
+//             ggrs_bank_stage_inputs, NO inline input bytes follow)
+//   [flags&1 && !flags&4] n_local * input_size raw input bytes
+//             (sorted-handle order)
 //   u16 n_ctrl;  per ctrl: u8 op, u16 ep, i64 frame
 //     op 1 = disconnect endpoint at `frame`
 //     op 2 = inject a simulated per-slot fault (`frame` carries the error
@@ -1397,6 +1609,10 @@ int ggrs_bank_set_timing(void* ptr, int enabled) {
 //              record), i32 err, i32 frames_ahead, i64 landed_frame,
 //              i64 current_frame, i64 last_confirmed, i64 save_frame (the
 //              quiet tick's save op frame, kNullFrame otherwise)
+// — then the request descriptor table (§21) — per session, kReqStride (24)
+// bytes (see the kReq* block above): the tick's request program as flat
+// data, so the pool and the device executor never parse op bytes for
+// quiet/resim/save-only slots
 // — then the body records, per session in order:
 //   i32 err  (0 = ok; negative kBankErr* = THIS SLOT faulted this tick —
 //             its ops/outbound/events are suppressed, only the status
@@ -1443,12 +1659,14 @@ static int bank_tick_impl(Bank* bank, int64_t now, const uint8_t* cmd,
                           size_t* out_len, bool io) {
   CmdReader r{cmd, cmd_len};
   bank->out.clear();
-  // packed per-tick header (DESIGN.md §19): one kHdrStride record per
-  // session, patched as each body record closes.  The header leads the
-  // output so the pool can classify all B slots (NumPy over this table)
-  // before touching any body bytes.
-  bank->out.resize(bank->sessions.size() * kHdrStride, 0);
+  // packed per-tick header (DESIGN.md §19) + request descriptor table
+  // (§21): one kHdrStride record per session, then one kReqStride record
+  // per session, both patched as each body record closes.  The two tables
+  // lead the output so the pool can classify all B slots AND build the
+  // device dispatch (NumPy over the tables) before touching body bytes.
+  bank->out.resize(bank->sessions.size() * (kHdrStride + kReqStride), 0);
   size_t hdr_off = 0;
+  size_t req_off = bank->sessions.size() * kHdrStride;
   std::vector<uint8_t> ops;
   std::vector<EpEvent> staged_events;
   std::vector<int32_t> staged_eps;
@@ -1471,7 +1689,7 @@ static int bank_tick_impl(Bank* bank, int64_t now, const uint8_t* cmd,
       uint8_t flags = scan.u8();
       if (!scan.ok) return kBankErrCmd;
       if (flags & kFlagSkip) continue;
-      if (flags & kFlagInputs) {
+      if ((flags & kFlagInputs) && !(flags & kFlagStaged)) {
         scan.raw(s->local_handles.size() *
                  static_cast<size_t>(s->input_size));
       }
@@ -1504,6 +1722,8 @@ static int bank_tick_impl(Bank* bank, int64_t now, const uint8_t* cmd,
     const size_t rec_start = o->size();
     const size_t my_hdr = hdr_off;
     hdr_off += kHdrStride;
+    const size_t my_req = req_off;
+    req_off += kReqStride;
     if (flags & kFlagSkip) {
       // quarantined/evicted slot: nothing runs, emit a status-only record
       // so the output stream stays positionally aligned.  The stale
@@ -1529,14 +1749,33 @@ static int bank_tick_impl(Bank* bank, int64_t now, const uint8_t* cmd,
       hdr_patch(o, my_hdr, hflags,
                 static_cast<uint32_t>(o->size() - rec_start), 0, 0,
                 kNullFrame, s->current_frame, s->last_confirmed, kNullFrame);
+      req_patch(o, my_req, ReqDesc{});  // kReqEmpty
       s->dirty = false;
       continue;
     }
     int err = kBankOk;  // per-SLOT fault accumulator; never fails the tick
     const uint8_t* local_inputs = nullptr;
-    if (flags & kFlagInputs) {
-      local_inputs = r.raw(s->local_handles.size() *
-                           static_cast<size_t>(s->input_size));
+    if (flags & kFlagStaged) {
+      // batched staging (§21): the inputs were staged natively; the flag
+      // byte carries no inline bytes.  An incomplete staging set is a
+      // BUILDER bug (the Python driver validates completeness before the
+      // crossing), so it is the whole-bank cmd error, not a slot fault.
+      if (!(flags & kFlagInputs) ||
+          s->staged_count != static_cast<int>(s->local_handles.size())) {
+        return kBankErrCmd;
+      }
+      local_inputs = s->staged_local.data();
+    } else {
+      if (s->staged_count) {
+        // the cmd chose the inline path this tick: any native staging is
+        // stale by definition and must not survive into a later tick
+        std::fill(s->staged_mask.begin(), s->staged_mask.end(), 0);
+        s->staged_count = 0;
+      }
+      if (flags & kFlagInputs) {
+        local_inputs = r.raw(s->local_handles.size() *
+                             static_cast<size_t>(s->input_size));
+      }
     }
     uint16_t n_ctrl = r.u16();
     if (!r.ok) return kBankErrCmd;
@@ -1887,6 +2126,19 @@ static int bank_tick_impl(Bank* bank, int64_t now, const uint8_t* cmd,
               static_cast<uint32_t>(o->size() - rec_start),
               static_cast<int32_t>(err), static_cast<int32_t>(frames_ahead),
               landed, s->current_frame, s->last_confirmed, save_frame);
+    // request descriptor (§21): classified from the ops the record carries
+    // (a faulted slot's ops were cleared above, so it classifies kReqEmpty)
+    ReqDesc rd = classify_ops(ops, n_ops, s->num_players, s->input_size);
+    req_patch(o, my_req, rd);
+    if ((flags & kFlagStaged) && err == kBankOk &&
+        (rd.rflags & kReqFlagTrailingAdv)) {
+      // the tick's trailing advance consumed the staged inputs — the
+      // native twin of the reference decoder's `if advanced:
+      // staged_inputs.clear()`.  A faulted or prediction-limited tick
+      // keeps them (eviction re-feeds; the caller re-stages next tick).
+      std::fill(s->staged_mask.begin(), s->staged_mask.end(), 0);
+      s->staged_count = 0;
+    }
     s->dirty = false;
     pt.lap(kPhEmit);
   }
@@ -1901,6 +2153,11 @@ static int bank_tick_impl(Bank* bank, int64_t now, const uint8_t* cmd,
     uint64_t sum = 0;
     for (int i = 0; i < kPhOther; ++i) sum += pt.ns[i];
     pt.ns[kPhOther] = total > sum ? total - sum : 0;
+    // staging happened OUTSIDE this tick's window (ggrs_bank_stage_inputs
+    // crossings since the last tick); it rides the tail as its own entry
+    // and is never part of the in-crossing sum the `other` phase closes
+    pt.ns[kPhStaging] = bank->staging_pending;
+    bank->staging_pending = 0;
     bank->timed_ticks += 1;
     for (int i = 0; i < kNumPhases; ++i) {
       bank->phase_total[i] += pt.ns[i];
@@ -2009,6 +2266,71 @@ int64_t ggrs_bank_session_count(void* ptr) {
 // one kHdrStride-byte record per session and (b) extends each harvest
 // endpoint record with the peer status mirrors.  Returns the stride.
 int ggrs_bank_hdr_stride(void) { return static_cast<int>(kHdrStride); }
+
+// Presence/version probes for the descriptor plane (DESIGN.md §21): a
+// library exporting these (a) accepts batched input staging via
+// ggrs_bank_stage_inputs + the kFlagStaged cmd flag, (b) emits the per-slot
+// request descriptor table between the header table and the body records,
+// and (c) appends the staged-inputs tail to every harvest.  A stride that
+// does not match the Python driver's dtype is layout skew — the pool falls
+// back to per-session Python sessions, like a header-stride mismatch.
+int ggrs_bank_req_stride(void) { return static_cast<int>(kReqStride); }
+int ggrs_bank_stage_stride(void) { return static_cast<int>(kStageStride); }
+
+// Batched input staging (descriptor plane, §21): stage MANY slots' local
+// inputs in ONE crossing.  `desc` is n records of kStageStride bytes
+// (u32 slot, i32 handle, i64 frame, u32 off, u32 len) whose off/len jump
+// into `payload`; `frame` is reserved for delayed/variable staging and
+// must be kNullFrame today.  Every record's len must equal its slot's
+// input_size (the variable-size seam is the len field itself).  Staging
+// the same (slot, handle) twice re-stages (last write wins).  Returns the
+// number of records staged, or kBankErrCmd on any malformed record —
+// nothing is partially visible on error except records already staged
+// (the Python driver validates first, so a failure here is a builder bug).
+int64_t ggrs_bank_stage_inputs(void* ptr, const uint8_t* desc, int64_t n,
+                               const uint8_t* payload, size_t payload_len) {
+  Bank* bank = static_cast<Bank*>(ptr);
+  if (n < 0 || (n > 0 && (!desc || !payload))) return kBankErrCmd;
+  const uint64_t t0 = bank->timing ? mono_ns() : 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const uint8_t* p = desc + static_cast<size_t>(i) * kStageStride;
+    auto r32 = [&p](size_t at) {
+      uint32_t v = 0;
+      for (int k = 0; k < 4; ++k) {
+        v |= static_cast<uint32_t>(p[at + k]) << (8 * k);
+      }
+      return v;
+    };
+    uint32_t slot = r32(0);
+    int32_t handle = static_cast<int32_t>(r32(4));
+    uint64_t fu = 0;
+    for (int k = 0; k < 8; ++k) {
+      fu |= static_cast<uint64_t>(p[8 + k]) << (8 * k);
+    }
+    int64_t frame = static_cast<int64_t>(fu);
+    uint32_t off = r32(16);
+    uint32_t len = r32(20);
+    if (slot >= bank->sessions.size()) return kBankErrCmd;
+    BankSession* s = bank->sessions[slot];
+    if (frame != kNullFrame) return kBankErrCmd;  // reserved
+    if (len != static_cast<uint32_t>(s->input_size)) return kBankErrCmd;
+    if (static_cast<size_t>(off) + len > payload_len) return kBankErrCmd;
+    size_t j = 0;
+    for (; j < s->local_handles.size(); ++j) {
+      if (s->local_handles[j] == handle) break;
+    }
+    if (j == s->local_handles.size()) return kBankErrCmd;  // not local
+    if (!s->staged_mask[j]) {
+      s->staged_mask[j] = 1;
+      s->staged_count += 1;
+    }
+    std::memcpy(s->staged_local.data() +
+                    j * static_cast<size_t>(s->input_size),
+                payload + off, len);
+  }
+  if (bank->timing) bank->staging_pending += mono_ns() - t0;
+  return n;
+}
 
 // Harvest one session's resumable state for Python-fallback eviction — the
 // read-only dump host_bank.py turns into a mid-stream P2PSession via the
@@ -2123,6 +2445,19 @@ int ggrs_bank_harvest(void* ptr, int64_t session, uint8_t* out, size_t cap,
       break;
     }
     put_raw(&h, scratch.data(), need);
+  }
+  // staged-inputs tail (descriptor plane, §21): inputs staged via
+  // ggrs_bank_stage_inputs that no advance has consumed yet — a FAULTED
+  // tick keeps them, and eviction/export must re-feed them to the
+  // fallback session exactly like the Python-side staged dict.
+  //   u8 n_staged; per staged handle: i32 handle, input_size bytes
+  put_u8(&h, static_cast<uint8_t>(s->staged_count));
+  for (size_t j = 0; j < s->local_handles.size(); ++j) {
+    if (!s->staged_mask[j]) continue;
+    put_u32(&h, static_cast<uint32_t>(s->local_handles[j]));
+    put_raw(&h, s->staged_local.data() +
+                    j * static_cast<size_t>(s->input_size),
+            static_cast<size_t>(s->input_size));
   }
   *out_len = h.size();
   if (h.size() > cap) return kErrBufferTooSmall;

@@ -66,6 +66,7 @@ ASAN_OPTIONS="detect_leaks=0:abort_on_error=1" \
 GGRS_NATIVE_SANITIZE=1 \
 JAX_PLATFORMS=cpu \
 python -m pytest tests/test_session_bank.py tests/test_policy_plane.py \
+    tests/test_descriptor_plane.py \
     tests/test_bank_faults.py \
     tests/test_obs.py tests/test_broadcast.py tests/test_replay_journal.py \
     tests/test_trace.py tests/test_desync_detection.py \
@@ -73,7 +74,7 @@ python -m pytest tests/test_session_bank.py tests/test_policy_plane.py \
     tests/test_fleet.py tests/test_fleet_rpc.py tests/test_fleet_proc.py \
     tests/test_fleet_obs.py \
     -q -p no:cacheprovider -m "not slow" \
-    -k "not batched_executor and not size_mismatch and not fused_scrub and not scrub_matches" "$@"
+    -k "not batched_executor and not size_mismatch and not fused_scrub and not scrub_matches and not device_state_bit_identical and not reaches_the_device" "$@"
 
 if [ -n "${GGRS_SKIP_TSAN:-}" ]; then
     echo "TSan leg skipped (GGRS_SKIP_TSAN)"
@@ -104,7 +105,8 @@ GGRS_NATIVE_SANITIZE=thread \
 JAX_PLATFORMS=cpu \
 python -m pytest tests/test_native_io.py tests/test_socket_datapath.py \
     tests/test_thread_ownership.py tests/test_fleet_proc.py \
+    tests/test_descriptor_plane.py \
     -q -p no:cacheprovider -m "not slow" \
-    -k "not batched_executor and not size_mismatch" "$@"
+    -k "not batched_executor and not size_mismatch and not device_state_bit_identical and not reaches_the_device" "$@"
 
 echo "sanitized legs green (ASan+UBSan, TSan) + ggrs-verify"

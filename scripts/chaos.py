@@ -279,11 +279,16 @@ def verify_leg(name: str, matches: int, ticks: int, seed: int,
         ],
         "crossings": {"tick": pool.crossings, "harvest": pool.harvests,
                       "stats": pool.stat_crossings},
-        # vectorized policy plane (DESIGN.md §19): how much of the run the
-        # quiet fast path served — fault ticks and their neighbors must
-        # take the slow reference decoder, survivors stay fast
+        # vectorized policy plane (DESIGN.md §19) + descriptor plane
+        # (§21): how much of the run the quiet fast path served — fault
+        # ticks and their neighbors must take the slow reference decoder,
+        # survivors stay fast — and how many plan-tick slots needed the
+        # eager per-slot decoder
         "fastpath": {"slot_ticks": pool.fast_slot_ticks,
-                     "all_fast_ticks": pool.fast_ticks},
+                     "all_fast_ticks": pool.fast_ticks,
+                     "plan_ticks": getattr(pool, "plan_ticks", 0),
+                     "desc_slow_slots": getattr(
+                         pool, "desc_slow_slots", 0)},
         "desync_report": str(report_path) if report_path else None,
         "metrics": json_snapshot(chaos["registry"]),
     })

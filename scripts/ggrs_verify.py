@@ -121,6 +121,22 @@ def check_runtime_probes(analysis) -> list:
                 f"ggrs_bank_hdr_stride() = {stride} != static contract "
                 f"{header['stride']}",
             ))
+        # descriptor plane (§21): request-descriptor + staging strides
+        for sym, want in (
+            ("ggrs_bank_req_stride", analysis.layout.LAYOUT_REQ_STRIDE),
+            ("ggrs_bank_stage_stride",
+             analysis.layout.LAYOUT_STAGE_STRIDE),
+        ):
+            if not hasattr(lib, sym):
+                continue  # pre-descriptor library: the loader rebuilds it
+            fn = getattr(lib, sym)
+            fn.restype = ctypes.c_int
+            got = int(fn())
+            if got != want:
+                findings.append(Finding(
+                    "layout/runtime-probe", f"ggrs_tpu/net/{name}", 0,
+                    f"{sym}() = {got} != static contract {want}",
+                ))
     return findings
 
 

@@ -74,18 +74,30 @@ def build_pool(n_matches: int, tracer=None, fastpath=True):
     return pool, schedules, net
 
 
-def drive(pool, schedules, net, ticks, base=0):
+def drive(pool, schedules, net, ticks, base=0, staged=True, split=None):
+    """``staged``: route inputs through the batched ``stage_inputs``
+    crossing (descriptor plane, §21) when the pool offers it; ``split``
+    (a list) collects per-tick (staging_ms, decode_ms) host sub-phases —
+    the §21 staging/decode attribution."""
     n = len(pool)
     times = np.empty(ticks)
+    stage = getattr(pool, "stage_inputs", None) if staged else None
     for i in range(ticks):
         t0 = time.perf_counter()
-        for h in range(n):
-            pool.add_local_input(h, h % 2, schedules[h](base + i))
+        if stage is not None:
+            stage([(h, h % 2, schedules[h](base + i)) for h in range(n)])
+        else:
+            for h in range(n):
+                pool.add_local_input(h, h % 2, schedules[h](base + i))
+        ts = time.perf_counter()
         for reqs in pool.advance_all():
             for r in reqs:
                 if type(r).__name__ == "SaveGameState":
                     r.cell.save(r.frame, None, None)
-        times[i] = (time.perf_counter() - t0) * 1e3
+        t1 = time.perf_counter()
+        if split is not None:
+            split.append(((ts - t0) * 1e3, (t1 - ts) * 1e3))
+        times[i] = (t1 - t0) * 1e3
         net.tick()
     return times
 
@@ -116,7 +128,8 @@ def main() -> int:
     pool, schedules, net = build_pool(args.matches, tracer=tracer)
     drive(pool, schedules, net, 16)  # warm
     tracer.clear()
-    times = drive(pool, schedules, net, args.ticks, base=16)
+    split: list = []
+    times = drive(pool, schedules, net, args.ticks, base=16, split=split)
     pool.scrape()
 
     T = args.ticks
@@ -148,6 +161,13 @@ def main() -> int:
     other = tick_us - cross_us - slot_us
     print(f"  other (staging, superv){max(0.0, other):9.0f} us/tick  "
           f"{bar(max(0.0, other), tick_us)}")
+    if split:
+        arr = np.asarray(split)
+        stage_us = float(arr[:, 0].mean()) * 1e3
+        decode_us = float(arr[:, 1].mean()) * 1e3
+        print(f"\n# §21 staging/decode split (wall, batched staging): "
+              f"staging {stage_us:.0f} us/tick, "
+              f"advance_all (crossing+decode) {decode_us:.0f} us/tick")
 
     if args.trace:
         path = tracer.write(args.trace)

@@ -394,7 +394,11 @@ class TestScrapeAllocationB256:
         )
         # 20 ticks × 256 slots with per-tick scrapes: the steady state
         # must retain (almost) nothing — the bound is deliberately tight
-        # relative to the ~500 dicts/tick the naive version allocated
-        assert growth < 64 * 1024, (
+        # relative to the ~500 dicts/tick the naive version allocated.
+        # (The descriptor plane retains ONE RequestPlan — bounded, O(B),
+        # replaced each tick — whose resim-row list varies with the
+        # tick's rollback count; the slack above 64 KiB covers that
+        # variance, nothing per-tick.)
+        assert growth < 96 * 1024, (
             f"steady-state heap grew {growth} bytes over 20 scraped ticks"
         )

@@ -28,7 +28,14 @@ from ggrs_tpu.analysis import (
     static_bank_header,
 )
 from ggrs_tpu.analysis.layout import (
+    LAYOUT_REQ_FIELDS,
+    LAYOUT_REQ_STRIDE,
+    LAYOUT_SEND_FIELDS,
+    LAYOUT_SEND_STRIDE,
+    LAYOUT_STAGE_FIELDS,
+    LAYOUT_STAGE_STRIDE,
     MIRRORED_CONSTANTS,
+    _check_field_table,
     _check_header,
     _check_mirrors,
 )
@@ -178,6 +185,74 @@ class TestDeliberateSkew:
             f.rule == "layout/header-stride" for f in _check_header(root)
         )
 
+    # ---- descriptor-plane structs (§21): same three layers of pinning --
+
+    REQ_GOOD = (
+        'BANK_REQ_FIELDS = (\n'
+        '    ("pattern", "<u1"), ("rflags", "<u1"), ("n_adv", "<u2"),\n'
+        '    ("adv_off", "<u4"), ("adv_stride", "<u4"),\n'
+        '    ("ops_end", "<u4"), ("frame", "<i8"),\n'
+        ')\n'
+    )
+    STAGE_GOOD = (
+        'BANK_STAGE_FIELDS = (\n'
+        '    ("slot", "<u4"), ("handle", "<i4"), ("frame", "<i8"),\n'
+        '    ("off", "<u4"), ("len", "<u4"),\n'
+        ')\n'
+    )
+
+    def _table_tree(self, tmp_path, text):
+        (tmp_path / "ggrs_tpu/net").mkdir(parents=True)
+        (tmp_path / "ggrs_tpu/net/_native.py").write_text(text)
+        return tmp_path
+
+    def test_clean_req_table_passes(self, tmp_path):
+        root = self._table_tree(tmp_path, self.REQ_GOOD + self.STAGE_GOOD)
+        assert _check_field_table(
+            root, "BANK_REQ_FIELDS", LAYOUT_REQ_FIELDS, LAYOUT_REQ_STRIDE
+        ) == []
+        assert _check_field_table(
+            root, "BANK_STAGE_FIELDS", LAYOUT_STAGE_FIELDS,
+            LAYOUT_STAGE_STRIDE,
+        ) == []
+
+    def test_req_one_byte_drift_fires(self, tmp_path):
+        # n_adv shrinks u2 -> u1: every later offset shifts, stride 23
+        root = self._table_tree(
+            tmp_path,
+            self.REQ_GOOD.replace('("n_adv", "<u2")', '("n_adv", "<u1")'),
+        )
+        findings = _check_field_table(
+            root, "BANK_REQ_FIELDS", LAYOUT_REQ_FIELDS, LAYOUT_REQ_STRIDE
+        )
+        assert findings, "1-byte descriptor field drift must fail lint"
+        assert any("stride" in f.rule or "fields" in f.rule
+                   for f in findings)
+
+    def test_stage_big_endian_fires(self, tmp_path):
+        root = self._table_tree(
+            tmp_path,
+            self.STAGE_GOOD.replace('("frame", "<i8")',
+                                    '("frame", ">i8")'),
+        )
+        assert any(
+            f.rule == "layout/table-endian"
+            for f in _check_field_table(
+                root, "BANK_STAGE_FIELDS", LAYOUT_STAGE_FIELDS,
+                LAYOUT_STAGE_STRIDE,
+            )
+        )
+
+    def test_send_stride_mirror_drift_fires(self, tmp_path):
+        # the C++ kSendStride is pinned through the mirror table — a
+        # native-side stride bump without the Python twin fires
+        (tmp_path / "a.cpp").write_text("constexpr size_t kSendStride = 24;\n")
+        (tmp_path / "b.py").write_text("NET_SEND_STRIDE = 20\n")
+        findings = _check_mirrors(
+            tmp_path, [("a.cpp", "kSendStride", "b.py", "NET_SEND_STRIDE")]
+        )
+        assert [f.rule for f in findings] == ["layout/mirror-mismatch"]
+
     def test_mirror_value_drift_fires(self, tmp_path):
         (tmp_path / "a.cpp").write_text("constexpr int kX = -70;\n")
         (tmp_path / "b.py").write_text("X = -71\n")
@@ -217,7 +292,8 @@ class TestTreeIsClean:
         declared = {
             k for k in native
             if k.startswith("kBankErr") or k.startswith("kHdr")
-            or k.startswith("kFlag")
+            or k.startswith("kFlag") or k.startswith("kReq")
+            or k.startswith("kStage")
         } - {"kHdrStride"}  # stride is pinned by the header check
         assert declared <= mirrored, (
             f"unmirrored native constants: {sorted(declared - mirrored)}"
@@ -240,6 +316,28 @@ class TestTreeIsClean:
             pytest.skip("no native bank library on this platform")
         assert int(lib.ggrs_bank_hdr_stride()) == \
             static_bank_header()["stride"]
+
+    def test_descriptor_tables_match_live_dtypes_and_probes(self):
+        """The §21 contract tables equal both the live np.dtypes and the
+        runtime stride probes."""
+        for fields, contract, stride in (
+            (_native.BANK_REQ_FIELDS, LAYOUT_REQ_FIELDS,
+             LAYOUT_REQ_STRIDE),
+            (_native.BANK_STAGE_FIELDS, LAYOUT_STAGE_FIELDS,
+             LAYOUT_STAGE_STRIDE),
+            (_native.NET_SEND_FIELDS, LAYOUT_SEND_FIELDS,
+             LAYOUT_SEND_STRIDE),
+        ):
+            dtype = np.dtype(list(fields))
+            assert dtype.itemsize == stride
+            for name, fmt, offset in contract:
+                assert dtype.fields[name][1] == offset
+                assert np.dtype(fmt) == dtype.fields[name][0]
+        lib = _native.bank_lib()
+        if lib is None or not hasattr(lib, "ggrs_bank_req_stride"):
+            pytest.skip("no descriptor-plane library on this platform")
+        assert int(lib.ggrs_bank_req_stride()) == LAYOUT_REQ_STRIDE
+        assert int(lib.ggrs_bank_stage_stride()) == LAYOUT_STAGE_STRIDE
 
     def test_cmd_flags_match_native_literals(self):
         native = parse_cpp_constants(REPO / "native/session_bank.cpp")
