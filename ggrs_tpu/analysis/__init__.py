@@ -24,19 +24,48 @@ Three pillars, all source-level — they read the tree, not the process:
   actually guard with ``_check_owner()`` (and vice versa), so the
   thread-affinity contract is visible to review and checkable without
   running the race.
+- ggrs-model (DESIGN.md §22) — the protocol state machines,
+  machine-checked.  :mod:`.model` is a deterministic explicit-state
+  BFS engine (safety invariants, liveness-via-progress, replayable
+  shortest counterexamples, state/time budgets); :mod:`.machines`
+  builds the tree's real §9/§16/§17 machines from source and runs the
+  :data:`~.machines.MODEL_CATALOG` (HEAD models must explore clean,
+  known-broken fixtures like the pre-PR-11 checkpoint ordering must
+  keep their pinned counterexamples); :mod:`.conformance` is the
+  static half — every setter site performs an edge of the declared
+  ``SLOT_TRANSITIONS``/``PROC_TRANSITIONS``/``SHARD_TRANSITIONS``
+  tables.
 
-``scripts/ggrs_verify.py`` fronts all three (plus tree-hygiene checks)
+``scripts/ggrs_verify.py`` fronts all of it (plus tree-hygiene checks)
 with baseline handling and a non-zero exit on new violations;
-``scripts/build_sanitized.sh`` runs it before the sanitizer legs.
+``scripts/build_sanitized.sh`` runs it before the sanitizer legs and
+runs the model leg (``--model``) behind ``GGRS_SKIP_MODEL``.
 """
 
 from .baseline import Baseline, load_baseline, write_baseline
+from .conformance import (
+    MACHINE_SPECS,
+    TRANSITION_RULES,
+    lint_transitions,
+    parse_transition_table,
+)
 from .cpp import parse_cpp_constants
 from .determinism import DETERMINISM_RULES, lint_determinism
 from .layout import (
     LAYOUT_HEADER_FIELDS,
     check_layout,
     static_bank_header,
+)
+from .machines import MODEL_CATALOG, MODEL_RULES, check_models
+from .model import (
+    Action,
+    CheckResult,
+    Invariant,
+    Model,
+    ModelError,
+    Progress,
+    check,
+    replay,
 )
 from .ownership import lint_ownership
 from .pysrc import (
@@ -47,18 +76,33 @@ from .pysrc import (
 from .report import Finding
 
 __all__ = [
+    "Action",
     "Baseline",
+    "CheckResult",
     "DETERMINISM_RULES",
     "Finding",
+    "Invariant",
     "LAYOUT_HEADER_FIELDS",
+    "MACHINE_SPECS",
+    "MODEL_CATALOG",
+    "MODEL_RULES",
+    "Model",
+    "ModelError",
+    "Progress",
+    "TRANSITION_RULES",
+    "check",
     "check_layout",
+    "check_models",
     "lint_determinism",
     "lint_ownership",
+    "lint_transitions",
     "load_baseline",
     "parse_cpp_constants",
     "parse_py_constants",
     "parse_py_field_tuples",
     "parse_py_struct_formats",
+    "parse_transition_table",
+    "replay",
     "static_bank_header",
     "write_baseline",
 ]

@@ -73,6 +73,9 @@ DET_SCOPE: Tuple[Tuple[str, str], ...] = (
     ("sim", "ggrs_tpu/sessions/"),
     ("sim", "ggrs_tpu/broadcast/journal.py"),
     ("sim", "ggrs_tpu/utils/checkpoint.py"),
+    # rollback-visible despite living in parallel/: the pool's staging
+    # and replay paths feed the sessions' input queues directly
+    ("sim", "ggrs_tpu/parallel/session_pool.py"),
     ("bundle", "ggrs_tpu/parallel/host_bank.py"),
     ("bundle", "ggrs_tpu/fleet/rpc.py"),
     ("bundle", "ggrs_tpu/fleet/shard.py"),

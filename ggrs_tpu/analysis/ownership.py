@@ -16,13 +16,24 @@ declarative and closed:
 - ``own/unlisted-guard`` — every method that calls ``_check_owner()``
   must be declared, so the declaration stays authoritative.
 - ``own/thread-target`` — a bound driving method must not be handed to
-  ``threading.Thread(target=...)`` at any call site: driving from a
-  spawned thread without ``transfer_ownership()`` is the exact race the
-  guard exists to stop.  This is a NAME-based heuristic (the lint
-  cannot type the target object); a reviewed false positive on an
-  unrelated object is suppressed in place with
-  ``# ggrs-verify: allow(own/thread-target)`` — the same pragma the
-  determinism lint honors, and it works for every own/* rule.
+  ``threading.Thread(target=...)`` or ``threading.Timer(delay, fn)`` at
+  any call site: driving from a spawned thread without
+  ``transfer_ownership()`` is the exact race the guard exists to stop.
+  This is a NAME-based heuristic (the lint cannot type the target
+  object); a reviewed false positive on an unrelated object is
+  suppressed in place with ``# ggrs-verify: allow(own/thread-target)``
+  — the same pragma the determinism lint honors, and it works for
+  every own/* rule.
+- ``own/executor-submit`` — the pool-shaped variant of the same escape:
+  ``executor.submit(bound_driving_method, ...)`` drives from a worker
+  thread just as surely as ``Thread(target=...)`` does.
+
+Hand-off sites see through one level of bound-method ALIASING: a file
+that does ``advance = pool.advance_frame`` and later hands ``advance``
+to Thread/Timer/submit fires the same rules.  The alias alone is fine —
+the same-thread hot-path alias (e.g. session_pool's
+``add = self.host.add_local_input``) is idiomatic and stays clean; only
+the cross-thread hand-off is the bug.
 
 The checker is AST-only and resolves inheritance within the scanned
 file set (a subclass of a ThreadOwned class is ThreadOwned).
@@ -199,11 +210,34 @@ def lint_ownership(
                 "_DRIVING_METHODS",
             ))
 
-    # pass 2: Thread(target=<bound driving method>) at any scanned site
+    # pass 2: a bound driving method handed to another thread at any
+    # scanned site — Thread(target=...), Timer(delay, fn),
+    # executor.submit(fn, ...) — directly or through one level of
+    # file-local aliasing (name = obj.driving_method)
     all_driving = set()
     for names in driving_by_class.values():
         all_driving |= names
     for rel, tree in trees:
+        aliases: Dict[str, str] = {}
+        for node in ast.walk(tree):
+            if (
+                isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Attribute)
+                and node.value.attr in all_driving
+            ):
+                aliases[node.targets[0].id] = node.value.attr
+
+        def _handed_driving(expr: Optional[ast.AST]) -> Optional[str]:
+            """'….name' when expr is a bound driving method (or a
+            file-local alias of one), else None."""
+            if isinstance(expr, ast.Attribute) and expr.attr in all_driving:
+                return f"….{expr.attr}"
+            if isinstance(expr, ast.Name) and expr.id in aliases:
+                return f"{expr.id} (= ….{aliases[expr.id]})"
+            return None
+
         for node in ast.walk(tree):
             if not isinstance(node, ast.Call):
                 continue
@@ -211,17 +245,42 @@ def lint_ownership(
                 node.func, ast.Attribute
             ) else (node.func.id if isinstance(node.func, ast.Name)
                     else None)
-            if fname != "Thread":
-                continue
-            for kw in node.keywords:
-                if kw.arg != "target":
-                    continue
-                if isinstance(kw.value, ast.Attribute) and \
-                        kw.value.attr in all_driving:
+            if fname == "Thread":
+                for kw in node.keywords:
+                    if kw.arg != "target":
+                        continue
+                    handed = _handed_driving(kw.value)
+                    if handed is not None:
+                        findings.append(Finding(
+                            "own/thread-target", rel, node.lineno,
+                            f"Thread(target={handed}) hands a driving "
+                            "method to another thread without "
+                            "transfer_ownership()",
+                        ))
+            elif fname == "Timer":
+                # threading.Timer(interval, function): positional or kw
+                fn_expr = node.args[1] if len(node.args) > 1 else None
+                for kw in node.keywords:
+                    if kw.arg == "function":
+                        fn_expr = kw.value
+                handed = _handed_driving(fn_expr)
+                if handed is not None:
                     findings.append(Finding(
                         "own/thread-target", rel, node.lineno,
-                        f"Thread(target=….{kw.value.attr}) hands a "
-                        "driving method to another thread without "
+                        f"Timer(…, {handed}) fires a driving method on "
+                        "the timer thread without transfer_ownership()",
+                    ))
+            elif fname == "submit" and isinstance(
+                node.func, ast.Attribute
+            ):
+                handed = _handed_driving(
+                    node.args[0] if node.args else None
+                )
+                if handed is not None:
+                    findings.append(Finding(
+                        "own/executor-submit", rel, node.lineno,
+                        f"….submit({handed}) runs a driving method on "
+                        "an executor worker thread without "
                         "transfer_ownership()",
                     ))
     findings = [

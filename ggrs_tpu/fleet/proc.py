@@ -443,6 +443,7 @@ class ShardRunner:
             set_runner_clock(msg["clock"])
         state = msg.get("state")
         if state in (SHARD_ACTIVE, SHARD_DRAINING):
+            # ggrs-model: transitions(active->draining, draining->active)
             shard.state = state
         for mid, handle, value in msg.get("inputs", ()):
             shard.add_local_input(mid, handle, value)
@@ -634,6 +635,20 @@ PROC_RUNNING = "running"
 PROC_TERMINATING = "terminating"  # SIGTERM sent, drain deadline armed
 PROC_EXITED = "exited"
 
+# The declared watchdog transition table (DESIGN.md §17, §22): every
+# ``self._status`` assignment performs an edge from this table — the
+# ggrs-model conformance lint proves it, and the §17 watchdog model
+# (analysis/machines.py) parses this tuple to validate its supervisor
+# edges.  EXITED is the initial AND the respawn-source status: a shard
+# is only failed over once its status reaches EXITED (confirmed death),
+# never straight from TERMINATING.
+PROC_TRANSITIONS = (
+    (PROC_EXITED, PROC_RUNNING),       # spawn / respawn
+    (PROC_RUNNING, PROC_TERMINATING),  # wedge detected: SIGTERM sent
+    (PROC_RUNNING, PROC_EXITED),       # crash / clean exit, reaped
+    (PROC_TERMINATING, PROC_EXITED),   # drained, or SIGKILL past deadline
+)
+
 
 class ProcShard:
     """Supervisor-side proxy for one shard subprocess.
@@ -772,6 +787,7 @@ class ProcShard:
             self._teardown_proc(expect_exit=False)
             raise
         self.pid = r["pid"]
+        # ggrs-model: transitions(exited->running)
         self._status = PROC_RUNNING
         self._hung_reason = None
         self._term_deadline = None
@@ -809,6 +825,7 @@ class ProcShard:
             if self._child_alive() and not expect_exit:
                 self._send_signal(signal.SIGKILL)
             self.last_exit = "adopted runner gone"
+        # ggrs-model: transitions(running->exited, terminating->exited)
         self._status = PROC_EXITED
         self._update_orphan_gauge()
 
@@ -1239,6 +1256,7 @@ class ProcShard:
         self.restarts += 1
         self._m_restarts.labels(shard=self.shard_id).inc()
         self.killed = False
+        # ggrs-model: transitions(dead->active)
         self.state = SHARD_ACTIVE
         self._matches.clear()
         self._ports.clear()
@@ -1258,6 +1276,7 @@ class ProcShard:
     # ------------------------------------------------------------------
 
     def retire(self) -> None:
+        # ggrs-model: transitions(active->retired, draining->retired)
         self.state = SHARD_RETIRED
         self._expected_exit = True
         self._shutdown_runner()

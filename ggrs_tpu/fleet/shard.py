@@ -51,6 +51,23 @@ SHARD_DRAINING = "draining"    # serving, admission closed, migrating off
 SHARD_RETIRED = "retired"      # drained empty; no longer ticked
 SHARD_DEAD = "dead"            # failed health check; matches failed over
 
+# The declared lifecycle transition table (DESIGN.md §16, §22): every
+# assignment to a shard's ``state`` — here, in proc.py, and in
+# supervisor.py — performs an edge from this table; the ggrs-model
+# conformance lint proves it and the §16 lifecycle model
+# (analysis/machines.py) is built from it.  RETIRED is absorbing; DEAD
+# is not (a failed-over proc shard respawns empty and re-enters
+# admission).
+SHARD_TRANSITIONS = (
+    (SHARD_ACTIVE, SHARD_DRAINING),    # drain begins (admission off)
+    (SHARD_DRAINING, SHARD_ACTIVE),    # drain cancelled / re-admitted
+    (SHARD_ACTIVE, SHARD_RETIRED),     # retired without a drain phase
+    (SHARD_DRAINING, SHARD_RETIRED),   # drained empty
+    (SHARD_ACTIVE, SHARD_DEAD),        # failed health check -> failover
+    (SHARD_DRAINING, SHARD_DEAD),      # died mid-drain -> failover
+    (SHARD_DEAD, SHARD_ACTIVE),        # proc respawn: fresh incarnation
+)
+
 
 class AdoptedMatch:
     """A match running beside the bank on its own Python session: a
@@ -794,6 +811,7 @@ class PoolShard:
         self.pool.inject_slot_error(slot, code)
 
     def retire(self) -> None:
+        # ggrs-model: transitions(active->retired, draining->retired)
         self.state = SHARD_RETIRED
         for match_id in list(self._journals):
             self._close_journal(match_id)

@@ -582,6 +582,7 @@ class ShardSupervisor:
                 if shard.try_respawn():
                     # the replacement serves NEW admissions; the dead
                     # incarnation's matches already failed over
+                    # ggrs-model: transitions(dead->active)
                     shard.state = SHARD_ACTIVE
                     self.ring.add(sid)
                     self._update_shard_gauge()
@@ -792,6 +793,7 @@ class ShardSupervisor:
                 f"shard {shard_id} is {shard.state}: only active shards "
                 "drain"
             )
+        # ggrs-model: transitions(active->draining)
         shard.state = SHARD_DRAINING
         self._update_shard_gauge()
 
@@ -849,6 +851,7 @@ class ShardSupervisor:
         survivors — the durable artifacts (journal + checkpoints + cached
         identity) are all that is assumed to exist."""
         shard = self.shards[shard_id]
+        # ggrs-model: transitions(active->dead, draining->dead)
         shard.state = SHARD_DEAD
         self.ring.remove(shard_id)
         self._m_failovers.inc()

@@ -251,6 +251,26 @@ SLOT_DEAD = "dead"              # unrecoverable; request lists stay empty
 SLOT_MIGRATED = "migrated"      # exported to another pool (fleet layer);
 #                                 behaves like dead here — the match lives on
 
+# The declared supervision transition table (DESIGN.md §9, §22): every
+# ``_set_slot_state`` call site performs an edge from this table.  The
+# ggrs-model conformance lint (analysis/conformance.py) proves the
+# code-performed transitions are a subset of it, and the §9 supervision
+# model (analysis/machines.py) is built by parsing this tuple from
+# source — so an edge added here without a model update, or a call site
+# added without an edge here, fails `scripts/ggrs_verify.py`.  DEAD and
+# MIGRATED are absorbing: no edge leaves them.
+SLOT_TRANSITIONS = (
+    (SLOT_NATIVE, SLOT_QUARANTINED),   # bank fault -> quarantine
+    (SLOT_NATIVE, SLOT_DEAD),          # match retired / fallback tick fault
+    (SLOT_NATIVE, SLOT_MIGRATED),      # live-migration commit
+    (SLOT_QUARANTINED, SLOT_EVICTED),  # eviction succeeded
+    (SLOT_QUARANTINED, SLOT_DEAD),     # eviction attempts exhausted
+    (SLOT_QUARANTINED, SLOT_MIGRATED),
+    (SLOT_EVICTED, SLOT_DEAD),         # fallback tick fault / match retired
+    (SLOT_EVICTED, SLOT_MIGRATED),
+)
+_SLOT_TRANSITION_SET = frozenset(SLOT_TRANSITIONS)
+
 # eviction retry policy: attempt n+1 waits n * backoff ticks PLUS a
 # deterministic per-slot jitter draw; after the bounded attempts the slot
 # is marked dead.  The jitter decorrelates a shard-wide failure (N slots
@@ -2495,6 +2515,7 @@ class HostSessionPool:
                 raise
             except Exception as e:
                 self._on_slot_fault(i, 0, f"{type(e).__name__}: {e}")
+                # ggrs-model: transitions(quarantined->dead, evicted->dead)
                 self._set_slot_state(i, SLOT_DEAD)
                 out.append([])
                 continue
@@ -2518,6 +2539,7 @@ class HostSessionPool:
                 self._tick_no, 0,
                 "match over: every remote endpoint disconnected",
             ))
+            # ggrs-model: transitions(native->dead, evicted->dead)
             self._set_slot_state(index, SLOT_DEAD)
 
     def _supervise(self, request_lists: List[List[GgrsRequest]],
@@ -2569,6 +2591,7 @@ class HostSessionPool:
                 # the fallback faulted too (e.g. the same malicious peer):
                 # blast radius stays this one slot
                 self._on_slot_fault(i, 0, f"evicted tick: {type(e).__name__}: {e}")
+                # ggrs-model: transitions(evicted->dead)
                 self._set_slot_state(i, SLOT_DEAD)
                 request_lists[i] = []
                 continue
@@ -2590,6 +2613,13 @@ class HostSessionPool:
         old = self._slot_state[index]
         if old == new_state:
             return
+        if (old, new_state) not in _SLOT_TRANSITION_SET:
+            # undeclared edge: loud in logs, never fatal in production —
+            # the static conformance lint is the enforcing layer
+            _logger.error(
+                "undeclared supervision transition %s -> %s (slot %d)",
+                old, new_state, index,
+            )
         self._slot_state[index] = new_state
         # the staging router resolves slot state at transition time, not
         # per call (§21 satellite) — rebuild this slot's dispatch
@@ -2724,6 +2754,7 @@ class HostSessionPool:
                 rec.record(self._tick_no, EV_EVICT,
                            f"attempt {attempt} failed: {e}")
             if attempt >= EVICT_MAX_ATTEMPTS:
+                # ggrs-model: transitions(quarantined->dead)
                 self._set_slot_state(index, SLOT_DEAD)
                 if rec is not None:
                     _logger.error(
@@ -2734,6 +2765,7 @@ class HostSessionPool:
             return True
         self._evicted[index] = session
         self._pending_load[index] = load_req
+        # ggrs-model: transitions(quarantined->evicted)
         self._set_slot_state(index, SLOT_EVICTED)
         self._m_evictions.inc()
         self._m_evict_latency.observe(
@@ -3141,6 +3173,7 @@ class HostSessionPool:
         self._fault_log[index].append(
             SlotFault(self._tick_no, 0, f"released: {detail}")
         )
+        # ggrs-model: transitions(native->migrated, quarantined->migrated, evicted->migrated)
         self._set_slot_state(index, SLOT_MIGRATED)
 
     # ------------------------------------------------------------------

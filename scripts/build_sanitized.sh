@@ -19,6 +19,7 @@
 #
 # Usage: scripts/build_sanitized.sh [extra pytest args]
 #   GGRS_SKIP_VERIFY=1  skip the static gate (sanitizers only)
+#   GGRS_SKIP_MODEL=1   skip the model-exploration leg (static only)
 #   GGRS_SKIP_TSAN=1    skip the TSan leg (ASan only)
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -28,6 +29,21 @@ if [ -z "${GGRS_SKIP_VERIFY:-}" ]; then
     JAX_PLATFORMS=cpu python scripts/ggrs_verify.py
 else
     echo "skipped (GGRS_SKIP_VERIFY)"
+fi
+
+# Model-exploration leg (DESIGN.md §22): breadth-first exploration of
+# the §9/§16/§17 protocol machines.  HEAD models must be
+# invariant-clean; the known-broken fixtures (pre-PR-11 checkpoint
+# ordering, barrier-less journal, threshold-1 rebase, premature
+# failover) must keep their pinned shortest counterexamples.  The whole
+# catalog runs in well under the 60s wall budget — ggrs_verify prints
+# the states/elapsed budget line for the record.
+echo "=== ggrs-model (protocol model exploration) ==="
+if [ -z "${GGRS_SKIP_MODEL:-}" ] && [ -z "${GGRS_SKIP_VERIFY:-}" ]; then
+    JAX_PLATFORMS=cpu timeout -k 10 60 \
+        python scripts/ggrs_verify.py --model --no-runtime
+else
+    echo "skipped (GGRS_SKIP_MODEL / GGRS_SKIP_VERIFY)"
 fi
 
 if ! command -v g++ >/dev/null; then

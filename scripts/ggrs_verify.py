@@ -1,7 +1,7 @@
 #!/usr/bin/env python
 """ggrs-verify: run the static-analysis plane over the tree.
 
-Four gates, all source-level (DESIGN.md §20):
+Five gates, all source-level (DESIGN.md §20, §22):
 
   layout       cross-language ABI/layout checker: native constants vs
                the Python decoders (header stride/fields, flag bits,
@@ -12,14 +12,28 @@ Four gates, all source-level (DESIGN.md §20):
                set iteration, salted hash, jit float reductions,
                unpinned pickles), baseline-aware
   ownership    ThreadOwned declaration lint (_DRIVING_METHODS closed
-               both ways, no Thread(target=driving method))
+               both ways; no Thread/Timer/submit hand-off of a driving
+               method)
+  transitions  ggrs-model conformance: every fleet-layer state-setter
+               site performs an edge of the declared SLOT_/PROC_/
+               SHARD_TRANSITIONS tables
   hygiene      no generated artifacts (__pycache__, *.pyc, *.so,
                bench_out) tracked by git; .gitignore keeps covering them
 
+plus, with --model, the exploration leg: the §9/§16/§17 protocol
+models from analysis/machines.py are explored breadth-first under a
+state/time budget — HEAD models must be invariant-clean, known-broken
+fixture models (the pre-PR-11 checkpoint ordering) must keep their
+pinned shortest counterexamples.
+
 Usage:
   python scripts/ggrs_verify.py                 # verify, exit 1 on new
+  python scripts/ggrs_verify.py --quick         # pre-commit: no runtime
+                                                # probe, no models
+  python scripts/ggrs_verify.py --model         # + model exploration
+  python scripts/ggrs_verify.py --model --model-budget 500000,60
   python scripts/ggrs_verify.py --baseline-update
-  python scripts/ggrs_verify.py --json out.json
+  python scripts/ggrs_verify.py --json out.json # embeds model traces
 
 Exit codes: 0 = clean (modulo baseline), 1 = new violations, 2 = the
 tool itself could not run.  Never imports the modules it judges — a
@@ -155,7 +169,30 @@ def main(argv=None) -> int:
         "--no-runtime", action="store_true",
         help="skip the runtime-probe cross-check even if a .so exists",
     )
+    ap.add_argument(
+        "--quick", action="store_true",
+        help="pre-commit mode: layout + lints only (no runtime probe, "
+             "no model exploration)",
+    )
+    ap.add_argument(
+        "--model", action="store_true",
+        help="also explore the §9/§16/§17 protocol models "
+             "(analysis/machines.py catalog)",
+    )
+    ap.add_argument(
+        "--model-budget", default="200000,30", metavar="STATES[,SECONDS]",
+        help="per-model exploration budget (default: %(default)s)",
+    )
     args = ap.parse_args(argv)
+
+    try:
+        budget = args.model_budget.split(",")
+        model_states = int(budget[0])
+        model_seconds = float(budget[1]) if len(budget) > 1 else 30.0
+    except (ValueError, IndexError):
+        print(f"ggrs-verify: bad --model-budget {args.model_budget!r} "
+              "(want STATES[,SECONDS])", file=sys.stderr)
+        return 2
 
     try:
         analysis = _load_analysis()
@@ -168,18 +205,49 @@ def main(argv=None) -> int:
         "layout": list(analysis.check_layout(REPO)),
         "determinism": list(analysis.lint_determinism(REPO)),
         "ownership": list(analysis.lint_ownership(REPO)),
+        "transitions": list(analysis.lint_transitions(REPO)),
         "hygiene": check_hygiene(analysis),
     }
-    if not args.no_runtime:
+    if not args.no_runtime and not args.quick:
         sections["layout"] += check_runtime_probes(analysis)
 
+    model_results = None
+    if args.model and not args.quick:
+        model_findings, model_results = analysis.check_models(
+            REPO, max_states=model_states, max_seconds=model_seconds,
+        )
+        sections["model"] = model_findings
+        for r in model_results:
+            # "ok" here means MET EXPECTATION: fixture models are
+            # supposed to produce their pinned counterexample, and a
+            # fixture that explores clean is as broken as a HEAD model
+            # that does not (check_models emits the finding either way)
+            met = (r["kind"] == "clean") == (r["expect"] == "clean")
+            kind = r["kind"]
+            if kind != "clean" and r["expect"] == "counterexample":
+                kind += "(expected)"
+            print(
+                f"model {'ok  ' if met else 'FAIL'} "
+                f"{r['model']:<30s} ({r['section']}) "
+                f"{kind:<21s} {r['states']:>6d} states  "
+                f"depth {r['depth']:>2d}  {r['elapsed_s']*1000:7.1f} ms"
+            )
+        print(
+            f"model leg: {len(model_results)} models, "
+            f"{sum(r['states'] for r in model_results)} states, "
+            f"{sum(r['elapsed_s'] for r in model_results):.2f}s elapsed "
+            f"(budget: {model_states} states / {model_seconds:g}s "
+            "per model)"
+        )
+
     # only the determinism lint is baseline-eligible: layout/ownership/
-    # hygiene drift is always a hard failure (there is no "legacy" ABI
-    # skew to burn down — skew IS the bug)
+    # transitions/hygiene/model drift is always a hard failure (there is
+    # no "legacy" ABI skew or phantom transition to burn down — skew IS
+    # the bug)
     det = sections["determinism"]
-    hard = (
-        sections["layout"] + sections["ownership"] + sections["hygiene"]
-    )
+    hard = [
+        f for k, v in sections.items() if k != "determinism" for f in v
+    ]
     if args.baseline_update:
         analysis.write_baseline(
             args.baseline, analysis.Baseline.from_findings(det)
@@ -204,20 +272,29 @@ def main(argv=None) -> int:
 
     verdict = "PASS" if not hard and not new_det else "FAIL"
     counts = {k: len(v) for k, v in sections.items()}
-    print(
-        f"ggrs-verify: {verdict} "
-        f"({counts['layout']} layout, {len(new_det)} new + "
+    summary = (
+        f"{counts['layout']} layout, {len(new_det)} new + "
         f"{len(legacy_det)} legacy determinism, "
-        f"{counts['ownership']} ownership, {counts['hygiene']} hygiene)"
+        f"{counts['ownership']} ownership, "
+        f"{counts['transitions']} transitions, "
+        f"{counts['hygiene']} hygiene"
     )
+    if model_results is not None:
+        summary += f", {counts['model']} model"
+    print(f"ggrs-verify: {verdict} ({summary})")
     if args.json is not None:
-        args.json.parent.mkdir(parents=True, exist_ok=True)
-        args.json.write_text(json.dumps({
+        artifact = {
             "verdict": verdict,
             "counts": counts,
             "new": [f._asdict() for f in hard + new_det],
             "legacy": [f._asdict() for f in legacy_det],
-        }, indent=2) + "\n")
+        }
+        if model_results is not None:
+            # per-model verdicts WITH counterexample traces: the JSON
+            # artifact is the replayable record of what exploration saw
+            artifact["models"] = model_results
+        args.json.parent.mkdir(parents=True, exist_ok=True)
+        args.json.write_text(json.dumps(artifact, indent=2) + "\n")
     return 0 if verdict == "PASS" else 1
 
 

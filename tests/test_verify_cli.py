@@ -34,24 +34,53 @@ class TestVerifyCli:
         assert verdict["verdict"] == "PASS"
         assert verdict["new"] == []
         assert set(verdict["counts"]) == {
-            "layout", "determinism", "ownership", "hygiene"
+            "layout", "determinism", "ownership", "transitions",
+            "hygiene",
         }
+        assert "models" not in verdict  # only --model embeds the leg
+
+    def test_quick_mode_passes(self):
+        proc = run_cli("--quick")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "ggrs-verify: PASS" in proc.stdout
+        assert "model leg:" not in proc.stdout
+
+    def test_model_leg_and_trace_artifact(self, tmp_path):
+        out = tmp_path / "verify.json"
+        proc = run_cli("--model", "--no-runtime", "--json", str(out))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "model leg: 10 models," in proc.stdout
+        assert "invariant(expected)" in proc.stdout
+        verdict = json.loads(out.read_text())
+        assert verdict["counts"]["model"] == 0
+        models = {m["model"]: m for m in verdict["models"]}
+        assert len(models) == 10
+        # the pinned §20.4 counterexample rides in the artifact,
+        # replayable from the trace alone
+        fix = models["checkpoint-order:pre-pr11"]
+        assert [s["action"] for s in fix["trace"][1:]] == [
+            "advance_rollback", "checkpoint", "crash_failover",
+        ]
+        assert models["watchdog:head"]["kind"] == "clean"
+
+    def test_bad_model_budget_is_a_tool_error(self):
+        proc = run_cli("--model", "--model-budget", "lots")
+        assert proc.returncode == 2
+        assert "bad --model-budget" in proc.stderr
 
     def test_empty_baseline_fails_on_legacy_findings(self, tmp_path):
         """With a blank baseline the legacy findings become new: the
         exit must flip non-zero — the 'new violations fail' contract."""
         blank = tmp_path / "blank.json"
-        blank.write_text('{"version": 1, "entries": []}\n')
+        blank.write_text('{"version": 2, "files": {}}\n')
         proc = run_cli("--baseline", str(blank))
         # the tree currently carries legacy determinism findings; if it
         # ever becomes fully clean this leg degenerates to PASS, which
         # is fine — assert consistency either way
-        if "legacy" in Path(
-            REPO / "ggrs_tpu/analysis/determinism_baseline.json"
-        ).read_text() or json.loads(
+        if json.loads(
             (REPO / "ggrs_tpu/analysis/determinism_baseline.json")
             .read_text()
-        )["entries"]:
+        )["files"]:
             assert proc.returncode == 1, proc.stdout
             assert "FAIL" in proc.stdout
         else:

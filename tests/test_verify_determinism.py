@@ -251,14 +251,57 @@ class TestBaseline:
         assert new == [] and legacy == [f_moved]
 
     def test_roundtrip(self, tmp_path):
-        base = Baseline({"k1": 2, "k2": 1, "gone": 0})
+        # keys are rule::path::detail — version 2 splits them back into
+        # a per-file grouping on disk and must reassemble losslessly
+        base = Baseline({
+            "det/wall-clock::a.py::time.time() read": 2,
+            "det/hash-order::b.py::builtin hash()": 1,
+            "det/wall-clock::a.py::burned down": 0,
+        })
         path = tmp_path / "b.json"
         write_baseline(path, base)
         loaded = load_baseline(path)
-        assert loaded.counts == {"k1": 2, "k2": 1}
+        assert loaded.counts == {
+            "det/wall-clock::a.py::time.time() read": 2,
+            "det/hash-order::b.py::builtin hash()": 1,
+        }
+
+    def test_on_disk_format_is_per_file_v2(self, tmp_path):
+        import json
+        path = tmp_path / "b.json"
+        write_baseline(path, Baseline({
+            "det/wall-clock::a.py::time.time() read": 2,
+        }))
+        data = json.loads(path.read_text())
+        assert data["version"] == 2
+        assert data["files"] == {"a.py": [
+            {"rule": "det/wall-clock", "detail": "time.time() read",
+             "count": 2},
+        ]}
+
+    def test_v1_baseline_is_rejected_with_guidance(self, tmp_path):
+        # a flat v1 total could hide a violation MOVING between files;
+        # the loader refuses it and points at --baseline-update
+        import json
+        import pytest
+        path = tmp_path / "b.json"
+        path.write_text(json.dumps({"version": 1, "entries": []}))
+        with pytest.raises(ValueError, match="--baseline-update"):
+            load_baseline(path)
 
     def test_missing_file_is_empty(self, tmp_path):
         assert load_baseline(tmp_path / "nope.json").counts == {}
+
+
+class TestScope:
+    def test_rollback_visible_files_are_in_sim_scope(self):
+        """session_pool.py (the host-session driver: rollback-visible
+        despite living in parallel/) and broadcast/journal.py (replay
+        source of truth) must be linted at sim strictness."""
+        from ggrs_tpu.analysis.determinism import DET_SCOPE
+        sim_files = {p for s, p in DET_SCOPE if s == "sim"}
+        assert "ggrs_tpu/parallel/session_pool.py" in sim_files
+        assert "ggrs_tpu/broadcast/journal.py" in sim_files
 
 
 class TestTreeIsClean:

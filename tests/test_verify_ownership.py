@@ -104,6 +104,93 @@ class TestGoldenFixtures:
         assert [f.rule for f in findings] == ["own/undeclared"]
 
 
+class TestHandOffGoldens:
+    """The non-Thread escape hatches: Timer, executor.submit, and
+    one-level bound-method aliasing.  The alias ALONE must stay clean —
+    session_pool's same-thread hot-path alias is idiomatic — only the
+    cross-thread hand-off fires."""
+
+    def test_timer_positional_fires(self, tmp_path):
+        src = OK_CLASS + (
+            "\n"
+            "import threading\n"
+            "def arm(s):\n"
+            "    return threading.Timer(0.5, s.advance)\n"
+        )
+        findings = lint_src(tmp_path, src)
+        assert [f.rule for f in findings] == ["own/thread-target"]
+
+    def test_timer_function_kw_fires(self, tmp_path):
+        src = OK_CLASS + (
+            "\n"
+            "import threading\n"
+            "def arm(s):\n"
+            "    return threading.Timer(0.5, function=s.advance)\n"
+        )
+        findings = lint_src(tmp_path, src)
+        assert [f.rule for f in findings] == ["own/thread-target"]
+
+    def test_timer_with_benign_callback_is_clean(self, tmp_path):
+        src = OK_CLASS + (
+            "\n"
+            "import threading\n"
+            "def arm(s):\n"
+            "    return threading.Timer(0.5, s.read_only)\n"
+        )
+        assert lint_src(tmp_path, src) == []
+
+    def test_executor_submit_fires(self, tmp_path):
+        src = OK_CLASS + (
+            "\n"
+            "def offload(pool, s):\n"
+            "    return pool.submit(s.advance, 1)\n"
+        )
+        findings = lint_src(tmp_path, src)
+        assert [f.rule for f in findings] == ["own/executor-submit"]
+
+    def test_executor_submit_benign_is_clean(self, tmp_path):
+        src = OK_CLASS + (
+            "\n"
+            "def offload(pool, s):\n"
+            "    return pool.submit(s.read_only)\n"
+        )
+        assert lint_src(tmp_path, src) == []
+
+    def test_alias_handed_to_thread_fires(self, tmp_path):
+        src = OK_CLASS + (
+            "\n"
+            "import threading\n"
+            "def spawn(s):\n"
+            "    step = s.advance\n"
+            "    return threading.Thread(target=step)\n"
+        )
+        findings = lint_src(tmp_path, src)
+        assert [f.rule for f in findings] == ["own/thread-target"]
+        assert "step (= ….advance)" in findings[0].detail
+
+    def test_alias_handed_to_submit_fires(self, tmp_path):
+        src = OK_CLASS + (
+            "\n"
+            "def offload(pool, s):\n"
+            "    step = s.advance\n"
+            "    return pool.submit(step)\n"
+        )
+        findings = lint_src(tmp_path, src)
+        assert [f.rule for f in findings] == ["own/executor-submit"]
+
+    def test_bare_alias_is_clean(self, tmp_path):
+        # the same-thread hot-path alias (session_pool's
+        # `add = self.host.add_local_input`) must never fire
+        src = OK_CLASS + (
+            "\n"
+            "def hot_loop(s):\n"
+            "    step = s.advance\n"
+            "    for _ in range(8):\n"
+            "        step()\n"
+        )
+        assert lint_src(tmp_path, src) == []
+
+
 class TestTreeIsClean:
     def test_repo_ownership_clean(self):
         findings = lint_ownership(REPO)

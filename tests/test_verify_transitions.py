@@ -1,0 +1,164 @@
+"""ggrs-model's static half: the transition-conformance lint.
+
+Golden fixtures for each model/* rule (firing and non-firing) over a
+toy machine spec, plus the self-clean gate: every setter site in the
+live fleet layer performs an edge of its declared table.
+"""
+
+from pathlib import Path
+
+from ggrs_tpu.analysis import MACHINE_SPECS, lint_transitions
+from ggrs_tpu.analysis.conformance import (
+    MachineSpec,
+    parse_transition_table,
+)
+
+REPO = Path(__file__).resolve().parents[1]
+
+SPEC = MachineSpec(
+    name="toy",
+    table_path="pkg/mod.py",
+    table_name="TOY_TRANSITIONS",
+    prefix="TOY_",
+    setter_kind="attr",
+    setter_name="state",
+    dst_arg=0,
+    scan=("pkg/mod.py",),
+)
+
+HEADER = '''
+TOY_IDLE = "idle"
+TOY_BUSY = "busy"
+TOY_TRANSITIONS = (
+    (TOY_IDLE, TOY_BUSY),
+    (TOY_BUSY, TOY_IDLE),
+)
+'''
+
+
+def lint_src(tmp_path, body: str, header: str = HEADER):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(header + body)
+    return lint_transitions(tmp_path, specs=(SPEC,))
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+class TestTableParsing:
+    def test_missing_file(self, tmp_path):
+        findings = lint_transitions(tmp_path, specs=(SPEC,))
+        assert rules_of(findings) == ["model/table-missing"]
+
+    def test_missing_table(self, tmp_path):
+        findings = lint_src(tmp_path, "", header='TOY_IDLE = "idle"\n')
+        assert rules_of(findings) == ["model/table-missing"]
+
+    def test_table_entry_with_undeclared_constant(self, tmp_path):
+        bad = HEADER.replace("(TOY_BUSY, TOY_IDLE),",
+                             "(TOY_BUSY, TOY_GONE),")
+        findings = lint_src(tmp_path, "", header=bad)
+        assert "model/unknown-state" in rules_of(findings)
+
+    def test_parse_live_tables(self):
+        for spec in MACHINE_SPECS:
+            table, findings = parse_transition_table(REPO, spec)
+            assert findings == [], (spec.name, findings)
+            assert table is not None and len(table.edges) >= 4
+
+
+class TestSiteResolution:
+    def test_pragma_site_on_declared_edge_is_clean(self, tmp_path):
+        assert lint_src(tmp_path, '''
+class Toy:
+    def go(self):
+        # ggrs-model: transitions(idle->busy)
+        self.state = TOY_BUSY
+''') == []
+
+    def test_pragma_declaring_unlisted_edge_fires(self, tmp_path):
+        findings = lint_src(tmp_path, '''
+class Toy:
+    def go(self):
+        # ggrs-model: transitions(busy->busy2)
+        self.state = TOY_BUSY
+''')
+        assert "model/unknown-state" in rules_of(findings)
+
+    def test_pragma_dst_mismatch_fires(self, tmp_path):
+        findings = lint_src(tmp_path, '''
+class Toy:
+    def go(self):
+        # ggrs-model: transitions(idle->busy)
+        self.state = TOY_IDLE
+''')
+        assert rules_of(findings) == ["model/transition-unlisted"]
+
+    def test_guard_inference_clean(self, tmp_path):
+        assert lint_src(tmp_path, '''
+class Toy:
+    def go(self):
+        if self.state == TOY_IDLE:
+            self.state = TOY_BUSY
+''') == []
+
+    def test_guard_inference_unlisted_edge_fires(self, tmp_path):
+        findings = lint_src(tmp_path, '''
+TOY_DEAD = "dead"
+
+class Toy:
+    def go(self):
+        if self.state == TOY_IDLE:
+            self.state = TOY_DEAD
+''')
+        assert rules_of(findings) == ["model/transition-unlisted"]
+
+    def test_else_branch_never_infers(self, tmp_path):
+        # inferring idle from the ELSE of `== TOY_IDLE` would invert the
+        # guard; the site must be undeclared instead
+        findings = lint_src(tmp_path, '''
+class Toy:
+    def go(self):
+        if self.state == TOY_IDLE:
+            pass
+        else:
+            self.state = TOY_BUSY
+''')
+        assert rules_of(findings) == ["model/transition-undeclared"]
+
+    def test_bare_site_is_undeclared(self, tmp_path):
+        findings = lint_src(tmp_path, '''
+class Toy:
+    def go(self):
+        self.state = TOY_BUSY
+''')
+        assert rules_of(findings) == ["model/transition-undeclared"]
+
+    def test_init_sites_are_exempt(self, tmp_path):
+        assert lint_src(tmp_path, '''
+class Toy:
+    def __init__(self):
+        self.state = TOY_IDLE
+''') == []
+
+    def test_reflexive_pragma_edge_is_fine(self, tmp_path):
+        assert lint_src(tmp_path, '''
+class Toy:
+    def refresh(self):
+        # ggrs-model: transitions(busy->busy)
+        self.state = TOY_BUSY
+''') == []
+
+    def test_allow_pragma_suppresses(self, tmp_path):
+        assert lint_src(tmp_path, '''
+class Toy:
+    def go(self):
+        self.state = TOY_BUSY  # ggrs-verify: allow(model/transition-undeclared)
+''') == []
+
+
+class TestTreeIsClean:
+    def test_live_fleet_layer_conforms(self):
+        assert lint_transitions(REPO) == []
