@@ -99,11 +99,44 @@ LAYOUT_SEND_FIELDS: Tuple[Tuple[str, str, int], ...] = (
     ("fd", "<i4", 0),
     ("ip", "<u4", 4),
     ("port", "<u2", 8),
-    ("pad", "<u2", 10),
+    ("flags", "<u2", 10),
     ("off", "<u4", 12),
     ("len", "<u4", 16),
 )
 LAYOUT_SEND_STRIDE = 20
+
+# ---- datapath gen 2 (DESIGN.md §23) -------------------------------------
+# Batched inbound drain record (net_batch.cpp ggrs_net_recv_table /
+# kRecvStride ↔ _native.NET_RECV_FIELDS): one row per datagram pulled by
+# the one-crossing drain, addressing bytes in the shared slab.
+LAYOUT_RECV_FIELDS: Tuple[Tuple[str, str, int], ...] = (
+    ("slot", "<i4", 0),
+    ("fd_idx", "<i4", 4),
+    ("ip", "<u4", 8),
+    ("port", "<u2", 12),
+    ("pad", "<u2", 14),
+    ("off", "<u4", 16),
+    ("len", "<u4", 20),
+)
+LAYOUT_RECV_STRIDE = 24
+
+# Dispatch demux route row (kRouteStride ↔ _native.NET_ROUTE_FIELDS):
+# sorted by ((u64)ip << 16) | port, binary-searched natively per datagram.
+LAYOUT_ROUTE_FIELDS: Tuple[Tuple[str, str, int], ...] = (
+    ("ip", "<u4", 0),
+    ("port", "<u2", 4),
+    ("pad", "<u2", 6),
+    ("slot", "<i4", 8),
+)
+LAYOUT_ROUTE_STRIDE = 12
+
+# Drain fd-table row (kFdStride ↔ _native.NET_FD_FIELDS): slot >= 0 binds
+# the fd to one slot; slot == -1 marks a shared dispatch fd (route demux).
+LAYOUT_FD_FIELDS: Tuple[Tuple[str, str, int], ...] = (
+    ("fd", "<i4", 0),
+    ("slot", "<i4", 4),
+)
+LAYOUT_FD_STRIDE = 8
 
 _NP_WIDTH = {"u4": 4, "i4": 4, "u8": 8, "i8": 8, "u2": 2, "i2": 2,
              "u1": 1, "i1": 1}
@@ -231,6 +264,20 @@ MIRRORED_CONSTANTS: Tuple[Tuple[str, str, str, str], ...] = (
      "ggrs_tpu/net/_native.py", "REQ_FLAG_TRAILING_ADV"),
     ("native/net_batch.cpp", "kSendStride",
      "ggrs_tpu/net/_native.py", "NET_SEND_STRIDE"),
+    # datapath gen 2 (§23): drain/route/fd strides, dispatch flag, stat
+    # table widths
+    ("native/net_batch.cpp", "kRecvStride",
+     "ggrs_tpu/net/_native.py", "NET_RECV_STRIDE"),
+    ("native/net_batch.cpp", "kRouteStride",
+     "ggrs_tpu/net/_native.py", "NET_ROUTE_STRIDE"),
+    ("native/net_batch.cpp", "kFdStride",
+     "ggrs_tpu/net/_native.py", "NET_FD_STRIDE"),
+    ("native/net_batch.cpp", "kSendFlagDispatch",
+     "ggrs_tpu/net/_native.py", "NET_SEND_FLAG_DISPATCH"),
+    ("native/net_batch.cpp", "kSendTableStats",
+     "ggrs_tpu/net/_native.py", "NET_SEND_STATS"),
+    ("native/net_batch.cpp", "kRecvTableStats",
+     "ggrs_tpu/net/_native.py", "NET_RECV_TABLE_STATS"),
     ("native/session_bank.cpp", "kFrameWindow",
      "ggrs_tpu/core/time_sync.py", "FRAME_WINDOW_SIZE"),
     # kernel-batched datapath verdicts + socket caps
@@ -462,6 +509,16 @@ def _check_descriptor_plane(root: Path) -> List[Finding]:
     out += _check_field_table(
         root, "NET_SEND_FIELDS", LAYOUT_SEND_FIELDS, LAYOUT_SEND_STRIDE
     )
+    # datapath gen 2 (§23): the drain record table and demux tables
+    out += _check_field_table(
+        root, "NET_RECV_FIELDS", LAYOUT_RECV_FIELDS, LAYOUT_RECV_STRIDE
+    )
+    out += _check_field_table(
+        root, "NET_ROUTE_FIELDS", LAYOUT_ROUTE_FIELDS, LAYOUT_ROUTE_STRIDE
+    )
+    out += _check_field_table(
+        root, "NET_FD_FIELDS", LAYOUT_FD_FIELDS, LAYOUT_FD_STRIDE
+    )
     return out
 
 
@@ -584,6 +641,24 @@ def _check_stat_tables(root: Path) -> List[Finding]:
                 "layout/stat-table", "ggrs_tpu/net/_native.py", 0,
                 f"IO stat words {words} (fields + 2×(buckets+inf)) != "
                 f"native kNumNetStats {n_stats}",
+            ))
+    # gen-2 drain stats (§23a): scalar fields + one batch histogram share
+    # kRecvTableStats words — kept SEPARATE from the 22-word NetStat tail
+    # so kNumNetStats (and every attached-slot scrape) is untouched
+    drain_fields = tables.get("NET_RECV_TABLE_STAT_FIELDS")
+    n_drain = net.get("kRecvTableStats")
+    if drain_fields is None:
+        out.append(Finding(
+            "layout/stat-table", "ggrs_tpu/net/_native.py", 0,
+            "NET_RECV_TABLE_STAT_FIELDS not statically parseable",
+        ))
+    elif n_drain is not None and io_buckets is not None:
+        words = len(drain_fields) + len(io_buckets) + 1
+        if words != n_drain:
+            out.append(Finding(
+                "layout/stat-table", "ggrs_tpu/net/_native.py", 0,
+                f"recv-table stat words {words} (fields + buckets+inf) "
+                f"!= native kRecvTableStats {n_drain}",
             ))
     return out
 

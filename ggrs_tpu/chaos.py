@@ -323,6 +323,108 @@ def drive_socket_chaos(
     return ctx
 
 
+def drive_dispatch_chaos(
+    ticks: int,
+    n_matches: int = 3,
+    seed: int = 0,
+    inject: Optional[Callable[[int, Dict[str, Any]], Any]] = None,
+    siblings: int = 1,
+    metrics: Optional[Registry] = None,
+) -> Dict[str, Any]:
+    """The shared-dispatch-socket sibling of :func:`drive_socket_chaos`
+    (DESIGN.md §23): ``n_matches + 1`` host slots all served by ONE
+    ``DispatchHub`` port (plus SO_REUSEPORT siblings), inbound drained by
+    the one-crossing ``ggrs_net_recv_table`` with native (ip,port)->slot
+    demux, outbound on the shared fd through ``ggrs_net_send_table``
+    dispatch-flagged records.  Each slot is matched against an external
+    Python ``P2PSession`` on a frozen list-clock.
+
+    The TARGET is slot 0: ``inject(i, ctx)`` typically arms
+    ``ggrs_net_inject_table_errno(err, 0, 1)``, which fails the FIRST
+    record of the next tick's send table — slot 0's, since the table is
+    packed in slot order — exercising the §9 contract that a fatal errno
+    on the shared fd faults exactly the owning slot, never the co-tenant
+    pool.  The wire observable is each PEER's received datagram bytes
+    (:class:`RecvRecordingSocket`) — the dispatch slots are not
+    NetBatch-attached, so there is no capture tee; peer-observed bytes
+    are the port-free comparison the proc-fleet legs already use.
+
+    Raises ``RuntimeError`` when the gen-2 datapath is unavailable on
+    this platform — callers skip the scenario.
+    """
+    from .net import _native
+    from .net.sockets import DispatchHub, UdpNonBlockingSocket
+
+    lib = _native.net_lib()
+    if lib is None or not hasattr(lib, "ggrs_net_recv_table"):
+        raise RuntimeError("gen-2 shared-dispatch datapath unavailable")
+    base = seed * 1000
+    clock = [0]
+    registry = metrics if metrics is not None else Registry()
+    pool = HostSessionPool(metrics=registry)
+    hub = DispatchHub(siblings=siblings)
+    peers = []
+    peer_socks = []
+    n = n_matches + 1
+    for m in range(n):
+        peer_sock = RecvRecordingSocket(UdpNonBlockingSocket(0))
+        pool.add_session(
+            two_peer_builder(
+                clock, base + 3 + 5 * m, 0,
+                ("127.0.0.1", peer_sock.local_port()),
+            ),
+            hub.view(),
+        )
+        peers.append(two_peer_builder(
+            clock, base + 4 + 5 * m, 1,
+            ("127.0.0.1", hub.local_port()),
+        ).start_p2p_session(peer_sock))
+        peer_socks.append(peer_sock)
+    if not pool.native_active:
+        raise RuntimeError("native session bank unavailable")
+    target = 0
+
+    reqs_log: List[List] = [[] for _ in range(n)]
+    events_log: List[List] = [[] for _ in range(n)]
+
+    def sched(i, idx):
+        return ((i + 2 * idx) // (2 + idx % 3)) % 16
+
+    ctx: Dict[str, Any] = dict(
+        pool=pool, hub=hub, peers=peers, target=target, clock=clock,
+        seed=seed, lib=lib,
+    )
+    for i in range(ticks):
+        clock[0] += 16
+        if inject is not None:
+            inject(i, ctx)
+        for m, peer in enumerate(peers):
+            peer.add_local_input(1, sched(i, m))
+            fulfill(peer.advance_frame())
+        for idx in range(n):
+            pool.add_local_input(idx, 0, sched(i, idx))
+        for idx, reqs in enumerate(pool.advance_all()):
+            fulfill(reqs)
+            reqs_log[idx].append(req_summary(reqs))
+        for idx in range(n):
+            events_log[idx].extend(pool.events(idx))
+    ctx.update(
+        wire=[list(s.received) for s in peer_socks],
+        reqs=reqs_log,
+        events=events_log,
+        states=[pool.slot_state(i) for i in range(n)],
+        frames=[pool.current_frame(i) for i in range(n)],
+        peer_frames=[p.current_frame for p in peers],
+        io=pool.io_stats(),
+        capabilities=pool.io_capabilities(),
+        hub_fds=len(hub.filenos()),
+        registry=registry,
+        scrape=pool.scrape(),
+    )
+    hub.close()
+    return ctx
+
+
 def drive_desync_forensics(
     ticks: int,
     fault_frame: int,

@@ -507,6 +507,36 @@ def _load() -> Optional[ctypes.CDLL]:
                     ctypes.POINTER(ctypes.c_uint64),
                     ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
                 ]
+            if hasattr(lib, "ggrs_net_recv_table"):
+                # datapath gen 2 (§23): one-crossing inbound drain over
+                # arbitrary fds + dispatch demux + GSO fan-out; absent on
+                # a prebuilt gen-1 .so — pools keep the per-slot
+                # receive_all_datagrams reference drain
+                lib.ggrs_net_recv_table.restype = ctypes.c_int
+                lib.ggrs_net_recv_table.argtypes = [
+                    ctypes.c_void_p, ctypes.c_int,
+                    ctypes.c_void_p, ctypes.c_int,
+                    ctypes.c_void_p, ctypes.c_int,
+                    ctypes.c_void_p, ctypes.c_int64,
+                    ctypes.POINTER(ctypes.c_uint64),
+                    ctypes.POINTER(ctypes.c_int32), ctypes.c_int,
+                    ctypes.POINTER(ctypes.c_int32),
+                ]
+                lib.ggrs_net_gso_supported.restype = ctypes.c_int
+                lib.ggrs_net_gso_supported.argtypes = []
+                lib.ggrs_net_set_gso.restype = None
+                lib.ggrs_net_set_gso.argtypes = [ctypes.c_int]
+                lib.ggrs_net_inject_table_errno.restype = None
+                lib.ggrs_net_inject_table_errno.argtypes = [
+                    ctypes.c_int, ctypes.c_int64, ctypes.c_int,
+                ]
+                for _probe in (
+                    "ggrs_net_recv_stride", "ggrs_net_route_stride",
+                    "ggrs_net_fd_stride", "ggrs_net_send_stats_len",
+                    "ggrs_net_recv_stats_len",
+                ):
+                    getattr(lib, _probe).restype = ctypes.c_int
+                    getattr(lib, _probe).argtypes = []
             if hasattr(lib, "ggrs_bank_pump"):
                 # kernel-batched socket datapath (net_batch.cpp + the
                 # bank's pump entry, DESIGN.md §15); absent on a prebuilt
@@ -667,12 +697,48 @@ REQ_FLAG_TRAILING_ADV = 1  # the tick's last op was an advance ("advanced")
 # Batched outbound send record (net_batch.cpp ggrs_net_send_table): per
 # datagram fd + wire address + a jump into the shared payload (usually the
 # tick output buffer itself).  Records for one fd must form one contiguous
-# run.
+# run.  ``flags`` bit 0 (NET_SEND_FLAG_DISPATCH) marks a record on a
+# SHARED dispatch fd: a fatal errno there faults only that record's slot,
+# co-tenant records keep flushing (gen 2, §23).
 NET_SEND_FIELDS = (
-    ("fd", "<i4"), ("ip", "<u4"), ("port", "<u2"), ("pad", "<u2"),
+    ("fd", "<i4"), ("ip", "<u4"), ("port", "<u2"), ("flags", "<u2"),
     ("off", "<u4"), ("len", "<u4"),
 )  # itemsize 20 == net_batch.cpp kSendStride
 NET_SEND_STRIDE = 20
+NET_SEND_FLAG_DISPATCH = 1  # net_batch.cpp kSendFlagDispatch
+
+# ggrs_net_send_table stats words (net_batch.cpp kSendTableStats):
+# {sent, transient_errors, oversized, gso_sends, gso_segments}
+NET_SEND_STATS = 5
+
+# ---- datapath gen 2 (net_batch.cpp §23 tables) --------------------------
+# One-crossing inbound drain (ggrs_net_recv_table).  The fd table names
+# every socket to drain (slot == -1 marks a shared dispatch fd demuxed by
+# source address); the route table maps (ip, port) -> slot and must be
+# sorted ascending by (ip << 16) | port; the record table describes each
+# received datagram as a jump into the shared slab, in per-fd arrival
+# order — exactly what the per-slot receive_all_datagrams reference sees.
+NET_FD_FIELDS = (
+    ("fd", "<i4"), ("slot", "<i4"),
+)  # itemsize 8 == net_batch.cpp kFdStride
+NET_FD_STRIDE = 8
+NET_ROUTE_FIELDS = (
+    ("ip", "<u4"), ("port", "<u2"), ("pad", "<u2"), ("slot", "<i4"),
+)  # itemsize 12 == net_batch.cpp kRouteStride
+NET_ROUTE_STRIDE = 12
+NET_RECV_FIELDS = (
+    ("slot", "<i4"), ("fd_idx", "<i4"), ("ip", "<u4"), ("port", "<u2"),
+    ("pad", "<u2"), ("off", "<u4"), ("len", "<u4"),
+)  # itemsize 24 == net_batch.cpp kRecvStride
+NET_RECV_STRIDE = 24
+
+# ggrs_net_recv_table stats words (net_batch.cpp kRecvTableStats):
+# {recv_calls, datagrams, unroutable, backpressure_stops} + the 8-bucket
+# batch-size histogram (bounds IO_BATCH_BUCKETS + inf)
+NET_RECV_TABLE_STAT_FIELDS = (
+    "recv_calls", "datagrams", "unroutable", "backpressure_stops",
+)
+NET_RECV_TABLE_STATS = 12
 
 # packed per-tick output header (session_bank.cpp kHdr*; DESIGN.md §19):
 # one BANK_HDR_DTYPE-shaped record per session leads the tick output when

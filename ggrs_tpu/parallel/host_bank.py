@@ -186,6 +186,7 @@ _HDR_DTYPE = np.dtype(list(_native.BANK_HDR_FIELDS))
 _REQ_DTYPE = np.dtype(list(_native.BANK_REQ_FIELDS))
 _STAGE_DTYPE = np.dtype(list(_native.BANK_STAGE_FIELDS))
 _SEND_DTYPE = np.dtype(list(_native.NET_SEND_FIELDS))
+_RECV_DTYPE = np.dtype(list(_native.NET_RECV_FIELDS))
 # per-session command flag bytes (session_bank.cpp kFlag*, mirrored as
 # _native.CMD_FLAG_*; ggrs-verify pins the pairs equal)
 _CMD_INPUTS = bytes([_native.CMD_FLAG_INPUTS])
@@ -659,6 +660,36 @@ class HostSessionPool:
         # final counter snapshots of detached/evicted slots: io_stats()
         # totals must never regress when a NetBatch is released
         self._io_final: Dict[int, Dict[str, Any]] = {}
+        # ---- datapath gen 2 (DESIGN.md §23): one-crossing inbound drain
+        # over all non-attached fd-backed sockets (ggrs_net_recv_table) +
+        # shared dispatch sockets + GSO fan-out.  The drain tables are
+        # rebuilt by _refresh_drain() on any membership/state change.
+        self._drain_ok = False
+        self._drain_fd_tab = b""      # packed NET_FD_STRIDE entries
+        self._drain_route_tab = b""   # packed NET_ROUTE_STRIDE entries
+        self._drain_n_fds = 0
+        self._drain_n_routes = 0
+        self._drain_fd_fault: List[List[int]] = []  # fd_idx -> slots to
+        # fault on a fatal recv errno (one slot per private fd; every
+        # routed slot for a shared dispatch fd)
+        self._drain_covered: List[bool] = []  # slot served by the drain
+        self._drain_covered_keys: List[int] = []  # covered slot indices
+        self._drain_wire: List[Optional[Dict]] = []  # slot ->
+        # {(ip, port): ('e'|'s', idx)} — the Python-side half of the demux
+        self._drain_deliver: Dict[int, Any] = {}  # quarantined/evicted
+        # co-tenant on a shared hub -> its view (records go to _pending)
+        self._drain_recs: Optional[ctypes.Array] = None
+        self._drain_recs_cap = 0
+        self._drain_slab: Optional[ctypes.Array] = None
+        self._drain_slab_cap = 0
+        self._drain_totals = dict.fromkeys(
+            _native.NET_RECV_TABLE_STAT_FIELDS, 0
+        )
+        self._drain_hist = [0] * (len(_native.IO_BATCH_BUCKETS) + 1)
+        self.drain_crossings = 0  # ggrs_net_recv_table invocations
+        self.drain_ns = 0  # wall ns in _drain_inbound (profiling split)
+        self._send_flags: List[int] = []  # per-slot NET_SEND_FIELDS flags
+        self._gso_totals = {"gso_sends": 0, "gso_segments": 0}
         self._builders: List[Tuple[Any, Any]] = []
         self._finalized = False
         self._native_active = False
@@ -823,6 +854,27 @@ class HostSessionPool:
             "ggrs_pool_fastpath_slots_total",
             "slot ticks served by the vectorized quiet path (no per-slot "
             "body parse)")
+        # datapath gen 2 (§23): the one-crossing inbound drain + GSO
+        self._m_drain_crossings = m.counter(
+            "ggrs_io_drain_crossings_total",
+            "ggrs_net_recv_table invocations (one per pool tick when the "
+            "batched inbound drain is active)")
+        self._m_drain_dgrams = m.counter(
+            "ggrs_io_drain_datagrams_total",
+            "datagrams moved by the one-crossing inbound drain")
+        self._m_drain_unroutable = m.counter(
+            "ggrs_io_drain_unroutable_total",
+            "dispatch-socket datagrams dropped for an unclaimed source")
+        self._m_drain_batch = m.histogram(
+            "ggrs_io_drain_batch_size",
+            "datagrams per recvmmsg call on the batched inbound drain",
+            buckets=_native.IO_BATCH_BUCKETS)
+        self._m_gso_sends = m.counter(
+            "ggrs_io_gso_sends_total",
+            "UDP_SEGMENT segmented sends on the batched outbound path")
+        self._m_gso_segments = m.counter(
+            "ggrs_io_gso_segments_total",
+            "datagrams coalesced into UDP_SEGMENT segmented sends")
         self._quarantined_at: Dict[int, int] = {}  # index -> quarantine tick
         self._stats_cache: Optional[Tuple[int, List[Dict[str, Any]]]] = None
         self._setter_cache: Dict[int, Any] = {}  # slot -> prebound gauge sets
@@ -1175,8 +1227,18 @@ class HostSessionPool:
         # are excluded
         self._send_fds = [None] * len(self._mirrors)
         self._ep_wire = [None] * len(self._mirrors)
+        self._send_flags = [0] * len(self._mirrors)
         for i in range(len(self._mirrors)):
             self._refresh_send_fd(i)
+        # ---- datapath gen 2 (§23) ----
+        # GSO posture: the env override is applied once, process-wide (the
+        # probe result itself is cached in the library); the per-feature
+        # fallback matrix is reported by io_capabilities()
+        if lib is not None and hasattr(lib, "ggrs_net_set_gso"):
+            lib.ggrs_net_set_gso(
+                0 if os.environ.get("GGRS_TPU_NO_GSO") else -1
+            )
+        self._refresh_drain()
 
     def _refresh_send_fd(self, index: int) -> None:
         """(Re)compute slot ``index``'s native batched-outbound
@@ -1190,6 +1252,8 @@ class HostSessionPool:
             return
         self._send_fds[index] = None
         self._ep_wire[index] = None
+        if self._send_flags:
+            self._send_flags[index] = 0
         m = self._mirrors[index]
         lib = self._lib
         if (
@@ -1218,6 +1282,11 @@ class HostSessionPool:
             return
         self._send_fds[index] = fd
         self._ep_wire[index] = wire
+        if self._send_flags and getattr(m.socket, "is_dispatch", False):
+            # shared dispatch fd (§23b): a fatal errno on one record must
+            # fault only the owning slot, so the native flush skips the
+            # record instead of abandoning the co-tenants' run
+            self._send_flags[index] = _native.NET_SEND_FLAG_DISPATCH
 
     @staticmethod
     def _resolve_wire_addr(addr) -> Tuple[int, int]:
@@ -1238,6 +1307,11 @@ class HostSessionPool:
         a real one and every remote/spectator address must resolve to
         (ipv4, port).  Any miss leaves the slot on the Python shuttle."""
         lib = self._lib
+        if getattr(m.socket, "is_dispatch", False):
+            # shared dispatch fd (§23b): a whole-fd NetBatch attach would
+            # couple co-tenant faults; dispatch slots ride the table
+            # paths, whose per-record dispatch flag keeps §9 isolation
+            return
         fileno = getattr(m.socket, "fileno", None)
         if fileno is None:
             return
@@ -1271,6 +1345,313 @@ class HostSessionPool:
         self._net_handles[index] = handle
         self._io_attached[index] = True
         self._io_live.append(index)
+
+    def _refresh_drain(self) -> None:
+        """(Re)build the gen-2 one-crossing inbound drain plan (§23a):
+        the packed fd table (every SLOT_NATIVE, non-NetBatch-attached,
+        fd-backed socket — dispatch hubs contribute their sibling fds
+        once, marked slot ``-1``), the sorted (ip, port) -> slot route
+        table the native demux binary-searches, and the per-slot wire
+        maps the Python side uses to turn records into the cmd stream's
+        ``(ep_idx, data)`` sections.  Any ineligible slot simply stays on
+        the per-slot ``receive_all_datagrams`` reference drain — the
+        per-feature fallback, never an error."""
+        self._drain_ok = False
+        if not self._finalized or not self._native_active:
+            return
+        # dispatch claims first, OUTSIDE the native gate: the hub's
+        # reference Python demux needs them even when ggrs_net_recv_table
+        # is unavailable (per-feature degradation)
+        for i, m in enumerate(self._mirrors):
+            sock = m.socket
+            if getattr(sock, "is_dispatch", False) and hasattr(
+                sock, "claim"
+            ):
+                for addr in m.addr_to_ep:
+                    sock.claim(addr)
+                for addr in m.addr_to_spec:
+                    sock.claim(addr)
+        lib = self._lib
+        if (
+            lib is None
+            or not hasattr(lib, "ggrs_net_recv_table")
+            or not hasattr(lib, "ggrs_net_supported")
+            or not lib.ggrs_net_supported()
+            or os.environ.get("GGRS_TPU_NO_NATIVE_IO")
+            or os.environ.get("GGRS_TPU_NO_RECV_TABLE")
+        ):
+            return
+        n = len(self._mirrors)
+        fd_rows: List[Tuple[int, int]] = []
+        fd_fault: List[List[int]] = []
+        route_rows: List[Tuple[int, int, int]] = []
+        covered = [False] * n
+        wire_maps: List[Optional[Dict]] = [None] * n
+        deliver: Dict[int, Any] = {}  # slot -> hub view (pending queue)
+        dispatch_idx: Dict[int, int] = {}  # shared fd -> fd table index
+        for i, m in enumerate(self._mirrors):
+            sock = m.socket
+            if self._slot_state[i] != SLOT_NATIVE or self._io_attached[i]:
+                # §9 on a SHARED fd: a quarantined/evicted co-tenant's
+                # inbound still arrives on the hub socket the native
+                # drain keeps reading — dropping its routes would starve
+                # the Python-path session (its datagrams become
+                # unroutable drops).  Keep its routes and deliver its
+                # records into the view's pending queue, where the
+                # evicted session's receive path already looks.
+                if (
+                    getattr(sock, "is_dispatch", False)
+                    and self._slot_state[i] in (SLOT_QUARANTINED,
+                                                SLOT_EVICTED)
+                ):
+                    try:
+                        for addr in m.addr_to_ep:
+                            ip, port = self._resolve_wire_addr(addr)
+                            route_rows.append((ip, port, i))
+                        for addr in m.addr_to_spec:
+                            ip, port = self._resolve_wire_addr(addr)
+                            route_rows.append((ip, port, i))
+                    except (TypeError, ValueError, OSError):
+                        continue
+                    deliver[i] = sock
+                continue
+            fileno = getattr(sock, "fileno", None)
+            if fileno is None:
+                continue
+            try:
+                fd = fileno()
+            except Exception:
+                continue
+            if not isinstance(fd, int) or fd < 0:
+                continue
+            try:
+                wire: Dict[Tuple[int, int], Tuple[str, int]] = {}
+                for addr, idx in m.addr_to_ep.items():
+                    wire[self._resolve_wire_addr(addr)] = ("e", idx)
+                for addr, idx in m.addr_to_spec.items():
+                    wire[self._resolve_wire_addr(addr)] = ("s", idx)
+            except (TypeError, ValueError, OSError):
+                continue
+            if getattr(sock, "is_dispatch", False):
+                hub = getattr(sock, "hub", None)
+                if hub is None:
+                    continue
+                for fd2 in hub.filenos():
+                    at = dispatch_idx.get(fd2)
+                    if at is None:
+                        dispatch_idx[fd2] = len(fd_rows)
+                        fd_rows.append((fd2, -1))
+                        fd_fault.append([i])
+                    elif i not in fd_fault[at]:
+                        fd_fault[at].append(i)
+                for ip, port in wire:
+                    route_rows.append((ip, port, i))
+            else:
+                fd_rows.append((fd, i))
+                fd_fault.append([i])
+            covered[i] = True
+            wire_maps[i] = wire
+        if not fd_rows:
+            return
+        pack = struct.pack
+        route_rows.sort(key=lambda r: (r[0] << 16) | r[1])
+        self._drain_fd_tab = b"".join(
+            pack("<ii", fd, slot) for fd, slot in fd_rows
+        )
+        self._drain_route_tab = b"".join(
+            pack("<IHHi", ip, port, 0, slot)
+            for ip, port, slot in route_rows
+        )
+        self._drain_n_fds = len(fd_rows)
+        self._drain_n_routes = len(route_rows)
+        self._drain_fd_fault = fd_fault
+        self._drain_covered = covered
+        self._drain_covered_keys = [
+            i for i, c in enumerate(covered) if c
+        ]
+        self._drain_wire = wire_maps
+        self._drain_deliver = deliver
+        if self._drain_recs is None:
+            self._drain_recs_cap = max(256, 4 * len(fd_rows))
+            self._drain_recs = ctypes.create_string_buffer(
+                self._drain_recs_cap * _native.NET_RECV_STRIDE
+            )
+            self._drain_slab_cap = max(1 << 18, 4096 * len(fd_rows))
+            self._drain_slab = ctypes.create_string_buffer(
+                self._drain_slab_cap
+            )
+        self._drain_ok = True
+
+    def _drain_inbound(self) -> Optional[Dict[int, Tuple[list, list]]]:
+        """The gen-2 inbound drain: ONE ctypes crossing pulls every
+        covered slot's pending datagrams (recvmmsg per fd, dispatch demux
+        in C) and this routine walks the packed record table once to
+        build each slot's ``(datagrams, spec_datagrams)`` cmd sections —
+        zero per-slot Python calls.  A fatal recv errno faults exactly
+        the owning slot(s) BEFORE the tick snapshot, so the faulted slot
+        skips this tick (§9); the drain itself never raises.  Returns
+        None when the drain plan is stale/disabled (caller falls back to
+        the reference per-slot drain)."""
+        if not self._drain_ok:
+            return None
+        lib = self._lib
+        nb = len(_native.IO_BATCH_BUCKETS) + 1
+        # every covered slot gets a key (the consumer reads membership as
+        # "already drained" — a missing key would re-drain the socket on
+        # the shuttle path); the two lists are allocated only for slots
+        # with traffic this tick
+        out: Dict[int, Optional[Tuple[list, list]]] = dict.fromkeys(
+            self._drain_covered_keys
+        )
+        stats = (ctypes.c_uint64 * _native.NET_RECV_TABLE_STATS)()
+        fatal = (ctypes.c_int32 * 64)()
+        n_fatal = ctypes.c_int32(0)
+        wire_maps = self._drain_wire
+        # local snapshot: a fault below triggers _refresh_drain(), which
+        # REPLACES these tables — the indices in this call's record/fatal
+        # buffers refer to the plan the crossing actually ran against
+        fault_map = self._drain_fd_fault
+        deliver = self._drain_deliver
+        for _round in range(8):  # regrow-and-continue bound (backpressure)
+            ctypes.memset(stats, 0, ctypes.sizeof(stats))
+            n_recs = lib.ggrs_net_recv_table(
+                self._drain_fd_tab, self._drain_n_fds,
+                self._drain_route_tab, self._drain_n_routes,
+                self._drain_recs, self._drain_recs_cap,
+                self._drain_slab, self._drain_slab_cap,
+                stats, fatal, 32, ctypes.byref(n_fatal),
+            )
+            self.drain_crossings += 1
+            if n_recs < 0:
+                # builder bug (corrupt tables): disable the drain and let
+                # this tick run the reference path rather than poison it
+                self._drain_ok = False
+                return None
+            slab = self._drain_slab
+            if n_recs:
+                # one vectorized parse of the record table, then plain-int
+                # column lists for the routing walk (a B=512 dispatch pool
+                # sees ~2B records per tick — per-record unpack_from was
+                # the walk's hottest line)
+                arr = np.frombuffer(
+                    self._drain_recs, dtype=_RECV_DTYPE, count=n_recs
+                )
+                slot_l = arr["slot"].tolist()
+                ip_l = arr["ip"].tolist()
+                port_l = arr["port"].tolist()
+                off_l = arr["off"].tolist()
+                len_l = arr["len"].tolist()
+            for k in range(n_recs):
+                slot = slot_l[k]
+                ip = ip_l[k]
+                port = port_l[k]
+                off = off_l[k]
+                wire = wire_maps[slot]
+                if wire is None:
+                    # quarantined/evicted co-tenant on a shared hub: hand
+                    # the record to the view's pending queue — the slot's
+                    # Python session drains it exactly where the hub's
+                    # reference demux would have put it
+                    view = deliver.get(slot)
+                    if view is not None:
+                        src = (
+                            _pysocket.inet_ntoa(ip.to_bytes(4, "little")),
+                            port,
+                        )
+                        view._pending.append(
+                            (src, slab[off:off + len_l[k]])
+                        )
+                    continue
+                dst = wire.get((ip, port))
+                if dst is None:
+                    continue  # unknown source: the reference drain's drop
+                kind, idx = dst
+                data = slab[off:off + len_l[k]]
+                entry = out[slot]
+                if entry is None:
+                    entry = out[slot] = ([], [])
+                if kind == "e":
+                    entry[0].append((idx, data))
+                else:
+                    entry[1].append((idx, data))
+            t = self._drain_totals
+            t["recv_calls"] += int(stats[0])
+            t["datagrams"] += int(stats[1])
+            t["unroutable"] += int(stats[2])
+            t["backpressure_stops"] += int(stats[3])
+            for b in range(nb):
+                self._drain_hist[b] += int(stats[4 + b])
+            if self._obs_on:
+                self._m_drain_crossings.inc()
+                if stats[1]:
+                    self._m_drain_dgrams.inc(int(stats[1]))
+                if stats[2]:
+                    self._m_drain_unroutable.inc(int(stats[2]))
+                hist = getattr(self._m_drain_batch, "_default", None)
+                if hist is not None and stats[0]:
+                    for b in range(nb):
+                        hist.counts[b] += int(stats[4 + b])
+                    hist.count += int(stats[0])
+                    hist.sum += int(stats[1])
+            for k in range(min(int(n_fatal.value), 32)):
+                fd_idx = fatal[2 * k]
+                err = fatal[2 * k + 1]
+                for slot in fault_map[fd_idx]:
+                    self._on_slot_fault(
+                        slot, _native.BANK_ERR_IO,
+                        f"batched inbound drain errno {err}",
+                    )
+            if int(n_fatal.value):
+                # supervision transitions invalidated the plan (and the
+                # faulted slots must not be re-drained this tick)
+                break
+            if not int(stats[3]):
+                break
+            # backpressure: the kernel still holds datagrams — double the
+            # record/slab capacity and keep draining (appending)
+            self._drain_recs_cap *= 2
+            self._drain_recs = ctypes.create_string_buffer(
+                self._drain_recs_cap * _native.NET_RECV_STRIDE
+            )
+            self._drain_slab_cap *= 2
+            self._drain_slab = ctypes.create_string_buffer(
+                self._drain_slab_cap
+            )
+        return out
+
+    def io_capabilities(self) -> Dict[str, bool]:
+        """The gen-2 per-feature capability/fallback matrix (§23): which
+        datapath tiers THIS pool can use right now.  Every False here is
+        a per-feature fallback to the tier below, never an error."""
+        lib = self._lib
+        native = bool(
+            lib is not None
+            and hasattr(lib, "ggrs_net_supported")
+            and lib.ggrs_net_supported()
+            and not os.environ.get("GGRS_TPU_NO_NATIVE_IO")
+        )
+        return {
+            "native_io": native,
+            "recv_table": bool(
+                native
+                and hasattr(lib, "ggrs_net_recv_table")
+                and not os.environ.get("GGRS_TPU_NO_RECV_TABLE")
+            ),
+            "send_table": bool(
+                native and hasattr(lib, "ggrs_net_send_table")
+            ),
+            "dispatch": any(
+                getattr(m.socket, "is_dispatch", False)
+                for m in self._mirrors
+            ),
+            "reuseport": hasattr(_pysocket, "SO_REUSEPORT"),
+            "gso": bool(
+                native
+                and hasattr(lib, "ggrs_net_gso_supported")
+                and lib.ggrs_net_gso_supported()
+                and not os.environ.get("GGRS_TPU_NO_GSO")
+            ),
+        }
 
     @staticmethod
     def _io_words_to_dict(words) -> Dict[str, Any]:
@@ -1317,8 +1698,10 @@ class HostSessionPool:
             # (the pump's pre-drain scan would walk the cmd for nothing)
             self._use_pump = False
         # the slot is back on the Python shuttle: it may now qualify for
-        # the batched one-crossing outbound flush instead
+        # the batched one-crossing outbound flush and the gen-2 batched
+        # inbound drain instead
         self._refresh_send_fd(index)
+        self._refresh_drain()
 
     # ------------------------------------------------------------------
     # per-tick API
@@ -1517,6 +1900,15 @@ class HostSessionPool:
                     f"Missing local input for handle {missing} while "
                     "calling advance_frame()."
                 )
+        # gen-2 batched inbound (§23a): ONE crossing drains every covered
+        # fd-backed socket BEFORE the tick snapshot — a fatal recv errno
+        # faults the owning slot(s) here, so they skip this tick cleanly
+        if self._drain_ok:
+            _dt0 = time.perf_counter_ns()
+            drained = self._drain_inbound()
+            self.drain_ns += time.perf_counter_ns() - _dt0
+        else:
+            drained = None
         # snapshot which slots the bank steps this tick: the parse below
         # must use the build-time view even if new faults land mid-parse
         ticked = [s == SLOT_NATIVE for s in self._slot_state]
@@ -1544,7 +1936,14 @@ class HostSessionPool:
                 cmd_parts.append(pack("<BHq", op, ep_idx, frame))
             datagrams = []
             spec_datagrams = []
-            if not self._io_attached[i]:
+            if drained is not None and i in drained:
+                # gen-2: this slot's inbound was already pulled by the
+                # one-crossing batched drain above — routed record table,
+                # zero per-slot Python calls (None = covered, no traffic)
+                rec = drained[i]
+                if rec is not None:
+                    datagrams, spec_datagrams = rec
+            elif not self._io_attached[i]:
                 # the Python shuttle: drain + route per datagram here.
                 # Attached slots drain INSIDE the crossing (recvmmsg) —
                 # only injected chaos traffic rides the cmd sections.
@@ -1819,7 +2218,7 @@ class HostSessionPool:
         table_rows: List[Tuple[int, int, int, int, int]] = []  # native tbl
         table_slots: List[int] = []
         pass2: List[Tuple[int, int]] = []  # (slot, pos after out sections)
-        flush_failed: Dict[int, str] = {}
+        flush_failed: Dict[int, Tuple[int, str]] = {}  # slot -> code, msg
         for idx in range(n):
             if not fast_l[idx]:
                 requests, _, _ = self._parse_slot(
@@ -1887,7 +2286,7 @@ class HostSessionPool:
                 except Exception as e:
                     failed = f"socket send failed: {e!r}"
             if failed is not None:
-                flush_failed[idx] = failed
+                flush_failed[idx] = (0, failed)
             pass2.append((idx, pos))
 
         # ---- the one native outbound crossing for fd-backed slots ----
@@ -1897,10 +2296,14 @@ class HostSessionPool:
             desc["fd"] = cols[0]
             desc["ip"] = cols[1]
             desc["port"] = cols[2]
-            desc["pad"] = 0
+            # dispatch-mode rows carry kSendFlagDispatch: a fatal errno on
+            # the SHARED fd faults only the owning record's slot, the run
+            # continues for co-tenants (§23b)
+            send_flags = self._send_flags
+            desc["flags"] = [send_flags[s] for s in table_slots]
             desc["off"] = cols[3]
             desc["len"] = cols[4]
-            stats3 = (ctypes.c_uint64 * 3)()
+            stats3 = (ctypes.c_uint64 * _native.NET_SEND_STATS)()
             fatal = (ctypes.c_int32 * 32)()
             rc = self._lib.ggrs_net_send_table(
                 desc.ctypes.data, len(table_rows), self._out_buf, out_len,
@@ -1912,15 +2315,16 @@ class HostSessionPool:
                 # silently (dict.fromkeys: deterministic slot order)
                 for idx in dict.fromkeys(table_slots):
                     flush_failed.setdefault(
-                        idx, f"ggrs_net_send_table failed: {rc}"
+                        idx, (0, f"ggrs_net_send_table failed: {rc}")
                     )
             else:
                 for k in range(min(rc, 16)):
                     slot = table_slots[fatal[2 * k]]
                     flush_failed.setdefault(
                         slot,
-                        "socket send failed: batched flush errno "
-                        f"{fatal[2 * k + 1]}",
+                        (_native.BANK_ERR_IO,
+                         "socket send failed: batched flush errno "
+                         f"{fatal[2 * k + 1]}"),
                     )
                 if rc > 16:
                     # more fatal fds than the report buffer holds (a
@@ -1931,13 +2335,20 @@ class HostSessionPool:
                     for idx in dict.fromkeys(table_slots):
                         flush_failed.setdefault(
                             idx,
-                            "socket send failed: batched flush fatal "
-                            f"overflow ({rc} fatal fds)",
+                            (_native.BANK_ERR_IO,
+                             "socket send failed: batched flush fatal "
+                             f"overflow ({rc} fatal fds)"),
                         )
             if self._obs_on and stats3[1]:
                 self._m_io_send_errors.inc(int(stats3[1]))
             if self._obs_on and stats3[2]:
                 self._m_io_oversized.inc(int(stats3[2]))
+            if stats3[3]:
+                self._gso_totals["gso_sends"] += int(stats3[3])
+                self._gso_totals["gso_segments"] += int(stats3[4])
+                if self._obs_on:
+                    self._m_gso_sends.inc(int(stats3[3]))
+                    self._m_gso_segments.inc(int(stats3[4]))
 
         # ---- pass 2: journal taps, policy, frame mirrors, forensics ----
         for idx, pos in pass2:
@@ -1971,7 +2382,8 @@ class HostSessionPool:
                             buf[bo + h * isize : bo + (h + 1) * isize]
                         )
                     m.staged_native.clear()
-                self._on_slot_fault(idx, 0, flush_failed[idx])
+                code, detail = flush_failed[idx]
+                self._on_slot_fault(idx, code, detail)
                 lists[idx] = []
             hf = flags_l[idx]
             players, isize = m.num_players, m.input_size
@@ -2359,6 +2771,26 @@ class HostSessionPool:
                     )
                     self._fanout_counters[idx] = fan
                 fan_d, fan_b = fan
+                # gen-2 fan-out (§23c): when the slot's socket rides the
+                # native send table, stage every viewer datagram as a
+                # table row and flush ONCE — the native side coalesces
+                # same-viewer equal-size runs into GSO segmented sends
+                # (sendmmsg fallback when UDP_SEGMENT is unavailable).
+                # GGRS_TPU_NO_FASTPATH pins this loop per-datagram.
+                fd = (
+                    self._send_fds[idx] if self._vectorized
+                    and self._send_fds else None
+                )
+                spec_rows: Optional[List[Tuple[int, int, bytes]]] = None
+                if fd is not None:
+                    try:
+                        spec_wire = [
+                            self._resolve_wire_addr(sp.addr)
+                            for sp in m.spectators
+                        ]
+                        spec_rows = []
+                    except (TypeError, ValueError, OSError):
+                        spec_rows = None  # unresolvable viewer: reference
                 for e, sp in enumerate(m.spectators):
                     to_send = sp.deferred
                     sp.deferred = []
@@ -2373,12 +2805,30 @@ class HostSessionPool:
                                 (f"spec{e}", len(data),
                                  zlib.crc32(data)),
                             )
+                        if spec_rows is not None:
+                            # same forensics caveat as §21c: the flush
+                            # outcome lands after the whole stage, so
+                            # these counters may include datagrams a
+                            # mid-flush fatal abandons (bounded by the
+                            # EV_FAULT marker)
+                            ip, port = spec_wire[e]
+                            spec_rows.append((ip, port, data))
+                            fan_d()
+                            fan_b(len(data))
+                            continue
                         try:
                             send_raw(data, sp.addr)
                             fan_d()
                             fan_b(len(data))
                         except Exception as exc:
                             send_failed = f"socket send failed: {exc!r}"
+                if spec_rows and send_failed is None:
+                    # flushed BEFORE the adv-phase endpoint sends below:
+                    # the reference path interleaves on the same socket
+                    # in exactly this order
+                    send_failed = self._spec_send_table(
+                        idx, fd, spec_rows
+                    )
             elif not live:
                 # a faulted/skipped slot's deferred stream is stale: the
                 # fan-out window lives in the harvest's pending dumps
@@ -2467,6 +2917,53 @@ class HostSessionPool:
         if not live:
             requests = []
         return requests, pos, current
+
+    def _spec_send_table(self, idx: int, fd: int,
+                         rows: List[Tuple[int, int, bytes]]) -> Optional[str]:
+        """Flush one slot's staged spectator fan-out through the native
+        send table (§23c) — one crossing for the whole viewer burst; the
+        native side GSO-coalesces same-viewer equal-size runs and windows
+        the rest through sendmmsg.  Returns a fault string (the
+        ``send_failed`` contract of :meth:`_parse_slot`) or None."""
+        payload = b"".join(r[2] for r in rows)
+        desc = np.empty(len(rows), _SEND_DTYPE)
+        desc["fd"] = fd
+        desc["ip"] = [r[0] for r in rows]
+        desc["port"] = [r[1] for r in rows]
+        desc["flags"] = self._send_flags[idx] if self._send_flags else 0
+        off = 0
+        offs: List[int] = []
+        lens: List[int] = []
+        for _, _, data in rows:
+            offs.append(off)
+            lens.append(len(data))
+            off += len(data)
+        desc["off"] = offs
+        desc["len"] = lens
+        stats = (ctypes.c_uint64 * _native.NET_SEND_STATS)()
+        fatal = (ctypes.c_int32 * 8)()
+        rc = self._lib.ggrs_net_send_table(
+            desc.ctypes.data, len(rows), payload, len(payload),
+            stats, fatal, 4,
+        )
+        if self._obs_on and stats[1]:
+            self._m_io_send_errors.inc(int(stats[1]))
+        if self._obs_on and stats[2]:
+            self._m_io_oversized.inc(int(stats[2]))
+        if stats[3]:
+            self._gso_totals["gso_sends"] += int(stats[3])
+            self._gso_totals["gso_segments"] += int(stats[4])
+            if self._obs_on:
+                self._m_gso_sends.inc(int(stats[3]))
+                self._m_gso_segments.inc(int(stats[4]))
+        if rc < 0:
+            return f"socket send failed: ggrs_net_send_table {rc}"
+        if rc > 0:
+            return (
+                "socket send failed: batched fan-out errno "
+                f"{fatal[1]}"
+            )
+        return None
 
     # ------------------------------------------------------------------
     # supervision: quarantine, eviction, retirement (fault isolation)
@@ -2646,6 +3143,11 @@ class HostSessionPool:
             # path), so io_state() must say "python" and the NetBatch is
             # released rather than idling attached forever
             self._detach_io(index)
+        # the drain plan indexes slots by state: any transition in or out
+        # of SLOT_NATIVE changes which fds/routes the one-crossing
+        # inbound drain may touch (a faulted slot must drop out of the
+        # plan IMMEDIATELY — its socket now belongs to supervision)
+        self._refresh_drain()
         self._m_transitions.labels(src=old, dst=new_state).inc()
         self._m_slot_state.labels(state=old).dec()
         self._m_slot_state.labels(state=new_state).inc()
@@ -3218,6 +3720,10 @@ class HostSessionPool:
                 self._lib.ggrs_bank_map_addr(
                     self._bank, index, 1, int(sp_idx), ip, port
                 )
+        # the drain plan's per-slot wire map must learn the new viewer
+        # (and a dispatch hub must claim its source address) before the
+        # next tick's one-crossing drain
+        self._refresh_drain()
         return int(sp_idx)
 
     def _detach_spectator(self, index: int, addr) -> None:
@@ -3236,6 +3742,7 @@ class HostSessionPool:
         sp = m.spectators[sp_idx]
         sp.running = False
         sp.deferred = []
+        self._refresh_drain()
         if index in self._evicted:
             ep = self._evicted[index]._player_reg.spectators.get(addr)
             if ep is not None:
@@ -3407,24 +3914,31 @@ class HostSessionPool:
             self._finalize()
         return "native" if self._io_attached[index] else "python"
 
-    def io_stats(self) -> Dict[str, int]:
+    def io_stats(self) -> Dict[str, Any]:
         """Aggregated NetBatch counters over every attached slot (from
         the one-crossing stats scrape; all zeros when nothing is
-        attached).  Keys: ``_native.IO_STAT_FIELDS``."""
-        out = dict.fromkeys(_native.IO_STAT_FIELDS, 0)
+        attached).  Keys: ``_native.IO_STAT_FIELDS``, plus the gen-2
+        additions (§23): ``drain`` (batched-inbound totals +
+        ``crossings``), ``gso`` (segmented-send totals), and
+        ``capabilities`` (the per-feature fallback matrix)."""
+        out: Dict[str, Any] = dict.fromkeys(_native.IO_STAT_FIELDS, 0)
         if not self._finalized:
             self._finalize()
-        if not self._native_active:
-            return out
-        for s in self._bank_stats():
-            io = s.get("io")
-            # a detached slot's live tail is gone; its retained final
-            # snapshot keeps the totals monotonic
-            if io is None:
-                io = self._io_final.get(s["index"])
-            if io:
-                for k in _native.IO_STAT_FIELDS:
-                    out[k] += io[k]
+        if self._native_active:
+            for s in self._bank_stats():
+                io = s.get("io")
+                # a detached slot's live tail is gone; its retained final
+                # snapshot keeps the totals monotonic
+                if io is None:
+                    io = self._io_final.get(s["index"])
+                if io:
+                    for k in _native.IO_STAT_FIELDS:
+                        out[k] += io[k]
+        out["drain"] = dict(
+            self._drain_totals, crossings=self.drain_crossings
+        )
+        out["gso"] = dict(self._gso_totals)
+        out["capabilities"] = self.io_capabilities()
         return out
 
     def _io_set_capture(self, index: int, on: bool = True) -> None:

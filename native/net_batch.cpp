@@ -69,6 +69,34 @@ constexpr size_t kIdealMaxUdp = 508;
 // stride and field order mirrored by _native.NET_SEND_FIELDS.
 constexpr size_t kSendStride = 20;
 
+// ---- datapath gen 2 (DESIGN.md §23) -------------------------------------
+// ggrs_net_recv_table: ONE crossing drains every non-attached fd-backed
+// socket of the pool.  Inputs: an fd descriptor table (kFdStride bytes per
+// entry: i32 fd, i32 slot; slot == -1 marks a shared DISPATCH fd whose
+// datagrams are demuxed by source address through the route table) and a
+// route table sorted by (ip, port) (kRouteStride bytes per entry: u32 ip,
+// u16 port, u16 pad, i32 slot).  Output: a packed record table
+// (kRecvStride bytes per datagram: i32 slot, i32 fd_idx, u32 ip, u16 port,
+// u16 pad, u32 off, u32 len) whose off/len index the caller's slab.
+constexpr size_t kRecvStride = 24;
+constexpr size_t kRouteStride = 12;
+constexpr size_t kFdStride = 8;
+
+// send-table record flags (the u16 at record offset 10, formerly pad):
+// bit0 marks a DISPATCH record — the fd is shared by many slots, so a
+// fatal errno faults only THIS record (reported, skipped, run continues)
+// instead of abandoning the rest of the fd's run.
+constexpr uint16_t kSendFlagDispatch = 1;
+
+// ggrs_net_send_table stats words: {sent, transient_errors, oversized,
+// gso_sends, gso_segments} — mirrored as _native.NET_SEND_STATS.
+constexpr int kSendTableStats = 5;
+
+// ggrs_net_recv_table stats words: {recv_calls, datagrams, unroutable,
+// backpressure_stops} + the 8-bucket batch-size histogram — mirrored as
+// _native.NET_RECV_TABLE_STATS.
+constexpr int kRecvTableStats = 12;
+
 // stat slots (mirrored as _native.IO_STAT_FIELDS + two 8-bucket
 // histograms; 22 u64 total, the per-slot io tail of ggrs_bank_stats)
 enum NetStat : int {
@@ -94,12 +122,35 @@ inline int batch_bucket(int n) {
 
 }  // namespace
 
+extern "C" {
+
+// runtime stride probes (ggrs-verify pins these against the static
+// layout contract, like ggrs_bank_hdr_stride on the bank side)
+int ggrs_net_recv_stride(void) { return static_cast<int>(kRecvStride); }
+int ggrs_net_route_stride(void) { return static_cast<int>(kRouteStride); }
+int ggrs_net_fd_stride(void) { return static_cast<int>(kFdStride); }
+int ggrs_net_send_stats_len(void) { return kSendTableStats; }
+int ggrs_net_recv_stats_len(void) { return kRecvTableStats; }
+
+}  // extern "C"
+
 #if defined(__linux__)
 
 #include <arpa/inet.h>
 #include <errno.h>
 #include <netinet/in.h>
+#include <netinet/udp.h>
 #include <sys/socket.h>
+#include <unistd.h>
+
+// UDP_SEGMENT landed in linux 4.18; build against older headers still
+// produces a working probe (the setsockopt simply fails on old kernels).
+#ifndef UDP_SEGMENT
+#define UDP_SEGMENT 103
+#endif
+#ifndef SOL_UDP
+#define SOL_UDP 17
+#endif
 
 namespace {
 
@@ -131,6 +182,70 @@ struct Dgram {
   uint16_t port;  // host byte order
   uint32_t off, len;  // slice into the owning slab
 };
+
+// ---- GSO capability (gen 2) ---------------------------------------------
+// One-time per-process probe: can a UDP socket take the UDP_SEGMENT
+// option on THIS kernel?  g_gso_mode is the caller-facing override
+// (ggrs_net_set_gso): -1 auto (probe decides), 0 forced off, 1 forced on
+// (still requires the probe — a kernel that refuses the option cannot be
+// forced).
+int g_gso_mode = -1;
+
+int gso_probe() {
+  static int cached = -1;
+  if (cached >= 0) return cached;
+  int fd = socket(AF_INET, SOCK_DGRAM, 0);
+  if (fd < 0) {
+    cached = 0;
+    return cached;
+  }
+  int seg = 1400;
+  cached = setsockopt(fd, SOL_UDP, UDP_SEGMENT, &seg, sizeof(seg)) == 0;
+  close(fd);
+  return cached;
+}
+
+bool gso_active() { return g_gso_mode != 0 && gso_probe() != 0; }
+
+// route table binary search: entries sorted by (ip, port) as the packed
+// u64 key below (the pool sorts the same way)
+inline uint64_t route_key(uint32_t ip, uint16_t port) {
+  return (static_cast<uint64_t>(ip) << 16) | port;
+}
+
+int32_t route_lookup(const uint8_t* routes, int n_routes, uint32_t ip,
+                     uint16_t port) {
+  uint64_t want = route_key(ip, port);
+  int lo = 0, hi = n_routes - 1;
+  while (lo <= hi) {
+    int mid = lo + (hi - lo) / 2;
+    const uint8_t* p = routes + static_cast<size_t>(mid) * kRouteStride;
+    uint32_t rip = 0;
+    for (int b = 0; b < 4; ++b) rip |= static_cast<uint32_t>(p[b]) << (8 * b);
+    uint16_t rport = static_cast<uint16_t>(p[4] | (p[5] << 8));
+    uint64_t key = route_key(rip, rport);
+    if (key == want) {
+      uint32_t slot = 0;
+      for (int b = 0; b < 4; ++b) {
+        slot |= static_cast<uint32_t>(p[8 + b]) << (8 * b);
+      }
+      return static_cast<int32_t>(slot);
+    }
+    if (key < want) {
+      lo = mid + 1;
+    } else {
+      hi = mid - 1;
+    }
+  }
+  return -1;
+}
+
+// send-table errno injection (scripts/chaos.py --fault socket, dispatch
+// leg): record indices [at, at+count) of subsequent ggrs_net_send_table
+// calls fail with `err` before any syscall, until count is exhausted.
+int g_table_inject_errno = 0;
+int64_t g_table_inject_at = 0;
+int g_table_inject_count = 0;
 
 struct NetBatch {
   int fd = -1;
@@ -393,23 +508,50 @@ void ggrs_net_inject_send_errno(void* p, int err, int count) {
   nb->inject_count = count;
 }
 
-// One-shot batched send over ARBITRARY fds (descriptor plane, §21): no
-// NetBatch attach, no rings kept — the Python pool hands the whole tick's
-// non-attached outbound as one packed table (`desc`: n records of
-// kSendStride bytes; `payload`: the buffer the off/len fields index,
-// usually the tick output buffer itself, zero copies).  Consecutive
-// same-fd records group into sendmmsg windows, so a pool tick pays one
-// Python→C crossing total and ~one syscall per socket instead of one of
-// each per datagram.
+// GSO capability + override (gen 2).  ggrs_net_gso_supported() is the
+// cached per-kernel probe; ggrs_net_set_gso(-1/0/1) is the caller
+// override (auto / forced off / forced on — forcing on still requires
+// the probe, a kernel that refuses UDP_SEGMENT cannot be forced).
+int ggrs_net_gso_supported(void) { return gso_probe(); }
+void ggrs_net_set_gso(int mode) {
+  g_gso_mode = mode < 0 ? -1 : (mode ? 1 : 0);
+}
+
+// Chaos seam for the table path (the NetBatch inject covers only
+// attached sockets): record indices >= `at` of subsequent
+// ggrs_net_send_table calls fail with `err` before any syscall, one
+// record per count, until `count` is exhausted.
+void ggrs_net_inject_table_errno(int err, int64_t at, int count) {
+  g_table_inject_errno = err;
+  g_table_inject_at = at < 0 ? 0 : at;
+  g_table_inject_count = count;
+}
+
+// One-shot batched send over ARBITRARY fds (descriptor plane, §21; gen 2
+// §23): no NetBatch attach, no rings kept — the Python pool hands the
+// whole tick's non-attached outbound as one packed table (`desc`: n
+// records of kSendStride bytes; `payload`: the buffer the off/len fields
+// index, usually the tick output buffer itself, zero copies).
+// Consecutive same-fd records group into sendmmsg windows, so a pool
+// tick pays one Python→C crossing total and ~one syscall per socket
+// instead of one of each per datagram.  Gen 2: consecutive same-(ip,port)
+// equal-size records inside a window coalesce into ONE UDP_SEGMENT
+// (GSO) message when the kernel supports it — the spectator fan-out's
+// per-viewer catch-up bursts become one segmented send — with automatic
+// per-group fallback to plain sendmmsg on any GSO send failure.
 //
 // Errno semantics mirror UdpNonBlockingSocket.send_datagram exactly:
-// transient errnos count the datagram as lost (stats3[1]) and the flush
-// continues; a fatal errno abandons the REST OF THAT FD's run (the same
-// partial-send window a raising sendto leaves) and is reported as a
-// (record index, errno) pair in `fatal` so the caller can fault exactly
-// the owning slot; other fds keep flushing.  Oversized datagrams are
-// counted (stats3[2]), never blocked.  stats3 = {sent, transient_errors,
-// oversized}, accumulated (callers zero it).
+// transient errnos count the datagram as lost (stats[1]) and the flush
+// continues; a fatal errno is reported as a (record index, errno) pair
+// in `fatal` so the caller can fault exactly the owning slot.  A fatal
+// on a plain per-slot record abandons the REST OF THAT FD's run (the
+// same partial-send window a raising sendto leaves); a fatal on a
+// record carrying kSendFlagDispatch (offset 10, bit0) skips ONLY that
+// record — the fd is shared by many slots, and co-tenant records must
+// still flush (§9: fault the owning slot, never the pool).  Oversized
+// datagrams are counted (stats[2]), never blocked.  stats =
+// {sent, transient_errors, oversized, gso_sends, gso_segments}
+// (kSendTableStats words, accumulated; callers zero it).
 //
 // Returns the number of fatal pairs written (0 = clean), or
 // kNetErrBadArgs.  The caller must sort records so each fd forms one
@@ -417,18 +559,29 @@ void ggrs_net_inject_send_errno(void* p, int err, int count) {
 // pool never emits split runs).
 int ggrs_net_send_table(const uint8_t* desc, int64_t n,
                         const uint8_t* payload, size_t payload_len,
-                        uint64_t* stats3, int32_t* fatal, int fatal_cap) {
-  if (n < 0 || (n > 0 && (!desc || !payload || !stats3))) {
+                        uint64_t* stats, int32_t* fatal, int fatal_cap) {
+  if (n < 0 || (n > 0 && (!desc || !payload || !stats))) {
     return kNetErrBadArgs;
   }
   constexpr int kWin = 64;
+  constexpr int kGsoMaxSegs = 60;       // < UDP_MAX_SEGMENTS (64)
+  constexpr size_t kGsoMaxBytes = 60000;  // < 16-bit UDP length budget
   static thread_local std::vector<mmsghdr> msgs(kWin);
-  static thread_local std::vector<iovec> iov(kWin);
+  static thread_local std::vector<iovec> iov(kWin * kGsoMaxSegs);
   static thread_local std::vector<sockaddr_in> addr(kWin);
+  static thread_local std::vector<uint8_t> cmsg(
+      kWin * CMSG_SPACE(sizeof(uint16_t)));
+  static thread_local std::vector<int64_t> msg_rec0(kWin);
+  static thread_local std::vector<int64_t> msg_nrec(kWin);
   int n_fatal = 0;
   int64_t i = 0;
+  // per-call GSO retreat: any send failure whose window head is a GSO
+  // group falls the whole group back to plain records (covers both
+  // transient parity — drop ONE datagram, not the group — and kernels
+  // that accept the setsockopt probe but refuse segmented sends)
+  int64_t plain_until = -1;
   auto rec = [&](int64_t k, int32_t* fd, uint32_t* ip, uint16_t* port,
-                 uint32_t* off, uint32_t* len) {
+                 uint16_t* flags, uint32_t* off, uint32_t* len) {
     const uint8_t* p = desc + static_cast<size_t>(k) * kSendStride;
     auto r32 = [&p](size_t at) {
       uint32_t v = 0;
@@ -440,74 +593,314 @@ int ggrs_net_send_table(const uint8_t* desc, int64_t n,
     *fd = static_cast<int32_t>(r32(0));
     *ip = r32(4);
     *port = static_cast<uint16_t>(p[8] | (p[9] << 8));
+    *flags = static_cast<uint16_t>(p[10] | (p[11] << 8));
     *off = r32(12);
     *len = r32(16);
+  };
+  auto inject_hits = [&](int64_t k) {
+    return g_table_inject_count > 0 && k >= g_table_inject_at;
   };
   while (i < n) {
     int32_t fd;
     uint32_t ip, off, len;
-    uint16_t port;
-    rec(i, &fd, &ip, &port, &off, &len);
+    uint16_t port, flags;
+    rec(i, &fd, &ip, &port, &flags, &off, &len);
     // the fd's contiguous run [i, run_end)
     int64_t run_end = i;
     while (run_end < n) {
       int32_t fd2;
       uint32_t ip2, off2, len2;
-      uint16_t port2;
-      rec(run_end, &fd2, &ip2, &port2, &off2, &len2);
+      uint16_t port2, flags2;
+      rec(run_end, &fd2, &ip2, &port2, &flags2, &off2, &len2);
       if (fd2 != fd) break;
       if (static_cast<size_t>(off2) + len2 > payload_len) {
         return kNetErrBadArgs;  // corrupt table: refuse whole call
       }
-      if (len2 > kIdealMaxUdp) stats3[2] += 1;
+      if (len2 > kIdealMaxUdp) stats[2] += 1;
       ++run_end;
     }
     int64_t j = i;
-    bool fd_fatal = false;
     while (j < run_end) {
-      size_t win = static_cast<size_t>(run_end - j);
-      if (win > kWin) win = kWin;
-      for (size_t k = 0; k < win; ++k) {
-        int32_t fdk;
-        uint32_t ipk, offk, lenk;
-        uint16_t portk;
-        rec(j + static_cast<int64_t>(k), &fdk, &ipk, &portk, &offk, &lenk);
-        iov[k].iov_base = const_cast<uint8_t*>(payload) + offk;
-        iov[k].iov_len = lenk;
-        std::memset(&addr[k], 0, sizeof(sockaddr_in));
-        addr[k].sin_family = AF_INET;
-        addr[k].sin_addr.s_addr = ipk;
-        addr[k].sin_port = htons(portk);
+      // chaos seam: the head record "fails" with the injected errno
+      // before any syscall (window building below guarantees an
+      // injected record always surfaces as a window head)
+      if (inject_hits(j)) {
+        g_table_inject_count -= 1;
+        int32_t fdj;
+        uint32_t ipj, offj, lenj;
+        uint16_t portj, flagsj;
+        rec(j, &fdj, &ipj, &portj, &flagsj, &offj, &lenj);
+        if (transient_send_errno(g_table_inject_errno)) {
+          stats[1] += 1;
+          j += 1;
+          continue;
+        }
+        if (n_fatal < fatal_cap && fatal) {
+          fatal[2 * n_fatal] = static_cast<int32_t>(j);
+          fatal[2 * n_fatal + 1] = static_cast<int32_t>(g_table_inject_errno);
+        }
+        ++n_fatal;
+        if (flagsj & kSendFlagDispatch) {
+          j += 1;  // shared fd: co-tenant records keep flushing
+          continue;
+        }
+        break;  // per-slot fd: abandon the rest of the run
+      }
+      // build one sendmmsg window of up to kWin messages; each message
+      // is either a single record or a GSO group of >= 2 consecutive
+      // same-destination records (all full segments except a shorter
+      // tail), expressed as one multi-iovec message + UDP_SEGMENT cmsg
+      size_t nmsg = 0;
+      size_t iov_used = 0;
+      int64_t cursor = j;
+      const bool gso = gso_active();
+      while (nmsg < static_cast<size_t>(kWin) && cursor < run_end) {
+        if (cursor > j && inject_hits(cursor)) break;  // keep at head
+        int32_t fd0;
+        uint32_t ip0, off0, len0;
+        uint16_t port0, flags0;
+        rec(cursor, &fd0, &ip0, &port0, &flags0, &off0, &len0);
+        int64_t g = 1;
+        if (gso && cursor >= plain_until && len0 > 0) {
+          size_t total = len0;
+          while (cursor + g < run_end && g < kGsoMaxSegs) {
+            if (inject_hits(cursor + g)) break;
+            int32_t fdg;
+            uint32_t ipg, offg, leng;
+            uint16_t portg, flagsg;
+            rec(cursor + g - 1, &fdg, &ipg, &portg, &flagsg, &offg, &leng);
+            if (leng != len0) break;  // previous must be a full segment
+            rec(cursor + g, &fdg, &ipg, &portg, &flagsg, &offg, &leng);
+            if (ipg != ip0 || portg != port0) break;
+            if (leng > len0 || total + leng > kGsoMaxBytes) break;
+            total += leng;
+            ++g;
+          }
+        }
+        if (g >= 2 && iov_used + static_cast<size_t>(g) > iov.size()) {
+          break;  // iovec pool exhausted: flush what we have first
+        }
+        std::memset(&addr[nmsg], 0, sizeof(sockaddr_in));
+        addr[nmsg].sin_family = AF_INET;
+        addr[nmsg].sin_addr.s_addr = ip0;
+        addr[nmsg].sin_port = htons(port0);
+        std::memset(&msgs[nmsg], 0, sizeof(mmsghdr));
+        msgs[nmsg].msg_hdr.msg_name = &addr[nmsg];
+        msgs[nmsg].msg_hdr.msg_namelen = sizeof(sockaddr_in);
+        msgs[nmsg].msg_hdr.msg_iov = &iov[iov_used];
+        msgs[nmsg].msg_hdr.msg_iovlen = static_cast<size_t>(g);
+        for (int64_t s = 0; s < g; ++s) {
+          int32_t fds_;
+          uint32_t ips, offs, lens;
+          uint16_t ports, flagss;
+          rec(cursor + s, &fds_, &ips, &ports, &flagss, &offs, &lens);
+          iov[iov_used + static_cast<size_t>(s)].iov_base =
+              const_cast<uint8_t*>(payload) + offs;
+          iov[iov_used + static_cast<size_t>(s)].iov_len = lens;
+        }
+        if (g >= 2) {
+          uint8_t* cb = cmsg.data() + nmsg * CMSG_SPACE(sizeof(uint16_t));
+          msgs[nmsg].msg_hdr.msg_control = cb;
+          msgs[nmsg].msg_hdr.msg_controllen = CMSG_SPACE(sizeof(uint16_t));
+          cmsghdr* cm = CMSG_FIRSTHDR(&msgs[nmsg].msg_hdr);
+          cm->cmsg_level = SOL_UDP;
+          cm->cmsg_type = UDP_SEGMENT;
+          cm->cmsg_len = CMSG_LEN(sizeof(uint16_t));
+          uint16_t seg = static_cast<uint16_t>(len0);
+          std::memcpy(CMSG_DATA(cm), &seg, sizeof(seg));
+        }
+        msg_rec0[nmsg] = cursor;
+        msg_nrec[nmsg] = g;
+        iov_used += static_cast<size_t>(g);
+        cursor += g;
+        ++nmsg;
+      }
+      if (nmsg == 0) break;  // defensive: cannot make progress
+      int r = sendmmsg(fd, msgs.data(), static_cast<unsigned>(nmsg), 0);
+      if (r < 0) {
+        if (errno == EINTR) continue;  // PEP 475: retry the window
+        if (msg_nrec[0] > 1) {
+          // GSO head failed: retreat the whole group to plain records
+          // and retry, so the errno attributes to exactly one datagram
+          plain_until = msg_rec0[0] + msg_nrec[0];
+          continue;
+        }
+        if (transient_send_errno(errno)) {
+          stats[1] += 1;  // the head datagram is lost; keep going
+          j += 1;
+          continue;
+        }
+        int32_t fdj;
+        uint32_t ipj, offj, lenj;
+        uint16_t portj, flagsj;
+        rec(j, &fdj, &ipj, &portj, &flagsj, &offj, &lenj);
+        if (n_fatal < fatal_cap && fatal) {
+          fatal[2 * n_fatal] = static_cast<int32_t>(j);
+          fatal[2 * n_fatal + 1] = static_cast<int32_t>(errno);
+        }
+        ++n_fatal;
+        if (flagsj & kSendFlagDispatch) {
+          j += 1;  // shared fd: co-tenant records keep flushing
+          continue;
+        }
+        break;  // per-slot fd: abandon the rest of the run
+      }
+      int64_t sent_recs = 0;
+      for (int k = 0; k < r; ++k) {
+        sent_recs += msg_nrec[static_cast<size_t>(k)];
+        if (msg_nrec[static_cast<size_t>(k)] > 1) {
+          stats[3] += 1;
+          stats[4] += static_cast<uint64_t>(msg_nrec[static_cast<size_t>(k)]);
+        }
+      }
+      stats[0] += static_cast<uint64_t>(sent_recs);
+      j += sent_recs;
+      // r < nmsg without errno: retry from the stall point next iteration
+    }
+    i = run_end;
+  }
+  return n_fatal;
+}
+
+// One-crossing inbound drain over ARBITRARY fds (gen 2, §23): the pool
+// hands its whole non-attached fd set as one packed table (`fds`: n_fds
+// entries of kFdStride bytes — i32 fd, i32 slot; slot == -1 marks a
+// shared DISPATCH fd) plus a route table sorted by (ip, port)
+// (`routes`: n_routes entries of kRouteStride bytes) for demuxing
+// dispatch datagrams by source address.  Every fd is drained
+// recvmmsg-until-dry with ggrs_net_recv_all's errno semantics; each
+// datagram is copied once into `slab` and described by one kRecvStride
+// record in `recs` (i32 slot, i32 fd_idx, u32 ip, u16 port, u16 pad,
+// u32 off, u32 len), in arrival order per fd — the exact order the
+// per-slot receive_all_datagrams reference observes.
+//
+// A fatal recv errno is reported as a (fd index, errno) pair in `fatal`
+// (that fd stops; others keep draining) so the caller faults exactly
+// the owning slot(s).  Unroutable dispatch datagrams are dropped and
+// counted (stats[2]), like the Python demux dropping unknown sources.
+// When the record table or slab cannot hold another full batch the
+// drain STOPS — never mid-batch, so nothing read from the kernel is
+// lost — and counts a backpressure stop (stats[3]); the kernel queue
+// keeps the rest for the caller to regrow and re-drain.  stats =
+// {recv_calls, datagrams, unroutable, backpressure_stops, hist[8]}
+// (kRecvTableStats words, accumulated; callers zero it).
+//
+// Returns the record count (>= 0) or kNetErrBadArgs; the fatal-pair
+// count lands in *n_fatal_out.
+int ggrs_net_recv_table(const uint8_t* fds, int n_fds,
+                        const uint8_t* routes, int n_routes,
+                        uint8_t* recs, int max_recs,
+                        uint8_t* slab, int64_t slab_cap,
+                        uint64_t* stats, int32_t* fatal, int fatal_cap,
+                        int32_t* n_fatal_out) {
+  if (n_fds < 0 || n_routes < 0 || max_recs < 0 || slab_cap < 0 ||
+      (n_fds > 0 && (!fds || !recs || !slab || !stats || !n_fatal_out)) ||
+      (n_routes > 0 && !routes)) {
+    return kNetErrBadArgs;
+  }
+  constexpr int kDrainWin = 64;
+  struct Ring {
+    std::vector<mmsghdr> msgs;
+    std::vector<iovec> iov;
+    std::vector<sockaddr_in> addr;
+    std::vector<uint8_t> buf;
+    Ring() : msgs(kDrainWin), iov(kDrainWin), addr(kDrainWin),
+             buf(static_cast<size_t>(kDrainWin) * kRecvBufSize) {
+      for (int k = 0; k < kDrainWin; ++k) {
+        iov[k].iov_base = buf.data() + static_cast<size_t>(k) * kRecvBufSize;
+        iov[k].iov_len = kRecvBufSize;
         std::memset(&msgs[k], 0, sizeof(mmsghdr));
         msgs[k].msg_hdr.msg_iov = &iov[k];
         msgs[k].msg_hdr.msg_iovlen = 1;
         msgs[k].msg_hdr.msg_name = &addr[k];
         msgs[k].msg_hdr.msg_namelen = sizeof(sockaddr_in);
       }
-      int r = sendmmsg(fd, msgs.data(), static_cast<unsigned>(win), 0);
+    }
+  };
+  static thread_local Ring ring;
+  int n_recs = 0;
+  int64_t slab_used = 0;
+  int n_fatal = 0;
+  bool full = false;
+  for (int e = 0; e < n_fds && !full; ++e) {
+    const uint8_t* fp = fds + static_cast<size_t>(e) * kFdStride;
+    int32_t fd = 0, slot = 0;
+    for (int b = 0; b < 4; ++b) {
+      fd |= static_cast<int32_t>(fp[b]) << (8 * b);
+      slot |= static_cast<int32_t>(fp[4 + b]) << (8 * b);
+    }
+    while (true) {
+      // clamp the batch so every datagram the kernel hands over has a
+      // guaranteed record + slab home — backpressure stops BEFORE the
+      // syscall, never after, so no datagram is silently dropped
+      int vlen = kDrainWin;
+      if (vlen > max_recs - n_recs) vlen = max_recs - n_recs;
+      int64_t slab_room =
+          (slab_cap - slab_used) / static_cast<int64_t>(kRecvBufSize);
+      if (vlen > slab_room) vlen = static_cast<int>(slab_room);
+      if (vlen <= 0) {
+        stats[3] += 1;
+        full = true;
+        break;
+      }
+      for (int k = 0; k < vlen; ++k) {
+        ring.msgs[k].msg_hdr.msg_namelen = sizeof(sockaddr_in);
+        ring.msgs[k].msg_len = 0;
+      }
+      int r = recvmmsg(fd, ring.msgs.data(), static_cast<unsigned>(vlen), 0,
+                       nullptr);
+      stats[0] += 1;
       if (r < 0) {
-        if (errno == EINTR) continue;  // PEP 475: retry the window
-        if (transient_send_errno(errno)) {
-          stats3[1] += 1;  // the head datagram is lost; keep going
-          j += 1;
-          continue;
+        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+        if (errno == EINTR || errno == ECONNRESET || errno == ECONNREFUSED) {
+          continue;  // the ConnectionResetError-continue of the Python path
         }
         if (n_fatal < fatal_cap && fatal) {
-          fatal[2 * n_fatal] = static_cast<int32_t>(j);
+          fatal[2 * n_fatal] = e;
           fatal[2 * n_fatal + 1] = static_cast<int32_t>(errno);
         }
         ++n_fatal;
-        fd_fatal = true;
-        break;
+        break;  // this fd stops; the others keep draining
       }
-      stats3[0] += static_cast<uint64_t>(r);
-      j += r;
-      // r < win without errno: retry from the stall point next iteration
+      if (r == 0) break;
+      stats[4 + batch_bucket(r)] += 1;
+      for (int k = 0; k < r; ++k) {
+        uint32_t ip = ring.addr[k].sin_addr.s_addr;
+        uint16_t port = ntohs(ring.addr[k].sin_port);
+        int32_t dst = slot;
+        if (dst < 0) {
+          dst = route_lookup(routes, n_routes, ip, port);
+          if (dst < 0) {
+            stats[2] += 1;  // unroutable dispatch source: drop, like the
+            continue;       // Python demux ignoring unknown senders
+          }
+        }
+        size_t len = ring.msgs[k].msg_len;
+        uint8_t* rp = recs + static_cast<size_t>(n_recs) * kRecvStride;
+        auto w32 = [&rp](size_t at, uint32_t v) {
+          for (int b = 0; b < 4; ++b) rp[at + b] = (v >> (8 * b)) & 0xFF;
+        };
+        w32(0, static_cast<uint32_t>(dst));
+        w32(4, static_cast<uint32_t>(e));
+        w32(8, ip);
+        rp[12] = port & 0xFF;
+        rp[13] = port >> 8;
+        rp[14] = 0;
+        rp[15] = 0;
+        w32(16, static_cast<uint32_t>(slab_used));
+        w32(20, static_cast<uint32_t>(len));
+        std::memcpy(slab + slab_used,
+                    ring.buf.data() + static_cast<size_t>(k) * kRecvBufSize,
+                    len);
+        slab_used += static_cast<int64_t>(len);
+        ++n_recs;
+        stats[1] += 1;
+      }
+      if (r < vlen) break;  // queue ran dry mid-batch: no probe needed
     }
-    (void)fd_fatal;  // the rest of this fd's run was abandoned above
-    i = run_end;
   }
-  return n_fatal;
+  if (n_fatal_out) *n_fatal_out = n_fatal;
+  return n_recs;
 }
 
 }  // extern "C"
@@ -547,6 +940,15 @@ int ggrs_net_send_table(const uint8_t*, int64_t, const uint8_t*, size_t,
                         uint64_t*, int32_t*, int) {
   return kNetErrUnsupported;
 }
+int ggrs_net_recv_table(const uint8_t*, int, const uint8_t*, int, uint8_t*,
+                        int, uint8_t*, int64_t, uint64_t*, int32_t*, int,
+                        int32_t* n_fatal_out) {
+  if (n_fatal_out) *n_fatal_out = 0;
+  return kNetErrUnsupported;
+}
+int ggrs_net_gso_supported(void) { return 0; }
+void ggrs_net_set_gso(int) {}
+void ggrs_net_inject_table_errno(int, int64_t, int) {}
 
 }  // extern "C"
 

@@ -87,6 +87,7 @@ python -m pytest tests/test_session_bank.py tests/test_policy_plane.py \
     tests/test_obs.py tests/test_broadcast.py tests/test_replay_journal.py \
     tests/test_trace.py tests/test_desync_detection.py \
     tests/test_native_io.py tests/test_socket_datapath.py \
+    tests/test_net_gen2.py \
     tests/test_fleet.py tests/test_fleet_rpc.py tests/test_fleet_proc.py \
     tests/test_fleet_obs.py \
     -q -p no:cacheprovider -m "not slow" \
@@ -120,6 +121,7 @@ TSAN_OPTIONS="halt_on_error=1:second_deadlock_stack=1" \
 GGRS_NATIVE_SANITIZE=thread \
 JAX_PLATFORMS=cpu \
 python -m pytest tests/test_native_io.py tests/test_socket_datapath.py \
+    tests/test_net_gen2.py \
     tests/test_thread_ownership.py tests/test_fleet_proc.py \
     tests/test_descriptor_plane.py \
     -q -p no:cacheprovider -m "not slow" \

@@ -81,6 +81,7 @@ from ggrs_tpu.chaos import (  # noqa: E402
     drive_broadcast,
     drive_chaos,
     drive_desync_forensics,
+    drive_dispatch_chaos,
     drive_socket_chaos,
 )
 from ggrs_tpu.net import _native  # noqa: E402
@@ -509,6 +510,76 @@ def verify_socket_leg(matches: int, ticks: int, seed: int,
               f"io={{recv_calls: {chaos['io']['recv_calls']}, "
               f"send_calls: {chaos['io']['send_calls']}, "
               f"send_errors: {chaos['io']['send_errors']}}}")
+
+    # --- shared dispatch socket leg (DESIGN.md §23): a fatal errno on
+    # the SHARED fd must fault exactly the owning slot — the record's,
+    # not the fd's — while every co-tenant stays native and bit-identical
+    # (peer-observed bytes) to a fault-free dispatch control
+    try:
+        d_control = drive_dispatch_chaos(ticks, n_matches=matches,
+                                         seed=seed)
+    except RuntimeError as e:
+        print(f"  [dispatch_fatal] skip: {e}")
+        d_control = None
+    if d_control is not None:
+        def dispatch_storm(i, ctx):
+            # record 0 of tick 50's send table = the target slot's (the
+            # table is packed in slot order; slot 0 sends every tick)
+            if i == 50:
+                ctx["lib"].ggrs_net_inject_table_errno(_errno.EPERM, 0, 1)
+
+        d_chaos = drive_dispatch_chaos(
+            ticks, n_matches=matches, seed=seed, inject=dispatch_storm
+        )
+        legs["dispatch_fatal"] = d_chaos
+        target = d_chaos["target"]
+        pool = d_chaos["pool"]
+        for f in pool.fault_log(target):
+            print(f"    [dispatch_fatal] fault@tick {f.tick}: "
+                  f"code={f.code} {f.detail}")
+        if d_chaos["states"][target] != "evicted":
+            violations.append(
+                "dispatch_fatal: shared-fd fatal did not evict the "
+                f"owner: {d_chaos['states'][target]}"
+            )
+        if not any(f.code == _nat.BANK_ERR_IO
+                   for f in pool.fault_log(target)):
+            violations.append("dispatch_fatal: fault log missing "
+                              "BANK_ERR_IO")
+        if d_chaos["frames"][target] < ticks - 80:
+            violations.append(
+                "dispatch_fatal: target stalled at frame "
+                f"{d_chaos['frames'][target]}"
+            )
+        for idx in range(1, matches + 1):
+            if d_chaos["states"][idx] != "native":
+                violations.append(
+                    f"dispatch_fatal: co-tenant slot {idx} left native: "
+                    f"{d_chaos['states'][idx]}"
+                )
+            if d_chaos["wire"][idx] != d_control["wire"][idx]:
+                violations.append(
+                    f"dispatch_fatal: co-tenant slot {idx} wire diverged "
+                    f"({len(d_chaos['wire'][idx])} vs "
+                    f"{len(d_control['wire'][idx])} datagrams)"
+                )
+            if d_chaos["reqs"][idx] != d_control["reqs"][idx]:
+                violations.append(
+                    f"dispatch_fatal: co-tenant slot {idx} reqs diverged"
+                )
+        if d_chaos["pool"].crossings != ticks:
+            violations.append(
+                f"dispatch_fatal: crossing count "
+                f"{d_chaos['pool'].crossings} != {ticks} pool ticks"
+            )
+        drain = d_chaos["io"]["drain"]
+        print(f"  [dispatch_fatal] target state="
+              f"{d_chaos['states'][target]} "
+              f"frame={d_chaos['frames'][target]} fds={d_chaos['hub_fds']} "
+              f"drain={{datagrams: {drain['datagrams']}, "
+              f"unroutable: {drain['unroutable']}, "
+              f"crossings: {drain['crossings']}}} "
+              f"gso={d_chaos['io']['gso']}")
     verdict = not violations
     _write_artifact(artifact_dir, "socket", {
         "scenario": "socket",

@@ -135,11 +135,16 @@ def check_runtime_probes(analysis) -> list:
                 f"ggrs_bank_hdr_stride() = {stride} != static contract "
                 f"{header['stride']}",
             ))
-        # descriptor plane (§21): request-descriptor + staging strides
+        # descriptor plane (§21) + datapath gen 2 (§23): record strides
+        # and stat-table widths straight from the built library
         for sym, want in (
             ("ggrs_bank_req_stride", analysis.layout.LAYOUT_REQ_STRIDE),
             ("ggrs_bank_stage_stride",
              analysis.layout.LAYOUT_STAGE_STRIDE),
+            ("ggrs_net_recv_stride", analysis.layout.LAYOUT_RECV_STRIDE),
+            ("ggrs_net_route_stride",
+             analysis.layout.LAYOUT_ROUTE_STRIDE),
+            ("ggrs_net_fd_stride", analysis.layout.LAYOUT_FD_STRIDE),
         ):
             if not hasattr(lib, sym):
                 continue  # pre-descriptor library: the loader rebuilds it

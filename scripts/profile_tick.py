@@ -36,7 +36,7 @@ sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
 import numpy as np  # noqa: E402
 
 
-def build_pool(n_matches: int, tracer=None, fastpath=True):
+def build_pool(n_matches: int, tracer=None, fastpath=True, udp=False):
     from ggrs_tpu.core import Local, Remote
     from ggrs_tpu.games import boxgame_config
     from ggrs_tpu.net import InMemoryNetwork
@@ -47,24 +47,50 @@ def build_pool(n_matches: int, tracer=None, fastpath=True):
     if not fastpath:
         os.environ["GGRS_TPU_NO_FASTPATH"] = "1"
     try:
-        net = InMemoryNetwork()
         pool = HostSessionPool(tracer=tracer)
         schedules = []
-        for m in range(n_matches):
-            names = (f"A{m}", f"B{m}")
-            for me in (0, 1):
-                b = (
-                    SessionBuilder(boxgame_config())
-                    .with_clock(lambda: 0)
-                    .with_rng(random.Random(3 + 5 * m + me))
-                    .add_player(Local(), me)
-                    .add_player(Remote(names[1 - me]), 1 - me)
-                )
-                pool.add_session(b, net.socket(names[me]))
-                schedules.append(
-                    lambda i, m=m, me=me:
-                    ((i + 2 * m + me) // (2 + m % 3)) % 16
-                )
+        if udp:
+            # real loopback UDP, both sides pooled: every fd is drained
+            # by the gen-2 one-crossing recv table (DESIGN.md §23a), so
+            # the pool.drain split below is live
+            from ggrs_tpu.net.sockets import UdpNonBlockingSocket
+
+            net = _UdpNet()
+            for m in range(n_matches):
+                socks = [UdpNonBlockingSocket(0) for _ in (0, 1)]
+                addrs = [
+                    ("127.0.0.1", s.local_port()) for s in socks
+                ]
+                for me in (0, 1):
+                    b = (
+                        SessionBuilder(boxgame_config())
+                        .with_clock(lambda: 0)
+                        .with_rng(random.Random(3 + 5 * m + me))
+                        .add_player(Local(), me)
+                        .add_player(Remote(addrs[1 - me]), 1 - me)
+                    )
+                    pool.add_session(b, socks[me])
+                    schedules.append(
+                        lambda i, m=m, me=me:
+                        ((i + 2 * m + me) // (2 + m % 3)) % 16
+                    )
+        else:
+            net = InMemoryNetwork()
+            for m in range(n_matches):
+                names = (f"A{m}", f"B{m}")
+                for me in (0, 1):
+                    b = (
+                        SessionBuilder(boxgame_config())
+                        .with_clock(lambda: 0)
+                        .with_rng(random.Random(3 + 5 * m + me))
+                        .add_player(Local(), me)
+                        .add_player(Remote(names[1 - me]), 1 - me)
+                    )
+                    pool.add_session(b, net.socket(names[me]))
+                    schedules.append(
+                        lambda i, m=m, me=me:
+                        ((i + 2 * m + me) // (2 + m % 3)) % 16
+                    )
         if not pool.native_active:
             raise SystemExit("native bank did not engage (no toolchain?)")
     finally:
@@ -72,6 +98,14 @@ def build_pool(n_matches: int, tracer=None, fastpath=True):
         if prev is not None:
             os.environ["GGRS_TPU_NO_FASTPATH"] = prev
     return pool, schedules, net
+
+
+class _UdpNet:
+    """Drop-in for InMemoryNetwork's ``tick()`` when the population runs
+    over real loopback sockets (the kernel delivers; nothing to pump)."""
+
+    def tick(self) -> None:
+        pass
 
 
 def drive(pool, schedules, net, ticks, base=0, staged=True, split=None):
@@ -120,16 +154,25 @@ def main() -> int:
                          "tick A/B")
     ap.add_argument("--trace", default=None, metavar="OUT.json",
                     help="also write the full Perfetto export")
+    ap.add_argument("--udp", action="store_true",
+                    help="run the population over real loopback UDP so "
+                         "the gen-2 one-crossing inbound drain (§23a) "
+                         "engages; adds the pool.drain split line")
     args = ap.parse_args()
 
     from ggrs_tpu.obs import Tracer
 
     tracer = Tracer(capacity=1 << 16)
-    pool, schedules, net = build_pool(args.matches, tracer=tracer)
+    pool, schedules, net = build_pool(args.matches, tracer=tracer,
+                                      udp=args.udp)
     drive(pool, schedules, net, 16)  # warm
     tracer.clear()
+    d0_ns = pool.drain_ns
+    d0_cross = pool.drain_crossings
     split: list = []
     times = drive(pool, schedules, net, args.ticks, base=16, split=split)
+    drain_us = (pool.drain_ns - d0_ns) / 1000.0 / args.ticks
+    drain_crossings = pool.drain_crossings - d0_cross
     pool.scrape()
 
     T = args.ticks
@@ -158,6 +201,17 @@ def main() -> int:
     print(f"  pool.slot (decode+send){slot_us:9.0f} us/tick  "
           f"{bar(slot_us, tick_us)}"
           f"   ({slot.get('count', 0) / T:.0f} slots/tick)")
+    if drain_crossings:
+        # the gen-2 inbound split (§23a): the recv-table crossing + the
+        # routed record walk, measured at the advance_all call site —
+        # it runs BEFORE bank.crossing, inside pool.tick
+        print(f"  pool.drain (recv tbl)  {drain_us:9.0f} us/tick  "
+              f"{bar(drain_us, tick_us)}"
+              f"   ({drain_crossings / T:.1f} drains/tick)")
+        dio = pool.io_stats()["drain"]
+        print(f"    (batched inbound totals: {dio['datagrams']} datagrams"
+              f" over {dio['recv_calls']} recvmmsg calls, "
+              f"{dio['backpressure_stops']} backpressure stops)")
     other = tick_us - cross_us - slot_us
     print(f"  other (staging, superv){max(0.0, other):9.0f} us/tick  "
           f"{bar(max(0.0, other), tick_us)}")

@@ -619,7 +619,7 @@ class TestSendTable:
         desc = np.empty(len(rows), np.dtype(list(_native.NET_SEND_FIELDS)))
         for k, (fd, idx, _ln) in enumerate(rows):
             desc[k] = (fd, ip, port, 0, offs[idx], 10 + idx)
-        stats3 = (ctypes.c_uint64 * 3)()
+        stats3 = (ctypes.c_uint64 * _native.NET_SEND_STATS)()
         fatal = (ctypes.c_int32 * 32)()
         rc = lib.ggrs_net_send_table(
             desc.ctypes.data, len(rows), payload, len(payload),

@@ -1,0 +1,831 @@
+"""Pins for the gen-2 socket datapath (DESIGN.md §23): the one-crossing
+batched inbound drain (``ggrs_net_recv_table``), the shared dispatch
+socket (one fd + SO_REUSEPORT siblings serving many slots, native
+(ip,port)->slot demux), and GSO spectator fan-out (``UDP_SEGMENT``
+segmented sends with sendmmsg fallback).
+
+The headline pins:
+
+* INBOUND PARITY — the batched drain and the dispatch demux deliver a
+  bit-identical host tick stream to the per-slot reference drain under
+  seeded loss/dup/reorder over real loopback UDP (observed through the
+  host's outbound bytes: any inbound divergence changes what the session
+  sends).
+* CROSSING BUDGET — the drain is ONE extra crossing per pool tick; the
+  tick itself stays one.
+* FD FLOOR — dispatch mode's fd count is O(1) in B.
+* FAULT ISOLATION — a fatal errno on the shared fd faults exactly the
+  owning slot(s); co-tenants keep running (§9).
+* PER-FEATURE DEGRADATION — recv-table, dispatch-reuseport, and GSO each
+  fall back independently, never all-or-nothing.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import errno
+import os
+import random
+import socket as pysocket
+import struct
+
+import numpy as np
+import pytest
+
+from ggrs_tpu.core import Local, Remote
+from ggrs_tpu.core.config import Config
+from ggrs_tpu.net import _native
+from ggrs_tpu.net.sockets import DispatchHub, UdpNonBlockingSocket
+from ggrs_tpu.parallel.host_bank import HostSessionPool
+from ggrs_tpu.sessions import SessionBuilder
+
+needs_io = pytest.mark.skipif(
+    _native.net_lib() is None,
+    reason="kernel-batched socket datapath unavailable",
+)
+needs_gen2 = pytest.mark.skipif(
+    _native.net_lib() is None
+    or not hasattr(_native.net_lib(), "ggrs_net_recv_table"),
+    reason="gen-2 datapath unavailable",
+)
+
+
+def _ip(host: str) -> int:
+    return int.from_bytes(pysocket.inet_aton(host), "little")
+
+
+def _fd_tab(rows):
+    return b"".join(struct.pack("<ii", fd, slot) for fd, slot in rows)
+
+
+def _route_tab(rows):
+    rows = sorted(rows, key=lambda r: (r[0] << 16) | r[1])
+    return b"".join(
+        struct.pack("<IHHi", ip, port, 0, slot) for ip, port, slot in rows
+    )
+
+
+def _recv_table(lib, fd_rows, route_rows, max_recs=256, slab_cap=1 << 16):
+    """Direct one-shot drain; returns (records, slab, stats, fatals)."""
+    recs = ctypes.create_string_buffer(max_recs * _native.NET_RECV_STRIDE)
+    slab = ctypes.create_string_buffer(slab_cap)
+    stats = (ctypes.c_uint64 * _native.NET_RECV_TABLE_STATS)()
+    fatal = (ctypes.c_int32 * 64)()
+    n_fatal = ctypes.c_int32(0)
+    n = lib.ggrs_net_recv_table(
+        _fd_tab(fd_rows), len(fd_rows),
+        _route_tab(route_rows), len(route_rows),
+        recs, max_recs, slab, slab_cap,
+        stats, fatal, 32, ctypes.byref(n_fatal),
+    )
+    assert n >= 0, f"recv_table failed: {n}"
+    out = []
+    for k in range(n):
+        slot, fd_idx, ip, port, _pad, off, ln = struct.unpack_from(
+            "<iiIHHII", recs, k * _native.NET_RECV_STRIDE
+        )
+        out.append((slot, fd_idx, ip, port, slab[off:off + ln]))
+    fatals = [
+        (fatal[2 * k], fatal[2 * k + 1]) for k in range(n_fatal.value)
+    ]
+    return out, list(stats), fatals
+
+
+def fulfill(requests):
+    for r in requests:
+        if type(r).__name__ == "SaveGameState":
+            r.cell.save(r.frame, None, None)
+
+
+# ----------------------------------------------------------------------
+# ggrs_net_recv_table: direct native units
+# ----------------------------------------------------------------------
+
+
+@needs_gen2
+class TestRecvTableUnit:
+    def _bound(self):
+        s = pysocket.socket(pysocket.AF_INET, pysocket.SOCK_DGRAM)
+        s.bind(("127.0.0.1", 0))
+        s.setblocking(False)
+        return s
+
+    def test_slot_bound_fds_drain_in_order(self):
+        lib = _native.net_lib()
+        rx_a, rx_b, tx = self._bound(), self._bound(), self._bound()
+        try:
+            for i in range(3):
+                tx.sendto(bytes([i]) * (5 + i), rx_a.getsockname())
+            tx.sendto(b"bbbb", rx_b.getsockname())
+            recs, stats, fatals = _recv_table(
+                lib, [(rx_a.fileno(), 7), (rx_b.fileno(), 9)], []
+            )
+            assert fatals == []
+            a = [r for r in recs if r[0] == 7]
+            b = [r for r in recs if r[0] == 9]
+            assert [r[4] for r in a] == [bytes([i]) * (5 + i)
+                                         for i in range(3)]
+            assert [r[4] for r in b] == [b"bbbb"]
+            src_ip, src_port = tx.getsockname()
+            assert all(r[3] == src_port and r[2] == _ip("127.0.0.1")
+                       for r in recs)
+            assert stats[1] == 4  # datagrams
+            assert stats[0] >= 2  # one recvmmsg call per fd minimum
+        finally:
+            for s in (rx_a, rx_b, tx):
+                s.close()
+
+    def test_dispatch_routes_and_unroutable_drop(self):
+        lib = _native.net_lib()
+        rx, tx_a, tx_b, tx_x = (self._bound() for _ in range(4))
+        try:
+            dst = rx.getsockname()
+            tx_a.sendto(b"from-a", dst)
+            tx_b.sendto(b"from-b", dst)
+            tx_x.sendto(b"from-nobody", dst)
+            routes = [
+                (_ip("127.0.0.1"), tx_a.getsockname()[1], 3),
+                (_ip("127.0.0.1"), tx_b.getsockname()[1], 5),
+            ]
+            recs, stats, fatals = _recv_table(
+                lib, [(rx.fileno(), -1)], routes
+            )
+            assert fatals == []
+            got = {r[0]: r[4] for r in recs}
+            assert got == {3: b"from-a", 5: b"from-b"}
+            assert stats[2] == 1  # the unclaimed source was dropped
+        finally:
+            for s in (rx, tx_a, tx_b, tx_x):
+                s.close()
+
+    def test_backpressure_stops_before_losing_datagrams(self):
+        lib = _native.net_lib()
+        rx, tx = self._bound(), self._bound()
+        try:
+            for i in range(6):
+                tx.sendto(bytes([i]) * 8, rx.getsockname())
+            # room for only 2 records: the clamp must stop BEFORE the
+            # recvmmsg so the rest stay queued in the kernel
+            recs, stats, _ = _recv_table(
+                lib, [(rx.fileno(), 0)], [], max_recs=2
+            )
+            assert [r[4] for r in recs] == [bytes([i]) * 8
+                                            for i in range(2)]
+            assert stats[3] >= 1  # backpressure_stops
+            recs2, _, _ = _recv_table(lib, [(rx.fileno(), 0)], [])
+            assert [r[4] for r in recs2] == [bytes([i]) * 8
+                                             for i in range(2, 6)]
+        finally:
+            rx.close()
+            tx.close()
+
+    def test_fatal_fd_reports_index_and_drains_others(self):
+        lib = _native.net_lib()
+        rx, tx = self._bound(), self._bound()
+        try:
+            tx.sendto(b"alive", rx.getsockname())
+            recs, _, fatals = _recv_table(
+                lib, [(10_000, 1), (rx.fileno(), 2)], []
+            )
+            assert [(r[0], r[4]) for r in recs] == [(2, b"alive")]
+            assert len(fatals) == 1
+            assert fatals[0][0] == 0  # the bogus fd's TABLE index
+            assert fatals[0][1] == errno.EBADF
+        finally:
+            rx.close()
+            tx.close()
+
+    def test_bad_args_refused(self):
+        lib = _native.net_lib()
+        stats = (ctypes.c_uint64 * _native.NET_RECV_TABLE_STATS)()
+        fatal = (ctypes.c_int32 * 8)()
+        n_fatal = ctypes.c_int32(0)
+        rc = lib.ggrs_net_recv_table(
+            b"", -1, b"", 0, None, 0, None, 0,
+            stats, fatal, 4, ctypes.byref(n_fatal),
+        )
+        assert rc == _native.NET_ERR_BAD_ARGS
+
+
+# ----------------------------------------------------------------------
+# send-table gen 2: dispatch-flag fault isolation + GSO coalescing
+# ----------------------------------------------------------------------
+
+
+@needs_gen2
+class TestSendTableGen2:
+    def _send(self, lib, rows, payload, inject=None):
+        desc = np.empty(len(rows), np.dtype(list(_native.NET_SEND_FIELDS)))
+        for k, row in enumerate(rows):
+            desc[k] = row
+        stats = (ctypes.c_uint64 * _native.NET_SEND_STATS)()
+        fatal = (ctypes.c_int32 * 32)()
+        if inject is not None:
+            lib.ggrs_net_inject_table_errno(*inject)
+        try:
+            rc = lib.ggrs_net_send_table(
+                desc.ctypes.data, len(rows), payload, len(payload),
+                stats, fatal, 16,
+            )
+        finally:
+            lib.ggrs_net_inject_table_errno(0, 0, 0)
+        fatals = [(fatal[2 * k], fatal[2 * k + 1])
+                  for k in range(max(rc, 0))]
+        return rc, list(stats), fatals
+
+    def test_dispatch_flag_isolates_fatal_record(self):
+        """A fatal errno on a kSendFlagDispatch record reports the record
+        and CONTINUES the run — co-tenants on the shared fd still flush.
+        The same fault without the flag abandons the fd's run (gen-1
+        whole-fd semantics, unchanged)."""
+        lib = _native.net_lib()
+        tx = pysocket.socket(pysocket.AF_INET, pysocket.SOCK_DGRAM)
+        rx = pysocket.socket(pysocket.AF_INET, pysocket.SOCK_DGRAM)
+        rx.bind(("127.0.0.1", 0))
+        rx.settimeout(2.0)
+        ip, port = _ip("127.0.0.1"), rx.getsockname()[1]
+        payload = b"aaaa" + b"bbbb" + b"cccc"
+        disp = _native.NET_SEND_FLAG_DISPATCH
+        rows = [
+            (tx.fileno(), ip, port, disp, 0, 4),
+            (tx.fileno(), ip, port, disp, 4, 4),
+            (tx.fileno(), ip, port, disp, 8, 4),
+        ]
+        try:
+            # inject EPERM (fatal) on the middle record only
+            rc, stats, fatals = self._send(
+                lib, rows, payload, inject=(errno.EPERM, 1, 1)
+            )
+            assert fatals == [(1, errno.EPERM)]
+            assert stats[0] == 2
+            assert sorted(rx.recv(64) for _ in range(2)) == \
+                [b"aaaa", b"cccc"]
+            # same rows without the dispatch flag: the run is abandoned
+            # at the fault (gen-1 per-slot-fd semantics)
+            plain = [(fd, i, p, 0, o, ln)
+                     for fd, i, p, _f, o, ln in rows]
+            rc, stats, fatals = self._send(
+                lib, plain, payload, inject=(errno.EPERM, 1, 1)
+            )
+            assert fatals == [(1, errno.EPERM)]
+            assert stats[0] == 1  # only the record before the fault
+            assert rx.recv(64) == b"aaaa"
+        finally:
+            tx.close()
+            rx.close()
+
+    def test_gso_parity_and_counters(self):
+        """Same-destination equal-size runs arrive bit-identical whether
+        GSO is forced off (per-datagram sendmmsg) or on (one UDP_SEGMENT
+        send) — and the gso counters fire only when it engages."""
+        lib = _native.net_lib()
+        tx = pysocket.socket(pysocket.AF_INET, pysocket.SOCK_DGRAM)
+        rx = pysocket.socket(pysocket.AF_INET, pysocket.SOCK_DGRAM)
+        rx.bind(("127.0.0.1", 0))
+        rx.settimeout(2.0)
+        ip, port = _ip("127.0.0.1"), rx.getsockname()[1]
+        n, size = 5, 32
+        payload = b"".join(bytes([0x40 + i]) * size for i in range(n))
+        rows = [(tx.fileno(), ip, port, 0, i * size, size)
+                for i in range(n)]
+        want = [payload[i * size:(i + 1) * size] for i in range(n)]
+        try:
+            legs = {}
+            for mode in (0, -1):
+                lib.ggrs_net_set_gso(mode)
+                try:
+                    rc, stats, fatals = self._send(lib, rows, payload)
+                finally:
+                    lib.ggrs_net_set_gso(-1)
+                assert rc == 0 and fatals == []
+                assert stats[0] == n
+                got = [rx.recv(256) for _ in range(n)]
+                assert got == want, f"gso mode {mode} changed the bytes"
+                legs[mode] = stats
+            assert legs[0][3] == 0  # forced off: no gso sends
+            if lib.ggrs_net_gso_supported():
+                assert legs[-1][3] >= 1  # one segmented send…
+                assert legs[-1][4] == n  # …covering every record
+        finally:
+            tx.close()
+            rx.close()
+
+
+# ----------------------------------------------------------------------
+# pool-level: inbound parity fuzz across the three drain modes
+# ----------------------------------------------------------------------
+
+
+class FaultyTapPeerSocket:
+    """Peer-side socket: seeded loss/dup/reorder applied to sends (the
+    fault schedule is a pure function of the send sequence, identical
+    across legs) and a tape of every datagram RECEIVED — the host's
+    outbound bytes as observed on the wire."""
+
+    def __init__(self, inner: UdpNonBlockingSocket, seed: int,
+                 loss=0.0, duplicate=0.0, reorder=0.0):
+        self.inner = inner
+        self._rng = random.Random(seed)
+        self.loss, self.duplicate, self.reorder = loss, duplicate, reorder
+        self._staged = []
+        self.tape = []
+
+    def send_to(self, msg, addr) -> None:
+        payload = msg.encode()
+        rng = self._rng
+        drop = rng.random() < self.loss
+        dup = rng.random() < self.duplicate
+        swap = rng.random() < self.reorder
+        if drop:
+            return
+        self._staged.append((addr, payload))
+        if dup:
+            self._staged.append((addr, payload))
+        if swap and len(self._staged) >= 2:
+            self._staged[-1], self._staged[-2] = (
+                self._staged[-2], self._staged[-1]
+            )
+
+    def flush(self) -> None:
+        for addr, payload in self._staged:
+            self.inner.send_datagram(payload, addr)
+        self._staged.clear()
+
+    def receive_all_datagrams(self):
+        got = self.inner.receive_all_datagrams()
+        self.tape.extend(data for _, data in got)
+        return got
+
+    def receive_all_messages(self):
+        return self.inner.receive_all_messages()
+
+
+def run_inbound_leg(mode: str, seed: int, ticks: int, n_matches: int,
+                    faults: dict):
+    """One leg of the inbound parity fuzz.  ``mode``:
+
+    * ``reference`` — per-slot sockets, batched drain disabled
+      (``GGRS_TPU_NO_RECV_TABLE``): the pinned per-slot Python drain.
+    * ``batched``   — per-slot sockets through ``ggrs_net_recv_table``.
+    * ``dispatch``  — one DispatchHub port for every slot, native demux.
+    * ``dispatch-reference`` — the hub WITHOUT the native drain (the
+      Python claims demux): the per-feature fallback leg.
+    """
+    env = {}
+    if mode in ("reference", "dispatch-reference"):
+        env["GGRS_TPU_NO_RECV_TABLE"] = "1"
+    saved = {k: os.environ.get(k) for k in env}
+    os.environ.update(env)
+    try:
+        cfg = Config.for_uint(16)
+        clock = [0]
+        pool = HostSessionPool()
+        hub = (
+            DispatchHub(siblings=1)
+            if mode.startswith("dispatch") else None
+        )
+        peers, peer_socks = [], []
+        for m in range(n_matches):
+            host_sock = hub.view() if hub else UdpNonBlockingSocket(0)
+            host_port = host_sock.local_port()
+            peer_inner = UdpNonBlockingSocket(0)
+            peer_addr = ("127.0.0.1", peer_inner.local_port())
+            peer_sock = FaultyTapPeerSocket(
+                peer_inner, seed * 101 + m, **faults
+            )
+            pool.add_session(
+                SessionBuilder(cfg)
+                .with_clock(lambda: clock[0])
+                .with_rng(random.Random(3 + 5 * m))
+                .add_player(Local(), 0)
+                .add_player(Remote(peer_addr), 1),
+                host_sock,
+            )
+            peer = (
+                SessionBuilder(cfg)
+                .with_clock(lambda: clock[0])
+                .with_rng(random.Random(4 + 5 * m))
+                .add_player(Local(), 1)
+                .add_player(Remote(("127.0.0.1", host_port)), 0)
+            ).start_p2p_session(peer_sock)
+            peers.append(peer)
+            peer_socks.append(peer_sock)
+        for i in range(ticks):
+            clock[0] += 16
+            for m, peer in enumerate(peers):
+                peer.add_local_input(1, (i + 2 * m) % 16)
+                fulfill(peer.advance_frame())
+                peer_socks[m].flush()
+            for m in range(n_matches):
+                pool.add_local_input(m, 0, (i + 2 * m) % 16)
+            for reqs in pool.advance_all():
+                fulfill(reqs)
+        # final peer drain so the tape includes the last tick's sends
+        for sock in peer_socks:
+            sock.receive_all_datagrams()
+        return dict(
+            tapes=[s.tape for s in peer_socks],
+            frames=[pool.current_frame(m) for m in range(n_matches)],
+            crossings=pool.crossings,
+            drain_crossings=pool.drain_crossings,
+            stats=pool.io_stats(),
+        )
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+@needs_gen2
+class TestInboundParity:
+    @pytest.mark.parametrize("seed", [2, 31])
+    def test_all_modes_bit_identical_under_faults(self, seed):
+        """The headline pin: batched drain, native dispatch demux, and
+        the hub's Python fallback demux all deliver the same inbound to
+        the sessions as the per-slot reference drain — observed through
+        the host's outbound wire bytes, which any inbound divergence
+        would change."""
+        faults = dict(loss=0.05, duplicate=0.03, reorder=0.03)
+        ticks, n_matches = 140, 2
+        ref = run_inbound_leg("reference", seed, ticks, n_matches, faults)
+        assert ref["drain_crossings"] == 0  # the kill switch held
+        for mode in ("batched", "dispatch", "dispatch-reference"):
+            leg = run_inbound_leg(mode, seed, ticks, n_matches, faults)
+            for m in range(n_matches):
+                assert leg["tapes"][m] == ref["tapes"][m], (
+                    f"{mode}: match {m} wire bytes diverged "
+                    f"(ref {len(ref['tapes'][m])} datagrams, "
+                    f"{mode} {len(leg['tapes'][m])})"
+                )
+            assert leg["frames"] == ref["frames"]
+            if mode != "dispatch-reference":
+                assert leg["stats"]["drain"]["datagrams"] > 0, (
+                    f"{mode}: the batched drain never engaged"
+                )
+        assert all(f >= ticks - 64 for f in ref["frames"])
+
+    def test_crossing_budget(self):
+        """The drain is ONE crossing per pool tick and the tick stays
+        one: crossings == ticks, drain_crossings == ticks."""
+        ticks = 60
+        leg = run_inbound_leg("batched", 5, ticks, 2, {})
+        assert leg["crossings"] == ticks
+        assert leg["drain_crossings"] == ticks
+        assert leg["stats"]["drain"]["recv_calls"] >= ticks
+
+    def test_dispatch_fd_floor_is_constant_in_b(self):
+        """The dispatch mode's whole point: B slots, O(1) fds."""
+        cfg = Config.for_uint(16)
+        for b in (2, 6):
+            clock = [0]
+            pool = HostSessionPool()
+            hub = DispatchHub(siblings=1)
+            peer_ports = []
+            for m in range(b):
+                peer = UdpNonBlockingSocket(0)
+                peer_ports.append(peer)
+                pool.add_session(
+                    SessionBuilder(cfg)
+                    .with_clock(lambda: clock[0])
+                    .with_rng(random.Random(m))
+                    .add_player(Local(), 0)
+                    .add_player(
+                        Remote(("127.0.0.1", peer.local_port())), 1
+                    ),
+                    hub.view(),
+                )
+            for i in range(3):
+                clock[0] += 16
+                for m in range(b):
+                    pool.add_local_input(m, 0, i)
+                for reqs in pool.advance_all():
+                    fulfill(reqs)
+            n_fds = len(hub.filenos())
+            assert n_fds == (2 if hub.reuseport else 1)
+            assert pool._drain_n_fds == n_fds, (
+                "drain plan fd count must equal the hub's fds, not B"
+            )
+            assert pool._drain_n_routes == b
+            hub.close()
+            for p in peer_ports:
+                p.close()
+
+
+# ----------------------------------------------------------------------
+# §9 supervision through the shared fd
+# ----------------------------------------------------------------------
+
+
+@needs_gen2
+class TestDispatchFaultIsolation:
+    def test_shared_fd_fatal_evicts_only_the_owner(self):
+        """A fatal send errno on ONE dispatch record faults exactly the
+        owning slot; co-tenants on the same fd stay native and keep
+        advancing."""
+        lib = _native.net_lib()
+        cfg = Config.for_uint(16)
+        clock = [0]
+        pool = HostSessionPool()
+        hub = DispatchHub()
+        n = 3
+        peers, peer_socks = [], []
+        for m in range(n):
+            view = hub.view()
+            peer_sock = UdpNonBlockingSocket(0)
+            pool.add_session(
+                SessionBuilder(cfg)
+                .with_clock(lambda: clock[0])
+                .with_rng(random.Random(3 + 5 * m))
+                .add_player(Local(), 0)
+                .add_player(
+                    Remote(("127.0.0.1", peer_sock.local_port())), 1
+                ),
+                view,
+            )
+            peer = (
+                SessionBuilder(cfg)
+                .with_clock(lambda: clock[0])
+                .with_rng(random.Random(4 + 5 * m))
+                .add_player(Local(), 1)
+                .add_player(Remote(("127.0.0.1", hub.local_port())), 0)
+            ).start_p2p_session(peer_sock)
+            peers.append(peer)
+            peer_socks.append(peer_sock)
+
+        def tick(i):
+            clock[0] += 16
+            for m, peer in enumerate(peers):
+                peer.add_local_input(1, (i + m) % 16)
+                fulfill(peer.advance_frame())
+                pool.add_local_input(m, 0, (i + m) % 16)
+            for reqs in pool.advance_all():
+                fulfill(reqs)
+
+        for i in range(20):
+            tick(i)
+        assert all(pool.slot_state(m) == "native" for m in range(n))
+        # fatal errno on the FIRST outbound record of the next flush:
+        # its owner (one slot) faults; the run continues for co-tenants
+        lib.ggrs_net_inject_table_errno(errno.EPERM, 0, 1)
+        try:
+            tick(20)
+        finally:
+            lib.ggrs_net_inject_table_errno(0, 0, 0)
+        states = [pool.slot_state(m) for m in range(n)]
+        assert states.count("native") == n - 1, (
+            f"exactly one slot must fault, got {states}"
+        )
+        before = [pool.current_frame(m) for m in range(n)]
+        for i in range(21, 90):
+            tick(i)
+        states = [pool.slot_state(m) for m in range(n)]
+        assert states.count("native") == n - 1, (
+            f"blast radius exceeded one slot: {states}"
+        )
+        bad = next(m for m in range(n) if states[m] != "native")
+        assert states[bad] == "evicted"
+        for m in range(n):
+            # co-tenants AND the evicted slot (Python path) keep playing
+            assert pool.current_frame(m) > before[m], (
+                f"slot {m} stalled after the shared-fd fault"
+            )
+        # the starvation regression: the native drain keeps reading the
+        # SHARED fd after the eviction, so the evicted slot's inbound
+        # must be delivered to its view (never dropped as unroutable)
+        # and the slot must keep pace far past the prediction window
+        assert pool.io_stats()["drain"]["unroutable"] == 0, (
+            "evicted co-tenant's datagrams were dropped as unroutable"
+        )
+        assert pool.current_frame(bad) > 60, (
+            f"evicted slot starved at frame {pool.current_frame(bad)}"
+        )
+        hub.close()
+
+
+# ----------------------------------------------------------------------
+# per-feature degradation + the capability matrix
+# ----------------------------------------------------------------------
+
+
+@needs_gen2
+class TestDegradation:
+    def _mini_pool(self, n=2, dispatch=False, siblings=0):
+        cfg = Config.for_uint(16)
+        clock = [0]
+        pool = HostSessionPool()
+        hub = DispatchHub(siblings=siblings) if dispatch else None
+        peers, peer_socks = [], []
+        for m in range(n):
+            host_sock = hub.view() if hub else UdpNonBlockingSocket(0)
+            host_port = host_sock.local_port()
+            peer_sock = UdpNonBlockingSocket(0)
+            pool.add_session(
+                SessionBuilder(cfg)
+                .with_clock(lambda: clock[0])
+                .with_rng(random.Random(3 + 5 * m))
+                .add_player(Local(), 0)
+                .add_player(
+                    Remote(("127.0.0.1", peer_sock.local_port())), 1
+                ),
+                host_sock,
+            )
+            peer = (
+                SessionBuilder(cfg)
+                .with_clock(lambda: clock[0])
+                .with_rng(random.Random(4 + 5 * m))
+                .add_player(Local(), 1)
+                .add_player(Remote(("127.0.0.1", host_port)), 0)
+            ).start_p2p_session(peer_sock)
+            peers.append(peer)
+            peer_socks.append(peer_sock)
+        return pool, clock, peers, hub
+
+    def _run(self, pool, clock, peers, ticks=40):
+        for i in range(ticks):
+            clock[0] += 16
+            for m, peer in enumerate(peers):
+                peer.add_local_input(1, (i + m) % 16)
+                fulfill(peer.advance_frame())
+            for m in range(len(peers)):
+                pool.add_local_input(m, 0, (i + m) % 16)
+            for reqs in pool.advance_all():
+                fulfill(reqs)
+
+    def test_no_recv_table_env_forces_reference_drain(self, monkeypatch):
+        monkeypatch.setenv("GGRS_TPU_NO_RECV_TABLE", "1")
+        pool, clock, peers, _ = self._mini_pool()
+        self._run(pool, clock, peers)
+        s = pool.io_stats()
+        assert not s["capabilities"]["recv_table"]
+        assert s["drain"]["crossings"] == 0
+        assert pool.current_frame(0) > 20  # the fallback still plays
+
+    def test_no_gso_env_forces_per_datagram_sends(self, monkeypatch):
+        monkeypatch.setenv("GGRS_TPU_NO_GSO", "1")
+        pool, clock, peers, _ = self._mini_pool()
+        try:
+            self._run(pool, clock, peers)
+            s = pool.io_stats()
+            assert not s["capabilities"]["gso"]
+            assert s["drain"]["datagrams"] > 0  # recv-table unaffected
+            assert s["gso"] == {"gso_sends": 0, "gso_segments": 0}
+        finally:
+            lib = _native.net_lib()
+            if lib is not None and hasattr(lib, "ggrs_net_set_gso"):
+                lib.ggrs_net_set_gso(-1)  # global posture: restore
+
+    def test_missing_reuseport_runs_single_fd(self, monkeypatch):
+        # a kernel without SO_REUSEPORT: the hub silently runs one fd —
+        # dispatch still works, just without sibling spreading
+        import ggrs_tpu.net.sockets as sockets_mod
+
+        monkeypatch.delattr(
+            sockets_mod._socket, "SO_REUSEPORT", raising=False
+        )
+        hub = DispatchHub(siblings=3)
+        try:
+            assert not hub.reuseport
+            assert len(hub.filenos()) == 1
+        finally:
+            hub.close()
+        pool, clock, peers, hub = self._mini_pool(dispatch=True,
+                                                  siblings=3)
+        try:
+            self._run(pool, clock, peers)
+            assert pool.current_frame(0) > 20
+            assert len(hub.filenos()) == 1
+            s = pool.io_stats()
+            assert s["capabilities"]["dispatch"]
+            assert not s["capabilities"]["reuseport"]
+        finally:
+            hub.close()
+
+    def test_capability_matrix_reports_dispatch(self):
+        pool, clock, peers, hub = self._mini_pool(dispatch=True,
+                                                  siblings=1)
+        try:
+            self._run(pool, clock, peers, ticks=10)
+            caps = pool.io_capabilities()
+            assert caps["dispatch"] and caps["recv_table"]
+            assert set(caps) == {
+                "native_io", "recv_table", "send_table", "dispatch",
+                "reuseport", "gso",
+            }
+        finally:
+            hub.close()
+
+
+# ----------------------------------------------------------------------
+# GSO spectator fan-out: pool-level viewer-stream parity
+# ----------------------------------------------------------------------
+
+
+@needs_gen2
+class TestGsoFanoutParity:
+    def test_viewer_streams_identical_with_and_without_gso(self):
+        """The spectator fan-out bytes every viewer observes must be
+        bit-identical whether the flush rides GSO segmented sends or the
+        per-datagram reference — and the drain keeps viewer inbound
+        (acks) flowing either way."""
+        from ggrs_tpu.broadcast import SpectatorHub
+        from ggrs_tpu.core.errors import (
+            NotSynchronized,
+            PredictionThreshold,
+        )
+
+        def leg(no_gso: bool, no_fastpath: bool = False):
+            saved = {
+                k: os.environ.get(k)
+                for k in ("GGRS_TPU_NO_GSO", "GGRS_TPU_NO_FASTPATH")
+            }
+            if no_gso:
+                os.environ["GGRS_TPU_NO_GSO"] = "1"
+            if no_fastpath:
+                os.environ["GGRS_TPU_NO_FASTPATH"] = "1"
+            try:
+                cfg = Config.for_uint(16)
+                clock = [0]
+                pool = HostSessionPool()
+                shub = SpectatorHub(pool, rng=random.Random(77))
+                host_sock = UdpNonBlockingSocket(0)
+                host_port = host_sock.local_port()
+                peer_sock = UdpNonBlockingSocket(0)
+                pool.add_session(
+                    SessionBuilder(cfg)
+                    .with_clock(lambda: clock[0])
+                    .with_rng(random.Random(3))
+                    .add_player(Local(), 0)
+                    .add_player(
+                        Remote(("127.0.0.1", peer_sock.local_port())), 1
+                    ),
+                    host_sock,
+                )
+                peer = (
+                    SessionBuilder(cfg)
+                    .with_clock(lambda: clock[0])
+                    .with_rng(random.Random(4))
+                    .add_player(Local(), 1)
+                    .add_player(Remote(("127.0.0.1", host_port)), 0)
+                ).start_p2p_session(peer_sock)
+                viewers, tapes = [], []
+                for v in range(3):
+                    vsock_inner = UdpNonBlockingSocket(0)
+                    vsock = FaultyTapPeerSocket(vsock_inner, 50 + v)
+                    vaddr = ("127.0.0.1", vsock_inner.local_port())
+                    viewer = (
+                        SessionBuilder(cfg)
+                        .with_clock(lambda: clock[0])
+                        .with_rng(random.Random(7000 + v))
+                    ).start_spectator_session(
+                        ("127.0.0.1", host_port), vsock
+                    )
+                    shub.attach(0, vaddr)
+                    viewers.append(viewer)
+                    tapes.append(vsock)
+                for i in range(80):
+                    clock[0] += 16
+                    peer.add_local_input(1, i % 16)
+                    fulfill(peer.advance_frame())
+                    pool.add_local_input(0, 0, i % 16)
+                    for reqs in pool.advance_all():
+                        fulfill(reqs)
+                    for sock in tapes:
+                        sock.flush()
+                    for viewer in viewers:
+                        try:
+                            fulfill(viewer.advance_frame())
+                        except (NotSynchronized, PredictionThreshold):
+                            pass
+                for sock in tapes:
+                    sock.receive_all_datagrams()
+                return dict(
+                    tapes=[s.tape for s in tapes],
+                    frames=[v.current_frame for v in viewers],
+                    gso=pool.io_stats()["gso"],
+                )
+            finally:
+                for k, v in saved.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
+                lib = _native.net_lib()
+                if lib is not None and hasattr(lib, "ggrs_net_set_gso"):
+                    lib.ggrs_net_set_gso(-1)
+
+        on = leg(no_gso=False)
+        off = leg(no_gso=True)
+        ref = leg(no_gso=True, no_fastpath=True)  # per-datagram send_raw
+        assert on["tapes"] == off["tapes"] == ref["tapes"], (
+            "viewer streams diverged across GSO/send-table modes"
+        )
+        assert on["frames"] == off["frames"] == ref["frames"]
+        assert any(f > 40 for f in on["frames"]), "viewers never synced"
+        assert off["gso"]["gso_sends"] == 0
+        lib = _native.net_lib()
+        if lib.ggrs_net_gso_supported():
+            assert on["gso"]["gso_sends"] > 0, (
+                "GSO never engaged on the fan-out path"
+            )

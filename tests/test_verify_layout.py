@@ -28,8 +28,14 @@ from ggrs_tpu.analysis import (
     static_bank_header,
 )
 from ggrs_tpu.analysis.layout import (
+    LAYOUT_FD_FIELDS,
+    LAYOUT_FD_STRIDE,
+    LAYOUT_RECV_FIELDS,
+    LAYOUT_RECV_STRIDE,
     LAYOUT_REQ_FIELDS,
     LAYOUT_REQ_STRIDE,
+    LAYOUT_ROUTE_FIELDS,
+    LAYOUT_ROUTE_STRIDE,
     LAYOUT_SEND_FIELDS,
     LAYOUT_SEND_STRIDE,
     LAYOUT_STAGE_FIELDS,
@@ -243,6 +249,68 @@ class TestDeliberateSkew:
             )
         )
 
+    RECV_GOOD = (
+        'NET_RECV_FIELDS = (\n'
+        '    ("slot", "<i4"), ("fd_idx", "<i4"), ("ip", "<u4"),\n'
+        '    ("port", "<u2"), ("pad", "<u2"), ("off", "<u4"),\n'
+        '    ("len", "<u4"),\n'
+        ')\n'
+    )
+    ROUTE_GOOD = (
+        'NET_ROUTE_FIELDS = (\n'
+        '    ("ip", "<u4"), ("port", "<u2"), ("pad", "<u2"),\n'
+        '    ("slot", "<i4"),\n'
+        ')\n'
+    )
+
+    def test_clean_gen2_tables_pass(self, tmp_path):
+        root = self._table_tree(tmp_path, self.RECV_GOOD + self.ROUTE_GOOD)
+        assert _check_field_table(
+            root, "NET_RECV_FIELDS", LAYOUT_RECV_FIELDS, LAYOUT_RECV_STRIDE
+        ) == []
+        assert _check_field_table(
+            root, "NET_ROUTE_FIELDS", LAYOUT_ROUTE_FIELDS,
+            LAYOUT_ROUTE_STRIDE,
+        ) == []
+
+    def test_recv_record_one_byte_drift_fires(self, tmp_path):
+        # port widens u2 -> u4: off/len shift, stride 26 — the §23a
+        # record table is a wire struct and must fail lint like one
+        root = self._table_tree(
+            tmp_path,
+            self.RECV_GOOD.replace('("port", "<u2")', '("port", "<u4")'),
+        )
+        findings = _check_field_table(
+            root, "NET_RECV_FIELDS", LAYOUT_RECV_FIELDS, LAYOUT_RECV_STRIDE
+        )
+        assert findings, "recv-record field drift must fail lint"
+
+    def test_route_row_field_order_drift_fires(self, tmp_path):
+        # slot moves ahead of ip: same stride, different offsets — the
+        # native binary search would read garbage keys
+        root = self._table_tree(
+            tmp_path,
+            'NET_ROUTE_FIELDS = (\n'
+            '    ("slot", "<i4"), ("ip", "<u4"), ("port", "<u2"),\n'
+            '    ("pad", "<u2"),\n'
+            ')\n',
+        )
+        findings = _check_field_table(
+            root, "NET_ROUTE_FIELDS", LAYOUT_ROUTE_FIELDS,
+            LAYOUT_ROUTE_STRIDE,
+        )
+        assert findings, "route-row field order drift must fail lint"
+
+    def test_recv_stride_mirror_drift_fires(self, tmp_path):
+        (tmp_path / "a.cpp").write_text(
+            "constexpr size_t kRecvStride = 28;\n"
+        )
+        (tmp_path / "b.py").write_text("NET_RECV_STRIDE = 24\n")
+        findings = _check_mirrors(
+            tmp_path, [("a.cpp", "kRecvStride", "b.py", "NET_RECV_STRIDE")]
+        )
+        assert [f.rule for f in findings] == ["layout/mirror-mismatch"]
+
     def test_send_stride_mirror_drift_fires(self, tmp_path):
         # the C++ kSendStride is pinned through the mirror table — a
         # native-side stride bump without the Python twin fires
@@ -327,6 +395,12 @@ class TestTreeIsClean:
              LAYOUT_STAGE_STRIDE),
             (_native.NET_SEND_FIELDS, LAYOUT_SEND_FIELDS,
              LAYOUT_SEND_STRIDE),
+            (_native.NET_RECV_FIELDS, LAYOUT_RECV_FIELDS,
+             LAYOUT_RECV_STRIDE),
+            (_native.NET_ROUTE_FIELDS, LAYOUT_ROUTE_FIELDS,
+             LAYOUT_ROUTE_STRIDE),
+            (_native.NET_FD_FIELDS, LAYOUT_FD_FIELDS,
+             LAYOUT_FD_STRIDE),
         ):
             dtype = np.dtype(list(fields))
             assert dtype.itemsize == stride
@@ -338,6 +412,20 @@ class TestTreeIsClean:
             pytest.skip("no descriptor-plane library on this platform")
         assert int(lib.ggrs_bank_req_stride()) == LAYOUT_REQ_STRIDE
         assert int(lib.ggrs_bank_stage_stride()) == LAYOUT_STAGE_STRIDE
+
+    def test_gen2_tables_match_runtime_probes(self):
+        """The §23 drain/route/fd strides and stat-table widths equal the
+        built library's probes (compiled on BOTH branches, so this pins
+        the stub too)."""
+        lib = _native.bank_lib()
+        if lib is None or not hasattr(lib, "ggrs_net_recv_stride"):
+            pytest.skip("no gen-2 library on this platform")
+        assert int(lib.ggrs_net_recv_stride()) == LAYOUT_RECV_STRIDE
+        assert int(lib.ggrs_net_route_stride()) == LAYOUT_ROUTE_STRIDE
+        assert int(lib.ggrs_net_fd_stride()) == LAYOUT_FD_STRIDE
+        assert int(lib.ggrs_net_send_stats_len()) == _native.NET_SEND_STATS
+        assert int(lib.ggrs_net_recv_stats_len()) == \
+            _native.NET_RECV_TABLE_STATS
 
     def test_cmd_flags_match_native_literals(self):
         native = parse_cpp_constants(REPO / "native/session_bank.cpp")
