@@ -114,7 +114,7 @@ LAYOUT_RECV_FIELDS: Tuple[Tuple[str, str, int], ...] = (
     ("fd_idx", "<i4", 4),
     ("ip", "<u4", 8),
     ("port", "<u2", 12),
-    ("pad", "<u2", 14),
+    ("seg", "<u2", 14),
     ("off", "<u4", 16),
     ("len", "<u4", 20),
 )

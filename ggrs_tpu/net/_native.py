@@ -526,6 +526,14 @@ def _load() -> Optional[ctypes.CDLL]:
                 lib.ggrs_net_gso_supported.argtypes = []
                 lib.ggrs_net_set_gso.restype = None
                 lib.ggrs_net_set_gso.argtypes = [ctypes.c_int]
+                if hasattr(lib, "ggrs_net_gro_supported"):
+                    # GRO inbound (§23d); absent on a pre-GRO .so — the
+                    # recv table then never splits and pools leave the
+                    # sockets' GRO posture off
+                    lib.ggrs_net_gro_supported.restype = ctypes.c_int
+                    lib.ggrs_net_gro_supported.argtypes = []
+                    lib.ggrs_net_set_gro.restype = None
+                    lib.ggrs_net_set_gro.argtypes = [ctypes.c_int]
                 lib.ggrs_net_inject_table_errno.restype = None
                 lib.ggrs_net_inject_table_errno.argtypes = [
                     ctypes.c_int, ctypes.c_int64, ctypes.c_int,
@@ -728,17 +736,23 @@ NET_ROUTE_FIELDS = (
 NET_ROUTE_STRIDE = 12
 NET_RECV_FIELDS = (
     ("slot", "<i4"), ("fd_idx", "<i4"), ("ip", "<u4"), ("port", "<u2"),
-    ("pad", "<u2"), ("off", "<u4"), ("len", "<u4"),
-)  # itemsize 24 == net_batch.cpp kRecvStride
+    ("seg", "<u2"), ("off", "<u4"), ("len", "<u4"),
+)  # itemsize 24 == net_batch.cpp kRecvStride; ``seg`` is the segment
+# index when a GRO-coalesced train was split back into wire datagrams
+# (0 for ordinary datagrams — pre-GRO .so files always write 0 here)
 NET_RECV_STRIDE = 24
 
 # ggrs_net_recv_table stats words (net_batch.cpp kRecvTableStats):
 # {recv_calls, datagrams, unroutable, backpressure_stops} + the 8-bucket
-# batch-size histogram (bounds IO_BATCH_BUCKETS + inf)
+# batch-size histogram (bounds IO_BATCH_BUCKETS + inf) occupying words
+# [4..11], then the GRO tail APPENDED at [12..13] (gro_datagrams,
+# gro_segments) so existing indices never move.  ``datagrams`` counts
+# post-split wire datagrams, so it matches the GRO-off count exactly.
 NET_RECV_TABLE_STAT_FIELDS = (
     "recv_calls", "datagrams", "unroutable", "backpressure_stops",
+    "gro_datagrams", "gro_segments",
 )
-NET_RECV_TABLE_STATS = 12
+NET_RECV_TABLE_STATS = 14
 
 # packed per-tick output header (session_bank.cpp kHdr*; DESIGN.md §19):
 # one BANK_HDR_DTYPE-shaped record per session leads the tick output when

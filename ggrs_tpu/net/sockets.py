@@ -408,6 +408,7 @@ class DispatchHub:
             if bound_port == 0:
                 bound_port = s.getsockname()[1]
             self._socks.append(s)
+        self.gro = False
         self.stats = NetworkStats()
         self.io_syscalls = 0
         self.unroutable = 0
@@ -428,6 +429,31 @@ class DispatchHub:
 
     def local_port(self) -> int:
         return self._socks[0].getsockname()[1]
+
+    def enable_gro(self) -> bool:
+        """Ask the kernel to coalesce inbound UDP trains (``UDP_GRO``,
+        datapath gen 2 §23d) on every sibling fd.  ONLY the pool's native
+        one-crossing drain may enable this: the reference Python
+        :meth:`drain` reads into a ``RECV_BUFFER_SIZE`` buffer and would
+        mis-handle a coalesced train, so the caller flips GRO on exactly
+        when ``ggrs_net_recv_table`` (which splits trains back into wire
+        datagrams) covers these fds.  Idempotent; returns whether GRO is
+        now on."""
+        if self.gro:
+            return True
+        ok = True
+        # SOL_UDP=17 / UDP_GRO=104: numeric because pre-3.12 socket
+        # modules don't export UDP_GRO
+        sol_udp = getattr(_socket, "IPPROTO_UDP", 17)
+        udp_gro = getattr(_socket, "UDP_GRO", 104)
+        for s in self._socks:
+            try:
+                s.setsockopt(sol_udp, udp_gro, 1)
+            except OSError:
+                ok = False
+                break
+        self.gro = ok
+        return ok
 
     def claim(self, addr: Hashable, view: "DispatchSocket") -> None:
         self._claims[addr] = view

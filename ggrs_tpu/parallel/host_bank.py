@@ -690,6 +690,9 @@ class HostSessionPool:
         self.drain_ns = 0  # wall ns in _drain_inbound (profiling split)
         self._send_flags: List[int] = []  # per-slot NET_SEND_FIELDS flags
         self._gso_totals = {"gso_sends": 0, "gso_segments": 0}
+        self._gro_on = False  # UDP_GRO armed on >=1 covered hub (§23d)
+        self._decode_pool = None  # parallel slow-slot decode plane (§24)
+        self.decode_parallel_ticks = 0  # ticks that fanned decode out
         self._builders: List[Tuple[Any, Any]] = []
         self._finalized = False
         self._native_active = False
@@ -1239,6 +1242,15 @@ class HostSessionPool:
                 0 if os.environ.get("GGRS_TPU_NO_GSO") else -1
             )
         self._refresh_drain()
+        # parallel slow-slot decode plane (§24): backend resolved once
+        # per pool (env kill switch / force inside the constructor);
+        # "serial" means the pool object exists for the capability
+        # matrix but every decode stays on the inline _parse_slot
+        # reference — zero new machinery on the default GIL-build path
+        if self._decode_pool is None and self._native_active:
+            from .decode_pool import DecodePool
+
+            self._decode_pool = DecodePool()
 
     def _refresh_send_fd(self, index: int) -> None:
         """(Re)compute slot ``index``'s native batched-outbound
@@ -1389,6 +1401,7 @@ class HostSessionPool:
         wire_maps: List[Optional[Dict]] = [None] * n
         deliver: Dict[int, Any] = {}  # slot -> hub view (pending queue)
         dispatch_idx: Dict[int, int] = {}  # shared fd -> fd table index
+        hubs: List[Any] = []  # covered dispatch hubs (GRO candidates)
         for i, m in enumerate(self._mirrors):
             sock = m.socket
             if self._slot_state[i] != SLOT_NATIVE or self._io_attached[i]:
@@ -1444,6 +1457,8 @@ class HostSessionPool:
                         fd_fault.append([i])
                     elif i not in fd_fault[at]:
                         fd_fault[at].append(i)
+                if hub not in hubs:
+                    hubs.append(hub)
                 for ip, port in wire:
                     route_rows.append((ip, port, i))
             else:
@@ -1471,12 +1486,43 @@ class HostSessionPool:
         ]
         self._drain_wire = wire_maps
         self._drain_deliver = deliver
+        # GRO (§23d): every covered hub's inbound is now drained by the
+        # native recv table — which splits coalesced trains back into
+        # wire datagrams — so it is safe, and ONLY now, to let the kernel
+        # coalesce.  Hubs on the reference Python drain must never see
+        # GRO (drain() reads into a RECV_BUFFER_SIZE buffer).  The
+        # crossing's ring posture is process-wide, refreshed per plan
+        # like the GSO posture in _finalize.
+        gro_on = False
+        if (
+            hubs
+            and not os.environ.get("GGRS_TPU_NO_GRO")
+            and hasattr(lib, "ggrs_net_gro_supported")
+            and lib.ggrs_net_gro_supported()
+        ):
+            for hub in hubs:
+                if hub.enable_gro():
+                    gro_on = True
+        self._gro_on = gro_on
+        if hasattr(lib, "ggrs_net_set_gro"):
+            lib.ggrs_net_set_gro(1 if gro_on else 0)
         if self._drain_recs is None:
-            self._drain_recs_cap = max(256, 4 * len(fd_rows))
+            # a GRO drain can legally turn ONE message into 64 records /
+            # 64 KiB of slab, and the crossing reserves that worst case
+            # before each syscall — size the buffers so the reserve never
+            # clamps a recvmmsg below the ring's full 64-message window
+            # (recs: 64 msgs x 64 segs; slab: 64 msgs x 64 KiB = 4 MiB),
+            # else an armed drain batches WORSE than the plain ring on
+            # traffic the kernel happens not to coalesce
+            if gro_on:
+                self._drain_recs_cap = max(4096, 4 * len(fd_rows))
+                self._drain_slab_cap = max(4 << 20, 4096 * len(fd_rows))
+            else:
+                self._drain_recs_cap = max(256, 4 * len(fd_rows))
+                self._drain_slab_cap = max(1 << 18, 4096 * len(fd_rows))
             self._drain_recs = ctypes.create_string_buffer(
                 self._drain_recs_cap * _native.NET_RECV_STRIDE
             )
-            self._drain_slab_cap = max(1 << 18, 4096 * len(fd_rows))
             self._drain_slab = ctypes.create_string_buffer(
                 self._drain_slab_cap
             )
@@ -1579,6 +1625,10 @@ class HostSessionPool:
             t["datagrams"] += int(stats[1])
             t["unroutable"] += int(stats[2])
             t["backpressure_stops"] += int(stats[3])
+            # GRO tail lives at words [12..13], AFTER the histogram (a
+            # pre-GRO .so leaves them zeroed — the memset above)
+            t["gro_datagrams"] += int(stats[12])
+            t["gro_segments"] += int(stats[13])
             for b in range(nb):
                 self._drain_hist[b] += int(stats[4 + b])
             if self._obs_on:
@@ -1650,6 +1700,26 @@ class HostSessionPool:
                 and hasattr(lib, "ggrs_net_gso_supported")
                 and lib.ggrs_net_gso_supported()
                 and not os.environ.get("GGRS_TPU_NO_GSO")
+            ),
+            # kernel probe ok + not killed; _gro_on says whether THIS
+            # pool actually armed it (needs a covered dispatch hub)
+            "gro": bool(
+                native
+                and hasattr(lib, "ggrs_net_gro_supported")
+                and lib.ggrs_net_gro_supported()
+                and not os.environ.get("GGRS_TPU_NO_GRO")
+            ),
+            "gro_active": self._gro_on,
+            # parallel slow-slot decode plane (§24): backend the pool's
+            # DecodePool resolved ("serial" is the bit-identical
+            # fallback; the kill switch forces it)
+            "parallel_decode": bool(
+                self._decode_pool is not None
+                and self._decode_pool.backend != "serial"
+            ),
+            "decode_backend": (
+                self._decode_pool.backend
+                if self._decode_pool is not None else "serial"
             ),
         }
 
@@ -2067,17 +2137,41 @@ class HostSessionPool:
         tracing-mode parse — per-slot spans are the point of a traced
         tick."""
         buf = memoryview(self._out_buf).cast("B")[: self._out_len.value]
-        pos = len(self._mirrors) * (
+        n = len(self._mirrors)
+        pos = n * (
             self._hdr_stride + self._req_stride
         ) if self._has_hdr else 0
         request_lists: List[List[GgrsRequest]] = []
         tracer = self.tracer
         tracing = tracer.enabled
-        for idx in range(len(self._mirrors)):
-            t_slot = tracer.now_ns() if tracing else 0
-            requests, pos, current = self._parse_slot(
-                buf, pos, idx, ticked[idx]
+        # parallel decode plane (§24): with the header table's rec_len
+        # jump chain every slot's byte range is known up front, so the
+        # NO_FASTPATH/legacy path fans ALL slots across the DecodePool.
+        # A TRACED pool stays on the interleaved reference decoder —
+        # per-slot spans are the point of tracing, and fanning the byte
+        # walk out would destroy that attribution.
+        decs = None
+        if not tracing and self._has_hdr and n > 1:
+            hdr = np.frombuffer(self._out_buf, dtype=_HDR_DTYPE, count=n)
+            offs = np.empty(n, np.int64)
+            offs[0] = pos
+            if n > 1:
+                offs[1:] = pos + np.cumsum(
+                    hdr["rec_len"][:-1], dtype=np.int64
+                )
+            decs = self._decode_slow_slots(
+                buf, list(range(n)), offs.tolist(), ticked
             )
+        for idx in range(n):
+            t_slot = tracer.now_ns() if tracing else 0
+            if decs is not None:
+                requests, pos, current = self._apply_slot(
+                    decs[idx], idx, ticked[idx]
+                )
+            else:
+                requests, pos, current = self._parse_slot(
+                    buf, pos, idx, ticked[idx]
+                )
             request_lists.append(requests)
             if tracing:
                 tracer.add_complete(
@@ -2149,10 +2243,18 @@ class HostSessionPool:
             # than the column extraction + two-pass walk below when every
             # slot is slow anyway
             buf = memoryview(self._out_buf).cast("B")[:out_len]
+            decs = self._decode_slow_slots(
+                buf, list(range(n)), offs_l, ticked
+            )
             for idx in range(n):
-                reqs, _, _ = self._parse_slot(
-                    buf, offs_l[idx], idx, ticked[idx]
-                )
+                if decs is not None:
+                    reqs, _, _ = self._apply_slot(
+                        decs[idx], idx, ticked[idx]
+                    )
+                else:
+                    reqs, _, _ = self._parse_slot(
+                        buf, offs_l[idx], idx, ticked[idx]
+                    )
                 plan.lists[idx] = reqs
                 plan.eager_rows.append(idx)
             self.desc_slow_slots += n
@@ -2219,11 +2321,24 @@ class HostSessionPool:
         table_slots: List[int] = []
         pass2: List[Tuple[int, int]] = []  # (slot, pos after out sections)
         flush_failed: Dict[int, Tuple[int, str]] = {}  # slot -> code, msg
+        # parallel decode plane (§24): every slow slot's byte range is
+        # known up front (the offs jump chain), so their pure decode fans
+        # out across the DecodePool BEFORE the slot walk; the walk below
+        # then applies each decoded record in slot order, interleaved
+        # with the fast slots exactly where the serial decoder ran —
+        # side-effect order is untouched because decode is pure
+        slow_rows = [idx for idx in range(n) if not fast_l[idx]]
+        decs = self._decode_slow_slots(buf, slow_rows, offs_l, ticked)
         for idx in range(n):
             if not fast_l[idx]:
-                requests, _, _ = self._parse_slot(
-                    buf, offs_l[idx], idx, ticked[idx]
-                )
+                if decs is not None:
+                    requests, _, _ = self._apply_slot(
+                        decs[idx], idx, ticked[idx]
+                    )
+                else:
+                    requests, _, _ = self._parse_slot(
+                        buf, offs_l[idx], idx, ticked[idx]
+                    )
                 lists[idx] = requests
                 eager.append(idx)
                 continue
@@ -2917,6 +3032,245 @@ class HostSessionPool:
         if not live:
             requests = []
         return requests, pos, current
+
+    def _apply_slot(self, dec, idx, ticked_slot):
+        """Replay ONE slot's side effects from a decoded record (§24).
+
+        The stateful half of :meth:`_parse_slot`: ``dec`` is the
+        plain-data tuple ``decode_pool.decode_slot_record`` produced on
+        a worker; this method performs — on the owning thread, in slot
+        order — exactly the side effects the reference decoder
+        interleaves with its byte walk: request construction (cells,
+        pooled objects, user input_decode), sends, EV_WIRE/EV_ROLLBACK
+        forensics, event staging, status/frame mirrors, journal taps,
+        fault handling, policy.  Returns ``(requests, end_pos,
+        current_frame)`` — ``_parse_slot``'s contract; the parity fuzz
+        pins the pair bit-identical."""
+        m = self._mirrors[idx]
+        players, isize = m.num_players, m.input_size
+        (err, landed, frames_ahead, current, confirmed, consensus, ops,
+         poll_out, adv_out, staged_events, eps_t, local_t, spec,
+         end_pos) = dec
+        live = ticked_slot and err == 0
+        if ticked_slot and err != 0:
+            self._on_slot_fault(idx, err)
+        requests: List[GgrsRequest] = []
+        advanced = False
+        decode = m.config.input_decode
+        rec = self._recorders[idx] if self._recorders else None
+        for kind, a, b in ops:
+            if kind == 2:
+                statuses, blob = a, b
+                requests.append(AdvanceFrame(inputs=[
+                    (decode(blob[p * isize : (p + 1) * isize]),
+                     _STATUS[statuses[p]])
+                    for p in range(players)
+                ]))
+                advanced = True
+                self._m_req_advance.inc()
+            else:
+                frame = a
+                cell = m.saved_states.get_cell(frame)
+                if kind == 0:
+                    requests.append(SaveGameState(cell=cell, frame=frame))
+                    advanced = False
+                    self._m_req_save.inc()
+                else:
+                    assert cell.frame == frame, (
+                        f"rollback loads frame {frame} but its cell "
+                        f"holds {cell.frame} — was the save fulfilled?"
+                    )
+                    requests.append(LoadGameState(cell=cell, frame=frame))
+                    advanced = False
+                    self._m_req_load.inc()
+                    self._m_rollbacks.inc()
+                    if rec is not None:
+                        rec.record(
+                            self._tick_no, EV_ROLLBACK,
+                            f"load frame {frame} (was at "
+                            f"{m.current_frame})",
+                        )
+        has_spec = self._has_spec
+        send_raw = m.send_raw
+        send_failed: Optional[str] = None
+        for ep_idx, data in poll_out:
+            if send_failed is not None:
+                continue
+            if rec is not None:
+                rec.record(self._tick_no, EV_WIRE,
+                           (ep_idx, len(data), zlib.crc32(data)))
+            try:
+                send_raw(data, m.endpoints[ep_idx].addr)
+            except Exception as e:
+                send_failed = f"socket send failed: {e!r}"
+        for e, (running, prs) in enumerate(eps_t):
+            ep = m.endpoints[e]
+            ep.running = running == 0
+            for h in range(players):
+                disc, lf = prs[h]
+                ep.peer_disc[h] = bool(disc)
+                ep.peer_last[h] = lf
+        for h in range(players):
+            disc, lf = local_t[h]
+            m.local_disc[h] = bool(disc)
+            m.local_last[h] = lf
+        if has_spec and spec is not None:
+            (next_spec, n_specs, sstat, spec_poll, spec_adv, spec_events,
+             conf_start, conf_records) = spec
+            m.next_spec_frame = next_spec
+            for e, (st, la) in enumerate(sstat):
+                sp = m.spectators[e]
+                sp.running = st == 0
+                sp.last_acked = la
+            n_conf = len(conf_records)
+            if live and m.spectators:
+                fan = self._fanout_counters.get(idx)
+                if fan is None:
+                    fan = (
+                        self._m_fanout_dgrams.labels(slot=str(idx)).inc,
+                        self._m_fanout_bytes.labels(slot=str(idx)).inc,
+                    )
+                    self._fanout_counters[idx] = fan
+                fan_d, fan_b = fan
+                fd = (
+                    self._send_fds[idx] if self._vectorized
+                    and self._send_fds else None
+                )
+                spec_rows: Optional[List[Tuple[int, int, bytes]]] = None
+                if fd is not None:
+                    try:
+                        spec_wire = [
+                            self._resolve_wire_addr(sp.addr)
+                            for sp in m.spectators
+                        ]
+                        spec_rows = []
+                    except (TypeError, ValueError, OSError):
+                        spec_rows = None
+                for e, sp in enumerate(m.spectators):
+                    to_send = sp.deferred
+                    sp.deferred = []
+                    if e < n_specs:
+                        to_send = to_send + spec_poll[e]
+                    for data in to_send:
+                        if send_failed is not None:
+                            continue
+                        if rec is not None:
+                            rec.record(
+                                self._tick_no, EV_WIRE,
+                                (f"spec{e}", len(data),
+                                 zlib.crc32(data)),
+                            )
+                        if spec_rows is not None:
+                            ip, port = spec_wire[e]
+                            spec_rows.append((ip, port, data))
+                            fan_d()
+                            fan_b(len(data))
+                            continue
+                        try:
+                            send_raw(data, sp.addr)
+                            fan_d()
+                            fan_b(len(data))
+                        except Exception as exc:
+                            send_failed = f"socket send failed: {exc!r}"
+                if spec_rows and send_failed is None:
+                    send_failed = self._spec_send_table(
+                        idx, fd, spec_rows
+                    )
+            elif not live:
+                for sp in m.spectators:
+                    sp.deferred = []
+        for ep_idx, data in adv_out:
+            if send_failed is not None:
+                continue
+            if rec is not None:
+                rec.record(self._tick_no, EV_WIRE,
+                           (ep_idx, len(data), zlib.crc32(data)))
+            try:
+                send_raw(data, m.endpoints[ep_idx].addr)
+            except Exception as e:
+                send_failed = f"socket send failed: {e!r}"
+        if has_spec and spec is not None and live and m.spectators:
+            for e, sp in enumerate(m.spectators):
+                if e < n_specs:
+                    sp.deferred.extend(spec_adv[e])
+            hub = self._spectator_hub
+            if hub is not None and spec_events:
+                for kind, sp_idx, payload in spec_events:
+                    hub._on_native_event(idx, sp_idx, kind, payload)
+        if has_spec and spec is not None and live and n_conf:
+            sink = self._journal_sinks.get(idx)
+            if sink is not None:
+                sink.append_frames(conf_start, conf_records)
+        if send_failed is not None:
+            if m.staged_native and advanced:
+                adv = next(
+                    (r for r in reversed(requests)
+                     if type(r) is AdvanceFrame), None,
+                )
+                if adv is not None:
+                    encode = m.encode
+                    for h in m.local_handles:
+                        m.staged_inputs[h] = encode(adv.inputs[h][0])
+                m.staged_native.clear()
+            self._on_slot_fault(idx, 0, send_failed)
+            live = False
+        if live:
+            for kind, ep_idx, payload in staged_events:
+                ep = m.endpoints[ep_idx]
+                if kind == _EV_INTERRUPTED:
+                    m.push_event((_LZ_INTERRUPTED, ep.addr, payload))
+                elif kind == _EV_RESUMED:
+                    m.push_event((_LZ_RESUMED, ep.addr))
+                elif kind == _EV_DISCONNECTED:
+                    self._on_protocol_disconnected(m, ep_idx)
+                elif kind == _EV_CHECKSUM:
+                    frame, lo, hi = payload
+                    self._store_checksum(ep, frame, lo | (hi << 64))
+            pre_current = current - (1 if advanced else 0)
+            m.frames_ahead = frames_ahead
+            if (
+                pre_current > m.next_recommended_sleep
+                and frames_ahead >= MIN_RECOMMENDATION
+            ):
+                m.next_recommended_sleep = (
+                    pre_current + RECOMMENDATION_INTERVAL
+                )
+                m.push_event((_LZ_WAIT, frames_ahead))
+            if advanced:
+                m.staged_inputs.clear()
+                if m.staged_native:
+                    m.staged_native.clear()
+            if consensus:
+                self._run_consensus(m)
+        if ticked_slot:
+            m.current_frame = current
+            m.last_confirmed = confirmed
+        if not live:
+            requests = []
+        return requests, end_pos, current
+
+    def _decode_slow_slots(self, buf, slots: List[int], offs_l,
+                           ticked) -> Optional[Dict[int, Any]]:
+        """Fan the tick's slow slots across the DecodePool (§24) and
+        return ``slot -> decoded tuple`` — or None when the parallel
+        plane must stay out of the way (serial backend, no pool, a
+        single slot not worth the fan-out): the caller then uses the
+        reference ``_parse_slot`` directly, which IS the serial
+        fallback, bit for bit."""
+        pool = self._decode_pool
+        if pool is None or pool.backend == "serial" or len(slots) < 2:
+            return None
+        has_spec = self._has_spec
+        mirrors = self._mirrors
+        jobs = []
+        for idx in slots:
+            m = mirrors[idx]
+            jobs.append(
+                (offs_l[idx], m.num_players, m.input_size, has_spec)
+            )
+        decs = pool.decode_slots(buf, jobs)
+        self.decode_parallel_ticks += 1
+        return dict(zip(slots, decs))
 
     def _spec_send_table(self, idx: int, fd: int,
                          rows: List[Tuple[int, int, bytes]]) -> Optional[str]:
@@ -3938,6 +4292,14 @@ class HostSessionPool:
             self._drain_totals, crossings=self.drain_crossings
         )
         out["gso"] = dict(self._gso_totals)
+        out["decode"] = (
+            dict(self._decode_pool.stats(),
+                 parallel_ticks=self.decode_parallel_ticks)
+            if self._decode_pool is not None
+            else {"backend": "serial", "workers": 1, "jobs": 0,
+                  "batches": 0, "decode_ns": 0, "worker_jobs": {},
+                  "parallel_ticks": 0}
+        )
         out["capabilities"] = self.io_capabilities()
         return out
 
@@ -4636,6 +4998,9 @@ class HostSessionPool:
 
     def __del__(self) -> None:  # pragma: no cover
         try:
+            if self._decode_pool is not None:
+                self._decode_pool.close()
+                self._decode_pool = None
             if self._bank and self._lib is not None:
                 self._lib.ggrs_bank_free(self._bank)
                 self._bank = None

@@ -77,7 +77,10 @@ constexpr size_t kSendStride = 20;
 // route table sorted by (ip, port) (kRouteStride bytes per entry: u32 ip,
 // u16 port, u16 pad, i32 slot).  Output: a packed record table
 // (kRecvStride bytes per datagram: i32 slot, i32 fd_idx, u32 ip, u16 port,
-// u16 pad, u32 off, u32 len) whose off/len index the caller's slab.
+// u16 seg, u32 off, u32 len) whose off/len index the caller's slab.  `seg`
+// is the segment index when a GRO-coalesced datagram was split back into
+// its wire datagrams (§23d) — 0 for ordinary datagrams, 0..n-1 across one
+// coalesced train (same stride, the u16 that used to be padding).
 constexpr size_t kRecvStride = 24;
 constexpr size_t kRouteStride = 12;
 constexpr size_t kFdStride = 8;
@@ -93,9 +96,13 @@ constexpr uint16_t kSendFlagDispatch = 1;
 constexpr int kSendTableStats = 5;
 
 // ggrs_net_recv_table stats words: {recv_calls, datagrams, unroutable,
-// backpressure_stops} + the 8-bucket batch-size histogram — mirrored as
-// _native.NET_RECV_TABLE_STATS.
-constexpr int kRecvTableStats = 12;
+// backpressure_stops} + the 8-bucket batch-size histogram, then the GRO
+// tail appended AFTER the histogram so existing indices never move:
+// [12] gro_datagrams (coalesced trains split), [13] gro_segments (wire
+// datagrams recovered from them) — mirrored as _native.NET_RECV_TABLE_STATS.
+constexpr int kRecvTableStats = 14;
+constexpr int kStGroDgrams = 12;
+constexpr int kStGroSegs = 13;
 
 // stat slots (mirrored as _native.IO_STAT_FIELDS + two 8-bucket
 // histograms; 22 u64 total, the per-slot io tail of ggrs_bank_stats)
@@ -147,6 +154,11 @@ int ggrs_net_recv_stats_len(void) { return kRecvTableStats; }
 // produces a working probe (the setsockopt simply fails on old kernels).
 #ifndef UDP_SEGMENT
 #define UDP_SEGMENT 103
+#endif
+// UDP_GRO (receive-side coalescing) landed in linux 5.0; same old-header
+// story as UDP_SEGMENT — the probe simply fails on kernels without it.
+#ifndef UDP_GRO
+#define UDP_GRO 104
 #endif
 #ifndef SOL_UDP
 #define SOL_UDP 17
@@ -206,6 +218,39 @@ int gso_probe() {
 }
 
 bool gso_active() { return g_gso_mode != 0 && gso_probe() != 0; }
+
+// ---- GRO capability (gen 2, §23d) ---------------------------------------
+// The receive-side mirror of the GSO probe: can a UDP socket take the
+// UDP_GRO option on THIS kernel?  Unlike GSO (auto by default — the send
+// path only ever gains from coalescing), the recv drain defaults OFF:
+// gro_active() switches the drain onto the wide 16-message GRO ring,
+// which trades per-syscall message count for train capacity, so it must
+// be armed explicitly — the pool calls ggrs_net_set_gro(1) exactly when
+// it flipped UDP_GRO on the hub fds the recv table covers.  Contract:
+// 0 off (default), 1 on, -1 auto (probe-gated).  Sockets that never had
+// UDP_GRO set produce no cmsg and decode exactly as before (minus the
+// ordinary-datagram clamp, which preserves truncation parity).
+int g_gro_mode = 0;
+
+int gro_probe() {
+  static int cached = -1;
+  if (cached >= 0) return cached;
+  int fd = socket(AF_INET, SOCK_DGRAM, 0);
+  if (fd < 0) {
+    cached = 0;
+    return cached;
+  }
+  int on = 1;
+  cached = setsockopt(fd, SOL_UDP, UDP_GRO, &on, sizeof(on)) == 0;
+  close(fd);
+  return cached;
+}
+
+bool gro_active() {
+  if (g_gro_mode == 0) return false;
+  if (g_gro_mode == 1) return true;
+  return gro_probe() != 0;
+}
 
 // route table binary search: entries sorted by (ip, port) as the packed
 // u64 key below (the pool sorts the same way)
@@ -517,6 +562,15 @@ void ggrs_net_set_gso(int mode) {
   g_gso_mode = mode < 0 ? -1 : (mode ? 1 : 0);
 }
 
+// GRO capability + override (gen 2, §23d) — the receive-side siblings.
+// A kernel that refuses UDP_GRO cannot be forced on; forcing off pins the
+// drain to the pre-GRO record walk bit-identically (the 4096-byte ring,
+// no cmsg parse).
+int ggrs_net_gro_supported(void) { return gro_probe(); }
+void ggrs_net_set_gro(int mode) {
+  g_gro_mode = mode < 0 ? -1 : (mode ? 1 : 0);
+}
+
 // Chaos seam for the table path (the NetBatch inject covers only
 // attached sockets): record indices >= `at` of subsequent
 // ggrs_net_send_table calls fail with `err` before any syscall, one
@@ -782,8 +836,20 @@ int ggrs_net_send_table(const uint8_t* desc, int64_t n,
 // drain STOPS — never mid-batch, so nothing read from the kernel is
 // lost — and counts a backpressure stop (stats[3]); the kernel queue
 // keeps the rest for the caller to regrow and re-drain.  stats =
-// {recv_calls, datagrams, unroutable, backpressure_stops, hist[8]}
-// (kRecvTableStats words, accumulated; callers zero it).
+// {recv_calls, datagrams, unroutable, backpressure_stops, hist[8],
+// gro_datagrams, gro_segments} (kRecvTableStats words, accumulated;
+// callers zero it).
+//
+// GRO (§23d): when the kernel takes UDP_GRO (and the caller enabled it
+// on the fds — DispatchHub does), the drain runs on a wide ring (64 KiB
+// buffers + cmsg space), reads the UDP_GRO cmsg per message, and splits
+// each coalesced train back into one record per WIRE datagram: seg index
+// at record offset 14, stats[1] counting segments so the datagram count
+// matches the GRO-off drain exactly.  Ordinary datagrams on the wide
+// ring clamp to the reference ring's 4096-byte truncation, so GRO-on is
+// bit-identical to GRO-off on everything the records describe; the
+// backpressure clamp reserves the kernel's 64-segments-per-train worst
+// case before every syscall, same never-lose-what-was-read rule.
 //
 // Returns the record count (>= 0) or kNetErrBadArgs; the fatal-pair
 // count lands in *n_fatal_out.
@@ -817,11 +883,86 @@ int ggrs_net_recv_table(const uint8_t* fds, int n_fds,
       }
     }
   };
-  static thread_local Ring ring;
+  // GRO ring (§23d): 64 KiB messages — one coalesced train can be a full
+  // UDP payload — plus per-message cmsg space for the UDP_GRO
+  // segment-size ancillary data.  The window matches the normal ring's
+  // (kDrainWin): when the kernel coalesces nothing (small sparse flows)
+  // an armed drain must not batch WORSE than the 4 KiB ring, and when it
+  // does coalesce, 64 msgs × up to 64 segments pulls ~4k wire datagrams
+  // per syscall.  Lazily constructed thread-local: a GRO-less box never
+  // pays the ~4 MiB.
+  constexpr int kGroWin = 64;
+  constexpr size_t kGroBufSize = 65536;
+  constexpr int kGroMaxSegs = 64;   // kernel cap on segments per train
+  constexpr size_t kGroCtlSpace = 64;  // >= CMSG_SPACE(sizeof(int)) + slack
+  struct GroRing {
+    std::vector<mmsghdr> msgs;
+    std::vector<iovec> iov;
+    std::vector<sockaddr_in> addr;
+    std::vector<uint8_t> buf;
+    std::vector<uint8_t> ctl;
+    GroRing() : msgs(kGroWin), iov(kGroWin), addr(kGroWin),
+                buf(static_cast<size_t>(kGroWin) * kGroBufSize),
+                ctl(static_cast<size_t>(kGroWin) * kGroCtlSpace) {
+      for (int k = 0; k < kGroWin; ++k) {
+        iov[k].iov_base = buf.data() + static_cast<size_t>(k) * kGroBufSize;
+        iov[k].iov_len = kGroBufSize;
+        std::memset(&msgs[k], 0, sizeof(mmsghdr));
+        msgs[k].msg_hdr.msg_iov = &iov[k];
+        msgs[k].msg_hdr.msg_iovlen = 1;
+        msgs[k].msg_hdr.msg_name = &addr[k];
+        msgs[k].msg_hdr.msg_namelen = sizeof(sockaddr_in);
+        msgs[k].msg_hdr.msg_control =
+            ctl.data() + static_cast<size_t>(k) * kGroCtlSpace;
+        msgs[k].msg_hdr.msg_controllen = kGroCtlSpace;
+      }
+    }
+  };
+  const bool gro = gro_active();
+  mmsghdr* msgs;
+  sockaddr_in* addr_ring;
+  uint8_t* bufp;
+  int win;
+  size_t bufsz;
+  if (gro) {
+    static thread_local GroRing gring;
+    msgs = gring.msgs.data();
+    addr_ring = gring.addr.data();
+    bufp = gring.buf.data();
+    win = kGroWin;
+    bufsz = kGroBufSize;
+  } else {
+    static thread_local Ring ring;
+    msgs = ring.msgs.data();
+    addr_ring = ring.addr.data();
+    bufp = ring.buf.data();
+    win = kDrainWin;
+    bufsz = kRecvBufSize;
+  }
   int n_recs = 0;
   int64_t slab_used = 0;
   int n_fatal = 0;
   bool full = false;
+  auto emit_rec = [&](int32_t dst, int fd_idx, uint32_t ip, uint16_t port,
+                      uint16_t seg, const uint8_t* src, size_t len) {
+    uint8_t* rp = recs + static_cast<size_t>(n_recs) * kRecvStride;
+    auto w32 = [&rp](size_t at, uint32_t v) {
+      for (int b = 0; b < 4; ++b) rp[at + b] = (v >> (8 * b)) & 0xFF;
+    };
+    w32(0, static_cast<uint32_t>(dst));
+    w32(4, static_cast<uint32_t>(fd_idx));
+    w32(8, ip);
+    rp[12] = port & 0xFF;
+    rp[13] = port >> 8;
+    rp[14] = seg & 0xFF;
+    rp[15] = seg >> 8;
+    w32(16, static_cast<uint32_t>(slab_used));
+    w32(20, static_cast<uint32_t>(len));
+    std::memcpy(slab + slab_used, src, len);
+    slab_used += static_cast<int64_t>(len);
+    ++n_recs;
+    stats[1] += 1;
+  };
   for (int e = 0; e < n_fds && !full; ++e) {
     const uint8_t* fp = fds + static_cast<size_t>(e) * kFdStride;
     int32_t fd = 0, slot = 0;
@@ -832,11 +973,15 @@ int ggrs_net_recv_table(const uint8_t* fds, int n_fds,
     while (true) {
       // clamp the batch so every datagram the kernel hands over has a
       // guaranteed record + slab home — backpressure stops BEFORE the
-      // syscall, never after, so no datagram is silently dropped
-      int vlen = kDrainWin;
-      if (vlen > max_recs - n_recs) vlen = max_recs - n_recs;
+      // syscall, never after, so no datagram is silently dropped.  Under
+      // GRO each message can explode into up to kGroMaxSegs records and a
+      // full 64 KiB of slab, so the reservation divides by that worst
+      // case; the Python regrow loop absorbs the conservatism.
+      int vlen = win;
+      int rec_room = (max_recs - n_recs) / (gro ? kGroMaxSegs : 1);
+      if (vlen > rec_room) vlen = rec_room;
       int64_t slab_room =
-          (slab_cap - slab_used) / static_cast<int64_t>(kRecvBufSize);
+          (slab_cap - slab_used) / static_cast<int64_t>(bufsz);
       if (vlen > slab_room) vlen = static_cast<int>(slab_room);
       if (vlen <= 0) {
         stats[3] += 1;
@@ -844,11 +989,14 @@ int ggrs_net_recv_table(const uint8_t* fds, int n_fds,
         break;
       }
       for (int k = 0; k < vlen; ++k) {
-        ring.msgs[k].msg_hdr.msg_namelen = sizeof(sockaddr_in);
-        ring.msgs[k].msg_len = 0;
+        msgs[k].msg_hdr.msg_namelen = sizeof(sockaddr_in);
+        msgs[k].msg_len = 0;
+        if (gro) {
+          msgs[k].msg_hdr.msg_controllen = kGroCtlSpace;
+          msgs[k].msg_hdr.msg_flags = 0;
+        }
       }
-      int r = recvmmsg(fd, ring.msgs.data(), static_cast<unsigned>(vlen), 0,
-                       nullptr);
+      int r = recvmmsg(fd, msgs, static_cast<unsigned>(vlen), 0, nullptr);
       stats[0] += 1;
       if (r < 0) {
         if (errno == EAGAIN || errno == EWOULDBLOCK) break;
@@ -865,8 +1013,8 @@ int ggrs_net_recv_table(const uint8_t* fds, int n_fds,
       if (r == 0) break;
       stats[4 + batch_bucket(r)] += 1;
       for (int k = 0; k < r; ++k) {
-        uint32_t ip = ring.addr[k].sin_addr.s_addr;
-        uint16_t port = ntohs(ring.addr[k].sin_port);
+        uint32_t ip = addr_ring[k].sin_addr.s_addr;
+        uint16_t port = ntohs(addr_ring[k].sin_port);
         int32_t dst = slot;
         if (dst < 0) {
           dst = route_lookup(routes, n_routes, ip, port);
@@ -875,26 +1023,49 @@ int ggrs_net_recv_table(const uint8_t* fds, int n_fds,
             continue;       // Python demux ignoring unknown senders
           }
         }
-        size_t len = ring.msgs[k].msg_len;
-        uint8_t* rp = recs + static_cast<size_t>(n_recs) * kRecvStride;
-        auto w32 = [&rp](size_t at, uint32_t v) {
-          for (int b = 0; b < 4; ++b) rp[at + b] = (v >> (8 * b)) & 0xFF;
-        };
-        w32(0, static_cast<uint32_t>(dst));
-        w32(4, static_cast<uint32_t>(e));
-        w32(8, ip);
-        rp[12] = port & 0xFF;
-        rp[13] = port >> 8;
-        rp[14] = 0;
-        rp[15] = 0;
-        w32(16, static_cast<uint32_t>(slab_used));
-        w32(20, static_cast<uint32_t>(len));
-        std::memcpy(slab + slab_used,
-                    ring.buf.data() + static_cast<size_t>(k) * kRecvBufSize,
-                    len);
-        slab_used += static_cast<int64_t>(len);
-        ++n_recs;
-        stats[1] += 1;
+        size_t len = msgs[k].msg_len;
+        const uint8_t* src = bufp + static_cast<size_t>(k) * bufsz;
+        size_t gso_size = 0;
+        if (gro) {
+          for (cmsghdr* cm = CMSG_FIRSTHDR(&msgs[k].msg_hdr); cm;
+               cm = CMSG_NXTHDR(&msgs[k].msg_hdr, cm)) {
+            if (cm->cmsg_level == SOL_UDP && cm->cmsg_type == UDP_GRO) {
+              int gs = 0;
+              std::memcpy(&gs, CMSG_DATA(cm), sizeof(gs));
+              if (gs > 0) gso_size = static_cast<size_t>(gs);
+              break;
+            }
+          }
+        }
+        if (gso_size > 0 && len > gso_size) {
+          // coalesced train: split back into wire datagrams so the
+          // record walk sees exactly what GRO-off would have seen,
+          // tagging each record with its segment index
+          stats[kStGroDgrams] += 1;
+          uint16_t seg = 0;
+          size_t off = 0;
+          while (off < len) {
+            size_t part = len - off;
+            if (part > gso_size) part = gso_size;
+            // defensive fold: the pre-syscall reserve guarantees
+            // kGroMaxSegs records per message, so running out here
+            // means a >64-segment train — fold the remainder into the
+            // final record rather than drop bytes
+            if (n_recs + 1 >= max_recs || seg == kGroMaxSegs - 1) {
+              part = len - off;
+            }
+            emit_rec(dst, e, ip, port, seg, src + off, part);
+            stats[kStGroSegs] += 1;
+            off += part;
+            ++seg;
+          }
+        } else {
+          // ordinary datagram: on the wide GRO ring, clamp to the
+          // reference ring's buffer size so an oversized datagram
+          // truncates exactly as it does with GRO off (parity)
+          if (gro && len > kRecvBufSize) len = kRecvBufSize;
+          emit_rec(dst, e, ip, port, 0, src, len);
+        }
       }
       if (r < vlen) break;  // queue ran dry mid-batch: no probe needed
     }
@@ -948,6 +1119,8 @@ int ggrs_net_recv_table(const uint8_t*, int, const uint8_t*, int, uint8_t*,
 }
 int ggrs_net_gso_supported(void) { return 0; }
 void ggrs_net_set_gso(int) {}
+int ggrs_net_gro_supported(void) { return 0; }
+void ggrs_net_set_gro(int) {}
 void ggrs_net_inject_table_errno(int, int64_t, int) {}
 
 }  // extern "C"

@@ -87,7 +87,7 @@ python -m pytest tests/test_session_bank.py tests/test_policy_plane.py \
     tests/test_obs.py tests/test_broadcast.py tests/test_replay_journal.py \
     tests/test_trace.py tests/test_desync_detection.py \
     tests/test_native_io.py tests/test_socket_datapath.py \
-    tests/test_net_gen2.py \
+    tests/test_net_gen2.py tests/test_decode_parallel.py \
     tests/test_fleet.py tests/test_fleet_rpc.py tests/test_fleet_proc.py \
     tests/test_fleet_obs.py \
     -q -p no:cacheprovider -m "not slow" \
@@ -116,12 +116,16 @@ g++ -O1 -g -shared -fPIC -std=c++17 -fsanitize=thread \
 # inherit the preload and GGRS_NATIVE_SANITIZE=thread, so the runner's
 # serving loop drives the TSan bank too).  halt_on_error aborts the
 # run on the first race; second_deadlock_stack improves lock reports.
+# GGRS_TPU_DECODE_BACKEND=thread forces the §24 decode plane onto real
+# worker threads here, so its fan-out/merge runs under TSan even on
+# builds where the runtime default would resolve serial.
 LD_PRELOAD="$tsan_rt" \
 TSAN_OPTIONS="halt_on_error=1:second_deadlock_stack=1" \
 GGRS_NATIVE_SANITIZE=thread \
+GGRS_TPU_DECODE_BACKEND=thread \
 JAX_PLATFORMS=cpu \
 python -m pytest tests/test_native_io.py tests/test_socket_datapath.py \
-    tests/test_net_gen2.py \
+    tests/test_net_gen2.py tests/test_decode_parallel.py \
     tests/test_thread_ownership.py tests/test_fleet_proc.py \
     tests/test_descriptor_plane.py \
     -q -p no:cacheprovider -m "not slow" \

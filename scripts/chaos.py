@@ -573,13 +573,17 @@ def verify_socket_leg(matches: int, ticks: int, seed: int,
                 f"{d_chaos['pool'].crossings} != {ticks} pool ticks"
             )
         drain = d_chaos["io"]["drain"]
+        dec = d_chaos["io"]["decode"]
         print(f"  [dispatch_fatal] target state="
               f"{d_chaos['states'][target]} "
               f"frame={d_chaos['frames'][target]} fds={d_chaos['hub_fds']} "
               f"drain={{datagrams: {drain['datagrams']}, "
               f"unroutable: {drain['unroutable']}, "
               f"crossings: {drain['crossings']}}} "
-              f"gso={d_chaos['io']['gso']}")
+              f"gso={d_chaos['io']['gso']} "
+              f"decode={{backend: {dec['backend']}, "
+              f"parallel_ticks: {dec['parallel_ticks']}, "
+              f"jobs: {dec['jobs']}}}")
     verdict = not violations
     _write_artifact(artifact_dir, "socket", {
         "scenario": "socket",
@@ -598,6 +602,9 @@ def verify_socket_leg(matches: int, ticks: int, seed: int,
             }
             for name, leg in legs.items()
         },
+        # §24 decode-plane posture under fault load (each leg's full
+        # counters also ride along in legs[*].io.decode)
+        "decode_plane": legs["fatal"]["io"]["decode"],
         "metrics": json_snapshot(legs["fatal"]["registry"]),
         "desync_report": None,
     })

@@ -158,6 +158,14 @@ def main() -> int:
                     help="run the population over real loopback UDP so "
                          "the gen-2 one-crossing inbound drain (§23a) "
                          "engages; adds the pool.drain split line")
+    ap.add_argument("--decode", action="store_true",
+                    help="append the §24 decode-plane A/B: serial vs "
+                         "parallel slow-slot decode (untraced, fast path "
+                         "off so every slot is slow), with per-worker "
+                         "utilization and GRO segments-per-datagram")
+    ap.add_argument("--decode-backend", default="thread",
+                    metavar="B", help="parallel leg backend for --decode "
+                                      "(default thread)")
     args = ap.parse_args()
 
     from ggrs_tpu.obs import Tracer
@@ -238,6 +246,64 @@ def main() -> int:
                   f"p50 {np.percentile(xs, 50):6.2f} ms  "
                   f"p99 {np.percentile(xs, 99):6.2f} ms  "
                   f"(fast-path slot ticks {cov})")
+            del p, s, n2
+
+    if args.decode:
+        # §24: the parallel slow-slot decode plane.  Untraced (a traced
+        # pool keeps the interleaved reference decoder) and fast path
+        # OFF, so every slot routes through the slow decoder and the
+        # plane fans out every tick.  The serial leg is the kill-switch
+        # posture; the wall delta between the legs IS the plane's win
+        # (or, on a GIL build, its honest non-win).
+        print(f"\n# §24 decode plane A/B: B={args.matches} matches, "
+              f"fast path off (every slot slow), untraced")
+        legs = (
+            ("serial", {"GGRS_TPU_NO_PARALLEL_DECODE": "1"}),
+            (args.decode_backend,
+             {"GGRS_TPU_DECODE_BACKEND": args.decode_backend}),
+        )
+        for label, env in legs:
+            saved = {k: os.environ.pop(k, None)
+                     for k in ("GGRS_TPU_NO_PARALLEL_DECODE",
+                               "GGRS_TPU_DECODE_BACKEND")}
+            os.environ.update(env)
+            try:
+                p, s, n2 = build_pool(args.matches, fastpath=False,
+                                      udp=args.udp)
+                drive(p, s, n2, 16)
+                dec0 = p.io_stats()["decode"]
+                ns0, jobs0 = dec0["decode_ns"], dec0["jobs"]
+                xs = drive(p, s, n2, args.ticks, base=16)
+            finally:
+                for k, v in saved.items():
+                    os.environ.pop(k, None)
+                    if v is not None:
+                        os.environ[k] = v
+            dec = p.io_stats()["decode"]
+            print(f"  {label:<8}: p50 {np.percentile(xs, 50):6.2f} ms  "
+                  f"p99 {np.percentile(xs, 99):6.2f} ms  "
+                  f"(backend {dec['backend']}, "
+                  f"{dec['parallel_ticks']} fanned ticks)")
+            if dec["parallel_ticks"]:
+                jobs = dec["jobs"] - jobs0
+                in_pool_us = (dec["decode_ns"] - ns0) / 1000.0 / args.ticks
+                print(f"            slow slots/tick "
+                      f"{jobs / args.ticks:.1f}, in-pool decode "
+                      f"{in_pool_us:.0f} us/tick over "
+                      f"{dec['workers']} workers")
+                total = sum(dec["worker_jobs"].values()) or 1
+                spread = ", ".join(
+                    f"{100 * v / total:.0f}%"
+                    for v in sorted(dec["worker_jobs"].values(),
+                                    reverse=True)
+                )
+                print(f"            worker utilization (jobs): {spread}")
+            dio = p.io_stats()["drain"]
+            if dio.get("gro_datagrams"):
+                print(f"            gro: {dio['gro_segments']} segments "
+                      f"from {dio['gro_datagrams']} trains "
+                      f"({dio['gro_segments'] / dio['gro_datagrams']:.1f} "
+                      f"segs/datagram)")
             del p, s, n2
     return 0
 

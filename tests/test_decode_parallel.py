@@ -1,0 +1,531 @@
+"""Pins for the parallel slow-slot decode plane + GRO inbound (§24).
+
+The host bank's slow slots now decode on a worker pool —
+``decode_slot_record`` (the pure half of ``_parse_slot``) runs against
+read-only views of the shared tick buffer and the owning thread replays
+the side effects in slot order.  Everything here pins that plane
+bit-identical to the serial reference under every backend this box can
+run: request values, events, wire bytes, journal streams, and frame
+mirrors, under seeded loss/dup/reorder, on the event-heavy blackout
+path, and across fault/eviction ticks.  Plus: the crossing budget is
+untouched (the plane adds ZERO ctypes crossings), the §20 ownership
+guard holds, the kill switches force bit-identical degradation, and the
+GRO receive path is pinned both natively (a forced GSO train splits
+back into per-datagram records) and at the pool level (arming GRO never
+changes the peer-observed wire stream over real loopback UDP).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import ctypes
+import os
+import random
+import socket as pysocket
+import struct
+import threading
+
+import pytest
+
+from ggrs_tpu.core.config import Config
+from ggrs_tpu.net import InMemoryNetwork, _native
+from ggrs_tpu.parallel.decode_pool import DecodePool
+from ggrs_tpu.parallel.host_bank import HostSessionPool
+from ggrs_tpu.utils.ownership import CrossThreadAccess
+
+from test_session_bank import (  # noqa: E402  (pytest rootdir path)
+    assert_requests_equal,
+    fulfill_saves,
+    needs_native,
+    two_peer_builders,
+)
+from test_net_gen2 import needs_gen2, run_inbound_leg  # noqa: E402
+
+# Backends worth exercising on THIS box: serial always; thread always
+# (on a GIL build it wins no wall time but must stay bit-identical —
+# the whole point of the pin); interp only where the stdlib has it.
+_BACKENDS = ["thread"]
+if DecodePool._interp_available():
+    _BACKENDS.append("interp")
+
+_PLANE_ENV = (
+    "GGRS_TPU_DECODE_BACKEND",
+    "GGRS_TPU_NO_PARALLEL_DECODE",
+    "GGRS_TPU_DECODE_WORKERS",
+    "GGRS_TPU_NO_GRO",
+    "GGRS_TPU_NO_FASTPATH",
+)
+
+
+@contextlib.contextmanager
+def _env(d):
+    """Hold exactly ``d`` of the decode-plane env switches, restoring the
+    previous posture after.  The backend is resolved at pool finalization
+    (the first ``advance_all``), so drives wrap EVERY advance call — cheap,
+    and robust to re-plans."""
+    saved = {k: os.environ.pop(k, None) for k in _PLANE_ENV}
+    os.environ.update(d)
+    try:
+        yield
+    finally:
+        for k in _PLANE_ENV:
+            os.environ.pop(k, None)
+        for k, v in saved.items():
+            if v is not None:
+                os.environ[k] = v
+
+
+def _make_pool(builders, env):
+    with _env(env):
+        pool = HostSessionPool()
+        for b, s in builders:
+            pool.add_session(b, s)
+        assert pool.native_active, "native bank did not engage"
+    return pool
+
+
+def _drive_pair(env_a, env_b, faults, ticks, n_matches=3, journals=None,
+                blackout=None, scrape_every=0, inject_error_at=None):
+    """Drive two identically-seeded pools — leg A under ``env_a``, leg B
+    under ``env_b`` — comparing requests, events, frames, and wire bytes
+    every tick.  Returns (pool_a, pool_b, saw_events)."""
+    clock = [0]
+    net_a = InMemoryNetwork(**faults)
+    net_b = InMemoryNetwork(**faults)
+    builders_a = two_peer_builders(net_a, clock, n_matches)
+    builders_b = two_peer_builders(net_b, clock, n_matches)
+    pool_a = _make_pool(builders_a, env_a)
+    pool_b = _make_pool(builders_b, env_b)
+    if journals is not None:
+        from ggrs_tpu.broadcast.hub import SpectatorHub
+
+        hub_a = SpectatorHub(pool_a)
+        hub_b = SpectatorHub(pool_b)
+        (ja, jb) = journals
+        hub_a.attach_journal(0, ja)
+        hub_b.attach_journal(0, jb)
+    n = len(builders_a)
+    saw_events = 0
+    for i in range(ticks):
+        if inject_error_at is not None and i == inject_error_at:
+            pool_a.inject_slot_error(1)
+            pool_b.inject_slot_error(1)
+        dark = blackout is not None and i in blackout
+        if dark:
+            clock[0] += 300  # starved liveness: the event-heavy path
+        clock[0] += 16
+        for idx in range(n):
+            v = (i + idx) % 16
+            pool_a.add_local_input(idx, idx % 2, v)
+            pool_b.add_local_input(idx, idx % 2, v)
+        with _env(env_a):
+            reqs_a = pool_a.advance_all()
+        with _env(env_b):
+            reqs_b = pool_b.advance_all()
+        if scrape_every and i % scrape_every == 0:
+            pool_a.scrape()
+            pool_b.scrape()
+        for idx in range(n):
+            assert_requests_equal(
+                reqs_b[idx], reqs_a[idx], f"tick {i} slot {idx}"
+            )
+            fulfill_saves(reqs_a[idx])
+            fulfill_saves(reqs_b[idx])
+        if not dark:
+            net_a.tick()
+            net_b.tick()
+        for idx in range(n):
+            ev_a = pool_a.events(idx)
+            saw_events += len(ev_a)
+            assert ev_a == pool_b.events(idx), (
+                f"tick {i} slot {idx}: events diverged"
+            )
+            assert pool_a.current_frame(idx) == pool_b.current_frame(idx)
+            assert (
+                pool_a.last_confirmed_frame(idx)
+                == pool_b.last_confirmed_frame(idx)
+            )
+            sa = builders_a[idx][1].sent
+            sb = builders_b[idx][1].sent
+            assert sa == sb, (
+                f"tick {i} slot {idx}: wire bytes diverged "
+                f"({len(sa)} vs {len(sb)} datagrams)"
+            )
+    return pool_a, pool_b, saw_events
+
+
+# ----------------------------------------------------------------------
+# the headline parity fuzz: each available backend vs the serial
+# reference, bit for bit
+# ----------------------------------------------------------------------
+
+
+@needs_native
+class TestParallelDecodeParity:
+    @pytest.mark.parametrize("backend", _BACKENDS)
+    @pytest.mark.parametrize("seed", [7, 19])
+    def test_fuzzed_traffic_bit_identical(self, backend, seed):
+        """Seeded loss/dup/reorder with the fast path OFF (every slot
+        slow, every tick fans out): the parallel plane is bit-identical
+        to the serial reference — and it actually engaged."""
+        rng = random.Random(seed)
+        faults = dict(
+            loss=0.08, duplicate=0.04, reorder=0.15,
+            seed=rng.randrange(1 << 30),
+        )
+        pool_a, pool_b, _ = _drive_pair(
+            {"GGRS_TPU_DECODE_BACKEND": backend,
+             "GGRS_TPU_NO_FASTPATH": "1"},
+            {"GGRS_TPU_NO_PARALLEL_DECODE": "1",
+             "GGRS_TPU_NO_FASTPATH": "1"},
+            faults, ticks=120,
+        )
+        dec = pool_a.io_stats()["decode"]
+        assert dec["backend"] == backend
+        assert dec["parallel_ticks"] > 0, "parallel plane never engaged"
+        assert dec["jobs"] >= 2 * dec["parallel_ticks"]
+        assert len(dec["worker_jobs"]) >= 2, (
+            f"one worker decoded everything: {dec['worker_jobs']}"
+        )
+        assert pool_b.io_stats()["decode"]["backend"] == "serial"
+        assert pool_b.io_stats()["decode"]["parallel_ticks"] == 0
+
+    def test_fastpath_regime_parity(self):
+        """With the §19 fast path ON, only the tick's genuinely slow
+        slots reach the pool — parity must hold through the mixed
+        fast/slow plan decode too."""
+        faults = dict(loss=0.1, duplicate=0.05, reorder=0.2, seed=1234)
+        pool_a, _, _ = _drive_pair(
+            {"GGRS_TPU_DECODE_BACKEND": "thread"},
+            {"GGRS_TPU_NO_PARALLEL_DECODE": "1"},
+            faults, ticks=150,
+        )
+        assert pool_a.fast_slot_ticks > 0, "fast path never engaged"
+
+    def test_event_heavy_blackout_parity(self):
+        """Clock-jump blackouts force interrupt/resume events and retry
+        storms — the densest records the decoder sees — through the
+        parallel plane, pinned against the reference."""
+        pool_a, _, saw_events = _drive_pair(
+            {"GGRS_TPU_DECODE_BACKEND": "thread",
+             "GGRS_TPU_NO_FASTPATH": "1"},
+            {"GGRS_TPU_NO_PARALLEL_DECODE": "1",
+             "GGRS_TPU_NO_FASTPATH": "1"},
+            dict(), ticks=100, blackout={40, 41, 42, 80},
+        )
+        assert saw_events > 0, "blackout produced no events"
+        assert pool_a.decode_parallel_ticks > 0
+
+    def test_fault_and_eviction_ticks_parity(self):
+        """A slot faulting mid-run (quarantine -> eviction, §9) must
+        transit identically whether its neighbours decode in parallel or
+        serial — including the supervision feed and the evicted slot's
+        resumed progress."""
+        pool_a, pool_b, _ = _drive_pair(
+            {"GGRS_TPU_DECODE_BACKEND": "thread",
+             "GGRS_TPU_NO_FASTPATH": "1"},
+            {"GGRS_TPU_NO_PARALLEL_DECODE": "1",
+             "GGRS_TPU_NO_FASTPATH": "1"},
+            dict(), ticks=60, inject_error_at=12,
+        )
+        feed_a = pool_a.drain_state_transitions()
+        feed_b = pool_b.drain_state_transitions()
+        assert feed_a == feed_b, "supervision transitions diverged"
+        assert [t[2] for t in feed_a][:2] == ["quarantined", "evicted"]
+        for idx in range(len(pool_a._mirrors)):
+            assert pool_a.slot_state(idx) == pool_b.slot_state(idx)
+        assert pool_a.current_frame(1) > 12  # evicted slot resumed
+        assert pool_a.decode_parallel_ticks > 0
+
+    def test_journal_streams_bit_identical(self, tmp_path):
+        """The journal tap's confirmed-frame records ride the decoded
+        broadcast tail: both legs' journal files must be byte-identical."""
+        from ggrs_tpu.broadcast.journal import MatchJournal
+
+        cfg_players, isize = 2, Config.for_uint(16).native_input_size
+        ja = MatchJournal(tmp_path / "a.journal", cfg_players, isize)
+        jb = MatchJournal(tmp_path / "b.journal", cfg_players, isize)
+        pool_a, _, _ = _drive_pair(
+            {"GGRS_TPU_DECODE_BACKEND": "thread",
+             "GGRS_TPU_NO_FASTPATH": "1"},
+            {"GGRS_TPU_NO_PARALLEL_DECODE": "1",
+             "GGRS_TPU_NO_FASTPATH": "1"},
+            dict(loss=0.05, seed=7), ticks=90, journals=(ja, jb),
+        )
+        assert pool_a.decode_parallel_ticks > 0
+        ja.close()
+        jb.close()
+        a = (tmp_path / "a.journal").read_bytes()
+        b = (tmp_path / "b.journal").read_bytes()
+        assert a == b and len(a) > 0, "journal streams diverged"
+
+    def test_crossing_budget_plane_adds_zero(self):
+        """The decode plane lives entirely on the Python side of the tick
+        buffer: still exactly one tick crossing per pool tick and one
+        stats crossing per scraped tick — zero new ctypes crossings."""
+        pool_a, _, _ = _drive_pair(
+            {"GGRS_TPU_DECODE_BACKEND": "thread",
+             "GGRS_TPU_NO_FASTPATH": "1"},
+            {"GGRS_TPU_NO_PARALLEL_DECODE": "1",
+             "GGRS_TPU_NO_FASTPATH": "1"},
+            dict(), ticks=60, scrape_every=1,
+        )
+        assert pool_a.crossings == 60
+        assert pool_a.stat_crossings == 60
+        assert pool_a.harvests == 0
+        assert pool_a.decode_parallel_ticks > 0
+
+
+# ----------------------------------------------------------------------
+# kill switches, capability matrix, ownership
+# ----------------------------------------------------------------------
+
+
+@needs_native
+class TestDecodePlaneDegradation:
+    def test_kill_switch_forces_serial(self):
+        """GGRS_TPU_NO_PARALLEL_DECODE beats a forced backend: the pool
+        resolves serial, starts no workers, and the capability matrix
+        says so."""
+        with _env({"GGRS_TPU_NO_PARALLEL_DECODE": "1",
+                   "GGRS_TPU_DECODE_BACKEND": "thread"}):
+            dp = DecodePool()
+        assert dp.backend == "serial" and dp._executor is None
+
+        clock = [0]
+        net = InMemoryNetwork()
+        builders = two_peer_builders(net, clock, 1)
+        env = {"GGRS_TPU_NO_PARALLEL_DECODE": "1"}
+        pool = _make_pool(builders, env)
+        for i in range(4):
+            clock[0] += 16
+            for idx in range(len(builders)):
+                pool.add_local_input(idx, idx % 2, i)
+            with _env(env):
+                for reqs in pool.advance_all():
+                    fulfill_saves(reqs)
+            net.tick()
+        caps = pool.io_capabilities()
+        assert not caps["parallel_decode"]
+        assert caps["decode_backend"] == "serial"
+        assert pool.decode_parallel_ticks == 0
+
+    def test_unknown_forced_backend_degrades_to_serial(self):
+        with _env({"GGRS_TPU_DECODE_BACKEND": "quantum"}):
+            dp = DecodePool()
+        assert dp.backend == "serial"
+
+    def test_capability_matrix_reports_backend(self):
+        clock = [0]
+        net = InMemoryNetwork()
+        builders = two_peer_builders(net, clock, 1)
+        env = {"GGRS_TPU_DECODE_BACKEND": "thread"}
+        pool = _make_pool(builders, env)
+        for i in range(4):
+            clock[0] += 16
+            for idx in range(len(builders)):
+                pool.add_local_input(idx, idx % 2, i)
+            with _env(env):
+                for reqs in pool.advance_all():
+                    fulfill_saves(reqs)
+            net.tick()
+        caps = pool.io_capabilities()
+        assert caps["parallel_decode"]
+        assert caps["decode_backend"] == "thread"
+        dec = pool.io_stats()["decode"]
+        assert set(dec) >= {"backend", "workers", "jobs", "batches",
+                            "decode_ns", "worker_jobs", "parallel_ticks"}
+
+    def test_ownership_guard_holds(self):
+        """decode_slots is a §20 driving method: a foreign thread calling
+        it trips CrossThreadAccess — the worker boundary is the
+        module-level pure function, never the pool object."""
+        dp = DecodePool(backend="thread", workers=2)
+        try:
+            assert dp.decode_slots(b"", []) == []  # pins ownership here
+            caught = []
+
+            def foreign():
+                try:
+                    dp.decode_slots(b"", [])
+                except CrossThreadAccess as e:
+                    caught.append(e)
+
+            t = threading.Thread(target=foreign)
+            t.start()
+            t.join()
+            assert caught, "cross-thread decode_slots did not raise"
+        finally:
+            dp.close()
+
+
+# ----------------------------------------------------------------------
+# GRO inbound: native split units + pool-level wire parity
+# ----------------------------------------------------------------------
+
+
+def _gro_supported():
+    lib = _native.net_lib()
+    return bool(
+        lib is not None
+        and hasattr(lib, "ggrs_net_gro_supported")
+        and lib.ggrs_net_gro_supported()
+    )
+
+
+needs_gro = pytest.mark.skipif(
+    not _gro_supported(), reason="kernel lacks UDP_GRO"
+)
+
+
+def _drain_gro(lib, fd_rows, route_rows, max_recs=256, slab_cap=1 << 20):
+    """One-shot recv_table drain returning per-record ``seg`` too."""
+    recs = ctypes.create_string_buffer(max_recs * _native.NET_RECV_STRIDE)
+    slab = ctypes.create_string_buffer(slab_cap)
+    stats = (ctypes.c_uint64 * _native.NET_RECV_TABLE_STATS)()
+    fatal = (ctypes.c_int32 * 64)()
+    n_fatal = ctypes.c_int32(0)
+    fd_tab = b"".join(struct.pack("<ii", fd, s) for fd, s in fd_rows)
+    route_rows = sorted(route_rows, key=lambda r: (r[0] << 16) | r[1])
+    route_tab = b"".join(
+        struct.pack("<IHHi", ip, port, 0, s) for ip, port, s in route_rows
+    )
+    n = lib.ggrs_net_recv_table(
+        fd_tab, len(fd_rows), route_tab, len(route_rows),
+        recs, max_recs, slab, slab_cap,
+        stats, fatal, 32, ctypes.byref(n_fatal),
+    )
+    assert n >= 0, f"recv_table failed: {n}"
+    out = []
+    for k in range(n):
+        slot, fd_idx, ip, port, seg, off, ln = struct.unpack_from(
+            "<iiIHHII", recs, k * _native.NET_RECV_STRIDE
+        )
+        out.append((slot, seg, slab[off:off + ln]))
+    return out, list(stats)
+
+
+@needs_gen2
+class TestGroInbound:
+    @needs_gro
+    def test_gso_train_splits_into_per_datagram_records(self):
+        """A UDP_SEGMENT-coalesced train arriving on a UDP_GRO socket
+        must come out of ``ggrs_net_recv_table`` as per-datagram records
+        — seg-numbered, byte-exact, with the gro stat tail counting the
+        train and ``datagrams`` counting post-split wire datagrams."""
+        lib = _native.net_lib()
+        sol_udp = getattr(pysocket, "IPPROTO_UDP", 17)
+        tx = pysocket.socket(pysocket.AF_INET, pysocket.SOCK_DGRAM)
+        rx = pysocket.socket(pysocket.AF_INET, pysocket.SOCK_DGRAM)
+        try:
+            rx.bind(("127.0.0.1", 0))
+            rx.setblocking(False)
+            rx.setsockopt(sol_udp, getattr(pysocket, "UDP_GRO", 104), 1)
+            port = rx.getsockname()[1]
+            seg_size, n_segs = 320, 4
+            payload = b"".join(
+                bytes([0x41 + i]) * seg_size for i in range(n_segs)
+            )
+            tx.setsockopt(
+                sol_udp, getattr(pysocket, "UDP_SEGMENT", 103), seg_size
+            )
+            tx.sendto(payload, ("127.0.0.1", port))
+            tx_ip = int.from_bytes(
+                pysocket.inet_aton("127.0.0.1"), "little"
+            )
+            tx_port = tx.getsockname()[1]
+            lib.ggrs_net_set_gro(1)
+            try:
+                recs, stats = _drain_gro(
+                    lib, [(rx.fileno(), -1)], [(tx_ip, tx_port, 5)]
+                )
+            finally:
+                lib.ggrs_net_set_gro(0)  # global posture: restore default
+            assert [r[1] for r in recs] == list(range(n_segs))
+            assert all(r[0] == 5 for r in recs)  # demux held through GRO
+            assert b"".join(r[2] for r in recs) == payload
+            assert stats[1] == n_segs  # datagrams: post-split count
+            assert stats[12] == 1      # gro_datagrams: one train
+            assert stats[13] == n_segs  # gro_segments
+        finally:
+            tx.close()
+            rx.close()
+
+    @needs_gro
+    def test_ordinary_datagram_truncation_parity(self):
+        """The GRO ring's 64 KB buffers must not change what a plain
+        oversized datagram delivers: clamped to the non-GRO ring's 4096,
+        both modes, byte-identical."""
+        lib = _native.net_lib()
+        legs = {}
+        for mode in (0, 1):
+            tx = pysocket.socket(pysocket.AF_INET, pysocket.SOCK_DGRAM)
+            rx = pysocket.socket(pysocket.AF_INET, pysocket.SOCK_DGRAM)
+            try:
+                rx.bind(("127.0.0.1", 0))
+                rx.setblocking(False)
+                tx.sendto(bytes(range(256)) * 32,  # 8192 bytes
+                          ("127.0.0.1", rx.getsockname()[1]))
+                tx_ip = int.from_bytes(
+                    pysocket.inet_aton("127.0.0.1"), "little"
+                )
+                lib.ggrs_net_set_gro(mode)
+                try:
+                    recs, stats = _drain_gro(
+                        lib, [(rx.fileno(), -1)],
+                        [(tx_ip, tx.getsockname()[1], 0)],
+                    )
+                finally:
+                    lib.ggrs_net_set_gro(0)
+                assert len(recs) == 1 and recs[0][1] == 0
+                legs[mode] = recs[0][2]
+            finally:
+                tx.close()
+                rx.close()
+        assert len(legs[0]) == 4096
+        assert legs[0] == legs[1], "GRO ring changed truncation bytes"
+
+    @pytest.mark.parametrize("seed", [3])
+    def test_gro_on_off_peer_wire_parity(self, seed):
+        """Arming GRO on the dispatch hub must not change one byte of
+        what peers observe over real loopback UDP under seeded
+        loss/dup/reorder — any inbound divergence would change the
+        host's outbound stream."""
+        faults = dict(loss=0.05, duplicate=0.03, reorder=0.03)
+        ticks, n_matches = 120, 2
+        lib = _native.net_lib()
+        try:
+            with _env({"GGRS_TPU_NO_GRO": "1"}):
+                ref = run_inbound_leg("dispatch", seed, ticks, n_matches,
+                                      faults)
+            assert not ref["stats"]["capabilities"]["gro"]  # killed
+            assert not ref["stats"]["capabilities"]["gro_active"]
+            with _env({}):
+                leg = run_inbound_leg("dispatch", seed, ticks, n_matches,
+                                      faults)
+        finally:
+            if hasattr(lib, "ggrs_net_set_gro"):
+                lib.ggrs_net_set_gro(0)  # global posture: restore default
+        for m in range(n_matches):
+            assert leg["tapes"][m] == ref["tapes"][m], (
+                f"match {m}: wire bytes diverged with GRO armed "
+                f"(ref {len(ref['tapes'][m])} datagrams, "
+                f"gro {len(leg['tapes'][m])})"
+            )
+        assert leg["frames"] == ref["frames"]
+        if _gro_supported():
+            assert leg["stats"]["capabilities"]["gro"]
+            assert leg["stats"]["capabilities"]["gro_active"], (
+                "kernel supports GRO but the hub never armed it"
+            )
+            drain = leg["stats"]["drain"]
+            assert drain["gro_segments"] >= drain["gro_datagrams"]
+
+    def test_no_gro_env_reports_killed_capability(self):
+        """The kill switch shows up in the matrix even on a kernel with
+        GRO — per-feature degradation, never silent."""
+        with _env({"GGRS_TPU_NO_GRO": "1"}):
+            pool = HostSessionPool()
+            caps = pool.io_capabilities()
+        assert not caps["gro"]
+        assert not caps["gro_active"]

@@ -711,7 +711,8 @@ class TestDegradation:
             assert caps["dispatch"] and caps["recv_table"]
             assert set(caps) == {
                 "native_io", "recv_table", "send_table", "dispatch",
-                "reuseport", "gso",
+                "reuseport", "gso", "gro", "gro_active",
+                "parallel_decode", "decode_backend",
             }
         finally:
             hub.close()

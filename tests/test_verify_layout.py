@@ -252,7 +252,7 @@ class TestDeliberateSkew:
     RECV_GOOD = (
         'NET_RECV_FIELDS = (\n'
         '    ("slot", "<i4"), ("fd_idx", "<i4"), ("ip", "<u4"),\n'
-        '    ("port", "<u2"), ("pad", "<u2"), ("off", "<u4"),\n'
+        '    ("port", "<u2"), ("seg", "<u2"), ("off", "<u4"),\n'
         '    ("len", "<u4"),\n'
         ')\n'
     )
