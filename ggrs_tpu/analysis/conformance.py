@@ -113,6 +113,16 @@ MACHINE_SPECS: Tuple[MachineSpec, ...] = (
             "ggrs_tpu/fleet/supervisor.py",
         ),
     ),
+    MachineSpec(
+        name="link",
+        table_path="ggrs_tpu/fleet/transport.py",
+        table_name="LINK_TRANSITIONS",
+        prefix="LINK_",
+        setter_kind="attr",
+        setter_name="link_state",
+        dst_arg=0,
+        scan=("ggrs_tpu/fleet/transport.py",),
+    ),
 )
 
 

@@ -64,6 +64,19 @@ RPC_HEADER_FMT = "<2sBBII"
 RPC_HEADER_PREFIX_FMT = "<2sBBI"  # what _encode_frame packs before the crc
 RPC_CRC_COVERS = struct.calcsize(RPC_HEADER_PREFIX_FMT)  # 8
 
+# Multi-host TCP handshake records (fleet/transport.py, DESIGN.md §25):
+# challenge (magic, version, flags, nonce), auth record (magic, version,
+# flags, epoch u64, resume-cursor u64, shard id, then the HMAC-SHA256
+# mac over nonce+prefix), verdict (magic, version, code, granted epoch,
+# server resume cursor).  The mac covers exactly the auth prefix, so the
+# prefix format must be the full record minus its 32-byte mac tail.
+TCP_CHALLENGE_FMT = "<2sBB16s"
+TCP_AUTH_PREFIX_FMT = "<2sBBQQ16s"
+TCP_AUTH_FMT = "<2sBBQQ16s32s"
+TCP_VERDICT_FMT = "<2sBBQQ"
+TCP_MAC_BYTES = 32
+TCP_NONCE_BYTES = 16
+
 # Harvest prefix (ggrs_bank_harvest): i64 current, i64 last_confirmed,
 # i64 disconnect_frame.
 HARVEST_PREFIX_FMT = "<qqq"
@@ -591,6 +604,54 @@ def _check_rpc_framing(root: Path) -> List[Finding]:
     return out
 
 
+def _check_tcp_handshake(root: Path) -> List[Finding]:
+    """The §25 TCP handshake records vs transport.py: all four wire
+    structs present, auth = prefix + mac tail, the mac/nonce sizes
+    statically visible, and the handshake version negotiated (a
+    constant, compared on both sides)."""
+    out: List[Finding] = []
+    tp = root / "ggrs_tpu/fleet/transport.py"
+    fmts = {f.fmt for f in parse_py_struct_formats(tp)}
+    for label, fmt in (("challenge", TCP_CHALLENGE_FMT),
+                       ("auth prefix", TCP_AUTH_PREFIX_FMT),
+                       ("auth record", TCP_AUTH_FMT),
+                       ("verdict", TCP_VERDICT_FMT)):
+        if fmt not in fmts:
+            out.append(Finding(
+                "layout/tcp-handshake", "ggrs_tpu/fleet/transport.py", 0,
+                f"handshake {label} {fmt!r} not found (wire format "
+                "drifted from the §25 contract?)",
+            ))
+    if (struct.calcsize(TCP_AUTH_FMT)
+            != struct.calcsize(TCP_AUTH_PREFIX_FMT) + TCP_MAC_BYTES):
+        out.append(Finding(
+            "layout/tcp-handshake", "ggrs_tpu/fleet/transport.py", 0,
+            f"auth record {TCP_AUTH_FMT!r} is not prefix "
+            f"{TCP_AUTH_PREFIX_FMT!r} + {TCP_MAC_BYTES}-byte mac "
+            "(mac coverage drifted?)",
+        ))
+    consts = parse_py_constants(tp)
+    if consts.get("MAC_BYTES") != TCP_MAC_BYTES:
+        out.append(Finding(
+            "layout/tcp-handshake", "ggrs_tpu/fleet/transport.py", 0,
+            f"MAC_BYTES {consts.get('MAC_BYTES')!r} != contract "
+            f"{TCP_MAC_BYTES} (HMAC-SHA256 digest size)",
+        ))
+    if consts.get("NONCE_BYTES") != TCP_NONCE_BYTES:
+        out.append(Finding(
+            "layout/tcp-handshake", "ggrs_tpu/fleet/transport.py", 0,
+            f"NONCE_BYTES {consts.get('NONCE_BYTES')!r} != contract "
+            f"{TCP_NONCE_BYTES}",
+        ))
+    if consts.get("HS_VERSION") is None:
+        out.append(Finding(
+            "layout/tcp-handshake", "ggrs_tpu/fleet/transport.py", 0,
+            "HS_VERSION constant not statically visible (version "
+            "negotiation needs a comparable constant)",
+        ))
+    return out
+
+
 def _check_stat_tables(root: Path) -> List[Finding]:
     out: List[Finding] = []
     native_py = root / "ggrs_tpu/net/_native.py"
@@ -677,5 +738,6 @@ def check_layout(
     findings += _check_descriptor_plane(root)
     findings += _check_body_prefix(root)
     findings += _check_rpc_framing(root)
+    findings += _check_tcp_handshake(root)
     findings += _check_stat_tables(root)
     return findings

@@ -483,6 +483,159 @@ def watchdog_model(root: Path, premature_failover: bool = False) -> Model:
 
 
 # ----------------------------------------------------------------------
+# §25: reconnect-vs-failover (fleet/transport.py TCP link)
+# ----------------------------------------------------------------------
+
+
+# epoch ceiling for the link model's state space (like RESTART_MAX:
+# the invariants care about ORDER between epochs, not their magnitude,
+# so the mint saturates instead of growing without bound)
+EPOCH_MAX = 3
+
+
+def _mint(sup: int) -> int:
+    return min(sup + 1, EPOCH_MAX)
+
+
+class LkS(NamedTuple):
+    link: str                  # LINK_* value from LINK_TRANSITIONS
+    window: bool               # reconnect window open?
+    run: Optional[int]         # epoch the wire-side runner holds
+                               # (None = fresh, not yet granted one)
+    sup: int                   # the supervisor's minted epoch
+    failed_over: bool          # §16 journal failover already ran
+    stale_ack: bool            # a stale-epoch runner acked a tick
+    premature: bool            # failover fired inside an open window
+
+
+def link_model(root: Path, fenced: bool = True,
+               premature: bool = False) -> Model:
+    """The §25 TCP fleet-link machine: handshake → sever → bounded
+    reconnect window → resume, or window expiry → confirmed dead →
+    §16 failover → respawn under a fresh epoch — against a stale old
+    incarnation that resurrects and re-dials.
+
+    Every link_state edge the actions perform is validated against
+    ``LINK_TRANSITIONS`` parsed from transport.py.  ``fenced=False``
+    drops the epoch check from accept/resume — exactly what HEAD's
+    handshake refuses — and must counterexample with a resurrected
+    stale runner acking a tick after failover (split brain).
+    ``premature=True`` adds the failover HEAD cannot perform — failing
+    over while the reconnect window is still open."""
+    table = _table(root, "link")
+
+    def accept_guard(s: LkS) -> bool:
+        if s.link != "connecting":
+            return False
+        # the fence: a handshake presenting a stale epoch is refused
+        return (not fenced) or s.run is None or s.run == s.sup
+
+    def accept_step(s: LkS) -> LkS:
+        # a fresh runner is granted the current epoch in the verdict; a
+        # resurrected one KEEPS its stale epoch (no re-grant — that is
+        # the split-brain hazard the fence exists to stop)
+        run = s.sup if s.run is None else s.run
+        return s._replace(link="up", run=run)
+
+    def resume_guard(s: LkS) -> bool:
+        if s.link != "reconnecting" or not s.window:
+            return False
+        return (not fenced) or s.run == s.sup
+
+    actions = [
+        # handshake grant while awaiting a runner
+        Action("accept", accept_guard, accept_step),
+        # spawn deadline / refused handshakes only: give up on this
+        # incarnation (mints a fresh epoch, like ShardLink.down)
+        Action("fence_connect",
+               lambda s: (s.link == "connecting" and s.run is not None
+                          and s.run < s.sup),
+               lambda s: s._replace(link="down", sup=_mint(s.sup))),
+        # the transport sever: EOF/half-open opens the reconnect window
+        Action("sever", lambda s: s.link == "up",
+               lambda s: s._replace(link="reconnecting", window=True)),
+        # an authenticated re-dial resumes inside the window
+        Action("resume", resume_guard,
+               lambda s: s._replace(link="up", window=False)),
+        # window expiry: confirmed dead, epoch bumped (fencing mint)
+        Action("expire",
+               lambda s: s.link == "reconnecting" and s.window,
+               lambda s: s._replace(link="down", window=False,
+                                    sup=_mint(s.sup))),
+        # fenced goodbye / supervisor teardown from a live link
+        Action("goodbye", lambda s: s.link == "up",
+               lambda s: s._replace(link="down", sup=_mint(s.sup))),
+        # §16 journal failover: only once the link is DOWN (window
+        # closed) — the liveness split poll_lifecycle enforces
+        Action("failover",
+               lambda s: (s.link == "down" and not s.window
+                          and not s.failed_over),
+               lambda s: s._replace(failed_over=True)),
+        # the OLD incarnation survives on its host and re-dials
+        Action("resurrect",
+               lambda s: (s.link == "down" and s.failed_over
+                          and s.run is not None and s.run < s.sup),
+               lambda s: s._replace(link="connecting")),
+        # the supervisor respawns a fresh runner under the new epoch
+        Action("respawn",
+               lambda s: s.link == "down" and s.failed_over,
+               lambda s: s._replace(link="connecting", run=None)),
+        # the wire-side runner acks a tick — the §25 fencing rule is
+        # that a stale epoch must never get this far
+        Action("ack_tick", lambda s: s.link == "up",
+               lambda s: s._replace(
+                   stale_ack=s.stale_ack or s.run != s.sup)),
+    ]
+    if premature:
+        actions.append(Action(
+            "failover_premature",
+            lambda s: (s.link == "reconnecting" and s.window
+                       and not s.failed_over),
+            lambda s: s._replace(failed_over=True, premature=True,
+                                 sup=_mint(s.sup)),
+        ))
+    _assert_edges("link", table, {
+        "accept": [("connecting", "up")],
+        "fence_connect": [("connecting", "down")],
+        "sever": [("up", "reconnecting")],
+        "resume": [("reconnecting", "up")],
+        "expire": [("reconnecting", "down")],
+        "goodbye": [("up", "down")],
+        "failover": [],
+        "resurrect": [("down", "connecting")],
+        "respawn": [("down", "connecting")],
+        "ack_tick": [],
+        "failover_premature": [],
+    })
+    variant = ("premature-failover" if premature
+               else ("head" if fenced else "split-brain"))
+    return Model(
+        f"link:{variant}",
+        LkS("connecting", False, None, 1, False, False, False),
+        tuple(actions),
+        invariants=(
+            # the fencing rule: a runner holding a stale epoch cannot
+            # ack ticks (split brain = two incarnations driving state)
+            Invariant("stale-epoch-never-acks",
+                      lambda s: not s.stale_ack),
+            # the liveness split: no failover while a reconnect window
+            # is open — a severed link is NOT a dead shard
+            Invariant("no-failover-inside-reconnect-window",
+                      lambda s: not s.premature),
+            # epochs flow supervisor → runner, never ahead of the mint
+            Invariant("runner-epoch-never-ahead",
+                      lambda s: s.run is None or s.run <= s.sup),
+        ),
+        progress=(
+            # whatever the fault, a serving link is always reachable
+            # (resume inside the window, or failover + respawn past it)
+            Progress("link-eventually-serves",
+                     lambda s: s.link == "up"),
+        ),
+    )
+
+
+# ----------------------------------------------------------------------
 # the catalog + the verify leg
 # ----------------------------------------------------------------------
 
@@ -523,6 +676,20 @@ MODEL_CATALOG: Tuple[CatalogEntry, ...] = (
                  lambda root: watchdog_model(root, True),
                  "counterexample", "invariant",
                  ("sigterm", "failover_premature")),
+    CatalogEntry("link:head", "§25",
+                 lambda root: link_model(root), "clean"),
+    # split brain: without the epoch fence, a runner that survives its
+    # own failover resurrects, re-handshakes, and acks a tick while the
+    # journal-recovered incarnation drives the same matches elsewhere
+    CatalogEntry("link:split-brain", "§25",
+                 lambda root: link_model(root, fenced=False),
+                 "counterexample", "invariant",
+                 ("accept", "goodbye", "failover", "resurrect",
+                  "accept", "ack_tick")),
+    CatalogEntry("link:premature-failover", "§25",
+                 lambda root: link_model(root, premature=True),
+                 "counterexample", "invariant",
+                 ("accept", "sever", "failover_premature")),
 )
 
 _MACHINES_PATH = "ggrs_tpu/analysis/machines.py"

@@ -901,7 +901,10 @@ def drive_proc_fleet(
     (DESIGN.md §17): a two-shard ``ShardSupervisor`` where ``s0`` is
     always in-process and ``s1`` is a real subprocess when
     ``backend="proc"`` (``"inproc"`` runs the IDENTICAL topology fully
-    in-process — the backend-parity comparison leg).  ``2 *
+    in-process — the backend-parity comparison leg; ``"tcp"`` is
+    ``"proc"`` with the supervisor↔runner control plane carried over
+    the §25 authenticated TCP fleet link instead of a socketpair).
+    ``2 *
     matches_per_shard`` journaled 2-peer matches over REAL loopback UDP,
     ``m0..`` pinned to ``s0``, the rest to ``s1``; every match is
     described by picklable factories (``fleet.proc.proc_match_builder``
@@ -934,7 +937,7 @@ def drive_proc_fleet(
     )
     from .net.sockets import UdpNonBlockingSocket
 
-    if backend not in ("proc", "inproc"):
+    if backend not in ("proc", "inproc", "tcp"):
         raise ValueError(f"backend {backend!r}")
     base = seed * 1000
     clock = [0]
@@ -946,7 +949,8 @@ def drive_proc_fleet(
         journal_dir=journal_dir, checkpoint_every=checkpoint_every,
         journal_tail_window=8 * checkpoint_every,
         identity_refresh_every=4, seed=base + 1,
-        proc_shards=("s1",) if backend == "proc" else (),
+        proc_shards=("s1",) if backend in ("proc", "tcp") else (),
+        tcp_shards=("s1",) if backend == "tcp" else (),
         proc_clock=lambda: clock[0],
         tuning=tuning,
         tracer=tracer,
@@ -984,7 +988,7 @@ def drive_proc_fleet(
             # process releases the port before the next one binds);
             # matches served in THIS process reuse one long-lived socket
             # object, exactly like the in-memory fleet topologies.
-            if backend == "proc" and pin == "s1":
+            if backend in ("proc", "tcp") and pin == "s1":
                 host_port = _free_udp_port()
                 sf = functools.partial(udp_socket_factory, host_port)
             else:

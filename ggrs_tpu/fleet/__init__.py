@@ -29,6 +29,7 @@ from .shard import (
     SHARD_RETIRED,
 )
 from .supervisor import FleetError, MatchRecord, ShardSupervisor
+from .transport import HandshakeError, RunnerLink, ShardLink
 from .tuning import FleetTuning
 
 __all__ = [
@@ -36,6 +37,7 @@ __all__ = [
     "FleetError",
     "FleetTuning",
     "FrameError",
+    "HandshakeError",
     "HashRing",
     "MatchRecord",
     "PoolShard",
@@ -45,10 +47,12 @@ __all__ = [
     "RpcError",
     "RpcRemoteError",
     "RpcTimeout",
+    "RunnerLink",
     "SHARD_ACTIVE",
     "SHARD_DEAD",
     "SHARD_DRAINING",
     "SHARD_RETIRED",
+    "ShardLink",
     "ShardRunner",
     "ShardSupervisor",
     "proc_match_builder",

@@ -81,6 +81,14 @@ from .shard import (
     SHARD_DRAINING,
     SHARD_RETIRED,
 )
+from .transport import (
+    HandshakeError,
+    LINK_DOWN,
+    LINK_RECONNECTING,
+    LINK_UP,
+    RunnerLink,
+    ShardLink,
+)
 from .tuning import FleetTuning
 
 _logger = get_logger("fleet")
@@ -207,8 +215,11 @@ class ShardRunner:
     path — if this loop is stuck, heartbeats stop, which is exactly the
     signal the supervisor's watchdog wants)."""
 
-    def __init__(self, conn: RpcConn) -> None:
+    def __init__(self, conn: RpcConn, link=None) -> None:
         self.conn = conn
+        # the TCP dialer (fleet.transport.RunnerLink) when serving over
+        # --tcp: owns the reconnect window; None on fd/uds transports
+        self._link = link
         self.shard: Optional[PoolShard] = None
         self.tuning = FleetTuning()
         self._games: Dict[str, Any] = {}
@@ -231,19 +242,36 @@ class ShardRunner:
 
         signal.signal(signal.SIGTERM, _on_signal)
         signal.signal(signal.SIGINT, _on_signal)
-        try:
-            self._loop()
-        except _GracefulExit as e:
-            self._graceful_exit(str(e))
-            return 0
-        except (RpcClosed, FrameError, RpcTimeout) as e:
-            # the supervisor is gone, the stream is poisoned, or a frame
-            # never completed: there is no one to say goodbye to — leave
-            # the journals durable and exit nonzero so an init system
-            # knows this was not a drain
-            self._quiet_exit(str(e))
-            return 1
-        return 0
+        while True:
+            try:
+                self._loop()
+                return 0
+            except _GracefulExit as e:
+                self._graceful_exit(str(e))
+                return 0
+            except RpcClosed as e:
+                # over TCP an EOF is a LINK failure, not a death
+                # sentence: redial inside the reconnect window and
+                # resume the frame stream in place (DESIGN.md §25).
+                # A fence verdict means a newer incarnation owns the
+                # shard — exit without a fight.
+                if self._link is not None and self.conn.poisoned is None:
+                    r = self._link.reconnect(self.conn)
+                    if r == "resumed":
+                        continue
+                    if r == "fenced":
+                        self._quiet_exit(
+                            f"fenced at reconnect (stale epoch): {e}")
+                        return 1
+                self._quiet_exit(str(e))
+                return 1
+            except (FrameError, RpcTimeout) as e:
+                # the stream is poisoned or a frame never completed:
+                # corruption cannot be resumed — leave the journals
+                # durable and exit nonzero so an init system knows
+                # this was not a drain
+                self._quiet_exit(str(e))
+                return 1
 
     def _loop(self) -> None:
         hb_next = time.monotonic() + self.tuning.heartbeat_interval_s
@@ -282,6 +310,11 @@ class ShardRunner:
 
     def _dispatch(self, msg: Dict[str, Any]) -> None:
         op = msg.get("op")
+        # the call's correlation id is echoed in the reply envelope so
+        # a supervisor that abandoned the call (link sever mid-RPC, then
+        # a TCP resume replaying this reply) can drop it instead of
+        # mistaking it for a later call's answer
+        cid = msg.get("_cid")
         handler = getattr(self, f"_op_{op}", None)
         try:
             if handler is None:
@@ -290,12 +323,18 @@ class ShardRunner:
         except _GracefulExit:
             raise
         except Exception as e:
-            self.conn.send(KIND_ERR, dict(
+            err = dict(
                 type=type(e).__name__, msg=str(e),
                 traceback=traceback.format_exc(),
-            ))
+            )
+            if cid is not None:
+                err["_cid"] = cid
+            self.conn.send(KIND_ERR, err)
         else:
-            self.conn.send(KIND_REPLY, result)
+            if cid is not None:
+                self.conn.send(KIND_REPLY, dict(_cid=cid, _r=result))
+            else:
+                self.conn.send(KIND_REPLY, result)
 
     def _graceful_exit(self, reason: str) -> None:
         """The drain: admission off, journals flushed + fsynced + closed
@@ -339,6 +378,12 @@ class ShardRunner:
         if cfg.get("tuning"):
             self.tuning = FleetTuning.from_dict(cfg["tuning"])
             self.conn.max_frame = self.tuning.max_frame_bytes
+        if self._link is not None:
+            # serving over TCP: adopt the supervisor's reconnect policy
+            # and start retaining sent frames so a severed link can
+            # resume instead of failing over
+            self._link.configure(self.tuning)
+            self.conn.enable_retain(self.tuning.link_retain_frames)
         if cfg.get("trace"):
             # the supervisor is tracing: arm a local ring whose spans
             # ship back in tick replies (fleet trace correlation, §18)
@@ -607,13 +652,18 @@ def runner_main(argv: Optional[List[str]] = None) -> int:
                     help="inherited socketpair fd (spawned runners)")
     ap.add_argument("--uds", default=None, metavar="PATH",
                     help="UNIX socket path to listen on (adopted runners)")
+    ap.add_argument("--tcp", default=None, metavar="HOST:PORT",
+                    help="dial a supervisor's authenticated TCP link "
+                         "(multi-host runners, DESIGN.md §25); the "
+                         "shared token rides GGRS_FLEET_LINK_AUTH_TOKEN")
     args = ap.parse_args(argv)
-    if (args.fd is None) == (args.uds is None):
-        ap.error("exactly one of --fd / --uds is required")
+    if sum(a is not None for a in (args.fd, args.uds, args.tcp)) != 1:
+        ap.error("exactly one of --fd / --uds / --tcp is required")
+    link = None
     if args.fd is not None:
         sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM,
                              fileno=args.fd)
-    else:
+    elif args.uds is not None:
         listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
         try:
             os.unlink(args.uds)
@@ -623,7 +673,21 @@ def runner_main(argv: Optional[List[str]] = None) -> int:
         listener.listen(1)
         sock, _ = listener.accept()
         listener.close()
-    return ShardRunner(RpcConn(sock)).serve()
+    else:
+        host, _, port = args.tcp.rpartition(":")
+        if not port.isdigit():
+            ap.error(f"--tcp wants HOST:PORT, got {args.tcp!r}")
+        link = RunnerLink(
+            host or "127.0.0.1", int(port),
+            token=os.environ.get("GGRS_FLEET_LINK_AUTH_TOKEN", ""),
+            shard_id=os.environ.get("GGRS_FLEET_LINK_SHARD", ""),
+        )
+        try:
+            sock = link.dial_fresh()
+        except (HandshakeError, OSError) as e:
+            _logger.error("runner: TCP link handshake failed: %s", e)
+            return 1
+    return ShardRunner(RpcConn(sock), link=link).serve()
 
 
 # ======================================================================
@@ -681,6 +745,8 @@ class ProcShard:
         spawn: bool = True,
         uds_path: Optional[str] = None,
         fleet_obs: Optional[FleetObs] = None,
+        tcp: bool = False,
+        tcp_host: str = "127.0.0.1",
     ) -> None:
         self.shard_id = shard_id
         self.capacity = capacity
@@ -755,6 +821,16 @@ class ProcShard:
         self._g_orphans = m.gauge(
             "ggrs_fleet_proc_orphans",
             "spawned runner processes alive past their shard's lifetime")
+        # multi-host TCP link (DESIGN.md §25): the supervisor listens and
+        # the runner dials in; None for socketpair/uds shards
+        self._link: Optional["ShardLink"] = None
+        # spawn=True with tcp means we still fork the runner locally, but
+        # it connects back over TCP like a remote host would; spawn=False
+        # waits for an external `ShardRunner --tcp` to dial in (adopt_tcp)
+        self._tcp_spawn_child = spawn
+        if tcp:
+            self._link = ShardLink(shard_id, self.tuning,
+                                   host=tcp_host, metrics=self.metrics)
         if spawn:
             self._spawn()
 
@@ -763,7 +839,9 @@ class ProcShard:
     # ------------------------------------------------------------------
 
     def _spawn(self) -> None:
-        if self._uds_path is not None:
+        if self._link is not None:
+            sup_sock = self._spawn_tcp()
+        elif self._uds_path is not None:
             sup_sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
             sup_sock.connect(self._uds_path)  # adopt a running runner
         else:
@@ -779,6 +857,10 @@ class ProcShard:
                 run_sock.close()
         self._conn = RpcConn(sup_sock,
                              max_frame=self.tuning.max_frame_bytes)
+        if self._link is not None:
+            # arm the resume ring before any frame is sent so the hello
+            # itself is replayable across a reconnect
+            self._conn.enable_retain(self.tuning.link_retain_frames)
         try:
             r = self._conn.call("hello",
                                 timeout=self.tuning.spawn_timeout_s,
@@ -786,6 +868,8 @@ class ProcShard:
         except RpcError:
             self._teardown_proc(expect_exit=False)
             raise
+        if self._link is not None:
+            self._link.established(self._conn)
         self.pid = r["pid"]
         # ggrs-model: transitions(exited->running)
         self._status = PROC_RUNNING
@@ -797,15 +881,72 @@ class ProcShard:
         self._offset_rtt_ns = None
         self._conn.on_heartbeat = self._on_heartbeat
 
-    def _teardown_proc(self, expect_exit: bool) -> None:
+    def _spawn_tcp(self) -> socket.socket:
+        """Mint a fresh epoch, (optionally) fork a local runner pointed
+        at our listener, and block until one completes the authenticated
+        handshake (DESIGN.md §25)."""
+        link = self._link
+        assert link is not None
+        link.reopen()
+        link.mint_epoch()
+        if self._tcp_spawn_child:
+            host, port = link.address
+            env = dict(
+                os.environ,
+                GGRS_FLEET_LINK_AUTH_TOKEN=self.tuning.link_auth_token,
+                GGRS_FLEET_LINK_SHARD=self.shard_id,
+            )
+            self._proc = subprocess.Popen(
+                [sys.executable, str(_RUNNER_SCRIPT),
+                 "--tcp", f"{host}:{port}"],
+                env=env,
+            )
+            self._all_procs.append(self._proc)
+        try:
+            return link.wait_for_runner(self.tuning.spawn_timeout_s)
+        except TimeoutError as e:
+            self._teardown_proc(expect_exit=False)
+            raise RpcTimeout(str(e)) from e
+
+    def adopt_tcp(self, timeout: Optional[float] = None) -> None:
+        """Adopt an external ``ShardRunner --tcp`` that dials in over
+        the fleet link — the multi-host analogue of uds adoption.  Only
+        valid for a tcp shard constructed with ``spawn=False``."""
+        if self._link is None:
+            raise InvalidRequest(
+                f"shard {self.shard_id} has no TCP link to adopt on")
+        if self._status == PROC_RUNNING:
+            raise InvalidRequest(
+                f"shard {self.shard_id} already has a live runner")
+        if timeout is not None:
+            # one-shot override for the handshake wait only
+            saved = self.tuning.spawn_timeout_s
+            self.tuning.spawn_timeout_s = timeout
+            try:
+                self._spawn()
+            finally:
+                self.tuning.spawn_timeout_s = saved
+        else:
+            self._spawn()
+
+    def _teardown_proc(self, expect_exit: bool,
+                       kill_process: bool = True) -> None:
         """Close the conn and reap the child (SIGKILL if still alive) —
         the no-leak contract: no zombie, no parent-held fd survives.
         Adopted runners (no Popen handle) are signalled by pid and left
-        to their own parent/init to reap."""
+        to their own parent/init to reap.  ``kill_process=False`` is the
+        fencing path (§25): a TCP runner whose reconnect window expired
+        is declared dead *for this epoch* without being signalled —
+        a remote host's process is not ours to kill, the stale epoch
+        refuses it at re-handshake instead."""
+        if self._link is not None:
+            self._link.down("teardown")
         if self._conn is not None:
             self._conn.close()
             self._conn = None
-        if self._proc is not None:
+        if not kill_process:
+            self.last_exit = "fenced: reconnect window expired"
+        elif self._proc is not None:
             if self._proc.poll() is None:
                 if expect_exit:
                     try:
@@ -1122,6 +1263,25 @@ class ProcShard:
             return None
         return max(0.0, time.monotonic() - self._conn.last_frame_at)
 
+    def _drive_link(self, now: float) -> None:
+        """One control-plane step of the TCP link machine (§25):
+        UP + conn EOF → sever (open the reconnect window); while UP or
+        RECONNECTING, pump the listener (refuse garbage, judge resume
+        handshakes — a half-open peer's epoch-current resume severs
+        implicitly); past the window deadline → expire (→ DOWN, which
+        :meth:`poll_lifecycle` turns into confirmed-dead + fencing)."""
+        link = self._link
+        assert link is not None
+        if (link.link_state == LINK_UP
+                and self._conn is not None and self._conn.closed):
+            link.sever(now)
+        if link.link_state in (LINK_UP, LINK_RECONNECTING):
+            link.pump(now)
+        if (link.link_state == LINK_RECONNECTING
+                and link.window_deadline is not None
+                and now >= link.window_deadline):
+            link.expire(now)
+
     def poll_lifecycle(self) -> Optional[str]:
         """One control-plane step of the liveness state machine.  Returns
         ``"died"`` exactly once — on the step where the child is
@@ -1150,6 +1310,20 @@ class ProcShard:
             if self._expected_exit:
                 return None
             return "died"
+        if self._status == PROC_RUNNING and self._link is not None:
+            self._drive_link(now)
+            if self._link.link_state == LINK_DOWN:
+                # reconnect window expired (or resume was impossible):
+                # confirmed dead for this epoch.  The process — possibly
+                # on another host — is fenced, not signalled: its stale
+                # epoch is refused at any future handshake (§25).
+                self._teardown_proc(expect_exit=True, kill_process=False)
+                return None if self._expected_exit else "died"
+            if self._link.link_state == LINK_RECONNECTING:
+                # link down ≠ shard dead: failover is FORBIDDEN while
+                # the reconnect window is open, and the EOF/heartbeat
+                # wedge escalations below would be exactly that
+                return None
         if self._status == PROC_RUNNING:
             wedged = self._hung_reason
             if wedged is None and conn is not None and conn.closed:
@@ -1287,6 +1461,8 @@ class ProcShard:
         (the SIGKILL-only leak-check test pins it)."""
         self._expected_exit = True
         self._shutdown_runner()
+        if self._link is not None:
+            self._link.close()  # the listener fd
         self._update_orphan_gauge()
 
     def _shutdown_runner(self) -> None:
@@ -1301,17 +1477,38 @@ class ProcShard:
 
     def watchdog_stage(self) -> str:
         """Where the liveness state machine stands: ``ok`` (running,
-        no suspicion), ``suspect`` (hang-marked, SIGTERM not yet sent),
-        ``terminating`` (SIGTERM sent, drain deadline armed), or
-        ``exited`` — surfaced into ``healthz`` aggregates so a stale
-        runner pages BEFORE it is confirmed dead (§18)."""
+        no suspicion), ``reconnecting`` (TCP link severed, resume window
+        open — failover forbidden, §25), ``suspect`` (hang-marked,
+        SIGTERM not yet sent), ``terminating`` (SIGTERM sent, drain
+        deadline armed), or ``exited`` — surfaced into ``healthz``
+        aggregates so a stale runner pages BEFORE it is confirmed dead
+        (§18)."""
         if self._status == PROC_EXITED:
             return "exited"
         if self._status == PROC_TERMINATING:
             return "terminating"
+        if (self._link is not None
+                and self._link.link_state == LINK_RECONNECTING):
+            return "reconnecting"
         if self._hung_reason is not None:
             return "suspect"
         return "ok"
+
+    def link_info(self) -> Optional[Dict[str, Any]]:
+        """The TCP link's state/epoch/counters dict, or None for
+        socketpair/uds shards (§25)."""
+        return None if self._link is None else self._link.info()
+
+    def chaos_sever_link(self, how: str = "rdwr") -> None:
+        """Chaos verb: sever the supervisor→runner TCP stream at the
+        socket layer without telling either endpoint (``how`` as in
+        ``RpcConn.chaos_sever``: ``rdwr`` full sever, ``wr``/``rd``
+        half-open)."""
+        if self._link is None:
+            raise InvalidRequest(
+                f"shard {self.shard_id} has no TCP link to sever")
+        if self._conn is not None:
+            self._conn.chaos_sever(how)
 
     def healthz(self) -> Dict[str, Any]:
         alive = self._alive()
@@ -1350,4 +1547,5 @@ class ProcShard:
             ticks=self.ticks,
             last_tick_age_s=inner.get("last_tick_age_s"),
             tick_p99_ms=inner.get("tick_p99_ms", 0.0),
+            link=self.link_info(),
         )

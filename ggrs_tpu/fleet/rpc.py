@@ -146,9 +146,105 @@ class RpcConn:
         # without this hook, call() would consume and DROP the payload.
         # Exceptions are swallowed: telemetry must never poison a stream.
         self.on_heartbeat: Optional[Any] = None
+        # frame sequence numbers for the TCP resume seam (DESIGN.md
+        # §25): tx_seq counts frames handed to sendall (whether or not
+        # the bytes survived the wire), rx_seq counts frames fully
+        # parsed.  On reconnect each side presents its rx_seq as a
+        # cursor and the peer replays retained frames past it.
+        self.tx_seq = 0
+        self.rx_seq = 0
+        self._retain: Optional[Any] = None  # deque[(seq, frame bytes)]
+        self._call_id = 0
+        self.stale_replies = 0  # correlation-mismatched replies dropped
 
     def fileno(self) -> int:
         return self._sock.fileno()
+
+    @property
+    def poisoned(self) -> Optional[str]:
+        """The poison reason, or None.  A poisoned stream must never be
+        resumed — the byte stream itself is corrupt."""
+        return self._poisoned
+
+    # ------------------------------------------------------------------
+    # reconnect / resume seam (used by fleet.transport, DESIGN.md §25)
+    # ------------------------------------------------------------------
+
+    def enable_retain(self, n: int) -> None:
+        """Keep the last ``n`` sent frames (by sequence) for replay
+        after a reconnect.  Without it, resume is only possible when
+        the peer has already received everything we ever sent."""
+        import collections
+
+        self._retain = collections.deque(maxlen=max(1, int(n)))
+
+    def can_resume(self, peer_rx_seq: int) -> bool:
+        """Whether our retained frames cover everything the peer has
+        not received — i.e. every frame in ``(peer_rx_seq, tx_seq]`` is
+        still in the ring."""
+        if peer_rx_seq > self.tx_seq:
+            return False  # peer claims frames we never sent
+        if peer_rx_seq == self.tx_seq:
+            return True
+        if self._retain is None:
+            return False
+        # the ring is contiguous by construction: coverage == the
+        # oldest retained seq reaches back to the peer's cursor
+        return self._retain[0][0] <= peer_rx_seq + 1
+
+    def replay_from(self, peer_rx_seq: int,
+                    timeout: Optional[float] = 30.0) -> int:
+        """Resend every retained frame past the peer's cursor, in
+        order.  Returns the number replayed; raises :class:`RpcClosed`
+        when the gap is not coverable or the socket dies mid-replay."""
+        if not self.can_resume(peer_rx_seq):
+            raise RpcClosed(
+                f"cannot resume: peer cursor {peer_rx_seq}, tx_seq "
+                f"{self.tx_seq}, retain floor "
+                f"{self._retain[0][0] if self._retain else 'none'}"
+            )
+        n = 0
+        self._sock.settimeout(timeout)
+        for seq, frame in list(self._retain or ()):
+            if seq <= peer_rx_seq:
+                continue
+            try:
+                self._sock.sendall(frame)
+            except OSError as e:
+                self.closed = True
+                raise RpcClosed(f"resume replay failed: {e}") from None
+            n += 1
+        return n
+
+    def reattach(self, sock: socket.socket) -> None:
+        """Swap in a fresh socket after a reconnect handshake.  Partial
+        frame bytes buffered from the severed socket are discarded —
+        the unparsed frame was never counted in ``rx_seq``, so the
+        peer's replay delivers it whole.  Refused on a poisoned stream:
+        corruption is not a link failure."""
+        if self._poisoned:
+            raise FrameError(f"stream poisoned: {self._poisoned}")
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        self._sock = sock
+        self._buf.clear()
+        self.closed = False
+        self.goodbye = None
+        self.last_frame_at = time.monotonic()
+
+    def chaos_sever(self, how: str = "rdwr") -> None:
+        """Test/chaos hook: shut the underlying socket down without
+        marking the conn closed — the next send/recv on either end
+        surfaces EOF exactly like a cut cable (``how="wr"``/``"rd"``
+        emulate a half-open link)."""
+        flags = {"rdwr": socket.SHUT_RDWR, "wr": socket.SHUT_WR,
+                 "rd": socket.SHUT_RD}[how]
+        try:
+            self._sock.shutdown(flags)
+        except OSError:
+            pass
 
     # ------------------------------------------------------------------
     # sending
@@ -162,6 +258,12 @@ class RpcConn:
         payload = pickle.dumps(obj, protocol=PICKLE_PROTOCOL)
         frame = encode_frame(kind, payload, self.max_frame)
         self._check_usable()
+        # sequence + retain BEFORE the write: if sendall dies midway the
+        # peer may or may not have the frame — its resume cursor decides,
+        # and the ring must hold the frame either way
+        self.tx_seq += 1
+        if self._retain is not None:
+            self._retain.append((self.tx_seq, frame))
         self._sock.settimeout(timeout)
         try:
             self._sock.sendall(frame)
@@ -278,6 +380,7 @@ class RpcConn:
         expect = zlib.crc32(payload, zlib.crc32(bytes(self._buf[:8])))
         if (expect & 0xFFFFFFFF) != crc:
             raise self._poison("frame crc mismatch")
+        self.rx_seq += 1  # a fully-validated frame: the resume cursor
         del self._buf[: HEADER_SIZE + plen]
         try:
             obj = pickle.loads(payload)
@@ -301,8 +404,16 @@ class RpcConn:
         """Send ``{op, **kw}`` and wait for the matching reply.
         Heartbeats arriving first are consumed (they refresh
         ``last_frame_at``); a GOODBYE means the runner exited before
-        answering (:class:`RpcClosed`)."""
-        self.send(KIND_CALL, dict(kw, op=op), timeout=timeout)
+        answering (:class:`RpcClosed`).
+
+        Calls carry a correlation id (``_cid``): a reply to an EARLIER
+        call — possible after a TCP resume replays a reply whose call
+        was abandoned to an :class:`RpcClosed` — is dropped and counted
+        (``stale_replies``) instead of being mistaken for this call's
+        answer.  Replies without an id (bare test servers) pass."""
+        self._call_id += 1
+        cid = self._call_id
+        self.send(KIND_CALL, dict(kw, op=op, _cid=cid), timeout=timeout)
         deadline = time.monotonic() + timeout
         while True:
             try:
@@ -311,9 +422,10 @@ class RpcConn:
                 )
             except RpcTimeout:
                 # the reply is abandoned but may still arrive later;
-                # with no call/reply correlation on the wire, a later
-                # call would consume it as ITS reply — poison the
-                # stream so the connection is torn down instead
+                # correlation ids make a later call drop it, but the
+                # conservative contract stands: an intact same-socket
+                # stream with an unconsumed reply in flight is torn
+                # down, not trusted
                 self._poisoned = (
                     f"reply to {op!r} abandoned after timeout"
                 )
@@ -321,8 +433,17 @@ class RpcConn:
             if kind == KIND_HEARTBEAT:
                 continue
             if kind == KIND_REPLY:
+                if isinstance(obj, dict) and "_cid" in obj:
+                    if obj["_cid"] != cid:
+                        self.stale_replies += 1
+                        continue
+                    return obj.get("_r")
                 return obj
             if kind == KIND_ERR:
+                if (isinstance(obj, dict) and "_cid" in obj
+                        and obj["_cid"] != cid):
+                    self.stale_replies += 1
+                    continue
                 raise RpcRemoteError(
                     obj.get("type", "Exception"), obj.get("msg", ""),
                     obj.get("traceback", ""),

@@ -38,6 +38,7 @@ retries) rides the same tick.
 
 from __future__ import annotations
 
+import errno
 import os
 import pickle
 import random
@@ -167,6 +168,10 @@ class ShardSupervisor:
         proc_shards=(),
         proc_clock: Optional[Callable[[], int]] = None,
         tuning: Optional[FleetTuning] = None,
+        # shard ids listed here (must also be in proc_shards) drive their
+        # runner over the authenticated TCP fleet link instead of an
+        # inherited socketpair — the multi-host path (DESIGN.md §25)
+        tcp_shards=(),
     ) -> None:
         self.metrics = metrics if metrics is not None else default_registry()
         self.tuning = tuning if tuning is not None else FleetTuning.from_env()
@@ -186,6 +191,11 @@ class ShardSupervisor:
         self.shards: Dict[str, Any] = {}
         self.ring = HashRing()
         proc_set = {str(s) for s in proc_shards}
+        tcp_set = {str(s) for s in tcp_shards}
+        if tcp_set - proc_set:
+            raise ValueError(
+                f"tcp_shards must be a subset of proc_shards; "
+                f"{sorted(tcp_set - proc_set)} are not process-backed")
         for sid in shard_ids:
             sid = str(sid)
             if sid in proc_set:
@@ -199,6 +209,7 @@ class ShardSupervisor:
                     stale_after_s=stale_after_s, native_io=native_io,
                     retire_dead_matches=retire_dead_matches,
                     fleet_obs=self.fleet_obs,
+                    tcp=sid in tcp_set,
                 )
             else:
                 self.shards[sid] = PoolShard(
@@ -213,6 +224,10 @@ class ShardSupervisor:
             self.ring.add(sid)
         self._records: Dict[str, MatchRecord] = {}
         self._pending: List[_PendingAdmission] = []
+        # matches whose failover rebind hit EADDRINUSE (the dead
+        # incarnation still holds the port): retried each tick until
+        # tuning.failover_retry_s, then lost
+        self._failover_retry: Dict[str, tuple] = {}
         self._tick = 0
         self.last_tick_at: Optional[float] = None
         m = self.metrics
@@ -496,6 +511,7 @@ class ShardSupervisor:
             self._check_journal_failures()
             self._drive_drains()
             self._health_check()
+            self._retry_failovers()
             self._retry_pending()
             if self.identity_refresh_every and (
                 self._tick % self.identity_refresh_every == 0
@@ -871,6 +887,23 @@ class ShardSupervisor:
             try:
                 self._readopt_from_journal(record, exclude=shard_id)
             except Exception as e:
+                if getattr(e, "errno", None) == errno.EADDRINUSE:
+                    # the dead incarnation still holds the match's wire
+                    # port — when it was FENCED rather than signalled
+                    # (§25: a remote host's process is not ours to
+                    # kill) it releases its sockets only once the
+                    # handshake refusal lands.  Park and retry, bounded.
+                    record.location = None
+                    self._failover_retry[match_id] = (
+                        shard_id,
+                        time.monotonic() + self.tuning.failover_retry_s,
+                    )
+                    _logger.warning(
+                        "failover of %s stalled: wire port still bound "
+                        "by the dead incarnation; retrying for %.1fs",
+                        match_id, self.tuning.failover_retry_s,
+                    )
+                    continue
                 record.lost = f"failover failed: {e}"
                 record.location = None
                 self._m_migration_failures.inc()
@@ -879,6 +912,31 @@ class ShardSupervisor:
             else:
                 self._m_migrations.labels(reason="failover").inc()
         self._update_match_gauge()
+
+    def _retry_failovers(self) -> None:
+        """Re-drive parked failovers (wire port still bound — see
+        :meth:`_fail_shard`) until the rebind succeeds or the bounded
+        retry deadline passes; only then is the match lost."""
+        for match_id, (exclude, deadline) in list(
+                self._failover_retry.items()):
+            record = self._records[match_id]
+            try:
+                self._readopt_from_journal(record, exclude=exclude)
+            except Exception as e:
+                if (getattr(e, "errno", None) == errno.EADDRINUSE
+                        and time.monotonic() < deadline):
+                    continue
+                del self._failover_retry[match_id]
+                record.lost = f"failover failed: {e}"
+                record.location = None
+                self._m_migration_failures.inc()
+                self._m_lost.inc()
+                _logger.error("match %s lost: %s", match_id, record.lost)
+            else:
+                del self._failover_retry[match_id]
+                self._m_migrations.labels(reason="failover").inc()
+                _logger.info("parked failover of %s recovered", match_id)
+            self._update_match_gauge()
 
     def _readopt_from_journal(self, record: MatchRecord,
                               dst_shard: Optional[str] = None,
@@ -1051,6 +1109,7 @@ class ShardSupervisor:
                 heartbeat_age_s=shard.heartbeat_age_s(),
                 watchdog=shard.watchdog_stage(),
                 restarts=shard.restarts,
+                link=shard.link_info(),
             )
         if proc:
             ages = [

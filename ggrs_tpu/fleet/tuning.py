@@ -86,9 +86,37 @@ class FleetTuning:
     # --- bank eviction storm clamp (mirrors host_bank.EVICT_MAX_PER_TICK) ---
     evict_max_per_tick: int = 4
 
+    # --- multi-host TCP fleet link (DESIGN.md §25) ---
+    # shared HMAC secret for the challenge-response handshake; empty
+    # means "local trust" (fine for socketpair/uds and loopback tests,
+    # wrong for anything that crosses a host boundary)
+    link_auth_token: str = ""
+    # a severed link may reconnect+resume for this long; past it the
+    # shard is confirmed dead and §16 journal failover runs
+    link_reconnect_window_s: float = 3.0
+    # base of the runner's jittered exponential re-dial backoff
+    link_backoff_s: float = 0.05
+    # per-connection handshake deadline, both sides (slowloris bound)
+    link_handshake_timeout_s: float = 2.0
+    # TCP keepalive probe idle time; 0 disables SO_KEEPALIVE
+    link_keepalive_s: float = 5.0
+    # frames retained per direction for sequence-numbered resumption;
+    # a reconnect whose gap exceeds the ring forces epoch bump+re-adopt
+    link_retain_frames: int = 256
+    # how long §16 failover keeps retrying a match whose wire port is
+    # still bound (EADDRINUSE) — a fenced-but-alive incarnation is not
+    # ours to kill, but it releases its sockets when the handshake
+    # refusal lands, so the port frees within a handshake round trip
+    failover_retry_s: float = 2.0
+
     def __post_init__(self) -> None:
         for f in dataclasses.fields(self):
             v = getattr(self, f.name)
+            if isinstance(f.default, str):
+                if not isinstance(v, str):
+                    raise ValueError(
+                        f"FleetTuning.{f.name}: non-string {v!r}")
+                continue
             if not isinstance(v, (int, float)) or isinstance(v, bool):
                 raise ValueError(f"FleetTuning.{f.name}: non-numeric {v!r}")
             if v < 0:
@@ -110,6 +138,9 @@ class FleetTuning:
         for f in dataclasses.fields(cls):
             key = ENV_PREFIX + f.name.upper()
             if key not in env:
+                continue
+            if isinstance(f.default, str):
+                kw[f.name] = env[key]
                 continue
             cast = int if isinstance(f.default, int) else float
             try:

@@ -89,7 +89,7 @@ python -m pytest tests/test_session_bank.py tests/test_policy_plane.py \
     tests/test_native_io.py tests/test_socket_datapath.py \
     tests/test_net_gen2.py tests/test_decode_parallel.py \
     tests/test_fleet.py tests/test_fleet_rpc.py tests/test_fleet_proc.py \
-    tests/test_fleet_obs.py \
+    tests/test_fleet_link.py tests/test_fleet_obs.py \
     -q -p no:cacheprovider -m "not slow" \
     -k "not batched_executor and not size_mismatch and not fused_scrub and not scrub_matches and not device_state_bit_identical and not reaches_the_device" "$@"
 
@@ -127,7 +127,7 @@ JAX_PLATFORMS=cpu \
 python -m pytest tests/test_native_io.py tests/test_socket_datapath.py \
     tests/test_net_gen2.py tests/test_decode_parallel.py \
     tests/test_thread_ownership.py tests/test_fleet_proc.py \
-    tests/test_descriptor_plane.py \
+    tests/test_fleet_link.py tests/test_descriptor_plane.py \
     -q -p no:cacheprovider -m "not slow" \
     -k "not batched_executor and not size_mismatch and not device_state_bit_identical and not reaches_the_device" "$@"
 

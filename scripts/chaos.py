@@ -46,6 +46,16 @@ Fault classes (all driven through the pool's real tick path):
                 same recovery, and a 5x kill storm must exhaust the
                 restart budget instead of crash-looping; every artifact
                 records its FleetTuning knobs
+  net           multi-host fleet link leg (DESIGN.md §25): the proc
+                topology with the supervisor<->runner control plane on
+                the authenticated TCP link — a severed or half-open
+                link must RESUME inside the reconnect window with zero
+                failovers, hostile dribble against the listener
+                (garbage / slowloris / truncated auth) is refused and
+                counted without touching the served link, a SIGKILLed
+                runner journal-fails-over bit-identically to control,
+                and a runner resurrected after its window expired is
+                fenced at handshake by the bumped epoch and exits
   shard         fleet leg (DESIGN.md §16): a two-shard ShardSupervisor
                 (B = --fleet-matches journaled matches per shard, default
                 32) runs three scenarios — kill-a-shard (every affected
@@ -1038,6 +1048,324 @@ def verify_proc_leg(matches_per_shard: int, ticks: int, seed: int,
     return ok
 
 
+def verify_net_leg(matches_per_shard: int, ticks: int, seed: int,
+                   artifact_dir=None) -> bool:
+    """The multi-host fleet link scenarios (DESIGN.md §25), over
+    ``drive_proc_fleet(backend="tcp")`` — the proc topology with the
+    supervisor↔runner control plane on the authenticated TCP link.
+    Every scenario is judged against a fault-free tcp-backend control:
+
+    - ``net_sever``/``net_half_open``: cut the established link (full
+      shutdown / write-half only) mid-traffic; the runner must RESUME
+      inside the reconnect window with ZERO failovers — the severed
+      shard's matches never leave it, the link epoch never moves, and
+      the untouched shard stays bit-identical to control.
+    - ``net_dribble``: adversarial connections against the live
+      listener (garbage-before-magic, slowloris, truncated-then-EOF)
+      must each be refused and counted WITHOUT touching the served
+      link — the whole fleet stays bit-identical to control.
+    - ``net_host_kill``: SIGKILL the runner; a reaped local child is
+      confirmed-dead immediately (no window), every match
+      journal-recovers onto the survivor, survivors bit-identical to
+      control — §16 failover unchanged by the TCP transport.
+    - ``net_fence``: SIGSTOP the runner AND sever the link so the
+      window expires; failover must wait for the expiry (zero
+      failovers while the window is open), the dead incarnation is
+      fenced rather than signalled, and when the old runner RESURRECTS
+      it must be refused at handshake (HS_REFUSED_FENCE) and exit of
+      its own accord.
+    """
+    import os
+    import signal
+    import socket as _socket
+    import time
+
+    from ggrs_tpu.chaos import (
+        drive_proc_fleet,
+        fleet_recovery_violations,
+        fleet_survivor_violations,
+    )
+    from ggrs_tpu.fleet import FleetTuning, SHARD_DEAD
+
+    p = matches_per_shard
+    ticks = max(120, min(ticks, 240))
+    tuning = FleetTuning(
+        heartbeat_interval_s=0.05, heartbeat_deadline_s=0.5,
+        rpc_timeout_s=0.75, drain_deadline_s=0.4,
+        spawn_timeout_s=120.0, restart_max=0,
+        link_auth_token="chaos-net-token",
+        link_reconnect_window_s=0.6, link_backoff_s=0.01,
+        link_handshake_timeout_s=0.3,
+    )
+    survivors = [f"m{k}" for k in range(p)]           # pinned to s0
+    affected = [f"m{k}" for k in range(p, 2 * p)]     # pinned to s1
+    ok = True
+
+    def link_of(ctx):
+        return ctx["healthz"]["shards"]["s1"].get("link") or {}
+
+    def report(name, violations, ctx, extra=None) -> bool:
+        _write_artifact(artifact_dir, name, {
+            "scenario": name,
+            "verdict": "PASS" if not violations else "FAIL",
+            "violations": violations,
+            "matches_per_shard": p,
+            "ticks": ticks,
+            "tuning": tuning.as_dict(),
+            "locations": ctx["locations"],
+            "lost": ctx["lost"],
+            "link": link_of(ctx),
+            "failovers": int(
+                ctx["registry"].value("ggrs_fleet_failovers_total") or 0
+            ),
+            **(extra or {}),
+            "fleet_obs": fleet_metrics_digest(ctx["sup"]),
+            "metrics": json_snapshot(ctx["sup"].merged_registry()),
+        })
+        if violations:
+            print(f"  {name.upper()} VIOLATED:")
+            for v in violations:
+                print(f"    {v}")
+            return False
+        return True
+
+    print("--- net ---")
+    print(f"  s0 in-process + s1 subprocess over authenticated TCP x "
+          f"{p} journaled matches, {ticks} ticks")
+    control = drive_proc_fleet(
+        ticks, matches_per_shard=p, seed=seed, backend="tcp",
+        tuning=tuning,
+    )
+    control["sup"].close()
+
+    # 1 + 2. sever the established link (full, then write-half only):
+    # the runner must resume inside the window with zero failovers
+    for name, how in (("net_sever", "rdwr"), ("net_half_open", "wr")):
+        def sever(i, ctx, how=how):
+            if i == ticks // 2:
+                ctx["sup"].shards["s1"].chaos_sever_link(how)
+
+        chaos = drive_proc_fleet(
+            ticks, matches_per_shard=p, seed=seed, backend="tcp",
+            tuning=tuning, inject=sever, tick_sleep_s=0.005,
+        )
+        chaos["sup"].close()
+        violations = fleet_survivor_violations(chaos, control, survivors)
+        link = link_of(chaos)
+        failovers = int(
+            chaos["registry"].value("ggrs_fleet_failovers_total") or 0
+        )
+        if failovers:
+            violations.append(
+                f"{failovers} failovers despite an open reconnect window"
+            )
+        moved = [m for m in affected if chaos["locations"][m] != "s1"]
+        if moved:
+            violations.append(f"matches left the severed shard: {moved}")
+        if chaos["lost"]:
+            violations.append(f"matches lost: {chaos['lost']}")
+        if not link.get("reconnects"):
+            violations.append("link never recorded a resume")
+        if link.get("window_expiries"):
+            violations.append(
+                f"{link['window_expiries']} window expiries on a "
+                "recoverable sever"
+            )
+        if link.get("epoch") != 1:
+            violations.append(
+                f"epoch moved to {link.get('epoch')} without a failover"
+            )
+        print(f"  [{name}] link cut ({how}) @tick {ticks // 2}: "
+              f"state={link.get('state')} epoch={link.get('epoch')} "
+              f"reconnects={link.get('reconnects')} "
+              f"failovers={failovers}")
+        ok &= report(name, violations, chaos)
+
+    # 3. dribble: adversarial connections against the live listener —
+    # refused and counted, the served link untouched, fleet
+    # bit-identical to control
+    dribble_socks = []
+
+    def dribble(i, ctx):
+        if i != ticks // 3:
+            return
+        addr = ctx["sup"].shards["s1"]._link.address
+        garbage = _socket.create_connection(addr, timeout=2.0)
+        garbage.sendall(b"GET /healthz HTTP/1.1\r\n\r\n")
+        dribble_socks.append(garbage)
+        slow = _socket.create_connection(addr, timeout=2.0)
+        slow.sendall(b"GA")  # the magic, then... nothing
+        dribble_socks.append(slow)
+        trunc = _socket.create_connection(addr, timeout=2.0)
+        trunc.sendall(b"GA\x01\x00")  # a valid prefix, then EOF
+        trunc.close()
+
+    try:
+        chaos = drive_proc_fleet(
+            ticks, matches_per_shard=p, seed=seed, backend="tcp",
+            tuning=tuning, inject=dribble, tick_sleep_s=0.005,
+        )
+    finally:
+        for s in dribble_socks:
+            try:
+                s.close()
+            except OSError:
+                pass
+    chaos["sup"].close()
+    violations = fleet_survivor_violations(
+        chaos, control, survivors + affected
+    )
+    link = link_of(chaos)
+    refusals = link.get("refusals") or {}
+    for reason in ("garbage", "timeout", "eof"):
+        if not refusals.get(reason):
+            violations.append(f"no {reason!r} refusal recorded")
+    if link.get("reconnects"):
+        violations.append(
+            "dribble connections disturbed the established link"
+        )
+    failovers = int(
+        chaos["registry"].value("ggrs_fleet_failovers_total") or 0
+    )
+    if failovers:
+        violations.append(f"{failovers} failovers from unauthenticated "
+                          "dribble traffic")
+    print(f"  [net_dribble] 3 hostile conns @tick {ticks // 3}: "
+          f"refusals={refusals} failovers={failovers}")
+    ok &= report("net_dribble", violations, chaos, extra={
+        "refusals": refusals,
+    })
+
+    # 4. host kill: SIGKILL over TCP — §16 journal failover must be
+    # transport-agnostic (a reaped local child needs no window)
+    timing = {}
+
+    def host_kill(i, ctx):
+        sup = ctx["sup"]
+        if i == ticks // 2:
+            timing["pid"] = sup.shards["s1"].pid
+            timing["killed_at"] = time.monotonic()
+            os.kill(timing["pid"], signal.SIGKILL)
+        elif "killed_at" in timing and "detected_at" not in timing:
+            if sup.shards["s1"].state == SHARD_DEAD:
+                timing["detected_at"] = time.monotonic()
+
+    chaos = drive_proc_fleet(
+        ticks, matches_per_shard=p, seed=seed, backend="tcp",
+        tuning=tuning, inject=host_kill,
+    )
+    chaos["sup"].close()
+    violations = fleet_survivor_violations(chaos, control, survivors)
+    violations += fleet_recovery_violations(
+        chaos, affected, dead_shards=["s1"]
+    )
+    detect_s = (
+        timing.get("detected_at", float("inf")) - timing["killed_at"]
+    )
+    if detect_s > tuning.heartbeat_deadline_s:
+        violations.append(
+            f"death detected in {detect_s:.2f}s > heartbeat deadline "
+            f"{tuning.heartbeat_deadline_s}s"
+        )
+    orphans = chaos["sup"].shards["s1"].orphan_count()
+    if orphans:
+        violations.append(f"{orphans} orphan runner processes")
+    recovered = sum(
+        1 for m in affected if chaos["locations"][m] not in (None, "s1")
+    )
+    print(f"  [net_host_kill] pid {timing['pid']} SIGKILLed @tick "
+          f"{ticks // 2}: detected in {detect_s * 1000:.0f} ms, "
+          f"{recovered}/{p} matches journal-recovered, {orphans} orphans")
+    ok &= report("net_host_kill", violations, chaos, extra={
+        "recovered": recovered, "detect_seconds": detect_s,
+        "orphans": orphans,
+    })
+
+    # 5. fence: stop the runner AND cut the link; the window must
+    # expire before failover, the incarnation is fenced (not
+    # signalled), and its resurrected self is refused at handshake
+    fence = {}
+
+    def fence_inject(i, ctx):
+        s1 = ctx["sup"].shards["s1"]
+        if i == ticks // 3:
+            fence["pid"] = s1.pid
+            fence["proc"] = s1._proc
+            os.kill(fence["pid"], signal.SIGSTOP)
+            s1.chaos_sever_link()
+            return
+        if "pid" not in fence:
+            return
+        if "resurrected" not in fence and s1.state == SHARD_DEAD:
+            # confirmed dead via window expiry — bring the old
+            # incarnation back from suspension: it must be fenced
+            os.kill(fence["pid"], signal.SIGCONT)
+            fence["resurrected"] = i
+        if "resurrected" in fence:
+            s1._link.pump()  # judge the stale runner's redials
+
+    chaos = drive_proc_fleet(
+        ticks, matches_per_shard=min(p, 4), seed=seed, backend="tcp",
+        tuning=tuning, inject=fence_inject, tick_sleep_s=0.02,
+    )
+    s1 = chaos["sup"].shards["s1"]
+    # the fenced runner exits on its own once refused; give it a beat
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        s1._link.pump()
+        if fence.get("proc") is not None and fence["proc"].poll() is not None:
+            break
+        time.sleep(0.02)
+    chaos["sup"].close()
+    fence_affected = [
+        m for m in chaos["match_ids"]
+        if m not in [f"m{k}" for k in range(min(p, 4))]
+    ]
+    violations = fleet_recovery_violations(
+        chaos, fence_affected, dead_shards=["s1"]
+    )
+    link = link_of(chaos)
+    refusals = link.get("refusals") or {}
+    if not link.get("window_expiries"):
+        violations.append("reconnect window never expired")
+    if (link.get("epoch") or 0) < 2:
+        violations.append(
+            f"epoch {link.get('epoch')} not bumped past the fenced "
+            "incarnation"
+        )
+    if not refusals.get("fence"):
+        violations.append("resurrected stale runner was never "
+                          "fence-refused at handshake")
+    exit_code = fence["proc"].poll() if fence.get("proc") else None
+    if exit_code != 1:
+        violations.append(
+            f"fenced runner exit code {exit_code!r} (want 1: refused "
+            "and exited on its own)"
+        )
+    fence_exit = chaos["healthz"]["shards"]["s1"].get("exit") or ""
+    if "fenced" not in fence_exit:
+        violations.append(
+            f"exit reason {fence_exit!r} does not record the fence"
+        )
+    orphans = s1.orphan_count()
+    if orphans:
+        violations.append(f"{orphans} orphan runner processes")
+    print(f"  [net_fence] SIGSTOP+sever @tick {ticks // 3}: window "
+          f"expiries={link.get('window_expiries')} "
+          f"epoch={link.get('epoch')} fence refusals="
+          f"{refusals.get('fence', 0)} runner exit={exit_code}")
+    ok &= report("net_fence", violations, chaos, extra={
+        "refusals": refusals, "runner_exit": exit_code,
+        "tuning": tuning.as_dict(),
+    })
+    if ok:
+        print(f"  OK: {p}-per-shard TCP fleet resumed severed links "
+              "with zero failovers, shrugged off hostile dribble, "
+              "failed over a killed host bit-identically, and fenced "
+              "a resurrected stale runner")
+    return ok
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     ap.add_argument("--matches", type=int, default=4,
@@ -1045,7 +1373,7 @@ def main() -> int:
     ap.add_argument("--ticks", type=int, default=300)
     ap.add_argument("--seed", type=int, default=3)
     ap.add_argument("--fault", choices=[*FAULTS, "spectator", "socket",
-                                        "shard", "proc", "all"],
+                                        "shard", "proc", "net", "all"],
                     default="all")
     ap.add_argument("--fleet-matches", type=int, default=32, metavar="B",
                     help="matches per shard for --fault shard (default 32; "
@@ -1056,7 +1384,7 @@ def main() -> int:
     args = ap.parse_args()
 
     names = (
-        [*FAULTS, "spectator", "socket", "shard", "proc"]
+        [*FAULTS, "spectator", "socket", "shard", "proc", "net"]
         if args.fault == "all"
         else [args.fault]
     )
@@ -1065,6 +1393,11 @@ def main() -> int:
         if name == "proc":
             ok &= verify_proc_leg(
                 args.fleet_matches, args.ticks, args.seed,
+                artifact_dir=args.artifact_dir,
+            )
+        elif name == "net":
+            ok &= verify_net_leg(
+                min(args.fleet_matches, 8), args.ticks, args.seed,
                 artifact_dir=args.artifact_dir,
             )
         elif name == "spectator":

@@ -120,7 +120,7 @@ def render(healthz: Dict[str, Any], metrics: Dict[str, Any],
     header = (
         f"{'SHARD':<10} {'BACKEND':<8} {'STATE':<9} {'OK':<3} "
         f"{'MATCHES':<9} {'HB AGE':<8} {'WATCHDOG':<11} {'RST':<4} "
-        f"{'P99 MS':<8}"
+        f"{'LINK':<14} {'P99 MS':<8}"
     )
     lines.append(header)
     lines.append("-" * len(header))
@@ -129,6 +129,13 @@ def render(healthz: Dict[str, Any], metrics: Dict[str, Any],
     for sid in sorted(shards):
         h = shards[sid]
         p = proc.get(sid, {})
+        link = p.get("link") or h.get("link")
+        if link:
+            link_col = f"{link.get('state', '?')}/e{link.get('epoch', 0)}"
+            if link.get("reconnects"):
+                link_col += f"+r{link['reconnects']}"
+        else:
+            link_col = "-"
         matches = f"{h.get('matches', 0)}"
         if "bank_matches" in h:
             matches += (f" ({h.get('bank_matches', 0)}b/"
@@ -140,6 +147,7 @@ def render(healthz: Dict[str, Any], metrics: Dict[str, Any],
             f"{_fmt_age(p.get('heartbeat_age_s', h.get('heartbeat_age_s'))):<8} "
             f"{p.get('watchdog', h.get('watchdog', '-')) or '-':<11} "
             f"{str(p.get('restarts', h.get('restarts', 0))):<4} "
+            f"{link_col:<14} "
             f"{_fmt_ms(h.get('tick_p99_ms')):<8}"
         )
     p99s = _span_p99s(metrics)

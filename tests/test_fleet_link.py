@@ -1,0 +1,280 @@
+"""The multi-host TCP fleet link, end to end (DESIGN.md §25).
+
+A real ``ProcShard(tcp=True)``: the runner subprocess dials the
+supervisor's listener over AF_INET, completes the HMAC handshake, and
+serves the same RPC plane the socketpair backend does.  The scenarios
+here pin the liveness split the §25 model proves:
+
+- a severed link (full or half-open) RESUMES inside the reconnect
+  window with zero failovers — ``poll_lifecycle`` never says "died";
+- a runner that cannot return before the window closes is confirmed
+  dead WITHOUT being signalled (a remote host's process is not ours to
+  kill) — and when it resurrects, the bumped epoch fences it at
+  handshake, loudly, with the refusal counted;
+- adoption (``ShardRunner --tcp host:port``) works for externally
+  launched runners, the multi-host deployment shape.
+
+The adversarial handshake matrix (wrong token, replay, slowloris,
+garbage) lives in test_fleet_rpc.py; the data-plane bit-identity legs
+live in scripts/chaos.py --fault net.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from ggrs_tpu.fleet import FleetTuning, ShardSupervisor
+from ggrs_tpu.fleet.proc import PROC_EXITED, PROC_RUNNING, ProcShard
+from ggrs_tpu.fleet.transport import LINK_RECONNECTING, LINK_UP
+from ggrs_tpu.obs import Registry
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TUNING = FleetTuning(
+    heartbeat_interval_s=0.05,
+    heartbeat_deadline_s=1.0,
+    rpc_timeout_s=5.0,
+    spawn_timeout_s=120.0,
+    drain_deadline_s=0.5,
+    restart_max=3,
+    link_auth_token="e2e-token",
+    link_reconnect_window_s=2.0,
+    link_backoff_s=0.01,
+    link_handshake_timeout_s=1.0,
+)
+
+
+def _poll_until(shard, pred, timeout=10.0, expect=(None,)):
+    """Drive poll_lifecycle until ``pred(shard)``; every intermediate
+    verdict must be in ``expect`` (the zero-failover assertions ride
+    this: expect=(None,) means "died" is an instant failure)."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        r = shard.poll_lifecycle()
+        assert r in expect, f"unexpected lifecycle verdict {r!r}"
+        if pred(shard):
+            return
+        time.sleep(0.02)
+    raise AssertionError("condition not reached before timeout")
+
+
+@pytest.fixture
+def shard():
+    s = ProcShard("s0", tuning=TUNING, metrics=Registry(), tcp=True)
+    yield s
+    # belt and braces: reap anything a scenario left stopped/alive
+    for p in s._all_procs:
+        if p.poll() is None:
+            try:
+                os.kill(p.pid, signal.SIGCONT)
+            except (OSError, ProcessLookupError):
+                pass
+            p.kill()
+            p.wait(timeout=10)
+    s.close()
+
+
+class TestTcpSpawn:
+    def test_spawn_serves_over_tcp(self, shard):
+        assert shard._status == PROC_RUNNING
+        info = shard.link_info()
+        assert info["state"] == LINK_UP and info["epoch"] == 1
+        assert shard.watchdog_stage() == "ok"
+        h = shard.healthz()
+        assert h["link"]["state"] == "up"
+        assert h["ok"] and h["pid"] == shard.pid
+        # heartbeats flow over the TCP conn
+        _poll_until(shard,
+                    lambda s: (s.heartbeat_age_s() or 99) < 1.0)
+
+    def test_sever_resumes_with_zero_failovers(self, shard):
+        shard.chaos_sever_link()
+        # the whole excursion must stay failover-free: expect=(None,)
+        _poll_until(shard,
+                    lambda s: s.link_info()["state"] == LINK_RECONNECTING)
+        assert shard.watchdog_stage() == "reconnecting"
+        _poll_until(shard, lambda s: s.link_info()["state"] == LINK_UP)
+        info = shard.link_info()
+        assert info["reconnects"] == 1 and info["window_expiries"] == 0
+        assert info["epoch"] == 1  # same incarnation, same token
+        assert shard.watchdog_stage() == "ok"
+        # the conn still serves rpcs after the resume
+        assert shard._call("ping") is not None
+
+    def test_half_open_sever_resumes(self, shard):
+        # supervisor stops sending but keeps its read side: the runner
+        # sees EOF, we do not — its epoch-current resume IS the signal
+        shard.chaos_sever_link("wr")
+        _poll_until(shard,
+                    lambda s: s.link_info()["reconnects"] == 1)
+        assert shard.link_info()["state"] == LINK_UP
+
+    def test_window_expiry_confirms_death_without_kill(self, shard):
+        pid = shard.pid
+        os.kill(pid, signal.SIGSTOP)  # cannot redial
+        try:
+            shard.chaos_sever_link()
+            deadline = time.monotonic() + 15
+            died = None
+            while time.monotonic() < deadline:
+                died = shard.poll_lifecycle()
+                if died is not None:
+                    break
+                time.sleep(0.02)
+            assert died == "died"
+            assert shard._status == PROC_EXITED
+            assert "fenced" in (shard.last_exit or "")
+            assert shard.link_info()["window_expiries"] == 1
+            # epoch bumped at down(): the stale incarnation is fenced
+            assert shard.link_info()["epoch"] == 2
+            # the liveness split: the process was NOT signalled — on a
+            # real remote host it would not be ours to kill
+            os.kill(pid, 0)  # still exists (stopped)
+        finally:
+            os.kill(pid, signal.SIGCONT)
+
+    def test_resurrected_stale_runner_fenced_at_handshake(self, shard):
+        """The §25 acceptance bit: kill the link, let the window
+        expire, respawn a fresh incarnation — then the old runner
+        (SIGCONT'd back to life) redials with its stale epoch and must
+        be refused with HS_REFUSED_FENCE, then exit of its own accord
+        (never double-driven)."""
+        old_pid = shard.pid
+        old_proc = shard._proc
+        os.kill(old_pid, signal.SIGSTOP)
+        shard.chaos_sever_link()
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            if shard.poll_lifecycle() is not None:
+                break
+            time.sleep(0.02)
+        assert shard._status == PROC_EXITED
+        # resurrect the old incarnation, then respawn the new one; the
+        # spawn's wait_for_runner pump judges the stale redial
+        os.kill(old_pid, signal.SIGCONT)
+        assert shard.try_respawn()
+        assert shard._status == PROC_RUNNING
+        assert shard.pid != old_pid
+        assert shard.link_info()["epoch"] == 3  # expire +1, respawn +1
+        # the old runner must notice the fence and exit nonzero, and
+        # the refusal must be counted (it may need a few pump rounds)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            shard.poll_lifecycle()
+            if (old_proc.poll() is not None
+                    and shard.link_info()["refusals"].get("fence")):
+                break
+            time.sleep(0.02)
+        assert shard.link_info()["refusals"].get("fence", 0) >= 1
+        assert old_proc.poll() == 1  # fenced exit, not a crash
+        # and the NEW incarnation is untouched by the old one's redials
+        assert shard.link_info()["state"] == LINK_UP
+        assert shard._call("ping") is not None
+
+
+class TestTcpAdoption:
+    def test_adopt_external_runner(self):
+        shard = ProcShard("s9", tuning=TUNING, metrics=Registry(),
+                          tcp=True, spawn=False)
+        proc = None
+        try:
+            host, port = shard._link.address
+            env = dict(
+                os.environ,
+                GGRS_FLEET_LINK_AUTH_TOKEN=TUNING.link_auth_token,
+                GGRS_FLEET_LINK_SHARD="s9",
+            )
+            proc = subprocess.Popen(
+                [sys.executable, os.path.join(REPO, "scripts",
+                                              "shard_runner.py"),
+                 "--tcp", f"{host}:{port}"],
+                env=env, cwd=REPO,
+            )
+            shard.adopt_tcp(timeout=120.0)
+            assert shard._status == PROC_RUNNING
+            assert shard.link_info()["state"] == LINK_UP
+            assert shard.pid == proc.pid
+        finally:
+            shard.close()
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
+
+    def test_wrong_token_runner_never_adopted(self):
+        shard = ProcShard("s9", tuning=TUNING, metrics=Registry(),
+                          tcp=True, spawn=False)
+        proc = None
+        try:
+            host, port = shard._link.address
+            env = dict(
+                os.environ,
+                GGRS_FLEET_LINK_AUTH_TOKEN="not-the-token",
+                GGRS_FLEET_LINK_SHARD="s9",
+            )
+            proc = subprocess.Popen(
+                [sys.executable, os.path.join(REPO, "scripts",
+                                              "shard_runner.py"),
+                 "--tcp", f"{host}:{port}"],
+                env=env, cwd=REPO,
+            )
+            # the runner is refused at handshake and exits nonzero;
+            # pump enough to judge its attempt
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                shard._link.pump()
+                if proc.poll() is not None:
+                    break
+                time.sleep(0.02)
+            assert proc.poll() == 1
+            assert shard._link.refusals.get("auth", 0) >= 1
+            assert shard._status == PROC_EXITED  # never adopted
+        finally:
+            shard.close()
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
+
+
+class TestSupervisorTcp:
+    def test_tcp_shards_must_be_proc_backed(self):
+        with pytest.raises(ValueError, match="tcp_shards"):
+            ShardSupervisor(("a", "b"), tuning=TUNING,
+                            tcp_shards=("a",))
+
+    def test_healthz_carries_link_state(self):
+        sup = ShardSupervisor(
+            ("s0", "s1"), capacity=4, metrics=Registry(),
+            tuning=TUNING, proc_shards=("s1",), tcp_shards=("s1",),
+        )
+        try:
+            h = sup.healthz()
+            assert h["proc"]["s1"]["link"]["state"] == "up"
+            assert h["shards"]["s1"]["link"]["epoch"] == 1
+            # non-tcp shards have no link block
+            assert h["shards"]["s0"].get("link") is None
+        finally:
+            sup.close()
+
+    def test_fleet_top_renders_link_column(self):
+        sys.path.insert(0, os.path.join(REPO, "scripts"))
+        try:
+            from fleet_top import render
+        finally:
+            sys.path.pop(0)
+        from ggrs_tpu.obs.exporters import json_snapshot
+        sup = ShardSupervisor(
+            ("s0", "s1"), capacity=4, metrics=Registry(),
+            tuning=TUNING, proc_shards=("s1",), tcp_shards=("s1",),
+        )
+        try:
+            out = render(sup.healthz(), json_snapshot(sup.metrics))
+            assert "LINK" in out
+            assert "up/e1" in out  # state/epoch for the tcp shard
+        finally:
+            sup.close()

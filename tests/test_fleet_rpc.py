@@ -298,6 +298,39 @@ class TestFleetTuning:
         t = FleetTuning(heartbeat_interval_s=0.125, restart_max=5)
         assert FleetTuning.from_dict(json.loads(json.dumps(t.as_dict()))) == t
 
+    def test_link_env_overrides(self):
+        """The §25 link knobs ride the same GGRS_FLEET_* env plumbing —
+        including the first STRING-typed knob (the auth token, passed
+        through verbatim, never float-cast)."""
+        t = FleetTuning.from_env({
+            "GGRS_FLEET_LINK_AUTH_TOKEN": "sekrit",
+            "GGRS_FLEET_LINK_RECONNECT_WINDOW_S": "1.25",
+            "GGRS_FLEET_LINK_BACKOFF_S": "0.02",
+            "GGRS_FLEET_LINK_KEEPALIVE_S": "11",
+            "GGRS_FLEET_LINK_RETAIN_FRAMES": "512",
+        })
+        assert t.link_auth_token == "sekrit"
+        assert t.link_reconnect_window_s == 1.25
+        assert t.link_backoff_s == 0.02
+        assert t.link_keepalive_s == 11.0
+        assert t.link_retain_frames == 512
+
+    def test_link_malformed_env_is_loud(self):
+        with pytest.raises(ValueError,
+                           match="GGRS_FLEET_LINK_RECONNECT_WINDOW_S"):
+            FleetTuning.from_env(
+                {"GGRS_FLEET_LINK_RECONNECT_WINDOW_S": "soon"}
+            )
+
+    def test_link_token_must_be_string(self):
+        with pytest.raises(ValueError, match="link_auth_token"):
+            FleetTuning(link_auth_token=123)
+
+    def test_link_knobs_round_trip(self):
+        t = FleetTuning(link_auth_token="tok", link_reconnect_window_s=0.5,
+                        link_retain_frames=64, failover_retry_s=1.0)
+        assert FleetTuning.from_dict(json.loads(json.dumps(t.as_dict()))) == t
+
     def test_supervisor_uses_its_tuning(self, tmp_path):
         """The readmission backoff now flows from the instance's tuning,
         not the module constants."""
@@ -522,3 +555,402 @@ class TestJournalWriteFailure:
         # a migration would have re-incarnated the journal and cleared
         # the flag — pinned by the _adopt_on reset
         assert record.location is None
+
+
+# ----------------------------------------------------------------------
+# §25 TCP fleet link: adversarial handshakes + fd hygiene + resume seam
+# ----------------------------------------------------------------------
+
+import os as _os
+
+from ggrs_tpu.fleet.transport import (
+    AUTH,
+    CHALLENGE,
+    HS_MAGIC_AUTH,
+    HS_OK_FRESH,
+    HS_REFUSED_AUTH,
+    HS_REFUSED_FENCE,
+    HS_REFUSED_VERSION,
+    HS_VERSION,
+    HandshakeError,
+    ShardLink,
+    VERDICT,
+    client_handshake,
+    pack_auth,
+)
+
+LINK_TUNING = FleetTuning(
+    link_auth_token="test-token",
+    link_reconnect_window_s=1.0,
+    link_handshake_timeout_s=0.4,
+    link_backoff_s=0.01,
+)
+
+
+def _count_fds() -> int:
+    return len(_os.listdir("/proc/self/fd"))
+
+
+def _mk_link(**kw):
+    return ShardLink("s0", LINK_TUNING, metrics=Registry(), **kw)
+
+
+def _dial_raw(link):
+    s = socket.create_connection(link.address, timeout=2.0)
+    s.settimeout(2.0)
+    return s
+
+
+def _pump_until(link, pred, timeout=3.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        ev = link.pump()
+        if pred(ev, link):
+            return ev
+        time.sleep(0.005)
+    raise AssertionError("link pump never reached the condition")
+
+
+def _read_challenge(sock):
+    raw = b""
+    while len(raw) < CHALLENGE.size:
+        raw += sock.recv(CHALLENGE.size - len(raw))
+    return CHALLENGE.unpack(raw)
+
+
+def _read_verdict(sock):
+    raw = b""
+    while len(raw) < VERDICT.size:
+        chunk = sock.recv(VERDICT.size - len(raw))
+        if not chunk:
+            raise AssertionError("no verdict before close")
+        raw += chunk
+    return VERDICT.unpack(raw)
+
+
+class TestTcpHandshakeAdversarial:
+    def test_wrong_token_refused(self):
+        link = _mk_link()
+        try:
+            s = _dial_raw(link)
+            _pump_until(link, lambda ev, lk: lk.info()["pending"] == 1)
+            _, _, _, nonce = _read_challenge(s)
+            s.sendall(pack_auth("WRONG-token", nonce, epoch=0, cursor=0,
+                                shard_id="s0", resume=False))
+            _pump_until(link, lambda ev, lk: lk.refusals.get("auth"))
+            _, _, code, _, _ = _read_verdict(s)
+            assert code == HS_REFUSED_AUTH
+            assert link.link_state == "connecting"  # nothing granted
+            s.close()
+        finally:
+            link.close()
+
+    def test_replayed_handshake_refused(self):
+        """A captured auth record is worthless on a new connection: the
+        MAC is bound to the server's per-connection nonce."""
+        link = _mk_link()
+        try:
+            s1 = _dial_raw(link)
+            _pump_until(link, lambda ev, lk: lk.info()["pending"] == 1)
+            _, _, _, nonce1 = _read_challenge(s1)
+            record = pack_auth("test-token", nonce1, epoch=0, cursor=0,
+                               shard_id="s0", resume=False)
+            s1.close()  # attacker captured `record`; session never used
+            _pump_until(link, lambda ev, lk: lk.info()["pending"] == 0)
+            s2 = _dial_raw(link)
+            _pump_until(link, lambda ev, lk: lk.info()["pending"] == 1)
+            _read_challenge(s2)  # fresh nonce we ignore, like a replayer
+            s2.sendall(record)
+            _pump_until(link, lambda ev, lk: lk.refusals.get("auth"))
+            _, _, code, _, _ = _read_verdict(s2)
+            assert code == HS_REFUSED_AUTH
+            s2.close()
+        finally:
+            link.close()
+
+    def test_stale_epoch_fenced(self):
+        """A resume presenting an old epoch is refused with FENCE before
+        any link state moves — the split-brain rule at the wire."""
+        link = _mk_link()
+        try:
+            link.mint_epoch()  # epoch 1: granted to a past incarnation
+            link.mint_epoch()  # epoch 2: current
+            s = _dial_raw(link)
+            _pump_until(link, lambda ev, lk: lk.info()["pending"] == 1)
+            _, _, _, nonce = _read_challenge(s)
+            s.sendall(pack_auth("test-token", nonce, epoch=1, cursor=0,
+                                shard_id="s0", resume=True))
+            _pump_until(link, lambda ev, lk: lk.refusals.get("fence"))
+            _, _, code, current, _ = _read_verdict(s)
+            assert code == HS_REFUSED_FENCE
+            assert current == 2  # the verdict names the current mint
+            s.close()
+        finally:
+            link.close()
+
+    def test_wrong_version_refused(self):
+        link = _mk_link()
+        try:
+            s = _dial_raw(link)
+            _pump_until(link, lambda ev, lk: lk.info()["pending"] == 1)
+            _, _, _, nonce = _read_challenge(s)
+            rec = bytearray(pack_auth("test-token", nonce, epoch=0,
+                                      cursor=0, shard_id="s0",
+                                      resume=False))
+            rec[2] = 99  # version byte (MAC now stale too, but version
+            s.sendall(bytes(rec))  # is judged first)
+            _pump_until(link, lambda ev, lk: lk.refusals.get("version"))
+            _, _, code, _, _ = _read_verdict(s)
+            assert code == HS_REFUSED_VERSION
+            s.close()
+        finally:
+            link.close()
+
+    def test_truncated_auth_counted_not_wedged(self):
+        link = _mk_link()
+        try:
+            s = _dial_raw(link)
+            _pump_until(link, lambda ev, lk: lk.info()["pending"] == 1)
+            _read_challenge(s)
+            s.sendall(HS_MAGIC_AUTH + b"\x01")  # 3 of 68 bytes, then EOF
+            s.close()
+            _pump_until(link, lambda ev, lk: lk.refusals.get("eof"))
+            assert link.info()["pending"] == 0
+        finally:
+            link.close()
+
+    def test_slowloris_dribble_times_out(self):
+        link = _mk_link()
+        try:
+            s = _dial_raw(link)
+            _pump_until(link, lambda ev, lk: lk.info()["pending"] == 1)
+            _read_challenge(s)
+            s.sendall(HS_MAGIC_AUTH)  # valid magic, then... nothing
+            # the per-connection deadline (0.4s) reaps it; the pump
+            # (the supervisor tick loop) never blocks on the dribbler
+            t0 = time.monotonic()
+            _pump_until(link, lambda ev, lk: lk.refusals.get("timeout"))
+            assert time.monotonic() - t0 < 3.0
+            assert link.info()["pending"] == 0
+            s.close()
+        finally:
+            link.close()
+
+    def test_garbage_before_magic_dropped_early(self):
+        link = _mk_link()
+        try:
+            s = _dial_raw(link)
+            _pump_until(link, lambda ev, lk: lk.info()["pending"] == 1)
+            _read_challenge(s)
+            s.sendall(b"GET / HTTP/1.1\r\n\r\n")  # a scanner, basically
+            # dropped on the FIRST two bytes — not held to the deadline
+            _pump_until(link, lambda ev, lk: lk.refusals.get("garbage"),
+                        timeout=0.3)
+            assert link.info()["pending"] == 0
+            s.close()
+        finally:
+            link.close()
+
+    def test_fresh_handshake_grants_epoch(self):
+        link = _mk_link()
+        result = {}
+        try:
+            link.mint_epoch()
+
+            def dial():
+                s = socket.create_connection(link.address, timeout=2.0)
+                try:
+                    result["verdict"] = client_handshake(
+                        s, token="test-token", shard_id="s0", epoch=0,
+                        cursor=0, resume=False, timeout=2.0)
+                finally:
+                    s.close()
+
+            t = threading.Thread(target=dial)
+            t.start()
+            ev = _pump_until(link, lambda ev, lk: ev is not None)
+            t.join(timeout=2.0)
+            assert ev[0] == "fresh" and ev[1] is not None
+            ev[1].close()
+            code, granted, cursor = result["verdict"]
+            assert code == HS_OK_FRESH and granted == link.epoch
+            assert cursor == 0
+        finally:
+            link.close()
+
+
+class TestHandshakeFdHygiene:
+    """PR 8 rule, extended to the TCP link: every handshake error path
+    releases its fd — pinned by exact /proc/self/fd counts."""
+
+    def test_refused_and_garbage_paths_leak_nothing(self):
+        base = _count_fds()
+        link = _mk_link()
+        try:
+            for payload in (b"junkjunkjunk", HS_MAGIC_AUTH + b"\x00"):
+                s = _dial_raw(link)
+                _pump_until(link, lambda ev, lk: lk.info()["pending"] == 1)
+                _read_challenge(s)
+                s.sendall(payload)
+                if payload.startswith(HS_MAGIC_AUTH):
+                    s.close()  # truncated-then-EOF variant
+                    _pump_until(link,
+                                lambda ev, lk: lk.refusals.get("eof"))
+                else:
+                    _pump_until(link,
+                                lambda ev, lk: lk.refusals.get("garbage"))
+                    s.close()
+            # wrong token (a verdict IS owed on this path)
+            s = _dial_raw(link)
+            _pump_until(link, lambda ev, lk: lk.info()["pending"] == 1)
+            _, _, _, nonce = _read_challenge(s)
+            s.sendall(pack_auth("bad", nonce, epoch=0, cursor=0,
+                                shard_id="s0", resume=False))
+            _pump_until(link, lambda ev, lk: lk.refusals.get("auth"))
+            s.close()
+            assert link.info()["pending"] == 0
+        finally:
+            link.close()
+        assert _count_fds() == base, "handshake error path leaked an fd"
+
+    def test_timeout_mid_handshake_leaks_nothing(self):
+        base = _count_fds()
+        link = _mk_link()
+        try:
+            s = _dial_raw(link)
+            _pump_until(link, lambda ev, lk: lk.info()["pending"] == 1)
+            _read_challenge(s)  # then stall: never send the auth record
+            _pump_until(link, lambda ev, lk: lk.refusals.get("timeout"))
+            s.close()
+        finally:
+            link.close()
+        assert _count_fds() == base
+
+    def test_client_refused_version_leaks_nothing(self):
+        base = _count_fds()
+        with socket.socket() as srv:
+            srv.bind(("127.0.0.1", 0))
+            srv.listen(1)
+            addr = srv.getsockname()[:2]
+
+            def server():
+                c, _ = srv.accept()
+                with c:
+                    # advertise a version this client does not speak
+                    c.sendall(CHALLENGE.pack(b"GC", 99, 0, b"\x00" * 16))
+                    c.recv(256)
+
+            t = threading.Thread(target=server)
+            t.start()
+            from ggrs_tpu.fleet.transport import RunnerLink
+
+            rl = RunnerLink(addr[0], addr[1], token="x", shard_id="s0")
+            with pytest.raises(HandshakeError):
+                rl.dial_fresh(timeout=1.0)
+            t.join(timeout=2.0)
+        assert _count_fds() == base
+
+
+class TestRpcResumeSeam:
+    """The rpc.py seam the link rides: sequence numbers, the retain
+    ring, reattach+replay, and call-id correlation."""
+
+    def test_seq_numbers_and_resume_replay(self):
+        a, b = _pair()
+        a.enable_retain(64)
+        b.enable_retain(64)
+        try:
+            for i in range(3):
+                a.send(KIND_CALL, dict(op="tick", i=i))
+            assert a.tx_seq == 3
+            kind, obj = b.recv(timeout=2)
+            assert obj["i"] == 0 and b.rx_seq == 1
+            # sever: both sides move to a fresh pair; b's unread frames
+            # (i=1, i=2) are lost in flight and must be replayed
+            na, nb = socket.socketpair()
+            a.reattach(na)
+            b.reattach(nb)
+            assert a.can_resume(b.rx_seq)
+            replayed = a.replay_from(b.rx_seq)
+            assert replayed == 2
+            for want in (1, 2):
+                kind, obj = b.recv(timeout=2)
+                assert obj["i"] == want
+            assert b.rx_seq == 3
+        finally:
+            a.close(), b.close()
+
+    def test_can_resume_respects_ring_floor(self):
+        a, b = _pair()
+        a.enable_retain(2)
+        try:
+            for i in range(5):
+                a.send(KIND_HEARTBEAT, dict(i=i))
+            assert a.can_resume(5)          # nothing to replay
+            assert a.can_resume(4)          # frame 5 still retained
+            assert a.can_resume(3)          # frames 4,5 retained
+            assert not a.can_resume(2)      # frame 3 fell off the ring
+            assert not a.can_resume(0)
+            assert not a.can_resume(9)      # peer claims frames we
+        finally:                            # never sent: nonsense
+            a.close(), b.close()
+
+    def test_replay_past_ring_raises(self):
+        a, b = _pair()
+        a.enable_retain(2)
+        try:
+            for i in range(5):
+                a.send(KIND_HEARTBEAT, dict(i=i))
+            with pytest.raises(RpcClosed):
+                a.replay_from(1)
+        finally:
+            a.close(), b.close()
+
+    def test_reattach_refuses_poisoned_stream(self):
+        a, b = _pair()
+        try:
+            b._sock.sendall(b"\x00" * HEADER_SIZE)
+            with pytest.raises(FrameError):
+                a.recv(timeout=2)
+            na, _nb = socket.socketpair()
+            with pytest.raises(FrameError):
+                a.reattach(na)
+            na.close(), _nb.close()
+        finally:
+            a.close(), b.close()
+
+    def test_call_drops_stale_replies(self):
+        """A reply replayed from before a reconnect must not be taken
+        as the answer to the CURRENT call: call ids correlate."""
+        a, b = _pair()
+        try:
+            def runner():
+                kind, msg = b.recv(timeout=5)
+                cid = msg["_cid"]
+                # a stale reply (old cid), then the real one
+                b.send(KIND_REPLY, {"_cid": cid - 1 or 999, "_r": "old"})
+                b.send(KIND_REPLY, {"_cid": cid, "_r": "fresh"})
+
+            t = threading.Thread(target=runner)
+            t.start()
+            assert a.call("op", timeout=5) == "fresh"
+            t.join(timeout=2)
+            assert a.stale_replies == 1
+        finally:
+            a.close(), b.close()
+
+    def test_plain_replies_still_work(self):
+        """Back-compat: a reply without the _cid envelope (pre-link
+        servers, tests with bare fakes) is returned as-is."""
+        a, b = _pair()
+        try:
+            def runner():
+                b.recv(timeout=5)
+                b.send(KIND_REPLY, dict(plain=True))
+
+            t = threading.Thread(target=runner)
+            t.start()
+            assert a.call("op", timeout=5) == dict(plain=True)
+            t.join(timeout=2)
+        finally:
+            a.close(), b.close()

@@ -470,3 +470,85 @@ class TestReviewRegressions:
         assert c["kMask32"] == 0xFFFFFFFF
         assert c["kMask64"] == (1 << 64) - 1
         assert c["kMask8"] == 0xFF
+
+
+# ----------------------------------------------------------------------
+# §25 TCP handshake contract (PR 11 rule: wire structs land with their
+# checker — deliberate-skew fixtures prove the checker catches drift)
+# ----------------------------------------------------------------------
+
+TP_GOOD = """\
+import struct
+HS_VERSION = 1
+NONCE_BYTES = 16
+MAC_BYTES = 32
+CHALLENGE = struct.Struct("<2sBB16s")
+AUTH_PREFIX = struct.Struct("<2sBBQQ16s")
+AUTH = struct.Struct("<2sBBQQ16s32s")
+VERDICT = struct.Struct("<2sBBQQ")
+"""
+
+
+class TestTcpHandshakeSkew:
+    def _tree(self, tmp_path, text):
+        (tmp_path / "ggrs_tpu/fleet").mkdir(parents=True)
+        (tmp_path / "ggrs_tpu/fleet/transport.py").write_text(text)
+        return tmp_path
+
+    def _check(self, root):
+        from ggrs_tpu.analysis.layout import _check_tcp_handshake
+        return _check_tcp_handshake(root)
+
+    def test_clean_fixture_passes(self, tmp_path):
+        assert self._check(self._tree(tmp_path, TP_GOOD)) == []
+
+    def test_auth_epoch_field_drift_fires(self, tmp_path):
+        # shrinking the epoch from u64 to u32 must fire: a truncated
+        # epoch is exactly the fence-defeating skew
+        bad = TP_GOOD.replace('"<2sBBQQ16s"', '"<2sBBIQ16s"')
+        findings = self._check(self._tree(tmp_path, bad))
+        assert any(
+            f.rule == "layout/tcp-handshake" and "auth prefix" in f.detail
+            for f in findings
+        )
+
+    def test_resume_cursor_drift_fires(self, tmp_path):
+        # dropping the resume cursor from the verdict fires
+        bad = TP_GOOD.replace('"<2sBBQQ")', '"<2sBBQ")')
+        findings = self._check(self._tree(tmp_path, bad))
+        assert any(
+            f.rule == "layout/tcp-handshake" and "verdict" in f.detail
+            for f in findings
+        )
+
+    def test_mac_tail_drift_fires(self, tmp_path):
+        # a 16-byte mac tail breaks auth = prefix + MAC_BYTES
+        bad = TP_GOOD.replace('"<2sBBQQ16s32s"', '"<2sBBQQ16s16s"')
+        findings = self._check(self._tree(tmp_path, bad))
+        assert any("auth record" in f.detail or "mac" in f.detail
+                   for f in findings)
+
+    def test_mac_bytes_constant_drift_fires(self, tmp_path):
+        bad = TP_GOOD.replace("MAC_BYTES = 32", "MAC_BYTES = 20")
+        findings = self._check(self._tree(tmp_path, bad))
+        assert any("MAC_BYTES" in f.detail for f in findings)
+
+    def test_unversioned_handshake_fires(self, tmp_path):
+        bad = TP_GOOD.replace("HS_VERSION = 1\n", "")
+        findings = self._check(self._tree(tmp_path, bad))
+        assert any("HS_VERSION" in f.detail for f in findings)
+
+    def test_contract_matches_live_structs(self):
+        from ggrs_tpu.analysis.layout import (
+            TCP_AUTH_FMT,
+            TCP_AUTH_PREFIX_FMT,
+            TCP_CHALLENGE_FMT,
+            TCP_VERDICT_FMT,
+        )
+        from ggrs_tpu.fleet import transport
+
+        assert transport.CHALLENGE.format == TCP_CHALLENGE_FMT
+        assert transport.AUTH_PREFIX.format == TCP_AUTH_PREFIX_FMT
+        assert transport.AUTH.format == TCP_AUTH_FMT
+        assert transport.VERDICT.format == TCP_VERDICT_FMT
+        assert transport.AUTH.size == transport.AUTH_PREFIX.size + 32
