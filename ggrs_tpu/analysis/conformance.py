@@ -123,6 +123,16 @@ MACHINE_SPECS: Tuple[MachineSpec, ...] = (
         dst_arg=0,
         scan=("ggrs_tpu/fleet/transport.py",),
     ),
+    MachineSpec(
+        name="route-flip",
+        table_path="ggrs_tpu/fleet/placement_service.py",
+        table_name="MIG_TRANSITIONS",
+        prefix="MIG_",
+        setter_kind="attr",
+        setter_name="phase",
+        dst_arg=0,
+        scan=("ggrs_tpu/fleet/placement_service.py",),
+    ),
 )
 
 

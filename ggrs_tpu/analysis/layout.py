@@ -77,6 +77,17 @@ TCP_VERDICT_FMT = "<2sBBQQ"
 TCP_MAC_BYTES = 32
 TCP_NONCE_BYTES = 16
 
+# Ingress-plane wire records (fleet/ingress.py, DESIGN.md §26): the
+# forwarded-datagram header (magic, version, flags, vport, then the
+# peer's public source address as port + ip4) wrapping every payload on
+# the ingress<->leg uplink, and the route-update frame — the SAME shape
+# plus the two u64 fence words (placement epoch, route version) between
+# the version byte-pair and the vport, so a route write can never be
+# confused with (or replayed as) a forwarded datagram.
+ING_FWD_FMT = "<2sBBHH4s"
+ING_ROUTE_FMT = "<2sBBQQHH4s"
+ING_FENCE_BYTES = 16  # epoch u64 + route-version u64
+
 # Harvest prefix (ggrs_bank_harvest): i64 current, i64 last_confirmed,
 # i64 disconnect_frame.
 HARVEST_PREFIX_FMT = "<qqq"
@@ -652,6 +663,47 @@ def _check_tcp_handshake(root: Path) -> List[Finding]:
     return out
 
 
+def _check_ingress_wire(root: Path) -> List[Finding]:
+    """The §26 ingress wire records vs ingress.py: both structs
+    present, the route frame = forwarded header + the two u64 fence
+    words, and the versions/route ops statically visible (the deliberate
+    PUT=1/DEL=2 split the decode path refuses everything outside)."""
+    out: List[Finding] = []
+    ing = root / "ggrs_tpu/fleet/ingress.py"
+    fmts = {f.fmt for f in parse_py_struct_formats(ing)}
+    for label, fmt in (("forwarded-datagram header", ING_FWD_FMT),
+                       ("route-update frame", ING_ROUTE_FMT)):
+        if fmt not in fmts:
+            out.append(Finding(
+                "layout/ingress-wire", "ggrs_tpu/fleet/ingress.py", 0,
+                f"ingress {label} {fmt!r} not found (wire format "
+                "drifted from the §26 contract?)",
+            ))
+    if (struct.calcsize(ING_ROUTE_FMT)
+            != struct.calcsize(ING_FWD_FMT) + ING_FENCE_BYTES):
+        out.append(Finding(
+            "layout/ingress-wire", "ggrs_tpu/fleet/ingress.py", 0,
+            f"route frame {ING_ROUTE_FMT!r} is not the forwarded "
+            f"header {ING_FWD_FMT!r} + {ING_FENCE_BYTES} fence bytes "
+            "(epoch u64 + route-version u64 drifted?)",
+        ))
+    consts = parse_py_constants(ing)
+    for name in ("FWD_VERSION", "ROUTE_WIRE_VERSION"):
+        if consts.get(name) is None:
+            out.append(Finding(
+                "layout/ingress-wire", "ggrs_tpu/fleet/ingress.py", 0,
+                f"{name} constant not statically visible (version "
+                "refusal needs a comparable constant)",
+            ))
+    if (consts.get("ROUTE_OP_PUT"), consts.get("ROUTE_OP_DEL")) != (1, 2):
+        out.append(Finding(
+            "layout/ingress-wire", "ggrs_tpu/fleet/ingress.py", 0,
+            f"route ops PUT={consts.get('ROUTE_OP_PUT')!r} "
+            f"DEL={consts.get('ROUTE_OP_DEL')!r} != contract (1, 2)",
+        ))
+    return out
+
+
 def _check_stat_tables(root: Path) -> List[Finding]:
     out: List[Finding] = []
     native_py = root / "ggrs_tpu/net/_native.py"
@@ -739,5 +791,6 @@ def check_layout(
     findings += _check_body_prefix(root)
     findings += _check_rpc_framing(root)
     findings += _check_tcp_handshake(root)
+    findings += _check_ingress_wire(root)
     findings += _check_stat_tables(root)
     return findings

@@ -636,6 +636,116 @@ def link_model(root: Path, fenced: bool = True,
 
 
 # ----------------------------------------------------------------------
+# §26: route-flip ordering (fleet/placement_service.py migrations)
+# ----------------------------------------------------------------------
+
+
+class RfS(NamedTuple):
+    phase: str         # MIG_* value from MIG_TRANSITIONS
+    route: str         # where the virtual endpoint routes: "src" | "dst"
+    adopted: bool      # the target supervisor ACKED the adoption
+    sup: int           # the placement plane's minted route epoch
+    writer: int        # epoch the (possibly fenced) route writer holds
+    misroute: bool     # a flip landed before the adoption ack
+    stale: bool        # a stale-epoch writer's route was accepted
+
+
+def route_flip_model(root: Path, ordered: bool = True,
+                     fenced: bool = True) -> Model:
+    """The §26 cross-host migration machine: export off the source →
+    adoption ack on the target → ingress route flip → settle, with the
+    abort edge restoring the source — against a confirmed host death
+    that mints a fresh route epoch while a fenced supervisor still
+    believes it owns the route.
+
+    Every ``phase`` edge the actions perform is validated against
+    ``MIG_TRANSITIONS`` parsed from placement_service.py (the same
+    table the §22 transition lint conforms the implementation to).
+    ``ordered=False`` adds the flip HEAD cannot perform — pointing the
+    virtual endpoint at the target BEFORE the adoption ack — and must
+    counterexample with peers misrouted at a leg nobody serves.
+    ``fenced=False`` drops the epoch check from route writes — exactly
+    what the ingress's ``apply_route_update`` refuses as
+    ``stale-epoch`` — and must counterexample with a fenced supervisor
+    flipping a route after the failover epoch was minted."""
+    table = _table(root, "route-flip")
+
+    actions = [
+        # export_transfer off the source (or the journal pickup when a
+        # dead host's match fails over): nobody serves until adoption
+        Action("begin", lambda s: s.phase == "idle",
+               lambda s: s._replace(phase="exported")),
+        # the target supervisor acked adopt_transfer/adopt_from_meta
+        Action("adopt_ack", lambda s: s.phase == "exported",
+               lambda s: s._replace(phase="adopted", adopted=True)),
+        # the ingress route flip — HEAD orders it strictly after the
+        # adoption ack (MIG_TRANSITIONS has no exported->flipped edge)
+        Action("flip", lambda s: s.phase == "adopted",
+               lambda s: s._replace(phase="flipped", route="dst")),
+        # migration settles; the new leg is the next migration's source
+        Action("settle", lambda s: s.phase == "flipped",
+               lambda s: s._replace(phase="idle", route="src",
+                                    adopted=False)),
+        # adoption failed: the exported bytes restore the source
+        Action("abort", lambda s: s.phase == "exported",
+               lambda s: s._replace(phase="idle")),
+        # a whole machine is confirmed dead: the placement plane mints
+        # a fresh route epoch (kill_host), fencing everything the dead
+        # incarnation's supervisor signed
+        Action("host_die", lambda s: True,
+               lambda s: s._replace(sup=_mint(s.sup))),
+    ]
+    if not ordered:
+        actions.append(Action(
+            "flip_premature",
+            lambda s: s.phase == "exported",
+            lambda s: s._replace(route="dst", misroute=True),
+        ))
+    if not fenced:
+        actions.append(Action(
+            "stale_write",
+            lambda s: s.writer < s.sup,
+            lambda s: s._replace(route="src", stale=True),
+        ))
+    _assert_edges("route-flip", table, {
+        "begin": [("idle", "exported")],
+        "adopt_ack": [("exported", "adopted")],
+        "flip": [("adopted", "flipped")],
+        "settle": [("flipped", "idle")],
+        "abort": [("exported", "idle")],
+        "host_die": [],
+        "flip_premature": [],
+        "stale_write": [],
+    })
+    variant = ("head" if ordered and fenced
+               else ("flip-before-ack" if not ordered
+                     else "stale-route-write"))
+    return Model(
+        f"route-flip:{variant}",
+        RfS("idle", "src", False, 1, 1, False, False),
+        tuple(actions),
+        invariants=(
+            # the ordering rule: the public route never points at a leg
+            # whose adoption nobody acked (peers misrouted into a void)
+            Invariant("no-route-flip-before-adoption-ack",
+                      lambda s: not s.misroute),
+            # the fencing rule: once a death minted a fresh epoch, a
+            # supervisor holding the old one can never write a route
+            Invariant("fenced-writer-never-routes",
+                      lambda s: not s.stale),
+            # epochs flow placement -> writers, never ahead of the mint
+            Invariant("writer-epoch-never-ahead",
+                      lambda s: s.writer <= s.sup),
+        ),
+        progress=(
+            # whatever the interleaving, a migration can always settle
+            Progress("migration-settles",
+                     lambda s: s.phase == "idle"),
+        ),
+    )
+
+
+# ----------------------------------------------------------------------
 # the catalog + the verify leg
 # ----------------------------------------------------------------------
 
@@ -690,6 +800,22 @@ MODEL_CATALOG: Tuple[CatalogEntry, ...] = (
                  lambda root: link_model(root, premature=True),
                  "counterexample", "invariant",
                  ("accept", "sever", "failover_premature")),
+    CatalogEntry("route-flip:head", "§26",
+                 lambda root: route_flip_model(root), "clean"),
+    # misroute: flipping the virtual endpoint before the target acked
+    # adoption points every peer at a leg nobody serves — the ordering
+    # MIG_TRANSITIONS (no exported->flipped edge) makes unrepresentable
+    CatalogEntry("route-flip:flip-before-ack", "§26",
+                 lambda root: route_flip_model(root, ordered=False),
+                 "counterexample", "invariant",
+                 ("begin", "flip_premature")),
+    # stale route write: without the epoch fence at the ingress, a
+    # supervisor that slept through kill_host's mint flips a route back
+    # to the dead machine after failover already moved the match
+    CatalogEntry("route-flip:stale-route-write", "§26",
+                 lambda root: route_flip_model(root, fenced=False),
+                 "counterexample", "invariant",
+                 ("host_die", "stale_write")),
 )
 
 _MACHINES_PATH = "ggrs_tpu/analysis/machines.py"

@@ -1073,6 +1073,247 @@ def drive_proc_fleet(
     return ctx
 
 
+def placement_match_builder(seed, me, peer_addr, viewer_addrs=(),
+                            desync_interval: int = 0):
+    """:func:`~ggrs_tpu.fleet.proc.proc_match_builder` plus real UDP
+    spectators — the fully-picklable match description the placement
+    chaos legs admit with (``viewer_addrs`` are the viewers' wire
+    source addresses, known before admission because the driver binds
+    their sockets first).  Picklable by reference like its proc sibling,
+    so the same description survives ``export_transfer`` bytes and
+    journal failover onto another supervisor."""
+    from .core.types import Spectator
+    from .fleet.proc import proc_match_builder
+
+    b = proc_match_builder(
+        seed, me, peer_addr, desync_interval=desync_interval)
+    for v, addr in enumerate(viewer_addrs):
+        b = b.add_player(Spectator(tuple(addr)), 2 + v)
+    return b
+
+
+def drive_placement_fleet(
+    ticks: int,
+    matches_per_host: int = 2,
+    seed: int = 0,
+    inject: Optional[Callable[[int, Dict[str, Any]], Any]] = None,
+    n_spectators: int = 0,
+    spectate_match: str = "m0",
+    tuning=None,
+    journal_dir=None,
+    checkpoint_every: int = 8,
+    desync_interval: int = 1,
+    capacity: int = 64,
+    metrics: Optional[Registry] = None,
+    tracer=None,
+) -> Dict[str, Any]:
+    """The cross-host chaos world (DESIGN.md §26): a
+    ``PlacementService`` fronting two single-shard ``ShardSupervisor``
+    "hosts" (``h0``/``h1``, sharing one journal directory — the shared
+    storage a real fleet mounts) behind one in-process ``IngressNode``
+    that owns every public address.  ``2 * matches_per_host`` journaled
+    2-peer matches, ``m0..`` pinned to ``h0`` and the rest to ``h1`` so
+    placement is identical across legs; every external peer (and every
+    ``n_spectators`` viewer of ``spectate_match``) talks ONLY to the
+    match's virtual endpoint — the ingress public address — over real
+    loopback UDP, and records its received bytes
+    (:class:`RecvRecordingSocket`) as the wire observable.
+
+    The tick order makes runs bit-identical for identical arguments
+    (loopback ``sendto`` is synchronous, so each pump sees exactly the
+    datagrams sent since the last one): peers/viewers advance → ingress
+    pump (peer → serving leg) → hosts tick → ingress pump (leg replies →
+    peers).  At ``inject`` time the legs' buffers are therefore EMPTY —
+    an in-tick ``ctx['placement'].migrate(mid)`` or
+    ``.kill_host('h1')`` strands no in-flight datagram, which is what
+    lets the migrated-leg wire compare bit-identical to control.
+
+    Callers MUST run ``ctx['close']()`` (tests do it in ``finally``);
+    on an exception mid-run the driver closes everything before
+    re-raising."""
+    import functools
+    import tempfile
+
+    from .core.errors import (
+        NotSynchronized,
+        PredictionThreshold,
+        SpectatorTooFarBehind,
+    )
+    from .fleet import PlacementService, ShardSupervisor
+    from .fleet.ingress import IngressNode
+    from .fleet.proc import set_runner_clock
+    from .net.sockets import UdpNonBlockingSocket
+
+    base = seed * 1000
+    clock = [0]
+    registry = metrics if metrics is not None else Registry()
+    if journal_dir is None:
+        journal_dir = tempfile.mkdtemp(prefix="ggrs_placement_")
+    hosts = {}
+    for hn, (hid, sid) in enumerate((("h0", "a0"), ("h1", "b0"))):
+        hosts[hid] = ShardSupervisor(
+            (sid,), capacity=capacity, metrics=registry,
+            journal_dir=journal_dir, checkpoint_every=checkpoint_every,
+            journal_tail_window=8 * checkpoint_every,
+            identity_refresh_every=4, seed=base + 1 + hn,
+            tuning=tuning, tracer=tracer,
+        )
+    ingress = IngressNode(metrics=registry, tuning=tuning)
+    placement = PlacementService(
+        hosts, ingress=ingress, tuning=tuning, metrics=registry)
+    public = ingress.public_addr()
+
+    n = 2 * matches_per_host
+    match_ids = [f"m{k}" for k in range(n)]
+    peers: Dict[str, Any] = {}
+    peer_socks: Dict[str, RecvRecordingSocket] = {}
+    games: Dict[str, CrcGame] = {}
+    peer_games: Dict[str, CrcGame] = {}
+    viewers: List[Any] = []
+    viewer_socks: List[Any] = []
+
+    def close_all() -> None:
+        placement.close()  # live hosts only; dead ones it left alone
+        for hid in placement._dead:
+            try:
+                hosts[hid].close()
+            except Exception:
+                pass
+        ingress.close()
+        for s in viewer_socks:
+            s.close()
+        for s in peer_socks.values():
+            s.close()
+
+    try:
+        for v in range(n_spectators):
+            vs = RecvRecordingSocket(UdpNonBlockingSocket(0))
+            viewer_socks.append(vs)
+        viewer_addrs = tuple(
+            ("127.0.0.1", vs.local_port()) for vs in viewer_socks)
+        for k, mid in enumerate(match_ids):
+            pin = "h0" if k < matches_per_host else "h1"
+            peer_sock = RecvRecordingSocket(UdpNonBlockingSocket(0))
+            peer_socks[mid] = peer_sock
+            peer_addr = ("127.0.0.1", peer_sock.local_port())
+            vaddrs = viewer_addrs if mid == spectate_match else ()
+            bf = functools.partial(
+                placement_match_builder, base + 3 + 7 * k, 0,
+                peer_addr, vaddrs, desync_interval=desync_interval,
+            )
+            placement.admit(
+                mid, bf, peer_addrs=(peer_addr,) + vaddrs,
+                state_template=0, game_factory=CrcGame, host=pin,
+            )
+            # the peer's whole world is the virtual endpoint: the
+            # ingress public address, never the serving leg's port
+            pb = two_peer_builder(
+                clock, base + 4 + 7 * k, 1, tuple(public),
+                other_handle=0,
+            )
+            if desync_interval:
+                pb = pb.with_desync_detection_mode(
+                    DesyncDetection.on(desync_interval)
+                )
+            peers[mid] = pb.start_p2p_session(peer_sock)
+            games[mid] = CrcGame()
+            peer_games[mid] = CrcGame()
+        for v, vs in enumerate(viewer_socks):
+            vb = (
+                SessionBuilder(Config.for_uint(16))
+                .with_clock(lambda: clock[0])
+                .with_rng(random.Random(base + 900 + v))
+            )
+            viewers.append(
+                vb.start_spectator_session(tuple(public), vs))
+
+        reqs_log: Dict[str, List] = {mid: [] for mid in match_ids}
+        host_events: Dict[str, List] = {mid: [] for mid in match_ids}
+        peer_events: Dict[str, List] = {mid: [] for mid in match_ids}
+        viewer_streams: List[List] = [[] for _ in viewers]
+
+        def sched(i, k):
+            return ((i + 2 * k) // (2 + k % 3)) % 16
+
+        ctx: Dict[str, Any] = dict(
+            placement=placement, ingress=ingress, hosts=hosts,
+            peers=peers, clock=clock, seed=seed, match_ids=match_ids,
+            journal_dir=journal_dir, close=close_all,
+        )
+        for i in range(ticks):
+            clock[0] += 16
+            set_runner_clock(clock[0])
+            if inject is not None:
+                inject(i, ctx)
+            for mid, peer in peers.items():
+                try:
+                    peer.add_local_input(1, (i * 5) % 16)
+                    peer_games[mid].fulfill(peer.advance_frame())
+                except (NotSynchronized, PredictionThreshold):
+                    pass  # host mid-transfer: backpressure, not a fault
+                peer_events[mid].extend(peer.events())
+            for v, viewer in enumerate(viewers):
+                try:
+                    for r in viewer.advance_frame():
+                        viewer_streams[v].append(
+                            (viewer.current_frame, tuple(r.inputs))
+                        )
+                except (NotSynchronized, PredictionThreshold,
+                        SpectatorTooFarBehind):
+                    pass
+            ingress.pump()  # peers/viewers -> serving legs
+            for k, mid in enumerate(match_ids):
+                if mid in placement.lost_matches():
+                    continue
+                placement.add_local_input(mid, 0, sched(i, k))
+            out = placement.advance_all()
+            for hout in out.values():
+                for mid, reqs in hout.items():
+                    games[mid].fulfill(reqs)
+                    reqs_log[mid].append(req_summary(reqs))
+            lost_now = placement.lost_matches()
+            for mid in match_ids:
+                if mid not in lost_now:
+                    host_events[mid].extend(placement.events(mid))
+            ingress.pump()  # leg replies -> peers/viewers
+    except BaseException:
+        close_all()
+        raise
+    lost = placement.lost_matches()
+    ctx.update(
+        wire={mid: list(s.received) for mid, s in peer_socks.items()},
+        reqs=reqs_log,
+        host_events=host_events,
+        peer_events=peer_events,
+        viewer_streams=viewer_streams,
+        viewer_wire=[list(s.received) for s in viewer_socks],
+        locations={
+            mid: (
+                None if mid in lost
+                else (placement.match_host(mid),
+                      hosts[placement.match_host(mid)].match_location(mid))
+            )
+            for mid in match_ids
+        },
+        vports={
+            mid: placement.virtual_endpoint(mid)[1] for mid in match_ids
+        },
+        public=tuple(public),
+        lost=lost,
+        frames={
+            mid: (None if mid in lost
+                  else placement.current_frame(mid))
+            for mid in match_ids
+        },
+        peer_frames={mid: p.current_frame for mid, p in peers.items()},
+        states={mid: games[mid].state for mid in match_ids},
+        peer_states={mid: g.state for mid, g in peer_games.items()},
+        healthz=placement.healthz(),
+        registry=registry,
+    )
+    return ctx
+
+
 def fleet_survivor_violations(
     chaos: Dict[str, Any],
     control: Dict[str, Any],

@@ -10,7 +10,15 @@ seam, **graceful drain** (admission off, migrate everything, retire), and
 Chaos coverage: ``scripts/chaos.py --fault shard``.
 """
 
+from .ingress import (
+    IngressHandle,
+    IngressNode,
+    IngressRunner,
+    VirtualEndpointSocket,
+    virtual_endpoint_socket,
+)
 from .placement import HashRing
+from .placement_service import PlacementService
 from .proc import ProcShard, ShardRunner, proc_match_builder, runner_clock
 from .rpc import (
     FrameError,
@@ -39,7 +47,11 @@ __all__ = [
     "FrameError",
     "HandshakeError",
     "HashRing",
+    "IngressHandle",
+    "IngressNode",
+    "IngressRunner",
     "MatchRecord",
+    "PlacementService",
     "PoolShard",
     "ProcShard",
     "RpcClosed",
@@ -55,6 +67,8 @@ __all__ = [
     "ShardLink",
     "ShardRunner",
     "ShardSupervisor",
+    "VirtualEndpointSocket",
     "proc_match_builder",
     "runner_clock",
+    "virtual_endpoint_socket",
 ]

@@ -656,6 +656,10 @@ def runner_main(argv: Optional[List[str]] = None) -> int:
                     help="dial a supervisor's authenticated TCP link "
                          "(multi-host runners, DESIGN.md §25); the "
                          "shared token rides GGRS_FLEET_LINK_AUTH_TOKEN")
+    ap.add_argument("--ingress", action="store_true",
+                    help="serve the ingress role (DESIGN.md §26): a "
+                         "virtual-endpoint forwarding dataplane instead "
+                         "of a PoolShard, same RPC/heartbeat plumbing")
     args = ap.parse_args(argv)
     if sum(a is not None for a in (args.fd, args.uds, args.tcp)) != 1:
         ap.error("exactly one of --fd / --uds / --tcp is required")
@@ -687,6 +691,13 @@ def runner_main(argv: Optional[List[str]] = None) -> int:
         except (HandshakeError, OSError) as e:
             _logger.error("runner: TCP link handshake failed: %s", e)
             return 1
+    if args.ingress:
+        # imported here, not at module top: ingress.py imports this
+        # module (ShardRunner is its base), so the role dispatch must
+        # not close the cycle at import time
+        from .ingress import IngressRunner
+
+        return IngressRunner(RpcConn(sock), link=link).serve()
     return ShardRunner(RpcConn(sock), link=link).serve()
 
 

@@ -738,17 +738,31 @@ class PoolShard:
         return (session._sync_layer.saved_states,
                 session._sync_layer.last_confirmed_frame)
 
-    def _maybe_checkpoint(self) -> None:
+    def checkpoint_now(self, match_id: str) -> None:
+        """Append a state checkpoint for one match NOW, cadence aside —
+        the cross-host export seam (DESIGN.md §26) calls this before a
+        journal-path transfer so the resume window always holds a fresh
+        checkpoint (and the fast-forward prelude stays one save long)
+        even when the match is younger than ``checkpoint_every``.  Same
+        safety condition as the cadence path: runs between ticks, from
+        last tick's fully fulfilled save cells."""
+        self._maybe_checkpoint(only=match_id, force=True)
+
+    def _maybe_checkpoint(self, only: Optional[str] = None,
+                          force: bool = False) -> None:
         every = self.checkpoint_every
-        if not every:
+        if not every and not force:
             return
         for match_id, journal in self._journals.items():
+            if only is not None and match_id != only:
+                continue
             if match_id in self._ckpt_disabled:
                 continue
             saved, confirmed = self._saved_and_confirmed(match_id)
             if saved is None or confirmed is None or confirmed < 0:
                 continue
-            if confirmed < self._ckpt_next.get(match_id, every):
+            if not force and confirmed < self._ckpt_next.get(
+                    match_id, every):
                 continue
             # the newest committed frame whose save the game fulfilled
             # (the same two-candidate rule the resume selection uses)

@@ -797,6 +797,176 @@ class ShardSupervisor:
         ) from cause
 
     # ------------------------------------------------------------------
+    # cross-host transfer (DESIGN.md §26)
+    # ------------------------------------------------------------------
+
+    def match_port(self, match_id: str) -> Optional[int]:
+        """The UDP port the match's host-side socket bound (the leg the
+        ingress routes to), when determinable — the placement service
+        reads it after every adoption to aim the route flip."""
+        record = self._records[match_id]
+        if record.location is None:
+            return None
+        return self.shards[record.location].match_port(match_id)
+
+    def record_meta(self, match_id: str) -> Dict[str, Any]:
+        """The match's durable control-plane description as one
+        picklable dict — everything :meth:`adopt_from_meta` needs to
+        journal-failover the match onto ANOTHER supervisor after this
+        whole host dies.  The placement service snapshots it every tick
+        (cheap: references, not copies), which is exactly the metadata
+        replication a real deployment would do."""
+        record = self._records[match_id]
+        return dict(
+            match_id=record.match_id,
+            builder_factory=record.builder_factory,
+            socket_factory=record.socket_factory,
+            game_factory=record.game_factory,
+            state_template=record.state_template,
+            journaled=record.journaled,
+            journal_failed=record.journal_failed,
+            incarnation=record.incarnation,
+            journal_paths=list(record.journal_paths),
+            identity=record.identity,
+            num_players=record.num_players,
+            input_size=record.input_size,
+            max_prediction=record.max_prediction,
+            local_handles=list(record.local_handles),
+        )
+
+    def _record_from_meta(self, meta: Dict[str, Any]) -> MatchRecord:
+        record = MatchRecord(
+            meta["match_id"], meta["builder_factory"],
+            meta["socket_factory"], meta["state_template"],
+            game_factory=meta["game_factory"],
+        )
+        record.journaled = bool(meta["journaled"])
+        record.journal_failed = bool(meta["journal_failed"])
+        record.incarnation = int(meta["incarnation"])
+        record.journal_paths = list(meta["journal_paths"])
+        record.identity = meta["identity"]
+        record.num_players = meta["num_players"]
+        record.input_size = meta["input_size"]
+        record.max_prediction = meta["max_prediction"]
+        record.local_handles = list(meta["local_handles"])
+        if record.journaled and self.journal_dir is None:
+            raise FleetError(
+                "cannot adopt a journaled match: this supervisor has "
+                "no journal_dir for the next incarnation"
+            )
+        return record
+
+    def export_transfer(self, match_id: str) -> Dict[str, Any]:
+        """The source half of CROSS-HOST migration: release the match
+        here and return ONE picklable transfer blob — record metadata
+        plus the adoption materials (live harvest bundle when the source
+        shard can export natively, else the journal-rebuilt bundle with
+        its fast-forward prelude).  The caller ships the blob to the
+        target host's :meth:`adopt_transfer`; the match stops being
+        tracked by this supervisor the moment this returns."""
+        record = self._records[match_id]
+        if record.lost is not None or record.location is None:
+            raise FleetError(f"match {match_id!r} is not serving")
+        src = self.shards[record.location]
+        record.identity = src.wire_identity(match_id)
+        bundle = None
+        saved = prelude = replay_local = None
+        if src.is_bank_match(match_id):
+            try:
+                bundle = src.evict_match(match_id)
+            except InvalidRequest:
+                if not record.journaled:
+                    raise FleetError(
+                        f"match {match_id!r}: source shard cannot "
+                        "export natively and the match is not journaled"
+                    )
+        if bundle is None:
+            if not record.journaled:
+                raise FleetError(
+                    f"adopted match {match_id!r} has no journal to "
+                    "transfer through"
+                )
+            # freshen the journal checkpoint first (cadence aside): the
+            # resume window then always holds one, and the target's
+            # fast-forward prelude is as short as the journal allows
+            if hasattr(src, "checkpoint_now"):
+                src.checkpoint_now(match_id)
+            src.drop_match(match_id, reason="exported off-host")
+            bundle, saved, prelude, replay_local = (
+                self._resume_materials(record)
+            )
+        meta = self.record_meta(match_id)
+        del self._records[match_id]
+        self._update_match_gauge()
+        return dict(
+            version=1, meta=meta, bundle=bundle, saved_states=saved,
+            prelude=prelude, replay_local=replay_local,
+        )
+
+    def adopt_transfer(self, match_id: str, blob: Dict[str, Any], *,
+                       shard: Optional[str] = None) -> str:
+        """The target half of cross-host migration: register the
+        transferred match and adopt it on ``shard`` (or the first
+        accepting shard on the preference walk).  On failure nothing is
+        left half-tracked — the record is unwound and the caller still
+        holds the blob (re-adoptable on the source, or recoverable from
+        the journal)."""
+        if match_id in self._records:
+            raise InvalidRequest(f"match {match_id!r} already admitted")
+        meta = blob["meta"]
+        if meta["match_id"] != match_id:
+            raise InvalidRequest(
+                f"transfer blob is for {meta['match_id']!r}, "
+                f"not {match_id!r}"
+            )
+        record = self._record_from_meta(meta)
+        if shard is None:
+            for sid in self._candidate_shards(match_id):
+                cand = self.shards[sid]
+                if cand.state == SHARD_DEAD or cand.killed:
+                    continue
+                if self._placement_refusal(cand, record) is None:
+                    shard = sid
+                    break
+            if shard is None:
+                raise FleetError("no shard accepts the transfer")
+        self._records[match_id] = record
+        try:
+            self._adopt_on(
+                self.shards[shard], record, blob["bundle"],
+                saved_states=blob["saved_states"],
+                prelude=blob["prelude"],
+                replay_local=blob["replay_local"],
+            )
+        except Exception:
+            del self._records[match_id]
+            raise
+        record.location = shard
+        self._m_admissions.labels(tier="transfer").inc()
+        self._update_match_gauge()
+        return shard
+
+    def adopt_from_meta(self, meta: Dict[str, Any], *,
+                        shard: Optional[str] = None) -> str:
+        """Journal failover ACROSS hosts: rebuild a dead machine's match
+        on THIS supervisor from replicated record metadata alone — the
+        durable journal (shared storage) plus the cached wire identity
+        are all that is assumed to survive the machine."""
+        match_id = meta["match_id"]
+        if match_id in self._records:
+            raise InvalidRequest(f"match {match_id!r} already admitted")
+        record = self._record_from_meta(meta)
+        self._records[match_id] = record
+        try:
+            dst = self._readopt_from_journal(record, shard)
+        except Exception:
+            del self._records[match_id]
+            raise
+        self._m_admissions.labels(tier="transfer").inc()
+        self._update_match_gauge()
+        return dst
+
+    # ------------------------------------------------------------------
     # graceful drain
     # ------------------------------------------------------------------
 
@@ -938,14 +1108,15 @@ class ShardSupervisor:
                 _logger.info("parked failover of %s recovered", match_id)
             self._update_match_gauge()
 
-    def _readopt_from_journal(self, record: MatchRecord,
-                              dst_shard: Optional[str] = None,
-                              exclude: Optional[str] = None) -> str:
-        """Rebuild one match from its durable journal alone and adopt it
-        on ``dst_shard`` (or the first accepting survivor): load the
-        newest in-window checkpoint, fast-forward to the last durable
-        frame through a request prelude the game fulfills, resume the
-        wire from the synthesized harvest + cached identity."""
+    def _resume_materials(self, record: MatchRecord):
+        """Rebuild one match's adoption materials from its durable
+        journal alone — ``(bundle, saved_states, prelude, replay_local)``
+        — without placing it anywhere: load the newest in-window
+        checkpoint, fast-forward to the last durable frame through a
+        request prelude the game fulfills, resume the wire from the
+        synthesized harvest + cached identity.  Shared by same-host
+        journal failover (:meth:`_readopt_from_journal`) and the
+        cross-host transfer seam (:meth:`export_transfer`)."""
         from ..broadcast.journal import resume_from_file
         from ..utils.checkpoint import loads_pytree
 
@@ -1040,6 +1211,17 @@ class ShardSupervisor:
             f: {h: decode(p) for h, p in per_handle.items()}
             for f, per_handle in res["local_tail"].items()
         }
+        return bundle, saved, prelude, replay_local
+
+    def _readopt_from_journal(self, record: MatchRecord,
+                              dst_shard: Optional[str] = None,
+                              exclude: Optional[str] = None) -> str:
+        """Rebuild one match from its durable journal alone
+        (:meth:`_resume_materials`) and adopt it on ``dst_shard`` (or
+        the first accepting survivor)."""
+        bundle, saved, prelude, replay_local = (
+            self._resume_materials(record)
+        )
         if dst_shard is None:
             for sid in self._candidate_shards(
                 record.match_id, exclude=exclude

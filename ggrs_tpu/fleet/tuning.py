@@ -109,6 +109,15 @@ class FleetTuning:
     # refusal lands, so the port frees within a handshake round trip
     failover_retry_s: float = 2.0
 
+    # --- ingress & placement plane (DESIGN.md §26) ---
+    # max dataplane idle before the ingress runner's serving loop runs a
+    # forwarding pump cycle anyway (select() already wakes on traffic;
+    # this bounds how stale the obs mirrors can get while idle)
+    ingress_select_timeout_s: float = 0.05
+    # placement refuses a host whose merged fleet-obs p99 tick latency
+    # exceeds this budget, in milliseconds; 0 disables the p99 gate
+    placement_p99_budget_ms: float = 0.0
+
     def __post_init__(self) -> None:
         for f in dataclasses.fields(self):
             v = getattr(self, f.name)

@@ -576,6 +576,14 @@ class DispatchSocket:
         out, self._pending = self._pending, []
         return out
 
+    def take_pending(self) -> List[Tuple[Tuple[str, int], bytes]]:
+        """Hand over what a prior ``hub.drain()`` already bucketed here
+        WITHOUT re-draining the hub — the ingress forwarding pump drains
+        once per cycle and then collects every view (one drain sweep for
+        N virtual endpoints, not N sweeps)."""
+        out, self._pending = self._pending, []
+        return out
+
     def close(self) -> None:
         # the hub owns the fds; a single slot closing must not kill the
         # co-tenants.  Claims are released so late datagrams count as

@@ -55,7 +55,10 @@ Fault classes (all driven through the pool's real tick path):
                 counted without touching the served link, a SIGKILLed
                 runner journal-fails-over bit-identically to control,
                 and a runner resurrected after its window expired is
-                fenced at handshake by the bumped epoch and exits
+                fenced at handshake by the bumped epoch and exits;
+                ends with a cross-host placement leg (DESIGN.md §26):
+                killing a whole host fails every match over to the
+                survivor host behind UNCHANGED virtual endpoints
   shard         fleet leg (DESIGN.md §16): a two-shard ShardSupervisor
                 (B = --fleet-matches journaled matches per shard, default
                 32) runs three scenarios — kill-a-shard (every affected
@@ -1074,6 +1077,12 @@ def verify_net_leg(matches_per_shard: int, ticks: int, seed: int,
       fenced rather than signalled, and when the old runner RESURRECTS
       it must be refused at handshake (HS_REFUSED_FENCE) and exit of
       its own accord.
+    - ``net_placement_host_kill``: the §26 placement plane — kill one
+      of two HOSTS behind the ingress; every match journal-fails-over
+      cross-host onto the survivor, the route epoch is minted past the
+      dead host, the ingress flips every affected route, and players +
+      viewers keep streaming on the SAME virtual endpoints with the
+      untouched host bit-identical to a fault-free control.
     """
     import os
     import signal
@@ -1358,11 +1367,111 @@ def verify_net_leg(matches_per_shard: int, ticks: int, seed: int,
         "refusals": refusals, "runner_exit": exit_code,
         "tuning": tuning.as_dict(),
     })
+
+    # 6. cross-host placement (DESIGN.md §26): kill a whole HOST of the
+    # two-host placement fleet mid-traffic — every match on it must
+    # journal-fail-over ACROSS hosts onto the survivor while players and
+    # viewers keep talking to the SAME virtual endpoints (the ingress
+    # flips routes; no client ever re-addresses), the untouched host's
+    # matches bit-identical to a fault-free control, zero orphans
+    from ggrs_tpu.chaos import drive_placement_fleet
+
+    pp = min(p, 2)
+    pticks = max(32, min(ticks, 48))
+    kill_tick = pticks // 2
+    spectate = f"m{pp}"  # a viewer ON the doomed host's match
+    p_control = drive_placement_fleet(
+        pticks, matches_per_host=pp, seed=seed, n_spectators=2,
+        spectate_match=spectate,
+    )
+    p_control["close"]()
+
+    def kill_h1(i, ctx):
+        if i == kill_tick:
+            ctx["placement"].kill_host("h1")
+
+    chaos = drive_placement_fleet(
+        pticks, matches_per_host=pp, seed=seed, n_spectators=2,
+        spectate_match=spectate, inject=kill_h1,
+    )
+    chaos["close"]()
+    h0_matches = [f"m{k}" for k in range(pp)]
+    h1_matches = [f"m{k}" for k in range(pp, 2 * pp)]
+    violations = fleet_survivor_violations(chaos, p_control, h0_matches)
+    violations += fleet_recovery_violations(chaos, h1_matches)
+    for mid in h1_matches:
+        loc = chaos["locations"][mid]
+        if loc is None or loc[0] == "h1":
+            violations.append(f"{mid}: not failed over cross-host ({loc})")
+    # the public contract: virtual endpoints NEVER change — same vport
+    # per match as the fault-free control, peers/viewers never re-aim
+    if chaos["vports"] != p_control["vports"]:
+        violations.append(
+            f"virtual endpoints changed across the host kill: "
+            f"{chaos['vports']} vs control {p_control['vports']}"
+        )
+    hz = chaos["healthz"]
+    if (hz.get("route_epoch") or 0) < 2:
+        violations.append(
+            f"route epoch {hz.get('route_epoch')} not minted past the "
+            "dead host (a stale h1 write could still flip a route)"
+        )
+    flips = int(
+        chaos["registry"].value("ggrs_ingress_route_flips_total") or 0
+    )
+    if flips < len(h1_matches):
+        violations.append(
+            f"{flips} ingress route flips < {len(h1_matches)} failovers"
+        )
+    failovers = int(
+        chaos["registry"].value("ggrs_placement_host_failovers_total") or 0
+    )
+    if failovers != len(h1_matches):
+        violations.append(
+            f"{failovers} host failovers != {len(h1_matches)} affected"
+        )
+    for v, stream in enumerate(chaos["viewer_streams"]):
+        frames = [f for f, _ in stream]
+        if frames != sorted(set(frames)):
+            violations.append(f"viewer {v} stream reset/regressed")
+        if not frames or frames[-1] < kill_tick + 4:
+            violations.append(
+                f"viewer {v} stalled at {frames[-1] if frames else None} "
+                "after the host kill"
+            )
+    print(f"  [net_placement_host_kill] h1 killed @tick {kill_tick}: "
+          f"{sum(1 for m in h1_matches if chaos['locations'][m] and chaos['locations'][m][0] != 'h1')}"
+          f"/{len(h1_matches)} matches failed over cross-host, "
+          f"route_epoch={hz.get('route_epoch')} flips={flips} "
+          f"viewers at {[s[-1][0] if s else None for s in chaos['viewer_streams']]}")
+    _write_artifact(artifact_dir, "net_placement_host_kill", {
+        "scenario": "net_placement_host_kill",
+        "verdict": "PASS" if not violations else "FAIL",
+        "violations": violations,
+        "matches_per_host": pp,
+        "ticks": pticks,
+        "locations": {m: list(v) if v else None
+                      for m, v in chaos["locations"].items()},
+        "vports": chaos["vports"],
+        "lost": chaos["lost"],
+        "route_epoch": hz.get("route_epoch"),
+        "flips": flips,
+        "failovers": failovers,
+        "healthz": {k: v for k, v in hz.items() if k != "shards"},
+        "metrics": json_snapshot(chaos["registry"]),
+    })
+    if violations:
+        print("  NET_PLACEMENT_HOST_KILL VIOLATED:")
+        for v in violations:
+            print(f"    {v}")
+        ok = False
+
     if ok:
         print(f"  OK: {p}-per-shard TCP fleet resumed severed links "
               "with zero failovers, shrugged off hostile dribble, "
-              "failed over a killed host bit-identically, and fenced "
-              "a resurrected stale runner")
+              "failed over a killed host bit-identically, fenced a "
+              "resurrected stale runner, and failed a dead HOST over "
+              "cross-host behind unchanged virtual endpoints")
     return ok
 
 

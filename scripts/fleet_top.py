@@ -9,7 +9,9 @@ place:
 
 - the fleet header: tick, overall verdict, matches placed/pending/lost;
 - one row per shard: backend, lifecycle state, matches (bank/adopted),
-  heartbeat age, watchdog stage, restarts, tick p99;
+  heartbeat age, watchdog stage, restarts, ingress routes terminating
+  on the shard (when a §26 placement healthz is being rendered), tick
+  p99;
 - per-shard span-phase p99s estimated from the harvested
   ``ggrs_fleet_span_seconds{shard,name}`` histogram — the "which phase
   eats the budget" view ROADMAP item 3 wants;
@@ -120,7 +122,7 @@ def render(healthz: Dict[str, Any], metrics: Dict[str, Any],
     header = (
         f"{'SHARD':<10} {'BACKEND':<8} {'STATE':<9} {'OK':<3} "
         f"{'MATCHES':<9} {'HB AGE':<8} {'WATCHDOG':<11} {'RST':<4} "
-        f"{'LINK':<14} {'P99 MS':<8}"
+        f"{'LINK':<14} {'INGRESS':<8} {'P99 MS':<8}"
     )
     lines.append(header)
     lines.append("-" * len(header))
@@ -148,6 +150,7 @@ def render(healthz: Dict[str, Any], metrics: Dict[str, Any],
             f"{p.get('watchdog', h.get('watchdog', '-')) or '-':<11} "
             f"{str(p.get('restarts', h.get('restarts', 0))):<4} "
             f"{link_col:<14} "
+            f"{str(h.get('ingress_routes', '-')):<8} "
             f"{_fmt_ms(h.get('tick_p99_ms')):<8}"
         )
     p99s = _span_p99s(metrics)
@@ -160,6 +163,23 @@ def render(healthz: Dict[str, Any], metrics: Dict[str, Any],
                 for name, p99, _count in p99s[shard][:phases_per_shard]
             )
             lines.append(f"  {shard:<10} {tops}")
+    ing = healthz.get("ingress")
+    if ing:
+        lines.append("")
+        fwd = sum(ing.get("forwarded", {}).values())
+        dropped = sum(ing.get("dropped", {}).values())
+        lines.append(
+            "ingress {}: public={} routes={} flips={} fwd={} "
+            "dropped={} route_epoch={}".format(
+                ing.get("name", "?"),
+                ":".join(str(p) for p in ing.get("public", ())) or "-",
+                ing.get("routes", 0),
+                ing.get("flips", 0),
+                fwd,
+                dropped,
+                healthz.get("route_epoch", "-"),
+            )
+        )
     lines.append("")
     lines.append(
         "fleet: admissions={} migrations={} failovers={} lost={} | "

@@ -2,6 +2,9 @@
 (parity with /root/reference/src/network/compression.rs:188-231)."""
 
 import pytest
+
+pytest.importorskip("hypothesis")  # fuzz-only dep: absent on lean CI images
+
 from hypothesis import example, given, settings
 from hypothesis import strategies as st
 

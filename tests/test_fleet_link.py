@@ -95,11 +95,13 @@ class TestTcpSpawn:
 
     def test_sever_resumes_with_zero_failovers(self, shard):
         shard.chaos_sever_link()
-        # the whole excursion must stay failover-free: expect=(None,)
+        # the whole excursion must stay failover-free: expect=(None,).
+        # On loopback the redial can land before a poll observes the
+        # transient "reconnecting" state, so wait for the resume itself
+        # (reconnects counter), not for the transient.
         _poll_until(shard,
-                    lambda s: s.link_info()["state"] == LINK_RECONNECTING)
-        assert shard.watchdog_stage() == "reconnecting"
-        _poll_until(shard, lambda s: s.link_info()["state"] == LINK_UP)
+                    lambda s: s.link_info()["reconnects"] >= 1
+                    and s.link_info()["state"] == LINK_UP)
         info = shard.link_info()
         assert info["reconnects"] == 1 and info["window_expiries"] == 0
         assert info["epoch"] == 1  # same incarnation, same token

@@ -1,0 +1,411 @@
+"""The ingress plane (DESIGN.md §26): stable virtual match endpoints.
+
+Three layers, mirroring the module:
+
+- the wire codec — ``FWD_HEADER`` / ``ROUTE_UPDATE`` pack/unpack and the
+  ``WireError`` refusal matrix (one decoder judges the RPC op and the
+  in-process path alike);
+- the in-process :class:`IngressNode` dataplane — forwarding through a
+  claimed virtual endpoint over real loopback UDP, the route fence
+  (stale-epoch / stale-version refusals that survive DEL), and the
+  dataplane fence (only the CURRENT route's leg may speak as the
+  endpoint; unclaimed peers never hear replies);
+- :class:`VirtualEndpointSocket` — the serving-host leg wraps/unwraps
+  transparently, and an end-to-end adopted ``shard_runner.py --ingress
+  --tcp`` serves the same control surface over the §25 link.
+
+The cross-host failover/migration scenarios behind the ingress live in
+tests/test_placement.py and scripts/chaos.py --fault net.
+"""
+
+from __future__ import annotations
+
+import socket
+import time
+
+import pytest
+
+from ggrs_tpu.core.errors import InvalidRequest
+from ggrs_tpu.fleet import FleetTuning
+from ggrs_tpu.fleet.ingress import (
+    FWD_HEADER,
+    FWD_VERSION,
+    INGRESS_MAGIC,
+    IngressHandle,
+    IngressNode,
+    ROUTE_OP_DEL,
+    ROUTE_OP_PUT,
+    ROUTE_UPDATE,
+    ROUTE_WIRE_VERSION,
+    VirtualEndpointSocket,
+    decode_route_update,
+    encode_route_update,
+    pack_fwd,
+    unpack_fwd,
+)
+from ggrs_tpu.net.wire import WireError
+from ggrs_tpu.obs import Registry
+
+
+# ----------------------------------------------------------------------
+# the wire codec
+# ----------------------------------------------------------------------
+
+
+class TestRouteUpdateCodec:
+    def test_round_trip_put(self):
+        data = encode_route_update(
+            ROUTE_OP_PUT, 3, 17, 9, ("127.0.0.1", 40001))
+        assert len(data) == ROUTE_UPDATE.size == 28
+        op, epoch, version, vport, dst = decode_route_update(data)
+        assert (op, epoch, version, vport) == (ROUTE_OP_PUT, 3, 17, 9)
+        assert dst == ("127.0.0.1", 40001)
+
+    def test_round_trip_del(self):
+        data = encode_route_update(
+            ROUTE_OP_DEL, 1, 2, 5, ("10.0.0.7", 0))
+        op, epoch, version, vport, dst = decode_route_update(data)
+        assert op == ROUTE_OP_DEL and dst == ("10.0.0.7", 0)
+
+    def test_short_frame_refused(self):
+        with pytest.raises(WireError, match="bytes"):
+            decode_route_update(b"GI\x01\x01")
+
+    def test_bad_magic_refused(self):
+        data = bytearray(encode_route_update(
+            ROUTE_OP_PUT, 1, 1, 1, ("127.0.0.1", 1)))
+        data[:2] = b"XX"
+        with pytest.raises(WireError, match="magic"):
+            decode_route_update(bytes(data))
+
+    def test_unknown_version_refused(self):
+        data = bytearray(encode_route_update(
+            ROUTE_OP_PUT, 1, 1, 1, ("127.0.0.1", 1)))
+        data[2] = ROUTE_WIRE_VERSION + 1
+        with pytest.raises(WireError, match="version"):
+            decode_route_update(bytes(data))
+
+    def test_unknown_op_refused(self):
+        data = bytearray(encode_route_update(
+            ROUTE_OP_PUT, 1, 1, 1, ("127.0.0.1", 1)))
+        data[3] = 9
+        with pytest.raises(WireError, match="op"):
+            decode_route_update(bytes(data))
+
+
+class TestFwdCodec:
+    def test_round_trip(self):
+        data = pack_fwd(7, ("192.168.1.20", 5555), b"payload!")
+        assert data[:FWD_HEADER.size] == FWD_HEADER.pack(
+            INGRESS_MAGIC, FWD_VERSION, 0, 7, 5555,
+            socket.inet_aton("192.168.1.20"))
+        vport, peer, payload = unpack_fwd(data)
+        assert vport == 7
+        assert peer == ("192.168.1.20", 5555)
+        assert payload == b"payload!"
+
+    def test_empty_payload(self):
+        vport, peer, payload = unpack_fwd(pack_fwd(1, ("1.2.3.4", 9), b""))
+        assert payload == b""
+
+    def test_short_frame_refused(self):
+        with pytest.raises(WireError, match="short"):
+            unpack_fwd(b"GI\x01")
+
+    def test_bad_magic_refused(self):
+        data = b"XY" + pack_fwd(1, ("1.2.3.4", 9), b"x")[2:]
+        with pytest.raises(WireError, match="magic"):
+            unpack_fwd(data)
+
+    def test_unknown_version_refused(self):
+        data = bytearray(pack_fwd(1, ("1.2.3.4", 9), b"x"))
+        data[2] = FWD_VERSION + 1
+        with pytest.raises(WireError, match="version"):
+            unpack_fwd(bytes(data))
+
+
+# ----------------------------------------------------------------------
+# the in-process dataplane
+# ----------------------------------------------------------------------
+
+
+def _udp(port: int = 0) -> socket.socket:
+    s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    s.bind(("127.0.0.1", port))
+    s.setblocking(False)
+    return s
+
+
+def _recv(sock: socket.socket, timeout: float = 2.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            return sock.recvfrom(65535)
+        except BlockingIOError:
+            time.sleep(0.002)
+    raise AssertionError("no datagram arrived")
+
+
+@pytest.fixture
+def node():
+    n = IngressNode(metrics=Registry())
+    yield n
+    n.close()
+
+
+def _route(node, vport, leg_addr, epoch=1, version=None, op=ROUTE_OP_PUT):
+    if version is None:
+        _route.v += 1
+        version = _route.v
+    return node.apply_route_update(
+        encode_route_update(op, epoch, version, vport, leg_addr))
+
+
+_route.v = 0
+
+
+class TestIngressNodeForwarding:
+    def test_forwarded_round_trip(self, node):
+        peer, leg = _udp(), _udp()
+        try:
+            vport = node.allocate_endpoint(peers=[peer.getsockname()])
+            assert _route(node, vport, leg.getsockname()) == "ok"
+            # inbound: peer -> public port -> FWD-framed to the leg
+            peer.sendto(b"hello-in", node.public_addr())
+            node.pump()
+            data, src = _recv(leg)
+            got_vport, got_peer, payload = unpack_fwd(data)
+            assert got_vport == vport
+            assert got_peer == peer.getsockname()
+            assert payload == b"hello-in"
+            # outbound: leg reply -> uplink -> peer, FROM the public
+            # address (the stable-endpoint contract)
+            leg.sendto(pack_fwd(vport, got_peer, b"hello-out"), src)
+            node.pump()
+            data, reply_src = _recv(peer)
+            assert data == b"hello-out"
+            assert reply_src == node.public_addr()
+            assert node.forwarded == {"in": 1, "out": 1}
+        finally:
+            peer.close()
+            leg.close()
+
+    def test_unrouted_vport_drops(self, node):
+        peer = _udp()
+        try:
+            node.allocate_endpoint(peers=[peer.getsockname()])
+            peer.sendto(b"early", node.public_addr())
+            node.pump()
+            assert node.dropped.get("no-route") == 1
+        finally:
+            peer.close()
+
+    def test_fenced_sender_cannot_speak(self, node):
+        """After a route flip the OLD leg's replies are dropped: only
+        the current route's registered address may speak as the
+        endpoint — a fenced incarnation still breathing stays mute."""
+        peer, old_leg, new_leg = _udp(), _udp(), _udp()
+        try:
+            vport = node.allocate_endpoint(peers=[peer.getsockname()])
+            assert _route(node, vport, old_leg.getsockname()) == "ok"
+            assert _route(node, vport, new_leg.getsockname()) == "ok"
+            assert node.flips == 1
+            old_leg.sendto(
+                pack_fwd(vport, peer.getsockname(), b"stale!"),
+                node.uplink_addr())
+            node.pump()
+            assert node.dropped.get("fenced-sender") == 1
+            with pytest.raises(AssertionError):
+                _recv(peer, timeout=0.05)
+        finally:
+            peer.close()
+            old_leg.close()
+            new_leg.close()
+
+    def test_unclaimed_peer_never_hears(self, node):
+        leg, stranger = _udp(), _udp()
+        try:
+            vport = node.allocate_endpoint()
+            assert _route(node, vport, leg.getsockname()) == "ok"
+            leg.sendto(
+                pack_fwd(vport, stranger.getsockname(), b"psst"),
+                node.uplink_addr())
+            node.pump()
+            assert node.dropped.get("unclaimed-peer") == 1
+        finally:
+            leg.close()
+            stranger.close()
+
+    def test_claim_unknown_vport_refused(self, node):
+        with pytest.raises(InvalidRequest, match="no virtual endpoint"):
+            node.claim_peers(42, [("127.0.0.1", 1)])
+
+
+class TestRouteFence:
+    def test_stale_epoch_refused(self, node):
+        leg = ("127.0.0.1", 40000)
+        vport = node.allocate_endpoint()
+        assert _route(node, vport, leg, epoch=2) == "ok"
+        assert _route(node, vport, ("127.0.0.1", 40001),
+                      epoch=1) == "stale-epoch"
+        assert node._routes[vport].dst == leg
+
+    def test_stale_version_refused(self, node):
+        vport = node.allocate_endpoint()
+        assert _route(node, vport, ("127.0.0.1", 40000),
+                      epoch=1, version=5) == "ok"
+        assert _route(node, vport, ("127.0.0.1", 40001),
+                      epoch=1, version=5) == "stale-version"
+        assert _route(node, vport, ("127.0.0.1", 40001),
+                      epoch=1, version=4) == "stale-version"
+        # strictly newer wins (same epoch)
+        assert _route(node, vport, ("127.0.0.1", 40001),
+                      epoch=1, version=6) == "ok"
+
+    def test_fence_survives_delete(self, node):
+        """A late PUT from a dead epoch stays refused even after its
+        route was deleted — the floor outlives the entry."""
+        vport = node.allocate_endpoint()
+        assert _route(node, vport, ("127.0.0.1", 40000),
+                      epoch=2, version=10) == "ok"
+        assert _route(node, vport, ("127.0.0.1", 40000),
+                      epoch=2, version=11, op=ROUTE_OP_DEL) == "ok"
+        assert vport not in node._routes
+        assert _route(node, vport, ("127.0.0.1", 40666),
+                      epoch=1, version=99) == "stale-epoch"
+        assert vport not in node._routes
+
+    def test_unknown_vport_and_garbage(self, node):
+        assert _route(node, 777, ("127.0.0.1", 1)) == "unknown-vport"
+        assert node.apply_route_update(b"junk") == "bad-frame"
+        assert node.route_updates == {"unknown-vport": 1, "bad-frame": 1}
+
+    def test_verdicts_counted(self, node):
+        vport = node.allocate_endpoint()
+        _route(node, vport, ("127.0.0.1", 40000), epoch=2)
+        _route(node, vport, ("127.0.0.1", 40001), epoch=1)
+        reg = node.metrics
+        assert reg.value("ggrs_ingress_route_updates_total",
+                         verdict="ok") == 1
+        assert reg.value("ggrs_ingress_route_updates_total",
+                         verdict="stale-epoch") == 1
+
+
+# ----------------------------------------------------------------------
+# the serving-host leg
+# ----------------------------------------------------------------------
+
+
+class TestVirtualEndpointSocket:
+    def test_wraps_and_unwraps(self):
+        uplink = _udp()
+        try:
+            up_host, up_port = uplink.getsockname()
+            leg = VirtualEndpointSocket(up_host, up_port, vport=5)
+            try:
+                peer = ("203.0.113.9", 7777)
+                leg.send_datagram(b"to-peer", peer)
+                data, src = _recv(uplink)
+                assert unpack_fwd(data) == (5, peer, b"to-peer")
+                assert src[1] == leg.local_port()
+                # inbound: only FWD frames from the uplink, our vport
+                uplink.sendto(pack_fwd(5, peer, b"from-peer"),
+                              ("127.0.0.1", leg.local_port()))
+                uplink.sendto(pack_fwd(6, peer, b"wrong-vport"),
+                              ("127.0.0.1", leg.local_port()))
+                deadline = time.monotonic() + 2.0
+                got = []
+                while not got and time.monotonic() < deadline:
+                    got = leg.receive_all_datagrams()
+                assert got == [(peer, b"from-peer")]
+            finally:
+                leg.close()
+        finally:
+            uplink.close()
+
+    def test_batch_send(self):
+        uplink = _udp()
+        try:
+            up_host, up_port = uplink.getsockname()
+            leg = VirtualEndpointSocket(up_host, up_port, vport=3)
+            try:
+                leg.send_datagram_batch([
+                    (b"a", ("1.2.3.4", 10)), (b"b", ("1.2.3.4", 11)),
+                ])
+                seen = {unpack_fwd(_recv(uplink)[0]) for _ in range(2)}
+                assert seen == {
+                    (3, ("1.2.3.4", 10), b"a"), (3, ("1.2.3.4", 11), b"b"),
+                }
+            finally:
+                leg.close()
+        finally:
+            uplink.close()
+
+    def test_stranger_datagrams_ignored(self):
+        uplink, stranger = _udp(), _udp()
+        try:
+            up_host, up_port = uplink.getsockname()
+            leg = VirtualEndpointSocket(up_host, up_port, vport=1)
+            try:
+                stranger.sendto(pack_fwd(1, ("1.2.3.4", 9), b"forged"),
+                                ("127.0.0.1", leg.local_port()))
+                time.sleep(0.05)
+                assert leg.receive_all_datagrams() == []
+            finally:
+                leg.close()
+        finally:
+            uplink.close()
+            stranger.close()
+
+
+# ----------------------------------------------------------------------
+# end to end: an adopted ingress runner over the §25 TCP link
+# ----------------------------------------------------------------------
+
+
+class TestIngressRunnerE2E:
+    def test_spawned_runner_serves_control_and_dataplane(self):
+        tuning = FleetTuning(
+            heartbeat_interval_s=0.05, heartbeat_deadline_s=1.0,
+            rpc_timeout_s=5.0, spawn_timeout_s=120.0,
+            drain_deadline_s=2.0,
+            link_auth_token="ingress-e2e-token",
+            link_reconnect_window_s=2.0, link_backoff_s=0.01,
+            link_handshake_timeout_s=1.0,
+        )
+        handle = IngressHandle("ing0", tuning=tuning, metrics=Registry(),
+                               spawn_child=True)
+        peer = leg = None
+        try:
+            hello = handle.adopt()
+            assert hello["role"] == "ingress"
+            public = handle.public_addr()
+            uplink = handle.uplink_addr()
+            assert public is not None and uplink is not None
+            peer, leg = _udp(), _udp()
+            vport = handle.allocate_endpoint(peers=[peer.getsockname()])
+            assert handle.apply_route_update(encode_route_update(
+                ROUTE_OP_PUT, 1, 1, vport, leg.getsockname())) == "ok"
+            # the dataplane lives in the CHILD's select loop: no local
+            # pump — the forwarded frame just arrives
+            peer.sendto(b"over-the-wall", tuple(public))
+            data, src = _recv(leg, timeout=10.0)
+            got_vport, got_peer, payload = unpack_fwd(data)
+            assert (got_vport, payload) == (vport, b"over-the-wall")
+            leg.sendto(pack_fwd(vport, got_peer, b"and-back"), src)
+            data, reply_src = _recv(peer, timeout=10.0)
+            assert data == b"and-back"
+            assert reply_src == tuple(public)
+            # the fence judges identically over RPC
+            assert handle.apply_route_update(encode_route_update(
+                ROUTE_OP_PUT, 0, 99, vport,
+                leg.getsockname())) == "stale-epoch"
+            info = handle.info()
+            assert info["routes"] == 1
+            assert info["forwarded"]["in"] >= 1
+            assert info["forwarded"]["out"] >= 1
+        finally:
+            for s in (peer, leg):
+                if s is not None:
+                    s.close()
+            handle.close()

@@ -1,6 +1,9 @@
 """Wire message round-trip and hardening tests."""
 
 import pytest
+
+pytest.importorskip("hypothesis")  # fuzz-only dep: absent on lean CI images
+
 from hypothesis import example, given, settings
 from hypothesis import strategies as st
 

@@ -1,0 +1,358 @@
+"""The placement plane (DESIGN.md §26): multi-supervisor scheduling,
+cross-host live migration, and host-death journal failover — all behind
+virtual endpoints that NEVER change.
+
+Two layers:
+
+- scheduling/refusal unit tests over stub hosts (the ring walk, the
+  capacity/p99 refusal matrix, the kill_host epoch mint);
+- the cross-host chaos world (``drive_placement_fleet``: two real
+  ``ShardSupervisor`` hosts sharing a journal directory behind one
+  ``IngressNode``, external peers + viewers on real loopback UDP) run
+  three ways — fault-free control, live migration, host kill — and
+  compared.  The acceptance contracts mirror tests/test_fleet.py's §16
+  migration contract, one level up:
+
+  * survivors (matches on the untouched host) bit-identical to control:
+    peer-received wire bytes, request streams, events, placement;
+  * the migrated/failed-over match: peer sees a retransmission hiccup,
+    never a reset — no Disconnected, no DesyncDetected (per-frame
+    checksum exchange is ON), bounded frame lag — and its journal
+    streams are bit-identical across incarnations and to the control
+    prefix;
+  * the virtual endpoint is the SAME (public address, vport) before and
+    after — nothing public ever re-addresses.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+from pathlib import Path
+
+import pytest
+
+from ggrs_tpu.broadcast.journal import read_journal
+from ggrs_tpu.chaos import (
+    drive_placement_fleet,
+    fleet_recovery_violations,
+    fleet_survivor_violations,
+)
+from ggrs_tpu.core.errors import InvalidRequest
+from ggrs_tpu.fleet import FleetTuning, PlacementService
+from ggrs_tpu.fleet.ingress import IngressNode
+from ggrs_tpu.fleet.supervisor import FleetError
+from ggrs_tpu.obs import Registry, json_snapshot
+
+TICKS = 48
+SEED = 11
+MIGRATE_AT = 10
+KILL_AT = 14
+H0_MATCHES = ["m0", "m1"]
+H1_MATCHES = ["m2", "m3"]
+WORLD = dict(
+    matches_per_host=2, seed=SEED, n_spectators=2, spectate_match="m2",
+)
+
+
+# ----------------------------------------------------------------------
+# scheduling + refusal (stub hosts: no ticking, just the policy)
+# ----------------------------------------------------------------------
+
+
+class _StubShard:
+    def __init__(self, refusal=None):
+        self._refusal = refusal
+
+    def admission_refusal(self):
+        return self._refusal
+
+
+class _StubHost:
+    def __init__(self, refusal=None, p99=None):
+        self.shards = {"s0": _StubShard(refusal)}
+        self._p99 = p99
+
+    def healthz(self):
+        return {"shards": {"s0": {"tick_p99_ms": self._p99}}}
+
+
+def _service(hosts, **kw):
+    return PlacementService(hosts, ingress=object(),
+                            metrics=Registry(), **kw)
+
+
+class TestScheduling:
+    def test_ring_walk_skips_refusing_hosts(self):
+        svc = _service({"h0": _StubHost(refusal="full"),
+                        "h1": _StubHost()})
+        for mid in ("a", "b", "c", "z9"):
+            assert svc.choose_host(mid) == "h1"
+        assert svc.metrics.value("ggrs_placement_refusals_total",
+                                 reason="full") >= 1
+
+    def test_dead_host_never_chosen(self):
+        svc = _service({"h0": _StubHost(), "h1": _StubHost()})
+        svc.kill_host("h0")
+        assert svc.host_refusal("h0") == "dead"
+        for mid in ("a", "b", "c"):
+            assert svc.choose_host(mid) == "h1"
+
+    def test_p99_budget_refuses_overloaded(self):
+        tuning = FleetTuning(placement_p99_budget_ms=5.0)
+        svc = _service({"h0": _StubHost(p99=50.0),
+                        "h1": _StubHost(p99=1.0)}, tuning=tuning)
+        assert svc.host_refusal("h0") == "overloaded"
+        assert svc.host_refusal("h1") is None
+        for mid in ("a", "b", "c"):
+            assert svc.choose_host(mid) == "h1"
+
+    def test_no_host_accepts_raises(self):
+        svc = _service({"h0": _StubHost(refusal="full"),
+                        "h1": _StubHost(refusal="full")})
+        with pytest.raises(FleetError, match="no host accepts"):
+            svc.choose_host("m0")
+
+    def test_kill_host_mints_route_epoch(self):
+        svc = _service({"h0": _StubHost(), "h1": _StubHost()})
+        assert svc.route_epoch == 1
+        svc.kill_host("h1")
+        assert svc.route_epoch == 2
+        svc.kill_host("h1")  # idempotent: no double mint
+        assert svc.route_epoch == 2
+        svc.kill_host("h0")
+        assert svc.route_epoch == 3
+        assert svc.metrics.value("ggrs_placement_hosts",
+                                 state="dead") == 2
+
+    def test_needs_at_least_one_host(self):
+        with pytest.raises(InvalidRequest, match="at least one host"):
+            _service({})
+
+    def test_stale_supervisor_route_refused_at_ingress(self):
+        """The §26 fence end to end in miniature: a route signed with
+        the pre-kill epoch is refused once kill_host has minted — the
+        exact counterexample route-flip:stale-route-write pins."""
+        from ggrs_tpu.fleet.ingress import ROUTE_OP_PUT, encode_route_update
+
+        node = IngressNode(metrics=Registry())
+        try:
+            svc = _service({"h0": _StubHost(), "h1": _StubHost()})
+            vport = node.allocate_endpoint()
+            stale_epoch = svc.route_epoch
+            svc.kill_host("h1")
+            assert node.apply_route_update(encode_route_update(
+                ROUTE_OP_PUT, svc.route_epoch, 1, vport,
+                ("127.0.0.1", 40000))) == "ok"
+            assert node.apply_route_update(encode_route_update(
+                ROUTE_OP_PUT, stale_epoch, 2, vport,
+                ("127.0.0.1", 40666))) == "stale-epoch"
+        finally:
+            node.close()
+
+
+# ----------------------------------------------------------------------
+# the cross-host chaos world
+# ----------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def control():
+    ctx = drive_placement_fleet(TICKS, **WORLD)
+    ctx["close"]()
+    return ctx
+
+
+@pytest.fixture(scope="module")
+def migrated():
+    endpoints = {}
+
+    def inject(i, ctx):
+        if i == MIGRATE_AT:
+            endpoints["before"] = ctx["placement"].virtual_endpoint("m2")
+            ctx["placement"].migrate("m2", "h0")
+            endpoints["after"] = ctx["placement"].virtual_endpoint("m2")
+
+    ctx = drive_placement_fleet(TICKS, inject=inject, **WORLD)
+    ctx["close"]()
+    ctx["endpoints"] = endpoints
+    return ctx
+
+
+@pytest.fixture(scope="module")
+def host_killed():
+    def inject(i, ctx):
+        if i == KILL_AT:
+            ctx["placement"].kill_host("h1")
+
+    ctx = drive_placement_fleet(TICKS, inject=inject, **WORLD)
+    ctx["close"]()
+    return ctx
+
+
+class TestControlWorld:
+    def test_all_matches_live_and_placed(self, control):
+        assert not control["lost"]
+        assert control["healthz"]["ok"]
+        for mid in H0_MATCHES:
+            assert control["locations"][mid] == ("h0", "a0")
+        for mid in H1_MATCHES:
+            assert control["locations"][mid] == ("h1", "b0")
+        for mid, frame in control["frames"].items():
+            assert frame == TICKS, mid
+
+    def test_peers_and_viewers_stream_through_ingress(self, control):
+        # every peer byte arrived FROM the public address (the recv
+        # recorder would have captured any other source)
+        for mid, wire in control["wire"].items():
+            assert wire, f"{mid}: peer heard nothing"
+        for stream in control["viewer_streams"]:
+            frames = [f for f, _ in stream]
+            assert frames == sorted(set(frames))
+            assert frames[-1] >= TICKS - 4
+
+    def test_fault_free_world_is_clean(self, control):
+        # interval-1 checksum exchange is ON: any host/peer divergence
+        # would surface as DesyncDetected within one frame
+        assert fleet_recovery_violations(
+            control, control["match_ids"]) == []
+
+
+class TestLiveMigrationCrossHost:
+    """tests/test_fleet.py's §16 migration contract, one level up: the
+    move crosses SUPERVISORS (export → pickle bytes → adopt → route
+    flip) and the public endpoint provably never changes."""
+
+    def test_match_moved_cross_host(self, migrated):
+        assert migrated["locations"]["m2"] == ("h0", "a0")
+        assert not migrated["lost"]
+        assert migrated["registry"].value(
+            "ggrs_placement_migrations_total", reason="manual") == 1
+        assert migrated["registry"].value(
+            "ggrs_ingress_route_flips_total") == 1
+
+    def test_survivors_bit_identical_to_control(self, migrated, control):
+        assert fleet_survivor_violations(
+            migrated, control, ["m0", "m1", "m3"]) == []
+        for mid in ("m0", "m1", "m3"):
+            assert migrated["states"][mid] == control["states"][mid]
+            assert (migrated["peer_states"][mid]
+                    == control["peer_states"][mid])
+
+    def test_peer_sees_hiccup_never_reset(self, migrated):
+        """No Disconnected, no DesyncDetected (interval-1 checksums are
+        ON: any state divergence would trip within a frame), bounded
+        catch-up lag."""
+        assert fleet_recovery_violations(migrated, ["m2"]) == []
+        lag = migrated["peer_frames"]["m2"] - migrated["frames"]["m2"]
+        assert 0 <= lag <= 8
+
+    def test_virtual_endpoint_unchanged(self, migrated, control):
+        before = migrated["endpoints"]["before"]
+        after = migrated["endpoints"]["after"]
+        assert before == after  # the whole point of the plane
+        assert after == (migrated["public"], migrated["vports"]["m2"])
+        assert migrated["vports"] == control["vports"]
+
+    def test_journal_streams_survive_the_move(self, migrated, control):
+        """The durable artifact across incarnations: the source's file
+        and the adopter's file agree record for record on every frame
+        both hold, and the leg's merged stream is bit-identical to the
+        control journal for every frame up to the export tip."""
+        c = read_journal(
+            os.path.join(control["journal_dir"], "m2.000.ggjl"))
+        inc0 = read_journal(
+            os.path.join(migrated["journal_dir"], "m2.000.ggjl"))
+        inc1 = read_journal(
+            os.path.join(migrated["journal_dir"], "m2.001.ggjl"))
+        assert inc0["frames"] and inc1["frames"]
+        d_control = {f: rec for f, *rec in c["frames"]}
+        d0 = {f: rec for f, *rec in inc0["frames"]}
+        d1 = {f: rec for f, *rec in inc1["frames"]}
+        overlap = set(d0) & set(d1)
+        assert overlap, "incarnations share no frames (no overlap proof)"
+        for f in overlap:
+            assert d0[f] == d1[f], f"frame {f} differs across incarnations"
+        tip = max(d0)
+        merged = dict(d0)
+        merged.update(d1)
+        for f in range(tip + 1):
+            assert merged[f] == d_control[f], \
+                f"frame {f} differs from control before the export tip"
+        # the adopter resumed from a checkpoint, not frame 0
+        assert inc1["checkpoints"]
+
+    def test_viewers_follow_the_move(self, migrated):
+        for v, stream in enumerate(migrated["viewer_streams"]):
+            frames = [f for f, _ in stream]
+            assert frames == sorted(set(frames)), f"viewer {v} reset"
+            assert frames[-1] >= MIGRATE_AT + 16, f"viewer {v} stalled"
+
+
+class TestHostKillFailover:
+    """A whole machine dies: both its matches journal-fail-over ACROSS
+    hosts from replicated meta + shared-storage journals, the route
+    epoch fences the dead incarnation, and nothing public changes."""
+
+    def test_matches_failed_over_cross_host(self, host_killed):
+        assert not host_killed["lost"]
+        for mid in H1_MATCHES:
+            assert host_killed["locations"][mid] == ("h0", "a0")
+        assert host_killed["registry"].value(
+            "ggrs_placement_host_failovers_total") == 2
+        assert host_killed["registry"].value(
+            "ggrs_ingress_route_flips_total") == 2
+
+    def test_survivors_bit_identical_to_control(self, host_killed,
+                                                control):
+        assert fleet_survivor_violations(
+            host_killed, control, H0_MATCHES) == []
+
+    def test_failed_over_matches_recovered_clean(self, host_killed):
+        assert fleet_recovery_violations(host_killed, H1_MATCHES) == []
+        for mid in H1_MATCHES:
+            lag = (host_killed["peer_frames"][mid]
+                   - host_killed["frames"][mid])
+            assert 0 <= lag <= 12, f"{mid}: lag {lag}"
+
+    def test_route_epoch_fences_dead_host(self, host_killed):
+        hz = host_killed["healthz"]
+        assert hz["route_epoch"] == 2
+        assert hz["hosts"]["h1"] == {"ok": False, "state": "dead"}
+        assert hz["shards"]["h1/b0"]["state"] == "dead"
+        assert hz["ok"]  # nothing lost, the survivor serves everything
+
+    def test_virtual_endpoints_unchanged(self, host_killed, control):
+        assert host_killed["vports"] == control["vports"]
+
+    def test_viewers_ride_through_the_host_kill(self, host_killed):
+        # the viewers watch m2 — a match ON the killed host
+        for v, stream in enumerate(host_killed["viewer_streams"]):
+            frames = [f for f, _ in stream]
+            assert frames == sorted(set(frames)), f"viewer {v} reset"
+            assert frames[-1] >= KILL_AT + 12, f"viewer {v} stalled"
+
+
+# ----------------------------------------------------------------------
+# obs: the placement healthz renders in fleet_top
+# ----------------------------------------------------------------------
+
+
+class TestFleetTopPlacement:
+    def test_render_placement_healthz(self, host_killed):
+        spec = importlib.util.spec_from_file_location(
+            "fleet_top",
+            Path(__file__).resolve().parents[1] / "scripts"
+            / "fleet_top.py",
+        )
+        fleet_top = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(fleet_top)
+        frame = fleet_top.render(
+            host_killed["healthz"], json_snapshot(host_killed["registry"]))
+        assert "INGRESS" in frame
+        assert "h0/a0" in frame and "h1/b0" in frame
+        assert "ingress ingress:" in frame
+        assert "route_epoch=2" in frame
+        # the survivor host's shard carries every live route
+        assert host_killed["healthz"]["shards"]["h0/a0"][
+            "ingress_routes"] == 4

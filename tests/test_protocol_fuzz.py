@@ -15,6 +15,9 @@ from __future__ import annotations
 import random
 
 import pytest
+
+pytest.importorskip("hypothesis")  # fuzz-only dep: absent on lean CI images
+
 from hypothesis import HealthCheck, given, settings
 from hypothesis import strategies as st
 

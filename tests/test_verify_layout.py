@@ -552,3 +552,80 @@ class TestTcpHandshakeSkew:
         assert transport.AUTH.format == TCP_AUTH_FMT
         assert transport.VERDICT.format == TCP_VERDICT_FMT
         assert transport.AUTH.size == transport.AUTH_PREFIX.size + 32
+
+
+# ----------------------------------------------------------------------
+# §26 ingress wire contract (same PR 11 rule: wire structs land with
+# their checker — deliberate-skew fixtures prove the checker catches
+# drift in the forwarded-datagram header and route-update frame)
+# ----------------------------------------------------------------------
+
+ING_GOOD = """\
+import struct
+FWD_VERSION = 1
+ROUTE_WIRE_VERSION = 1
+ROUTE_OP_PUT = 1
+ROUTE_OP_DEL = 2
+FWD_HEADER = struct.Struct("<2sBBHH4s")
+ROUTE_UPDATE = struct.Struct("<2sBBQQHH4s")
+"""
+
+
+class TestIngressWireSkew:
+    def _tree(self, tmp_path, text):
+        (tmp_path / "ggrs_tpu/fleet").mkdir(parents=True)
+        (tmp_path / "ggrs_tpu/fleet/ingress.py").write_text(text)
+        return tmp_path
+
+    def _check(self, root):
+        from ggrs_tpu.analysis.layout import _check_ingress_wire
+        return _check_ingress_wire(root)
+
+    def test_clean_fixture_passes(self, tmp_path):
+        assert self._check(self._tree(tmp_path, ING_GOOD)) == []
+
+    def test_fence_word_drift_fires(self, tmp_path):
+        # shrinking the route epoch from u64 to u32 must fire: a
+        # truncated epoch is exactly the fence-defeating skew that
+        # would let a stale supervisor's route write wrap around
+        bad = ING_GOOD.replace('"<2sBBQQHH4s"', '"<2sBBIQHH4s"')
+        findings = self._check(self._tree(tmp_path, bad))
+        assert any(
+            f.rule == "layout/ingress-wire" and "route-update" in f.detail
+            for f in findings
+        )
+
+    def test_fwd_header_drift_fires(self, tmp_path):
+        # dropping the source-port word breaks peer-return routing
+        bad = ING_GOOD.replace('"<2sBBHH4s"', '"<2sBBH4s"')
+        findings = self._check(self._tree(tmp_path, bad))
+        assert any(
+            f.rule == "layout/ingress-wire"
+            and "forwarded-datagram" in f.detail
+            for f in findings
+        )
+
+    def test_unversioned_route_frame_fires(self, tmp_path):
+        bad = ING_GOOD.replace("ROUTE_WIRE_VERSION = 1\n", "")
+        findings = self._check(self._tree(tmp_path, bad))
+        assert any("ROUTE_WIRE_VERSION" in f.detail for f in findings)
+
+    def test_route_op_drift_fires(self, tmp_path):
+        # the decode path refuses everything outside PUT=1/DEL=2; an
+        # opcode renumber silently turns deletes into puts on old nodes
+        bad = ING_GOOD.replace("ROUTE_OP_DEL = 2", "ROUTE_OP_DEL = 3")
+        findings = self._check(self._tree(tmp_path, bad))
+        assert any("route ops" in f.detail for f in findings)
+
+    def test_contract_matches_live_structs(self):
+        from ggrs_tpu.analysis.layout import (
+            ING_FENCE_BYTES,
+            ING_FWD_FMT,
+            ING_ROUTE_FMT,
+        )
+        from ggrs_tpu.fleet import ingress
+
+        assert ingress.FWD_HEADER.format == ING_FWD_FMT
+        assert ingress.ROUTE_UPDATE.format == ING_ROUTE_FMT
+        assert (ingress.ROUTE_UPDATE.size
+                == ingress.FWD_HEADER.size + ING_FENCE_BYTES)
