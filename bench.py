@@ -151,6 +151,14 @@ CONFIGS = {
         "run_decode_parallel", 900,
         {"GGRS_BENCH_PLATFORM": "cpu"},
     ),
+    # the input plane (DESIGN.md §27): B=256 pooled matches with fixed
+    # 4-byte uint inputs vs variable-size command records in the varrec
+    # envelope — host tick p99 and wire bytes/tick, payload-vs-envelope
+    # accounting, native engagement named per leg
+    "input_plane": (
+        "run_input_plane", 900,
+        {"GGRS_BENCH_PLATFORM": "cpu"},
+    ),
     "flagship": ("run_flagship", 900),
 }
 
@@ -2855,6 +2863,151 @@ def _forward_child_lines(name: str, parsed: list, skipped: bool) -> bool:
     if skipped and not parsed:
         sys.stderr.write(f"bench config {name!r} skipped by design\n")
     return bool(parsed) or skipped
+
+
+def run_input_plane() -> None:
+    """The input plane (DESIGN.md §27): B=256 pooled matches with fixed
+    4-byte uint inputs vs variable-size RTS command records in the varrec
+    envelope — host-loop tick p99 and wire bytes per tick.
+
+    Both peers of every match live in ONE HostSessionPool (2B sessions)
+    over one in-memory network whose delivery hook counts every payload
+    byte; fulfillment is frame-as-state, so the number prices the host
+    input/wire plane, not device fulfillment.  The varrec leg checks the
+    §27 claim that variable-size records stay native-bank eligible (the
+    unit string names native on/off per leg), and the byte accounting
+    splits live payload bytes from envelope capacity — the headroom a
+    length-aware wire codec could reclaim."""
+    import random
+
+    from ggrs_tpu.core import Config, Local, Remote
+    from ggrs_tpu.games import RtsCmd, encode_commands
+    from ggrs_tpu.net import InMemoryNetwork
+    from ggrs_tpu.parallel import HostSessionPool
+    from ggrs_tpu.sessions import SessionBuilder
+
+    B = 256
+    T = 300
+    CYCLE = 64  # precomputed schedule window; rng stays out of the timing
+    frame_budget_ms = 1000.0 / 60.0
+    rts = RtsCmd(num_players=2, num_units=4, max_cmds=4)
+
+    def _cmds(rng) -> tuple:
+        cmds = []
+        for _ in range(rng.randrange(0, 4)):
+            kind = rng.randrange(3)
+            if kind == 0:
+                cmds.append(("move", rng.randrange(4),
+                             rng.randrange(-2, 3), rng.randrange(-2, 3)))
+            elif kind == 1:
+                cmds.append(("gather", rng.randrange(4)))
+            else:
+                cmds.append(("build", rng.randrange(16), rng.randrange(16)))
+        return tuple(cmds)
+
+    def leg(kind: str):
+        wire = [0]
+        net = InMemoryNetwork()
+        orig_send = net._send
+
+        def counted(src, dst, payload):
+            wire[0] += len(payload)
+            orig_send(src, dst, payload)
+
+        net._send = counted
+        host = HostSessionPool()
+        for m in range(B):
+            names = (f"A{m}", f"B{m}")
+            for me in (0, 1):
+                cfg = Config.for_uint(32) if kind == "fixed4" else rts.config()
+                b = (
+                    SessionBuilder(cfg)
+                    .with_clock(lambda: 0)
+                    .with_rng(random.Random(3 + 5 * m + me))
+                    .add_player(Local(), me)
+                    .add_player(Remote(names[1 - me]), 1 - me)
+                )
+                host.add_session(b, net.socket(names[me]))
+        n = len(host)
+        state = [0] * n
+
+        # per-session CYCLE-long schedules, plus the live payload bytes each
+        # tick of the cycle contributes (pre-envelope — what the game sent)
+        if kind == "fixed4":
+            sched = [
+                [((i + h) * 2654435761) & 0xFFFFFFFF for i in range(CYCLE)]
+                for h in range(n)
+            ]
+            pay_per_tick = 4.0 * n
+        else:
+            sched = [
+                [_cmds(random.Random(17 + h * 613 + i)) for i in range(CYCLE)]
+                for h in range(n)
+            ]
+            pay_per_tick = (
+                sum(
+                    len(encode_commands(c)) for row in sched for c in row
+                ) / CYCLE
+            )
+
+        def tick(i: int) -> float:
+            j = i % CYCLE
+            t0 = time.perf_counter()
+            for h in range(n):
+                host.add_local_input(h, h & 1, sched[h][j])
+            for h, reqs in enumerate(host.advance_all()):
+                for r in reqs:
+                    k = type(r).__name__
+                    if k == "SaveGameState":
+                        r.cell.save(r.frame, state[h], None)
+                    elif k == "LoadGameState":
+                        state[h] = r.cell.data()
+            return (time.perf_counter() - t0) * 1e3
+
+        for i in range(16):  # pipeline fill
+            tick(i)
+        enter_honest_timing_mode()
+        best = None
+        base = 16
+        for _ in range(REPEATS):
+            wire[0] = 0
+            ms = np.empty(T)
+            for i in range(T):
+                ms[i] = tick(base + i)
+            base += T
+            p50 = float(np.percentile(ms, 50))
+            p99 = float(np.percentile(ms, 99))
+            if best is None or p99 < best[0]:
+                best = (p99, p50, wire[0] / T)
+        return best, pay_per_tick, host.native_active
+
+    (fp99, fp50, fwire), fpay, f_native = leg("fixed4")
+    (vp99, vp50, vwire), vpay, v_native = leg("varrec")
+    env = rts.config().native_input_size  # [u16 len][payload][pad]
+
+    emit(
+        "input_plane_fixed4_b256_tick_ms_p99", fp99,
+        f"ms/tick p99, host loop, B={B} matches ({2 * B} pooled sessions), "
+        f"4-byte uint inputs, native {'on' if f_native else 'OFF'} "
+        f"(p50 {fp50:.2f} ms)",
+        frame_budget_ms / fp99 if fp99 else 0.0,
+    )
+    emit(
+        "input_plane_varrec_b256_tick_ms_p99", vp99,
+        f"ms/tick p99, host loop, B={B} matches, RTS command records in the "
+        f"{env}-byte varrec envelope, native {'on' if v_native else 'OFF'} "
+        f"(p50 {vp50:.2f} ms; fixed-4 leg {fp99:.2f} ms, "
+        f"{vp99 / fp99 if fp99 else 0.0:.2f}x)",
+        frame_budget_ms / vp99 if vp99 else 0.0,
+    )
+    emit(
+        "input_plane_varrec_wire_bytes_per_tick", vwire,
+        f"bytes/tick on the wire, B={B} ({vwire / B:.0f} B/match/tick; live "
+        f"payload {vpay:.0f} B/tick = {vpay / vwire if vwire else 0.0:.1%} "
+        f"of wire — the rest is the fixed {env}-byte envelope + protocol "
+        f"framing; fixed-4 leg {fwire:.0f} B/tick)",
+        fwire / vwire if vwire else 0.0,
+    )
 
 
 def orchestrate() -> None:

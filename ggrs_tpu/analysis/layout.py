@@ -92,6 +92,14 @@ ING_FENCE_BYTES = 16  # epoch u64 + route-version u64
 # i64 disconnect_frame.
 HARVEST_PREFIX_FMT = "<qqq"
 
+# §27 variable-size input envelope (core/varrec.py): every record is
+# framed [u16 payload_len LE][payload][zero pad] into a fixed
+# ``capacity + VARREC_HEADER_BYTES`` blob — the shape that keeps serde
+# inputs eligible for the native bank/journal/wire fast paths.
+VARREC_HEADER_FMT = "<H"
+VARREC_HEADER_BYTES = 2
+VARREC_MAX_CAPACITY = 0xFFFF
+
 # ---- descriptor plane (DESIGN.md §21) -----------------------------------
 # Batched input-staging record (ggrs_bank_stage_inputs / kStageStride ↔
 # _native.BANK_STAGE_FIELDS): the contract both sides are checked against.
@@ -776,6 +784,53 @@ def _check_stat_tables(root: Path) -> List[Finding]:
     return out
 
 
+def _check_varrec(root: Path) -> List[Finding]:
+    """The §27 variable-size input envelope vs core/varrec.py: the u16
+    length prefix is packed/unpacked with the declared format, the
+    statically-visible header width equals the contract (and the
+    contract's own fmt computes it), the capacity bound matches, and the
+    device-side consumer (games/rtscmd.py's in-kernel envelope decode)
+    derives its header offset from the shared constant, not a literal
+    that can drift."""
+    out: List[Finding] = []
+    vr = root / "ggrs_tpu/core/varrec.py"
+    fmts = {f.fmt for f in parse_py_struct_formats(vr)}
+    if VARREC_HEADER_FMT not in fmts:
+        out.append(Finding(
+            "layout/varrec-header", "ggrs_tpu/core/varrec.py", 0,
+            f"envelope length prefix {VARREC_HEADER_FMT!r} not found "
+            "(pack/unpack drifted from the §27 contract?)",
+        ))
+    consts = parse_py_constants(vr)
+    if consts.get("VARREC_HEADER_BYTES") != VARREC_HEADER_BYTES:
+        out.append(Finding(
+            "layout/varrec-header", "ggrs_tpu/core/varrec.py", 0,
+            f"VARREC_HEADER_BYTES = {consts.get('VARREC_HEADER_BYTES')!r} "
+            f"but the §27 contract says {VARREC_HEADER_BYTES}",
+        ))
+    if struct.calcsize(VARREC_HEADER_FMT) != VARREC_HEADER_BYTES:
+        out.append(Finding(
+            "layout/varrec-header", "ggrs_tpu/analysis/layout.py", 0,
+            f"contract fmt {VARREC_HEADER_FMT!r} is not "
+            f"{VARREC_HEADER_BYTES} bytes (the contract itself skewed)",
+        ))
+    if consts.get("VARREC_MAX_CAPACITY") != VARREC_MAX_CAPACITY:
+        out.append(Finding(
+            "layout/varrec-capacity", "ggrs_tpu/core/varrec.py", 0,
+            f"VARREC_MAX_CAPACITY = {consts.get('VARREC_MAX_CAPACITY')!r} "
+            f"but the u16 length prefix bounds it at "
+            f"{VARREC_MAX_CAPACITY}",
+        ))
+    rts = root / "ggrs_tpu/games/rtscmd.py"
+    if rts.exists() and "VARREC_HEADER_BYTES" not in rts.read_text():
+        out.append(Finding(
+            "layout/varrec-consumer", "ggrs_tpu/games/rtscmd.py", 0,
+            "device-side envelope decode does not reference "
+            "VARREC_HEADER_BYTES (header offset drifted to a literal?)",
+        ))
+    return out
+
+
 def check_layout(
     root: Path,
     mirrors: Sequence[Tuple[str, str, str, str]] = MIRRORED_CONSTANTS,
@@ -793,4 +848,5 @@ def check_layout(
     findings += _check_tcp_handshake(root)
     findings += _check_ingress_wire(root)
     findings += _check_stat_tables(root)
+    findings += _check_varrec(root)
     return findings

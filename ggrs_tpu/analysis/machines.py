@@ -144,6 +144,11 @@ def supervision_model(root: Path) -> Model:
                lambda s: SlotS("dead", 0)),
         Action("retire_match", lambda s: s.state in ("native", "evicted"),
                lambda s: SlotS("dead", 0)),
+        # load-shed demotion (§27): a healthy bank-resident slot is moved
+        # onto a per-session lockstep fallback — same destination state
+        # as eviction, but from NATIVE, without a fault or quarantine
+        Action("demote", lambda s: s.state == "native",
+               lambda s: SlotS("evicted", 0)),
         Action("migrate",
                lambda s: s.state in ("native", "quarantined", "evicted"),
                lambda s: SlotS("migrated", 0)),
@@ -154,6 +159,7 @@ def supervision_model(root: Path) -> Model:
         "evict_fail": [("quarantined", "dead")],
         "evicted_fault": [("evicted", "dead")],
         "retire_match": [("native", "dead"), ("evicted", "dead")],
+        "demote": [("native", "evicted")],
         "migrate": [("native", "migrated"), ("quarantined", "migrated"),
                     ("evicted", "migrated")],
     })
@@ -266,6 +272,81 @@ def checkpoint_order_model(order: str = "head") -> Model:
         progress=(
             Progress("checkpoint-durable", lambda s: s.ckpt == "ok"),
         ),
+    )
+
+
+# ----------------------------------------------------------------------
+# §27: the lockstep tier (max_prediction == 0)
+# ----------------------------------------------------------------------
+
+# frame horizon for the lockstep model's state space: the invariants are
+# about the ORDER of confirm vs advance, not frame magnitude
+LOCKSTEP_HORIZON = 3
+
+
+class LsS(NamedTuple):
+    current: int     # the frame the session is about to simulate
+    confirmed: int   # the confirmed-frame watermark (-1 = none yet)
+    saves: int       # SaveGameState requests emitted
+    loads: int       # LoadGameState requests emitted
+
+
+def lockstep_model(mode: str = "head") -> Model:
+    """The §27 lockstep tier (``max_prediction == 0``) as a model —
+    modeled BEFORE the pool demotion path was wired, per the §22 rule.
+
+    HEAD has exactly two moves: a remote confirmation raises the
+    watermark, and the session advances only when the current frame is
+    fully confirmed (``P2PSession`` lockstep gate: ``last_confirmed ==
+    current``).  The invariants are the tier's contract: zero
+    SaveGameState/LoadGameState ever, and the simulation never runs past
+    the confirmed frontier.  The ``predictive-advance`` fixture adds the
+    one move a rollback-tier session performs routinely — advancing on a
+    predicted (unconfirmed) frame — and must counterexample immediately:
+    prediction IS the thing lockstep removes."""
+    if mode not in ("head", "predictive-advance"):
+        raise ModelError(f"unknown lockstep mode {mode!r}")
+    actions = [
+        # a remote input completes the current frame's confirmation
+        Action("confirm_frame",
+               lambda s: s.confirmed < s.current
+               and s.confirmed < LOCKSTEP_HORIZON,
+               lambda s: s._replace(confirmed=s.confirmed + 1)),
+        # the lockstep advance gate: confirmed-frames-only
+        Action("advance_confirmed",
+               lambda s: s.confirmed == s.current
+               and s.current < LOCKSTEP_HORIZON,
+               lambda s: s._replace(current=s.current + 1)),
+    ]
+    if mode == "predictive-advance":
+        actions.append(Action(
+            "advance_predicted",
+            lambda s: s.current > s.confirmed
+            and s.current < LOCKSTEP_HORIZON,
+            lambda s: s._replace(current=s.current + 1),
+        ))
+    return Model(
+        f"lockstep:{mode}",
+        LsS(0, -1, 0, 0),
+        tuple(actions),
+        invariants=(
+            # the tier's defining contract: no state ring at all
+            Invariant("never-saves", lambda s: s.saves == 0),
+            Invariant("never-loads", lambda s: s.loads == 0),
+            # at most the in-flight current frame ahead of the watermark
+            Invariant("never-past-confirmed-frontier",
+                      lambda s: s.current <= s.confirmed + 1),
+        ),
+        progress=(
+            # confirmations always unblock the match: the full horizon
+            # stays reachable from every state
+            Progress("match-advances",
+                     lambda s: s.current == LOCKSTEP_HORIZON),
+        ),
+        # the bounded horizon's end state is the declared finish line,
+        # not a stall
+        terminal=lambda s: s.current == LOCKSTEP_HORIZON
+        and s.confirmed == LOCKSTEP_HORIZON,
     )
 
 
@@ -768,6 +849,15 @@ MODEL_CATALOG: Tuple[CatalogEntry, ...] = (
                  lambda root: checkpoint_order_model("pre-pr11"),
                  "counterexample", "invariant",
                  ("advance_rollback", "checkpoint", "crash_failover")),
+    CatalogEntry("lockstep:head", "§27",
+                 lambda root: lockstep_model("head"), "clean"),
+    # the rollback tier's routine move — advancing on a predicted frame
+    # — is exactly what lockstep forbids: one such advance runs past the
+    # confirmed frontier from the very first frame
+    CatalogEntry("lockstep:predictive-advance", "§27",
+                 lambda root: lockstep_model("predictive-advance"),
+                 "counterexample", "invariant",
+                 ("advance_predicted",)),
     CatalogEntry("durable-before-send:head", "§16",
                  lambda root: durable_before_send_model(True), "clean"),
     CatalogEntry("durable-before-send:no-barrier", "§16",

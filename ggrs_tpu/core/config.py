@@ -44,6 +44,12 @@ class PredictDefault(InputPredictor[I]):
     Suited to transition-style (edge-triggered) inputs."""
 
     def __init__(self, default_factory: Optional[Callable[[], I]] = None) -> None:
+        if default_factory is not None and not callable(default_factory):
+            raise TypeError(
+                "PredictDefault takes a zero-arg default FACTORY, not a "
+                f"default value (got {default_factory!r}); pass "
+                "PredictDefault() to use the config's own default"
+            )
         self._default_factory = default_factory
 
     def predict(self, previous: I) -> I:
@@ -104,8 +110,10 @@ class Config:
             isinstance(self.predictor, PredictDefault)
             and self.predictor._default_factory is None
         ):
+            # rebuild with the SAME type: subclasses (predict.BatchedDefault)
+            # must keep their batched kernel through the rebind
             object.__setattr__(
-                self, "predictor", PredictDefault(self.input_default)
+                self, "predictor", type(self.predictor)(self.input_default)
             )
 
     # ---------------------------------------------------------------
@@ -134,6 +142,57 @@ class Config:
             input_encode=lambda v: bytes(v),
             input_decode=lambda b: bytes(b),
             predictor=predictor if predictor is not None else PredictRepeatLast(),
+        )
+
+    @staticmethod
+    def for_varrec(
+        capacity: int,
+        encode: Optional[Callable[[Any], bytes]] = None,
+        decode: Optional[Callable[[bytes], Any]] = None,
+        default: Optional[Callable[[], Any]] = None,
+        predictor: Optional[InputPredictor] = None,
+    ) -> "Config":
+        """Variable-length byte records in a fixed native envelope.
+
+        The input is any value whose serde pair ``encode``/``decode``
+        produces at most ``capacity`` payload bytes (default: the value IS
+        the payload bytes, like :meth:`for_bytes`).  Each record is framed
+        as ``[u16 len][payload][zero pad]`` (core/varrec.py), so the
+        encoded size is constant and the session stays eligible for the
+        native bank, batched staging, journaling, and device-side batched
+        prediction — unlike :meth:`for_bytes`, which pins the session to
+        the per-session Python path.
+
+        Requirements (same injectivity contract as :meth:`for_struct`):
+        ``encode`` must be injective up to ``input_eq`` and the default
+        record must encode to ``b""`` (the all-zero envelope is the
+        native core's blank input).
+        """
+        # local import: varrec must stay importable without Config
+        from .varrec import envelope_pack, envelope_size, envelope_unpack
+
+        size = envelope_size(capacity)
+        rec_encode = encode if encode is not None else bytes
+        rec_decode = decode if decode is not None else bytes
+        rec_default = default if default is not None else (lambda: b"")
+        if rec_encode(rec_default()) != b"":
+            raise ValueError(
+                "for_varrec requires the default record to encode to b'' "
+                "(the all-zero envelope must be the default input)"
+            )
+
+        def _encode(v: Any) -> bytes:
+            return envelope_pack(rec_encode(v), capacity)
+
+        def _decode(b: bytes) -> Any:
+            return rec_decode(envelope_unpack(b))
+
+        return Config(
+            input_default=rec_default,
+            input_encode=_encode,
+            input_decode=_decode,
+            predictor=predictor if predictor is not None else PredictRepeatLast(),
+            native_input_size=size,
         )
 
     @staticmethod

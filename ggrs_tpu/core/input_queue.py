@@ -43,6 +43,12 @@ class InputQueue(Generic[I]):
         self._prediction: PlayerInput[I] = PlayerInput.blank(
             NULL_FRAME, config.input_default
         )
+        # optional device-batched prediction source (predict.batched): when
+        # bound, prediction-mode entry asks the plane's table first and
+        # falls back to the config's scalar predictor on a decline
+        self._plane = None
+        self._plane_slot = 0
+        self._plane_player = 0
 
     # ------------------------------------------------------------------
     # bookkeeping
@@ -50,6 +56,20 @@ class InputQueue(Generic[I]):
 
     def set_frame_delay(self, delay: int) -> None:
         self.frame_delay = delay
+
+    def bind_prediction_plane(self, plane, slot: int, player: int) -> None:
+        """Attach (or detach, with ``None``) a ``DevicePredictionPlane``
+        serving this queue's prediction-mode entries."""
+        self._plane = plane
+        self._plane_slot = slot
+        self._plane_player = player
+
+    def last_added_input(self) -> Optional[PlayerInput[I]]:
+        """The most recently added input — the base any prediction made
+        now would extend from — or None on a virgin queue."""
+        if self.last_added_frame == NULL_FRAME:
+            return None
+        return self._inputs[(self.head - 1) % INPUT_QUEUE_LENGTH]
 
     def reset_prediction(self) -> None:
         """Drop out of prediction mode after a rollback
@@ -102,7 +122,7 @@ class InputQueue(Generic[I]):
                 previous = self._inputs[prev_pos]
 
             if previous is not None:
-                predicted = self._config.predictor.predict(previous.input)
+                predicted = self._predict(previous.input)
                 base_frame = previous.frame
             else:
                 predicted = self._config.input_default()
@@ -112,6 +132,20 @@ class InputQueue(Generic[I]):
 
         assert self._prediction.frame != NULL_FRAME
         return (self._prediction.input, InputStatus.PREDICTED)
+
+    def _predict(self, previous: I) -> I:
+        """One prediction from ``previous``: the bound device plane's
+        table when it has a row for this queue's current base, else the
+        config's scalar predictor.  Both paths must produce the same
+        value (the kernel contract), so this is a dispatch, not a
+        semantic fork."""
+        if self._plane is not None:
+            hit, value = self._plane.predict_at(
+                self._plane_slot, self._plane_player, previous
+            )
+            if hit:
+                return value
+        return self._config.predictor.predict(previous)
 
     # ------------------------------------------------------------------
     # writes

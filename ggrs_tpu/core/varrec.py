@@ -1,0 +1,94 @@
+"""Variable-size input records over the fixed-stride native plane.
+
+The native bank, the journal, and the batched staging/wire fast paths all
+assume a fixed ``native_input_size`` per encoded input — one ctypes
+crossing moves ``B × P × S`` bytes per tick, and every jump-table offset
+is a multiple of S.  Serde-style inputs (enum/``Vec``-shaped command
+streams, fork delta #2) are variable length, which would seem to force
+every variable-size game onto the per-session Python path.
+
+The varrec *envelope* bridges the gap: a variable-length byte record is
+framed into a fixed ``VARREC_HEADER_BYTES + capacity`` blob as
+
+    [u16 payload_len LE][payload][zero padding to capacity]
+
+and the envelope — not the raw record — is what the sync core, bank,
+journal, and wire carry.  The framing was chosen so every assumption the
+native fast path makes about fixed-size inputs holds over envelopes:
+
+* **injective & canonical** — one record, one envelope (the length
+  prefix separates ``b"a"`` from ``b"a\\x00"``), so byte equality over
+  envelopes is exactly value equality over records and native
+  misprediction detection is sound;
+* **zero default** — the all-zero envelope is the empty record, so the
+  native core's zeroed blank/disconnect inputs decode to the config's
+  default without a Python hook;
+* **prediction-compatible** — repeat-last over envelopes is repeat-last
+  over records, and PredictDefault's zeros are the empty record;
+* **wire-cheap** — the reference's XOR + zero-run-RLE compression
+  (net/compression.py) collapses the constant zero padding to almost
+  nothing, so the envelope costs bytes at rest, not on the wire.
+
+Layout contract (analysis/layout.py ``_check_varrec`` + DESIGN.md §27):
+the header is exactly one little-endian u16; skew fixtures in
+tests/test_verify_layout.py prove the checker fires if it drifts.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Tuple
+
+# One little-endian u16 payload-length prefix.  VARREC_HEADER_BYTES is a
+# literal (not calcsize) so the static layout checker can read it from
+# the AST; the checker pins it equal to calcsize(VARREC_HEADER_FMT).
+VARREC_HEADER_FMT = "<H"
+VARREC_HEADER_BYTES = 2
+
+# u16 length prefix bounds the payload; anything bigger belongs on the
+# Python bytes path (Config.for_bytes), not in a fixed envelope.
+VARREC_MAX_CAPACITY = 0xFFFF
+
+
+def envelope_size(capacity: int) -> int:
+    """Fixed encoded size of every varrec input with this capacity."""
+    if not 0 < capacity <= VARREC_MAX_CAPACITY:
+        raise ValueError(
+            f"varrec capacity must be in 1..{VARREC_MAX_CAPACITY}, "
+            f"got {capacity}"
+        )
+    return VARREC_HEADER_BYTES + capacity
+
+
+def envelope_pack(payload: bytes, capacity: int) -> bytes:
+    """Frame ``payload`` into the fixed-size envelope."""
+    n = len(payload)
+    if n > capacity:
+        raise ValueError(
+            f"varrec payload is {n} bytes but capacity is {capacity}"
+        )
+    return (
+        struct.pack("<H", n) + payload + b"\x00" * (capacity - n)
+    )
+
+
+def envelope_split(blob: bytes) -> Tuple[bytes, bytes]:
+    """Split an envelope into (payload, padding) without validation of
+    the padding — the raw inverse of :func:`envelope_pack`."""
+    (n,) = struct.unpack_from("<H", blob, 0)
+    body = blob[VARREC_HEADER_BYTES:]
+    if n > len(body):
+        raise ValueError(
+            f"varrec header claims {n} payload bytes but envelope body "
+            f"is {len(body)}"
+        )
+    return bytes(body[:n]), bytes(body[n:])
+
+
+def envelope_unpack(blob: bytes) -> bytes:
+    """Extract the payload; rejects non-canonical (nonzero-padded)
+    envelopes so wire or journal corruption cannot alias two records."""
+    payload, padding = envelope_split(blob)
+    if padding.strip(b"\x00"):
+        raise ValueError("varrec envelope padding is not all zero")
+    return payload

@@ -616,6 +616,33 @@ class PoolShard:
         self._update_match_gauges()
         return bundle
 
+    def demote_match(self, match_id: str) -> int:
+        """Load-shedding (DESIGN.md §27, ROADMAP item 5): demote one
+        bank-tier match to the lockstep tier.  The match keeps its
+        slot, its wire address, and its journal tap, but runs from
+        here on as a ``max_prediction == 0`` per-session fallback —
+        zero save/load work, no rollback re-simulation, confirmed
+        frames only.  The cheap tier a shard answering "overloaded"
+        from :meth:`admission_refusal` sheds into before refusing
+        players outright.  Returns the resume frame.  One-way:
+        re-promotion to the bank is a migration concern."""
+        slot = self._matches.get(match_id)
+        if slot is None:
+            raise InvalidRequest(
+                f"match {match_id!r} has no bank slot on shard "
+                f"{self.shard_id} (adopted matches already run "
+                "per-session; rebuild them lockstep instead)"
+            )
+        self._ensure_started()
+        return self.pool.demote_to_lockstep(slot)
+
+    def lockstep_matches(self) -> List[str]:
+        """Bank matches demoted to the lockstep tier, by match id."""
+        return sorted(
+            mid for mid, slot in self._matches.items()
+            if self.pool.in_lockstep(slot)
+        )
+
     def drop_match(self, match_id: str, reason: str) -> None:
         """Forget a match without exporting (journal-path migration of an
         adopted match, or failover bookkeeping on a dead shard)."""

@@ -20,6 +20,7 @@ from .boxgame import (
 )
 from .chipvm import ChipVM
 from .ecs_world import EcsWorld
+from .rtscmd import RtsCmd, RtsCmdGame, decode_commands, encode_commands
 
 __all__ = [
     "BOX_INPUT_UP",
@@ -29,5 +30,9 @@ __all__ = [
     "BoxGame",
     "ChipVM",
     "EcsWorld",
+    "RtsCmd",
+    "RtsCmdGame",
     "boxgame_config",
+    "decode_commands",
+    "encode_commands",
 ]

@@ -1,0 +1,284 @@
+"""RtsCmd: an RTS-style command-stream game over variable-size inputs.
+
+The input-plane proof workload (DESIGN.md §27): each player submits a
+*command stream* per frame — zero or more orders for their units — so the
+per-frame input is genuinely ``Vec<enum>``-shaped (fork delta #2, the
+serde-inputs capability the fixed ``u32`` games never exercise).  An
+empty stream (the default input) is a no-op frame, which is exactly what
+a real RTS sends most ticks; stream length varies tick to tick, so the
+wire, journal, and rollback planes all see variable-size records.
+
+Command wire format — every order is one fixed 4-byte cell
+``[tag, op0, op1, op2]`` and a stream is their concatenation:
+
+    tag 1 MOVE   unit, dx (i8), dy (i8)      march a unit on the grid
+    tag 2 GATHER unit, 0, 0                  harvest at the unit's cell
+    tag 3 BUILD  x, y, 0                     spend 5 res, place a building
+
+The *stream* is variable length (0..max_cmds cells — that is what rides
+the varrec envelope); the fixed cell stride is a deliberate choice so the
+device interpreter can scan cell slots branchlessly, ChipVM-style,
+instead of chasing a data-dependent byte cursor.  State is all integer
+(positions wrap on a 64×64 grid), so advance is bitwise deterministic on
+every backend — ``advance`` (pure JAX over envelope bytes) and
+``advance_np`` (independent NumPy oracle over decoded commands) must
+agree exactly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..core.config import Config, InputPredictor
+from ..core.varrec import VARREC_HEADER_BYTES, envelope_pack
+
+CMD_BYTES = 4
+CMD_MOVE = 1
+CMD_GATHER = 2
+CMD_BUILD = 3
+
+GRID_MASK = 0x3F  # 64x64 torus
+BUILD_COST = 5
+
+_TAGS = {"move": CMD_MOVE, "gather": CMD_GATHER, "build": CMD_BUILD}
+
+
+def encode_commands(cmds: Sequence[Tuple]) -> bytes:
+    """Commands -> packed byte stream.  Accepts ("move", unit, dx, dy),
+    ("gather", unit), ("build", x, y)."""
+    out = bytearray()
+    for cmd in cmds:
+        tag = _TAGS[cmd[0]]
+        ops = [int(v) & 0xFF for v in cmd[1:]]
+        ops += [0] * (3 - len(ops))
+        out += bytes([tag, *ops])
+    return bytes(out)
+
+
+def decode_commands(data: bytes) -> Tuple[Tuple, ...]:
+    if len(data) % CMD_BYTES:
+        raise ValueError(
+            f"command stream length {len(data)} is not a multiple of "
+            f"{CMD_BYTES}"
+        )
+    cmds = []
+    for off in range(0, len(data), CMD_BYTES):
+        tag, op0, op1, op2 = data[off : off + CMD_BYTES]
+        if tag == CMD_MOVE:
+            # dx/dy travel as u8, mean i8
+            cmds.append(("move", op0, _i8(op1), _i8(op2)))
+        elif tag == CMD_GATHER:
+            cmds.append(("gather", op0))
+        elif tag == CMD_BUILD:
+            cmds.append(("build", op0, op1))
+        else:
+            raise ValueError(f"unknown command tag {tag}")
+    return tuple(cmds)
+
+
+def _i8(v: int) -> int:
+    return v - 256 if v >= 128 else v
+
+
+class RtsCmd:
+    """Factory mirroring the BoxGame/ChipVM interface, plus the varrec
+    config that puts its command streams on the native input plane."""
+
+    def __init__(self, num_players: int = 2, num_units: int = 4,
+                 max_cmds: int = 7) -> None:
+        assert 1 <= num_players <= 4
+        self.num_players = num_players
+        self.num_units = num_units
+        self.max_cmds = max_cmds
+        self.capacity = max_cmds * CMD_BYTES
+
+    def config(self, predictor: InputPredictor = None) -> Config:
+        """Session config: command tuples in a varrec envelope sized for
+        ``max_cmds`` orders per player per frame."""
+        return Config.for_varrec(
+            self.capacity,
+            encode=encode_commands,
+            decode=decode_commands,
+            default=tuple,
+            predictor=predictor,
+        )
+
+    # -- state ---------------------------------------------------------
+
+    def init_state_np(self) -> Dict[str, np.ndarray]:
+        p, u = self.num_players, self.num_units
+        units = np.zeros((p, u, 2), np.int32)
+        # spread starting positions deterministically
+        units[..., 0] = (np.arange(u)[None, :] * 5 + np.arange(p)[:, None] * 17) & GRID_MASK
+        units[..., 1] = (np.arange(u)[None, :] * 11 + np.arange(p)[:, None] * 29) & GRID_MASK
+        return {
+            "units": units,
+            "res": np.full(p, BUILD_COST, np.int32),
+            "built": np.zeros(p, np.int32),
+        }
+
+    def init_state(self) -> Dict[str, jax.Array]:
+        return jax.tree_util.tree_map(jnp.asarray, self.init_state_np())
+
+    # -- advance: numpy oracle over decoded commands --------------------
+
+    def advance_np(self, state: Dict[str, np.ndarray],
+                   streams: Sequence[Sequence[Tuple]]) -> Dict[str, np.ndarray]:
+        """One frame from *decoded* command tuples, one stream per player.
+        Orders apply in stream order; players apply in handle order."""
+        units = state["units"].copy()
+        res = state["res"].copy()
+        built = state["built"].copy()
+        for p, stream in enumerate(streams):
+            for cmd in stream:
+                if cmd[0] == "move":
+                    unit = cmd[1] % self.num_units
+                    units[p, unit, 0] = (units[p, unit, 0] + cmd[2]) & GRID_MASK
+                    units[p, unit, 1] = (units[p, unit, 1] + cmd[3]) & GRID_MASK
+                elif cmd[0] == "gather":
+                    unit = cmd[1] % self.num_units
+                    x, y = units[p, unit]
+                    res[p] += 1 + ((int(x) ^ int(y)) & 7)
+                elif cmd[0] == "build":
+                    if res[p] >= BUILD_COST:
+                        res[p] -= BUILD_COST
+                        built[p] += 1 + (((cmd[1] ^ cmd[2]) & 3) == 0)
+        return {"units": units, "res": res, "built": built}
+
+    # -- advance: jax, branchless, straight from varrec envelopes -------
+
+    def advance(self, state: Any, envelopes: Any) -> Any:
+        """One frame from raw varrec *envelope* bytes ``u8[P, S]`` — the
+        exact blobs the native bank and journal carry, no host decode.
+
+        Like ChipVM, every access is a one-hot compare+select so thousands
+        of divergent matches interpret in lockstep under vmap: the command
+        count comes from the u16 envelope header, and each of the
+        ``max_cmds`` cell slots executes masked by ``slot < n_cmds``.
+        Within a player the stream is sequential (res/built carry), so we
+        scan slots and vmap players.
+        """
+        env = jnp.asarray(envelopes, jnp.uint8)
+        n_bytes = env[:, 0].astype(jnp.int32) | (
+            env[:, 1].astype(jnp.int32) << 8
+        )
+        body = env[:, VARREC_HEADER_BYTES:]  # [P, capacity]
+        cells = body.reshape(self.num_players, self.max_cmds, CMD_BYTES)
+        n_cmds = n_bytes // CMD_BYTES
+        ulane = jnp.arange(self.num_units, dtype=jnp.int32)
+
+        def per_player(cells_one, units, res, built, n):
+            def player_step(carry, slot):
+                units, res, built = carry  # units [U,2] i32, res/built i32
+                cell = cells_one[slot]
+                live = slot < n
+                tag = cell[0].astype(jnp.int32)
+                op0 = cell[1].astype(jnp.int32)
+                op1 = cell[2].astype(jnp.int32)
+                op2 = cell[3].astype(jnp.int32)
+                d0 = jnp.where(op1 >= 128, op1 - 256, op1)
+                d1 = jnp.where(op2 >= 128, op2 - 256, op2)
+                unit = op0 % self.num_units
+                sel = (ulane == unit)[:, None]  # [U,1] one-hot unit mask
+
+                moved = (units + jnp.stack([d0, d1])[None, :]) & GRID_MASK
+                units = jnp.where(
+                    live & (tag == CMD_MOVE) & sel, moved, units
+                )
+
+                ux = jnp.max(jnp.where(ulane == unit, units[:, 0], 0))
+                uy = jnp.max(jnp.where(ulane == unit, units[:, 1], 0))
+                res = jnp.where(
+                    live & (tag == CMD_GATHER),
+                    res + 1 + ((ux ^ uy) & 7), res,
+                )
+
+                can = live & (tag == CMD_BUILD) & (res >= BUILD_COST)
+                res = jnp.where(can, res - BUILD_COST, res)
+                bonus = (((op0 ^ op1) & 3) == 0).astype(jnp.int32)
+                built = jnp.where(can, built + 1 + bonus, built)
+                return (units, res, built), None
+
+            (units, res, built), _ = jax.lax.scan(
+                player_step, (units, res, built),
+                jnp.arange(self.max_cmds), length=self.max_cmds,
+            )
+            return units, res, built
+
+        units_out, res_out, built_out = [], [], []
+        # python loop over the (static, tiny) player count: players are
+        # independent this frame except through their own carries
+        for p in range(self.num_players):
+            u, r, b = per_player(
+                cells[p], state["units"][p], state["res"][p],
+                state["built"][p], n_cmds[p],
+            )
+            units_out.append(u)
+            res_out.append(r)
+            built_out.append(b)
+        return {
+            "units": jnp.stack(units_out),
+            "res": jnp.stack(res_out),
+            "built": jnp.stack(built_out),
+        }
+
+    # -- helpers for session-driven tests -------------------------------
+
+    def envelopes_np(self, streams: Sequence[Sequence[Tuple]]) -> np.ndarray:
+        """Decoded command streams -> the u8[P, S] envelope batch
+        ``advance`` consumes (what the native plane would hand it)."""
+        rows = [
+            np.frombuffer(
+                envelope_pack(encode_commands(s), self.capacity), np.uint8
+            )
+            for s in streams
+        ]
+        return np.stack(rows)
+
+
+class RtsCmdGame:
+    """Host-game adapter (snapshot/restore/advance over session requests)
+    running the NumPy oracle — the FoldGame-shaped driver p2p tests use."""
+
+    def __init__(self, game: RtsCmd) -> None:
+        self._game = game
+        self.state = game.init_state_np()
+        self.frame = 0
+
+    def snapshot(self):
+        return (self.frame, jax.tree_util.tree_map(np.copy, self.state))
+
+    def restore(self, snap) -> None:
+        self.frame = snap[0]
+        self.state = jax.tree_util.tree_map(np.copy, snap[1])
+
+    def checksum(self) -> int:
+        flat = np.concatenate(
+            [np.asarray(v, np.int64).ravel() for v in
+             (self.state["units"], self.state["res"], self.state["built"])]
+        )
+        acc = np.int64(2166136261)
+        for v in flat:
+            acc = np.int64((int(acc) * 16777619 + int(v)) & 0x7FFFFFFF)
+        return int(acc)
+
+    def handle_requests(self, requests) -> None:
+        from ..core import AdvanceFrame, LoadGameState, SaveGameState
+
+        for request in requests:
+            if isinstance(request, LoadGameState):
+                self.restore(request.cell.load())
+            elif isinstance(request, SaveGameState):
+                assert self.frame == request.frame
+                request.cell.save(
+                    request.frame, self.snapshot(), self.checksum()
+                )
+            elif isinstance(request, AdvanceFrame):
+                streams = [value for value, _status in request.inputs]
+                self.state = self._game.advance_np(self.state, streams)
+                self.frame += 1

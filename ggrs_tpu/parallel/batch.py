@@ -31,6 +31,24 @@ except ImportError:  # pragma: no cover - older jax
 
 from ..ops.replay import ReplayPrograms, build_replay_programs
 
+
+def shard_map_check_kwargs(fn=None) -> dict:
+    """The kwarg disabling shard_map's replication check was renamed
+    (``check_rep`` -> ``check_vma``) across jax versions; feature-detect
+    which one this jax accepts so both signatures work."""
+    import inspect
+
+    target = shard_map if fn is None else fn
+    try:
+        params = inspect.signature(target).parameters
+    except (TypeError, ValueError):  # pragma: no cover - C callables
+        return {}
+    if "check_vma" in params:
+        return {"check_vma": False}
+    if "check_rep" in params:
+        return {"check_rep": False}
+    return {}
+
 SESSION_AXIS = "sessions"
 
 
@@ -237,7 +255,7 @@ class BatchedSessions:
                 mesh=self.mesh,
                 in_specs=(spec_b, spec_b),
                 out_specs=(spec_b, P()),
-                check_vma=False,
+                **shard_map_check_kwargs(),
             )(carry, inputs)
 
         self._run_warmup = jax.jit(partial(_sharded, self._programs.scan_warmup))

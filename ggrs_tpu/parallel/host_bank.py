@@ -93,6 +93,7 @@ survivors' wire bytes bit-identical with metrics enabled vs disabled.
 
 from __future__ import annotations
 
+import copy
 import ctypes
 import os
 import pickle
@@ -264,6 +265,7 @@ SLOT_TRANSITIONS = (
     (SLOT_NATIVE, SLOT_QUARANTINED),   # bank fault -> quarantine
     (SLOT_NATIVE, SLOT_DEAD),          # match retired / fallback tick fault
     (SLOT_NATIVE, SLOT_MIGRATED),      # live-migration commit
+    (SLOT_NATIVE, SLOT_EVICTED),       # load-shed demotion -> lockstep tier
     (SLOT_QUARANTINED, SLOT_EVICTED),  # eviction succeeded
     (SLOT_QUARANTINED, SLOT_DEAD),     # eviction attempts exhausted
     (SLOT_QUARANTINED, SLOT_MIGRATED),
@@ -700,6 +702,13 @@ class HostSessionPool:
         self._lib = None
         self._mirrors: List[_SessionMirror] = []
         self._sessions: List[Any] = []  # fallback P2PSessions
+        # ---- input plane (DESIGN.md §27) ----
+        # device-batched prediction over the Python-path slots: gathered
+        # once per tick in _advance_all_fallback, served to the queues
+        self._prediction_plane = None
+        # slots demoted to the lockstep tier (load-shedding): index ->
+        # tick demoted, for stats; the session itself lives in _evicted
+        self._lockstep_slots: Dict[int, int] = {}
         self._clock = None
         self._out_buf: Optional[ctypes.Array] = None
         self._out_len = ctypes.c_size_t(0)
@@ -800,6 +809,9 @@ class HostSessionPool:
             "ggrs_pool_eviction_latency_ticks",
             "ticks from quarantine to successful eviction",
             buckets=(1, 2, 4, 8, 16, 32, 64))
+        self._m_demotions = m.counter(
+            "ggrs_pool_lockstep_demotions_total",
+            "healthy slots demoted to the lockstep tier (load-shedding)")
         _req = m.counter(
             "ggrs_pool_requests_total",
             "GgrsRequests returned to the game, by kind",
@@ -3355,6 +3367,11 @@ class HostSessionPool:
         for i, s in enumerate(self._sessions):
             if self._slot_state[i] not in (SLOT_DEAD, SLOT_MIGRATED):
                 s.validate_local_inputs()
+        if self._prediction_plane is not None:
+            # one device op predicts every registered slot's missing
+            # inputs; queues fall back to the scalar strategy on any row
+            # the gather didn't cover (predict/batched.py contract)
+            self._prediction_plane.begin_tick()
         out: List[List[GgrsRequest]] = []
         for i, s in enumerate(self._sessions):
             if self._slot_state[i] in (SLOT_DEAD, SLOT_MIGRATED):
@@ -3642,13 +3659,27 @@ class HostSessionPool:
             )
         return True
 
-    def _evict(self, index: int):
+    def _evict(self, index: int, *, lockstep: bool = False):
         """Build a fresh ``P2PSession`` resuming from the slot's last
         committed frame: harvest the native state (read-only, retry-safe),
         adopt it through the adoption seam, feed this tick's staged inputs,
-        and hand back the session plus the leading ``LoadGameState``."""
+        and hand back the session plus the leading ``LoadGameState``.
+
+        ``lockstep=True`` is the load-shed demotion variant (DESIGN.md
+        §27): the same adoption seam, but the resumed session runs with
+        ``max_prediction == 0`` — confirmed frames only, no saves, no
+        rollbacks.  The ``LoadGameState`` handed back is the POOL's
+        one-time resume protocol, not session rollback machinery: it is
+        the last load this slot will ever emit."""
         m = self._mirrors[index]
         builder, socket = self._builders[index]
+        if lockstep:
+            # shallow copy: the registry/endpoints are rebuilt by
+            # start_p2p_session below; the original builder never starts
+            # another session for this slot (the slot leaves NATIVE for
+            # good), so sharing the registry object is safe
+            builder = copy.copy(builder)
+            builder.with_max_prediction_window(0)
         try:
             h = self._harvest(index)
         except Exception:
@@ -4031,6 +4062,98 @@ class HostSessionPool:
         )
         # ggrs-model: transitions(native->migrated, quarantined->migrated, evicted->migrated)
         self._set_slot_state(index, SLOT_MIGRATED)
+
+    # ------------------------------------------------------------------
+    # input plane: lockstep demotion + device-batched prediction
+    # (DESIGN.md §27)
+    # ------------------------------------------------------------------
+
+    def demote_to_lockstep(self, index: int) -> Frame:
+        """Load-shed demotion (ROADMAP item 5 hook, DESIGN.md §27):
+        move a HEALTHY bank-resident slot to the lockstep tier.  The
+        match keeps running — same peers, same wire address, same
+        journal tap — but as a ``max_prediction == 0`` Python session:
+        confirmed frames only, zero save/load work, no rollback
+        re-simulation.  Cheapest possible tier for a pool shedding tick
+        budget under flash-crowd load.
+
+        Rides the eviction seam: harvest → adopt → replay this tick's
+        staged inputs, landing in the EVICTED supervision state (the
+        per-session fallback tier; ``in_lockstep`` distinguishes demoted
+        slots from fault evictions).  Returns the resume frame; the
+        caller sees the one-time adoption ``LoadGameState`` prepended to
+        the slot's next request list, after which the session never
+        emits another save or load (pinned by tests/test_input_plane.py).
+
+        One-way: promotion back to the bank is a future concern — the
+        fleet re-admits demoted matches by migration instead."""
+        if not self._finalized:
+            self._finalize()
+        if not self._native_active:
+            raise InvalidRequest(
+                "demote_to_lockstep requires the native bank (a fallback "
+                "pool's sessions already run per-session; build them "
+                "lockstep via with_max_prediction_window(0) instead)"
+            )
+        state = self._slot_state[index]
+        if state != SLOT_NATIVE:
+            raise InvalidRequest(
+                f"slot {index} is {state}: only healthy bank-resident "
+                "slots demote to lockstep (quarantined slots take the "
+                "eviction path)"
+            )
+        rec = self._recorders[index] if self._recorders else None
+        with self.tracer.span("pool.demote_lockstep", slot=index):
+            session, load_req = self._evict(index, lockstep=True)
+        assert session.in_lockstep_mode()
+        self._evicted[index] = session
+        self._pending_load[index] = load_req
+        # ggrs-model: transitions(native->evicted)
+        self._set_slot_state(index, SLOT_EVICTED)
+        self._lockstep_slots[index] = self._tick_no
+        self._m_demotions.inc()
+        self._fault_log[index].append(SlotFault(
+            self._tick_no, 0,
+            f"demoted to lockstep tier, resuming from frame "
+            f"{load_req.frame}",
+        ))
+        if rec is not None:
+            rec.record(self._tick_no, EV_EVICT,
+                       f"demoted to lockstep from frame {load_req.frame}")
+        return load_req.frame
+
+    def in_lockstep(self, index: int) -> bool:
+        """True when ``index`` was demoted to the lockstep tier (it runs
+        a ``max_prediction == 0`` fallback session)."""
+        return index in self._lockstep_slots
+
+    def lockstep_slots(self) -> Dict[int, int]:
+        """Demoted slots: index -> the pool tick the demotion ran on."""
+        return dict(self._lockstep_slots)
+
+    def attach_prediction_plane(self, plane) -> None:
+        """Serve every fallback session's prediction-mode entries from
+        one device-batched table (predict.batched, DESIGN.md §27): the
+        plane gathers each queue's last-added input once per pool tick
+        (``begin_tick`` in ``_advance_all_fallback``) and answers
+        ``predict_at`` from the batched kernel's output.  Fallback pools
+        only — batched predictors are deliberately not native-eligible,
+        so a pool built with one always lands here."""
+        if not self._finalized:
+            self._finalize()
+        if self._native_active:
+            raise InvalidRequest(
+                "the prediction plane serves the per-session fallback "
+                "path; this pool runs the native bank (whose sync core "
+                "predicts repeat-last in-kernel already)"
+            )
+        for i, session in enumerate(self._sessions):
+            session.bind_prediction_plane(plane, i)
+        self._prediction_plane = plane
+
+    def prediction_plane(self):
+        """The attached ``DevicePredictionPlane``, or None."""
+        return self._prediction_plane
 
     # ------------------------------------------------------------------
     # broadcast seams (driven by ggrs_tpu.broadcast.SpectatorHub)

@@ -300,13 +300,15 @@ class BatchedRequestExecutor:
                 from jax.experimental.shard_map import shard_map
             from jax.sharding import PartitionSpec
 
+            from .batch import shard_map_check_kwargs
+
             spec_b = PartitionSpec(tuple(mesh.axis_names))
             tick = shard_map(
                 tick,
                 mesh=mesh,
                 in_specs=(spec_b, spec_b),
                 out_specs=spec_b,
-                check_vma=False,
+                **shard_map_check_kwargs(fn=shard_map),
             )
 
         donate = (0,) if jax.default_backend() == "tpu" else ()

@@ -1,0 +1,43 @@
+"""Input prediction strategies — the fork's pluggable ``InputPredictor``
+(lib.rs:374-406) plus the TPU-native extension the Rust reference cannot
+express: device-batched prediction over every slot of a session pool.
+
+Two tiers:
+
+* **Scalar strategies** (``PredictRepeatLast``, ``PredictDefault``,
+  ``PredictCustom``) — defined in :mod:`ggrs_tpu.core.config` because the
+  native-eligibility gate dispatches on ``type(predictor)`` and ``Config``
+  must bind defaults without import cycles; re-exported here so
+  ``ggrs_tpu.predict`` is the one stop for prediction strategies.
+* **Batched strategies** (:mod:`.batched`) — a ``BatchedInputPredictor``
+  carries a vectorized ``kernel(u8[B, P, S]) -> u8[B, P, S]`` predicting
+  every slot's missing inputs in ONE device op, served to the per-slot
+  input queues through a :class:`DevicePredictionPlane`.  The scalar
+  ``predict`` on the same object is the semantic reference and the
+  unconditional fallback, so confirmed streams are bit-identical with or
+  without the device table (pinned by tests/test_input_plane.py).
+"""
+
+from ..core.config import (
+    InputPredictor,
+    PredictCustom,
+    PredictDefault,
+    PredictRepeatLast,
+)
+from .batched import (
+    BatchedDefault,
+    BatchedInputPredictor,
+    BatchedRepeatLast,
+    DevicePredictionPlane,
+)
+
+__all__ = [
+    "BatchedDefault",
+    "BatchedInputPredictor",
+    "BatchedRepeatLast",
+    "DevicePredictionPlane",
+    "InputPredictor",
+    "PredictCustom",
+    "PredictDefault",
+    "PredictRepeatLast",
+]

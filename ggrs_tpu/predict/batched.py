@@ -1,0 +1,208 @@
+"""Device-batched input prediction: all B slots' missing inputs in one op.
+
+The reference predicts per input queue, per player, in scalar Rust.  A
+pool hosting hundreds of matches re-enters that scalar path B×P times a
+tick.  Here the prediction *strategy itself* is vectorized: a
+``BatchedInputPredictor`` exposes
+
+    kernel(base: u8[B, P, S]) -> u8[B, P, S]
+
+mapping every (slot, player)'s last-known encoded input to its predicted
+next encoded input in one jitted device call.  The
+``DevicePredictionPlane`` drives it: once per pool tick it gathers each
+registered slot's per-player last inputs, runs the kernel, and serves the
+result table to the per-slot ``InputQueue``s when they enter prediction
+mode.
+
+Correctness does not depend on the table: ``predict_at`` only answers
+when the queue's actual prediction base equals the gathered base row
+(encoded-byte equality); on any mismatch — a datagram landed between the
+gather and the queue's ask, an unregistered slot, no tick begun — the
+queue falls back to the strategy's scalar ``predict``, which is the
+semantic reference the kernel must agree with.  Either path yields the
+same value, so confirmed streams are bit-identical with the plane on or
+off (pinned by tests/test_input_plane.py); the plane only moves the
+prediction *work* onto the device.
+
+Batched strategies are deliberately NOT native-core eligible
+(``_native_sync_semantics_ok`` dispatches on ``type(predictor) is
+PredictRepeatLast``): a pool configured with one keeps its slots on the
+Python fallback path, where the plane hooks ``advance_all``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..core.config import Config, InputPredictor, PredictDefault
+
+__all__ = [
+    "BatchedDefault",
+    "BatchedInputPredictor",
+    "BatchedRepeatLast",
+    "DevicePredictionPlane",
+]
+
+
+class BatchedInputPredictor(InputPredictor):
+    """A prediction strategy with both a scalar and a device-batched form.
+
+    ``predict(previous)`` is the scalar semantics (the reference and the
+    fallback); ``kernel(base)`` must compute, for every row, exactly
+    ``encode(predict(decode(row)))`` — over the config's fixed-size
+    encoding (``native_input_size`` set, e.g. ``Config.for_varrec``), so
+    byte-level agreement is value-level agreement."""
+
+    def kernel(self, base):
+        """u8[B, P, S] last-known encoded inputs -> u8[B, P, S] predicted
+        encoded inputs.  Pure, traceable JAX."""
+        raise NotImplementedError
+
+
+class BatchedRepeatLast(BatchedInputPredictor):
+    """Repeat-last, batched: the kernel is the identity."""
+
+    def predict(self, previous):
+        return previous
+
+    def kernel(self, base):
+        return base
+
+
+class BatchedDefault(BatchedInputPredictor, PredictDefault):
+    """Always-default, batched: the kernel is zeros — sound because every
+    fixed-envelope config encodes its default input as all-zero bytes
+    (the same contract the native core's blank inputs rely on)."""
+
+    def kernel(self, base):
+        import jax.numpy as jnp
+
+        return jnp.zeros_like(base)
+
+
+class DevicePredictionPlane:
+    """Pool-level driver for a :class:`BatchedInputPredictor`.
+
+    Lifecycle::
+
+        plane = DevicePredictionPlane(config, capacity=B)
+        pool.attach_prediction_plane(plane)   # binds live fallback slots
+        pool.advance_all()                    # pool calls begin_tick()
+
+    ``begin_tick`` gathers u8[B, P, S] prediction bases from every
+    registered slot's input queues and runs the kernel once;
+    ``predict_at`` then answers queue prediction requests from the table
+    (or declines, sending the queue to the scalar fallback).  ``stats()``
+    reports the hit/fallback split for obs and the bench."""
+
+    def __init__(self, config: Config, capacity: int) -> None:
+        predictor = config.predictor
+        if not isinstance(predictor, BatchedInputPredictor):
+            raise ValueError(
+                "DevicePredictionPlane requires a BatchedInputPredictor "
+                f"strategy, got {type(predictor).__name__}"
+            )
+        if config.native_input_size is None:
+            raise ValueError(
+                "DevicePredictionPlane requires a fixed-size encoding "
+                "(native_input_size set — for_uint/for_struct/for_varrec)"
+            )
+        self._config = config
+        self._predictor = predictor
+        self._size = config.native_input_size
+        self._capacity = capacity
+        self._encode = config.input_encode
+        self._decode = config.input_decode
+        self._queues: Dict[int, List[Any]] = {}  # slot -> per-player queues
+        self._base: Optional[np.ndarray] = None  # u8[B, P, S] gather
+        self._valid: Optional[np.ndarray] = None  # bool[B, P]
+        self._table: Optional[np.ndarray] = None  # u8[B, P, S] predictions
+        self._jit_kernel = None
+        self.ticks = 0
+        self.hits = 0
+        self.fallbacks = 0
+
+    # -- registration ---------------------------------------------------
+
+    def register(self, slot: int, session) -> None:
+        """Bind one Python-path session's input queues to this plane.
+        (Sessions on the native core never ask Python queues for
+        predictions, so there is nothing to serve them.)"""
+        if not 0 <= slot < self._capacity:
+            raise ValueError(f"slot {slot} outside plane capacity {self._capacity}")
+        queues = session._sync_layer.input_queues
+        if not queues:
+            raise ValueError(
+                "session runs the native sync core; the device plane only "
+                "serves Python input queues"
+            )
+        self._queues[slot] = list(queues)
+        for player, q in enumerate(queues):
+            q.bind_prediction_plane(self, slot, player)
+
+    def unregister(self, slot: int) -> None:
+        for q in self._queues.pop(slot, ()):  # pragma: no branch
+            q.bind_prediction_plane(None, 0, 0)
+
+    @property
+    def num_registered(self) -> int:
+        return len(self._queues)
+
+    # -- per-tick -------------------------------------------------------
+
+    def begin_tick(self) -> None:
+        """Gather every registered queue's prediction base and run the
+        kernel: ONE device op predicts all slots' missing inputs."""
+        if not self._queues:
+            self._table = None
+            return
+        players = max(len(qs) for qs in self._queues.values())
+        base = np.zeros((self._capacity, players, self._size), np.uint8)
+        valid = np.zeros((self._capacity, players), bool)
+        for slot, queues in self._queues.items():
+            for player, q in enumerate(queues):
+                prev = q.last_added_input()
+                if prev is None:
+                    continue
+                row = self._encode(prev.input)
+                base[slot, player] = np.frombuffer(row, np.uint8)
+                valid[slot, player] = True
+        if self._jit_kernel is None:
+            import jax
+
+            self._jit_kernel = jax.jit(self._predictor.kernel)
+        self._base = base
+        self._valid = valid
+        self._table = np.asarray(self._jit_kernel(base), np.uint8)
+        self.ticks += 1
+
+    def predict_at(self, slot: int, player: int,
+                   previous) -> Tuple[bool, Any]:
+        """Serve one queue's prediction from the device table.  Returns
+        ``(True, value)`` on a base match, ``(False, None)`` when the
+        queue must fall back to the scalar strategy."""
+        table = self._table
+        if (
+            table is None
+            or self._valid is None
+            or not self._valid[slot, player]
+        ):
+            self.fallbacks += 1
+            return False, None
+        if self._encode(previous) != self._base[slot, player].tobytes():
+            # the queue's base moved since the gather (e.g. an input landed
+            # mid-tick): the table row predicts from stale state — decline
+            self.fallbacks += 1
+            return False, None
+        self.hits += 1
+        return True, self._decode(table[slot, player].tobytes())
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "ticks": self.ticks,
+            "registered": len(self._queues),
+            "hits": self.hits,
+            "fallbacks": self.fallbacks,
+        }

@@ -130,6 +130,16 @@ class SessionBuilder(Generic[I, S, A]):
         self._input_delay = delay
         return self
 
+    def with_predictor(self, predictor) -> "SessionBuilder[I, S, A]":
+        """Swap the config's input-prediction strategy (fork delta #1:
+        pluggable ``InputPredictor``; see ``ggrs_tpu.predict``).  Rebuilds
+        the frozen config, so ``PredictDefault``-family strategies rebind
+        their default factory exactly as at construction."""
+        import dataclasses
+
+        self._config = dataclasses.replace(self._config, predictor=predictor)
+        return self
+
     def with_sparse_saving_mode(self, sparse_saving: bool) -> "SessionBuilder[I, S, A]":
         """Only save the minimum confirmed frame: fewer saves, longer
         rollbacks.  Recommended when saving costs much more than advancing."""

@@ -273,6 +273,21 @@ class P2PSession(ThreadOwned, Generic[I, S, A]):
         self._pooled_adv = AdvanceFrame(inputs=[])
         self._pooled_list = []
 
+    def bind_prediction_plane(self, plane, slot: int) -> None:
+        """Register this session's input queues with a pool-level
+        ``predict.DevicePredictionPlane`` under ``slot``.  Python-path
+        sessions only: the native sync core predicts natively and never
+        consults Python queues."""
+        queues = self._sync_layer.input_queues
+        if not queues:
+            raise InvalidRequest(
+                "bind_prediction_plane() requires the Python input-queue "
+                "bank (batched predictors are never native-eligible, so "
+                "this session must have been built with a native-eligible "
+                "config — use the config's own predictor instead)"
+            )
+        plane.register(slot, self)
+
     def advance_frame(self) -> List[GgrsRequest]:
         """The main entry point; see the reference call stack
         (p2p_session.rs:265-426).  Returns the ordered request list."""

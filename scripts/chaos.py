@@ -25,6 +25,11 @@ Fault classes (all driven through the pool's real tick path):
   blackout      the target's peer goes permanently silent
   malformed     burst of truncated/corrupted datagrams into the target
   fuzz          seeded random junk datagrams into the target
+  lockstep      lockstep-demotion leg (DESIGN.md §27): a live native slot
+                is demoted to the lockstep tier mid-run — survivors must
+                stay bit-identical to control, the demoted slot must land
+                evicted+lockstep with exactly one adoption load, zero
+                saves, and CONFIRMED-only advances
   spectator     broadcast leg: a hub-fanned match with live viewers and a
                 journal is chaos-killed with its native harvest DEAD; the
                 slot must recover from the journal tail, the viewers must
@@ -352,6 +357,116 @@ def _verify_desync_forensics(ticks: int, seed: int, artifact_dir=None):
             path = report.write(out / "desync.forensic_report.json")
             print(f"  forensic report: {path}")
     return violations
+
+
+def verify_lockstep_leg(matches: int, ticks: int, seed: int,
+                        artifact_dir=None) -> bool:
+    """The lockstep-demotion scenario (DESIGN.md §27): a live native slot
+    is demoted to the lockstep tier mid-run — the pool's load-shed path.
+    The demoted slot must land evicted with ``max_prediction=0``, replay
+    its confirmed prefix through EXACTLY ONE adoption load, never save or
+    load again, advance only CONFIRMED inputs, and keep making frames;
+    every OTHER slot must stay bit-identical to a fault-free control leg."""
+    from ggrs_tpu.core import InputStatus
+    from ggrs_tpu.parallel.host_bank import SLOT_EVICTED
+
+    demote_at = max(20, min(60, ticks // 3))
+
+    def inject(i, ctx):
+        if i == demote_at:
+            ctx["resume_frame"] = ctx["pool"].demote_to_lockstep(
+                ctx["target"]
+            )
+
+    control = drive_chaos(ticks, n_matches=matches, seed=seed)
+    chaos = drive_chaos(ticks, n_matches=matches, seed=seed, inject=inject)
+    target = chaos["target"]
+    pool = chaos["pool"]
+    resume = chaos.get("resume_frame")
+    violations = list(blast_radius_violations(chaos, control))
+
+    print("--- lockstep ---")
+    print(f"  target slot {target}: demoted at tick {demote_at}, resume "
+          f"frame {resume}, state={chaos['states'][target]}, "
+          f"frame={chaos['frames'][target]}, ext peer frame="
+          f"{chaos['ext'].current_frame}")
+
+    if chaos["states"][target] != SLOT_EVICTED:
+        violations.append(
+            f"demoted slot state {chaos['states'][target]!r}, expected "
+            f"evicted-to-python ({SLOT_EVICTED!r})"
+        )
+    if not pool.in_lockstep(target):
+        violations.append("pool does not report the target in lockstep")
+    if pool.lockstep_slots() != {target: demote_at}:
+        violations.append(
+            f"lockstep_slots() = {pool.lockstep_slots()!r}, expected "
+            f"{{{target}: {demote_at}}}"
+        )
+    if not resume or resume <= 0:
+        violations.append(f"demotion returned resume frame {resume!r}")
+    elif chaos["frames"][target] <= resume:
+        violations.append(
+            f"demoted slot stuck: frame {chaos['frames'][target]} <= "
+            f"resume frame {resume}"
+        )
+
+    # post-demotion request discipline: one adoption load, zero saves,
+    # real progress, and every advance carries CONFIRMED inputs only
+    post = [r for tick_reqs in chaos["reqs"][target][demote_at:]
+            for r in tick_reqs]
+    loads = sum(1 for r in post if r[0] == "LoadGameState")
+    saves = sum(1 for r in post if r[0] == "SaveGameState")
+    advs = [r for r in post if r[0] == "adv"]
+    predicted = sum(
+        1 for r in advs
+        for _, status in r[1] if status != InputStatus.CONFIRMED
+    )
+    print(f"  post-demotion requests: {loads} loads (adoption), {saves} "
+          f"saves, {len(advs)} advances ({predicted} non-CONFIRMED inputs)")
+    if loads != 1:
+        violations.append(f"{loads} post-demotion loads, expected exactly "
+                          "the 1 adoption load")
+    if saves:
+        violations.append(f"{saves} post-demotion saves, expected 0 "
+                          "(lockstep never snapshots)")
+    if not advs:
+        violations.append("demoted slot produced no post-demotion advances")
+    if predicted:
+        violations.append(
+            f"{predicted} post-demotion inputs advanced non-CONFIRMED "
+            "(lockstep must never run predicted inputs)"
+        )
+    print(f"  crossings={pool.crossings} harvests={pool.harvests} "
+          f"stat_crossings={pool.stat_crossings} "
+          f"fastpath_slot_ticks={pool.fast_slot_ticks}")
+    print(_metrics_summary(chaos))
+
+    verdict = not violations
+    _write_artifact(artifact_dir, "lockstep", {
+        "scenario": "lockstep",
+        "verdict": "PASS" if verdict else "FAIL",
+        "violations": violations,
+        "target_slot": target,
+        "demoted_at_tick": demote_at,
+        "resume_frame": resume,
+        "target_state": chaos["states"][target],
+        "target_frame": chaos["frames"][target],
+        "post_demotion": {"loads": loads, "saves": saves,
+                          "advances": len(advs),
+                          "non_confirmed_inputs": predicted},
+        "crossings": {"tick": pool.crossings, "harvest": pool.harvests,
+                      "stats": pool.stat_crossings},
+        "metrics": json_snapshot(chaos["registry"]),
+    })
+    if violations:
+        print("  BLAST RADIUS VIOLATED:")
+        for v in violations:
+            print(f"    {v}")
+        return False
+    print(f"  OK: {len(chaos['states']) - 1} surviving slots bit-identical "
+          "to control; demoted slot lockstep-clean")
+    return True
 
 
 def verify_broadcast_leg(matches: int, ticks: int, seed: int,
@@ -1481,8 +1596,9 @@ def main() -> int:
                     help="in-bank 2-peer matches (default 4 -> B=9 slots)")
     ap.add_argument("--ticks", type=int, default=300)
     ap.add_argument("--seed", type=int, default=3)
-    ap.add_argument("--fault", choices=[*FAULTS, "spectator", "socket",
-                                        "shard", "proc", "net", "all"],
+    ap.add_argument("--fault", choices=[*FAULTS, "lockstep", "spectator",
+                                        "socket", "shard", "proc", "net",
+                                        "all"],
                     default="all")
     ap.add_argument("--fleet-matches", type=int, default=32, metavar="B",
                     help="matches per shard for --fault shard (default 32; "
@@ -1493,13 +1609,18 @@ def main() -> int:
     args = ap.parse_args()
 
     names = (
-        [*FAULTS, "spectator", "socket", "shard", "proc", "net"]
+        [*FAULTS, "lockstep", "spectator", "socket", "shard", "proc", "net"]
         if args.fault == "all"
         else [args.fault]
     )
     ok = True
     for name in names:
-        if name == "proc":
+        if name == "lockstep":
+            ok &= verify_lockstep_leg(
+                args.matches, args.ticks, args.seed,
+                artifact_dir=args.artifact_dir,
+            )
+        elif name == "proc":
             ok &= verify_proc_leg(
                 args.fleet_matches, args.ticks, args.seed,
                 artifact_dir=args.artifact_dir,

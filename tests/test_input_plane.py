@@ -1,0 +1,796 @@
+"""Input plane (DESIGN.md §27): variable-size varrec inputs end to end,
+pluggable + device-batched prediction, and the lockstep tier.
+
+Three pins, one per subsystem:
+
+* **Varrec**: the ``[u16 len][payload][zero pad]`` envelope is injective
+  and canonical (codec unit tests), and an enum/Vec-shaped command-stream
+  game (``games.rtscmd``) rides it through synctest rollbacks, the
+  two-peer wire path, the native session bank (bit-identical to the
+  Python reference under seeded loss/dup/reorder — wire, requests, AND
+  journal), and the journal file format round trip.
+* **Prediction**: confirmed streams are bit-identical with the device
+  plane on or off (predict/batched.py's correctness contract), and
+  ACROSS strategies — prediction only ever fills unconfirmed frames, so
+  the confirmed stream is predictor-independent.
+* **Lockstep**: a ``max_prediction == 0`` session never emits
+  SaveGameState/LoadGameState and never advances past the confirmed
+  frontier, while folding to the same confirmed output as a rollback
+  pair; ``HostSessionPool.demote_to_lockstep`` moves a healthy native
+  slot onto that tier mid-run with zero blast radius on its neighbours
+  (mirrors analysis/machines.py's ``lockstep:head`` model entry).
+"""
+
+import random
+
+import pytest
+
+from ggrs_tpu.broadcast.journal import JournalTap, MatchJournal, read_journal
+from ggrs_tpu.chaos import (
+    RecordingSocket,
+    blast_radius_violations,
+    fulfill,
+    req_summary,
+    two_peer_builder,
+)
+from ggrs_tpu.core import (
+    AdvanceFrame,
+    Config,
+    InputStatus,
+    InvalidRequest,
+    LoadGameState,
+    Local,
+    Remote,
+    SaveGameState,
+    SessionState,
+    Synchronized,
+    Synchronizing,
+)
+from ggrs_tpu.core.varrec import (
+    VARREC_HEADER_BYTES,
+    envelope_pack,
+    envelope_size,
+    envelope_split,
+    envelope_unpack,
+)
+from ggrs_tpu.fleet import PoolShard
+from ggrs_tpu.games import RtsCmd, RtsCmdGame, decode_commands, encode_commands
+from ggrs_tpu.net import InMemoryNetwork, _native
+from ggrs_tpu.obs.registry import Registry
+from ggrs_tpu.parallel.host_bank import (
+    SLOT_EVICTED,
+    SLOT_NATIVE,
+    HostSessionPool,
+)
+from ggrs_tpu.predict import (
+    BatchedDefault,
+    BatchedRepeatLast,
+    DevicePredictionPlane,
+    PredictDefault,
+    PredictRepeatLast,
+)
+from ggrs_tpu.sessions import SessionBuilder
+
+from test_input_types_e2e import FoldGame, run_p2p_pair, run_synctest
+
+needs_native = pytest.mark.skipif(
+    _native.bank_lib() is None, reason="native session bank unavailable"
+)
+
+FUZZ = dict(loss=0.08, duplicate=0.05, reorder=0.1, latency_ticks=1)
+
+
+# ---------------------------------------------------------------------------
+# deterministic command schedules (enum/Vec-shaped: 0-3 orders per frame)
+# ---------------------------------------------------------------------------
+
+
+def _commands(rng, units):
+    out = []
+    for _ in range(rng.randrange(0, 4)):
+        kind = rng.randrange(3)
+        if kind == 0:
+            out.append(("move", rng.randrange(units),
+                        rng.randrange(-2, 3), rng.randrange(-2, 3)))
+        elif kind == 1:
+            out.append(("gather", rng.randrange(units)))
+        else:
+            out.append(("build", rng.randrange(64), rng.randrange(64)))
+    return tuple(out)
+
+
+def cmd_sched(slot, i, units=4):
+    return _commands(random.Random(9000 + slot * 613 + i), units)
+
+
+def ext_sched(slot, i, units=4):
+    return _commands(random.Random(40000 + slot * 821 + i), units)
+
+
+# ---------------------------------------------------------------------------
+# the pool harness: B varrec matches, each against an external reference
+# peer on its own fault-isolated network (the chaos-suite topology, over
+# command streams instead of uint16)
+# ---------------------------------------------------------------------------
+
+
+def drive_varrec_pool(
+    ticks,
+    n_matches,
+    predictor_factory=None,
+    plane=False,
+    no_native=False,
+    seed=0,
+    fault_cfg=None,
+    journals=False,
+    tmp_path=None,
+    leg="",
+    inject=None,
+    frame_keyed=False,
+):
+    """Identical arguments (modulo the native/plane switches under test)
+    must produce bit-comparable observables: per-slot wire bytes, request
+    summaries, events, journal records, and final game checksums.
+
+    ``frame_keyed`` feeds each slot's local input by the slot's CURRENT
+    FRAME instead of the tick index (how a real driver samples input when
+    a frame is consumed).  Required by the demotion/eviction legs: a slot
+    adopted onto the per-session tier resumes behind the tick counter, so
+    a tick-keyed schedule would land different commands on each frame
+    than the control leg — a different game, not a comparable one."""
+    game = RtsCmd(num_players=2, num_units=4, max_cmds=4)
+    base = seed * 1000
+    clock = [0]
+    nets, socks, exts, ext_games = [], [], [], []
+    pool = HostSessionPool(metrics=Registry(enabled=False))
+    cfg0 = None
+    for m in range(n_matches):
+        fc = dict(fault_cfg or {"latency_ticks": 1})
+        fc.setdefault("seed", base + 100 + m)
+        net = InMemoryNetwork(**fc)
+        nets.append(net)
+        names = (f"A{m}", f"B{m}")
+        predictor = predictor_factory() if predictor_factory else None
+        cfg = game.config(predictor=predictor)
+        if cfg0 is None:
+            cfg0 = cfg
+        builder = (
+            SessionBuilder(cfg)
+            .with_clock(lambda: clock[0])
+            .with_rng(random.Random(base + 3 + 5 * m))
+            .add_player(Local(), 0)
+            .add_player(Remote(names[1]), 1)
+        )
+        sock = RecordingSocket(net.socket(names[0]))
+        socks.append(sock)
+        pool.add_session(builder, sock)
+        # the external peer is the per-session Python reference in EVERY
+        # leg: scalar repeat-last, never pooled, never predicted-for by
+        # the plane — its wire bytes must not depend on the leg switches
+        ext = (
+            SessionBuilder(game.config())
+            .with_clock(lambda: clock[0])
+            .with_rng(random.Random(base + 4000 + m))
+            .add_player(Remote(names[0]), 0)
+            .add_player(Local(), 1)
+            .start_p2p_session(net.socket(names[1]))
+        )
+        exts.append(ext)
+        ext_games.append(RtsCmdGame(game))
+    if no_native:
+        import os
+
+        os.environ["GGRS_TPU_NO_NATIVE"] = "1"
+        try:
+            native = pool.native_active
+        finally:
+            os.environ.pop("GGRS_TPU_NO_NATIVE", None)
+    else:
+        native = pool.native_active
+    journal_list = []
+    if journals:
+        for m in range(n_matches):
+            journal = MatchJournal(
+                tmp_path / f"{leg or ('n' if native else 'p')}-{m}.journal",
+                num_players=2,
+                input_size=cfg0.native_input_size,
+                tail_window=4 * ticks + 16,
+            )
+            journal_list.append(journal)
+            if native:
+                pool.set_confirmed_stream(m, journal)
+            else:
+                pool._sessions[m].adopt_spectator_endpoint(
+                    JournalTap.ADDR, JournalTap(journal, cfg0)
+                )
+    plane_obj = None
+    if plane:
+        plane_obj = DevicePredictionPlane(cfg0, capacity=n_matches)
+        pool.attach_prediction_plane(plane_obj)
+    slot_games = [RtsCmdGame(game) for _ in range(n_matches)]
+    reqs_log = [[] for _ in range(n_matches)]
+    events_log = [[] for _ in range(n_matches)]
+    ctx = dict(pool=pool, exts=exts, nets=nets, clock=clock,
+               games=slot_games, target=n_matches - 1, seed=seed)
+    last_fed = [-1] * n_matches
+    ext_fed = [-1] * n_matches
+    for i in range(ticks):
+        clock[0] += 16
+        if inject is not None:
+            inject(i, ctx)
+        for m, ext in enumerate(exts):
+            if frame_keyed:
+                frame = ext.current_frame
+                if frame != ext_fed[m]:
+                    ext.add_local_input(1, ext_sched(m, frame))
+                    ext_fed[m] = frame
+            else:
+                ext.add_local_input(1, ext_sched(m, i))
+            ext_games[m].handle_requests(ext.advance_frame())
+        for m in range(n_matches):
+            if frame_keyed:
+                frame = pool.current_frame(m)
+                if frame != last_fed[m]:
+                    pool.add_local_input(m, 0, cmd_sched(m, frame))
+                    last_fed[m] = frame
+            else:
+                pool.add_local_input(m, 0, cmd_sched(m, i))
+        for m, reqs in enumerate(pool.advance_all()):
+            slot_games[m].handle_requests(reqs)
+            reqs_log[m].append(req_summary(reqs))
+        for m in range(n_matches):
+            events_log[m].extend(pool.events(m))
+        for net in nets:
+            net.tick()
+    ctx.update(
+        native=native,
+        wire=[s.sent for s in socks],
+        reqs=reqs_log,
+        events=events_log,
+        states=[pool.slot_state(m) for m in range(n_matches)],
+        frames=[pool.current_frame(m) for m in range(n_matches)],
+        checksums=[g.checksum() for g in slot_games],
+        ext_checksums=[g.checksum() for g in ext_games],
+        journals=journal_list,
+        plane=plane_obj,
+    )
+    return ctx
+
+
+def assert_legs_identical(a, b, journals=False):
+    assert a["wire"] == b["wire"], "wire bytes diverged"
+    assert a["reqs"] == b["reqs"], "request streams diverged"
+    assert a["events"] == b["events"], "event streams diverged"
+    assert a["frames"] == b["frames"]
+    assert a["checksums"] == b["checksums"]
+    assert a["ext_checksums"] == b["ext_checksums"]
+    if journals:
+        for ja, jb in zip(a["journals"], b["journals"]):
+            assert list(ja.tail) == list(jb.tail), "journal records diverged"
+            assert ja.next_frame == jb.next_frame
+            assert ja.next_frame > 0, "journal never saw a confirmed frame"
+
+
+# ---------------------------------------------------------------------------
+# varrec envelope codec
+# ---------------------------------------------------------------------------
+
+
+class TestVarrecEnvelope:
+    def test_round_trip_all_lengths(self):
+        for n in range(17):
+            payload = bytes(range(n))
+            env = envelope_pack(payload, 16)
+            assert len(env) == envelope_size(16) == 16 + VARREC_HEADER_BYTES
+            assert envelope_unpack(env) == payload
+            assert envelope_split(env) == (payload, bytes(16 - n))
+
+    def test_empty_payload_is_all_zero_envelope(self):
+        # the native core's blank input IS the default record
+        assert envelope_pack(b"", 8) == bytes(envelope_size(8))
+
+    def test_nonzero_padding_rejected(self):
+        env = bytearray(envelope_pack(b"ab", 8))
+        env[-1] = 1
+        with pytest.raises(ValueError):
+            envelope_unpack(bytes(env))
+        # the raw splitter is the lenient inverse (wire decode path)
+        payload, padding = envelope_split(bytes(env))
+        assert payload == b"ab" and padding[-1] == 1
+
+    def test_capacity_errors(self):
+        with pytest.raises(ValueError):
+            envelope_pack(b"abc", 2)
+        with pytest.raises(ValueError):
+            envelope_size(0)
+        with pytest.raises(ValueError):
+            envelope_size(0x10000)
+
+    def test_injective_over_distinct_payloads(self):
+        seen = set()
+        for payload in (b"", b"\x00", b"\x00\x00", b"a", b"ab", b"ba"):
+            seen.add(envelope_pack(payload, 4))
+        assert len(seen) == 6
+
+    def test_config_for_varrec_round_trip(self):
+        cfg = RtsCmd(max_cmds=4).config()
+        cmds = (("move", 1, -2, 2), ("gather", 3), ("build", 7, 9))
+        blob = cfg.input_encode(cmds)
+        assert len(blob) == cfg.native_input_size == envelope_size(16)
+        assert cfg.input_decode(blob) == cmds
+        assert cfg.input_encode(cfg.input_default()) == bytes(len(blob))
+
+    def test_for_varrec_rejects_nonempty_default(self):
+        with pytest.raises(ValueError):
+            Config.for_varrec(8, default=lambda: b"x")
+
+
+# ---------------------------------------------------------------------------
+# the command-stream game: encode/decode + JAX-vs-NumPy oracle
+# ---------------------------------------------------------------------------
+
+
+class TestRtsCmdGame:
+    def test_encode_decode_round_trip(self):
+        for slot in range(4):
+            for i in range(32):
+                cmds = cmd_sched(slot, i)
+                assert decode_commands(encode_commands(cmds)) == cmds
+
+    def test_jax_advance_matches_numpy_oracle(self):
+        import numpy as np
+
+        game = RtsCmd(num_players=2, num_units=4, max_cmds=4)
+        s_np = game.init_state_np()
+        s_jx = game.init_state()
+        for i in range(24):
+            streams = [cmd_sched(0, i), ext_sched(0, i)]
+            s_np = game.advance_np(s_np, streams)
+            s_jx = game.advance(s_jx, game.envelopes_np(streams))
+        for k in s_np:
+            assert np.array_equal(np.asarray(s_jx[k]), s_np[k]), k
+
+
+# ---------------------------------------------------------------------------
+# varrec through the session pipeline (python path)
+# ---------------------------------------------------------------------------
+
+
+class TestVarrecSessions:
+    def test_synctest_rollback_round_trip(self):
+        cfg = RtsCmd(max_cmds=4).config()
+        game = run_synctest(
+            cfg, [lambda i: cmd_sched(0, i), lambda i: ext_sched(0, i)]
+        )
+        assert game.frame > 0 and game.acc != 0
+
+    def test_p2p_pair_converges(self):
+        cfg = RtsCmd(max_cmds=4).config()
+        game_a, game_b = run_p2p_pair(
+            cfg, lambda i: cmd_sched(0, i), lambda i: ext_sched(0, i)
+        )
+        assert game_a.acc == game_b.acc
+        assert game_a.frame == game_b.frame > 0
+
+
+# ---------------------------------------------------------------------------
+# pluggable prediction: plane on/off and cross-strategy parity
+# ---------------------------------------------------------------------------
+
+
+class TestPredictorParity:
+    def test_plane_on_off_bit_identical(self):
+        for fault in (None, dict(FUZZ)):
+            off = drive_varrec_pool(
+                40, 4, predictor_factory=BatchedRepeatLast, fault_cfg=fault
+            )
+            on = drive_varrec_pool(
+                40, 4, predictor_factory=BatchedRepeatLast, fault_cfg=fault,
+                plane=True,
+            )
+            # batched strategies are never native-eligible: both legs run
+            # the fallback path, where the plane hooks
+            assert not off["native"] and not on["native"]
+            assert_legs_identical(off, on)
+            stats = on["plane"].stats()
+            assert stats["ticks"] == 40 and stats["registered"] == 4
+            assert stats["hits"] > 0, "plane never served a prediction"
+
+    def test_batched_default_plane_parity(self):
+        off = drive_varrec_pool(
+            40, 4, predictor_factory=BatchedDefault,
+            fault_cfg=dict(FUZZ),
+        )
+        on = drive_varrec_pool(
+            40, 4, predictor_factory=BatchedDefault,
+            fault_cfg=dict(FUZZ), plane=True,
+        )
+        assert_legs_identical(off, on)
+        assert on["plane"].stats()["hits"] > 0
+
+    def test_confirmed_stream_is_predictor_independent(self, tmp_path):
+        """Prediction only fills unconfirmed frames: whatever the
+        strategy (and however differently it mispredicts under fuzz),
+        the confirmed stream — journal records, final game state, frame
+        count — must be identical."""
+        legs = [
+            drive_varrec_pool(
+                40, 4, predictor_factory=factory, fault_cfg=dict(FUZZ),
+                no_native=True, journals=True, tmp_path=tmp_path, leg=name,
+            )
+            for name, factory in [
+                ("repeat", None),
+                ("default", PredictDefault),
+                ("brepeat", BatchedRepeatLast),
+                ("bdefault", BatchedDefault),
+            ]
+        ]
+        ref = legs[0]
+        assert ref["journals"][0].next_frame > 0
+        for leg in legs[1:]:
+            for ja, jb in zip(ref["journals"], leg["journals"]):
+                assert list(ja.tail) == list(jb.tail)
+                assert ja.next_frame == jb.next_frame
+            # frame CADENCE is predictor-independent too; the head game
+            # states are not compared — they include speculative frames
+            # simulated from strategy-specific predictions
+            assert ref["frames"] == leg["frames"]
+
+
+# ---------------------------------------------------------------------------
+# the acceptance leg: B=64 device-batched pool vs per-session reference
+# ---------------------------------------------------------------------------
+
+
+class TestBatchedPoolAcceptance:
+    def test_b64_plane_bit_identical_to_reference(self, tmp_path):
+        fault = dict(loss=0.05, duplicate=0.03, reorder=0.05,
+                     latency_ticks=1)
+        ref = drive_varrec_pool(
+            25, 64, predictor_factory=BatchedRepeatLast, fault_cfg=fault,
+            journals=True, tmp_path=tmp_path, leg="ref",
+        )
+        dev = drive_varrec_pool(
+            25, 64, predictor_factory=BatchedRepeatLast, fault_cfg=fault,
+            journals=True, tmp_path=tmp_path, leg="dev", plane=True,
+        )
+        assert_legs_identical(ref, dev, journals=True)
+        stats = dev["plane"].stats()
+        assert stats["registered"] == 64
+        assert stats["hits"] > 0
+
+
+# ---------------------------------------------------------------------------
+# varrec on the native session bank
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+class TestNativeVarrecBank:
+    def test_native_matches_python_reference_under_fuzz(self, tmp_path):
+        nat = drive_varrec_pool(
+            50, 8, fault_cfg=dict(FUZZ), journals=True, tmp_path=tmp_path,
+        )
+        ref = drive_varrec_pool(
+            50, 8, fault_cfg=dict(FUZZ), journals=True, tmp_path=tmp_path,
+            no_native=True,
+        )
+        assert nat["native"] and not ref["native"]
+        assert_legs_identical(nat, ref, journals=True)
+
+    def test_journal_file_round_trips_commands(self, tmp_path):
+        """The journal's joined-input records split back into per-player
+        varrec envelopes whose payloads decode to the original command
+        tuples — the on-disk resume format carries variable-size inputs
+        losslessly."""
+        run = drive_varrec_pool(
+            30, 2, journals=True, tmp_path=tmp_path, leg="rt",
+        )
+        isize = RtsCmd(max_cmds=4).config().native_input_size
+        for m, journal in enumerate(run["journals"]):
+            journal.close()
+            parsed = read_journal(journal.path)
+            frames = parsed["frames"]
+            assert not parsed["truncated"] and len(frames) > 0
+            for frame, flags, joined in frames:
+                assert flags == b"\x00\x00"
+                for player in range(2):
+                    env = joined[player * isize:(player + 1) * isize]
+                    sched = cmd_sched if player == 0 else ext_sched
+                    assert decode_commands(envelope_unpack(env)) == \
+                        sched(m, frame)
+
+
+# ---------------------------------------------------------------------------
+# lockstep tier: session-level semantics
+# ---------------------------------------------------------------------------
+
+
+class TraceFold(FoldGame):
+    """FoldGame recording the accumulator after every simulated frame;
+    the LAST write per frame is the settled (confirmed) value."""
+
+    def __init__(self, encode):
+        super().__init__(encode)
+        self.trace = {}
+
+    def advance(self, inputs):
+        super().advance(inputs)
+        self.trace[self.frame] = self.acc
+
+
+def _drive_pair(cfg, max_prediction, ticks, fault_cfg=None):
+    net = InMemoryNetwork(**(fault_cfg or {"latency_ticks": 1}))
+    clock = [0]
+    sessions, games, raw = [], [], []
+    for me, other, handle in (("A", "B", 0), ("B", "A", 1)):
+        builder = (
+            SessionBuilder(cfg)
+            .with_clock(lambda: clock[0])
+            .with_rng(random.Random(17 + handle))
+            .add_player(Local(), handle)
+            .add_player(Remote(other), 1 - handle)
+        )
+        if max_prediction is not None:
+            builder.with_max_prediction_window(max_prediction)
+        sessions.append(builder.start_p2p_session(net.socket(me)))
+        games.append(TraceFold(cfg.input_encode))
+        raw.append([])
+    # inputs are keyed by FRAME, not tick: a lockstep session stalls at
+    # pipeline fill, so tick-keyed schedules would land on different
+    # frames than the rollback leg and the confirmed streams would be
+    # different games, not comparable ones
+    last_fed = [-1, -1]
+    for _ in range(ticks):
+        clock[0] += 16
+        for handle, (sess, game) in enumerate(zip(sessions, games)):
+            sess.poll_remote_clients()
+            frame = sess.current_frame
+            if frame != last_fed[handle]:
+                sess.add_local_input(handle, cmd_sched(handle, frame))
+                last_fed[handle] = frame
+            reqs = sess.advance_frame()
+            raw[handle].extend(reqs)
+            game.handle_requests(reqs)
+        net.tick()
+    return sessions, games, raw
+
+
+class TestLockstepSession:
+    def test_never_saves_never_loads_never_predicts(self):
+        cfg = RtsCmd(max_cmds=4).config()
+        sessions, games, raw = _drive_pair(cfg, 0, 40)
+        for sess, game, reqs in zip(sessions, games, raw):
+            assert sess.in_lockstep_mode()
+            assert not any(
+                isinstance(r, (SaveGameState, LoadGameState)) for r in reqs
+            ), "lockstep session emitted save/load work"
+            advances = [r for r in reqs if isinstance(r, AdvanceFrame)]
+            assert advances, "lockstep pair never advanced"
+            for adv in advances:
+                for _value, status in adv.inputs:
+                    assert status is InputStatus.CONFIRMED
+            # never past the confirmed frontier
+            assert game.frame <= sess.confirmed_frame() + 1
+
+    def test_confirmed_output_matches_rollback_pair(self):
+        cfg = RtsCmd(max_cmds=4).config()
+        _, lock_games, _ = _drive_pair(cfg, 0, 40)
+        _, roll_games, _ = _drive_pair(cfg, None, 48)
+        for lock, roll in zip(lock_games, roll_games):
+            assert lock.frame > 10
+            assert roll.frame >= lock.frame
+            for frame, acc in lock.trace.items():
+                assert roll.trace[frame] == acc, (
+                    f"frame {frame}: lockstep fold diverged from the "
+                    "rollback pair's settled value"
+                )
+
+    def test_pool_rejects_demotion_on_fallback(self):
+        run = drive_varrec_pool(3, 2, no_native=True)
+        with pytest.raises(InvalidRequest):
+            run["pool"].demote_to_lockstep(0)
+
+
+# ---------------------------------------------------------------------------
+# lockstep tier: pool demotion (load shedding)
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+class TestLockstepDemotion:
+    DEMOTE_AT = 25
+
+    def _inject(self, i, ctx):
+        if i == self.DEMOTE_AT:
+            ctx["resume_frame"] = ctx["pool"].demote_to_lockstep(
+                ctx["target"]
+            )
+
+    def test_demotion_mid_run(self, tmp_path):
+        run = drive_varrec_pool(
+            60, 3, journals=True, tmp_path=tmp_path, leg="demo",
+            inject=self._inject, frame_keyed=True,
+        )
+        control = drive_varrec_pool(
+            60, 3, journals=True, tmp_path=tmp_path, leg="ctl",
+            frame_keyed=True,
+        )
+        target = run["target"]
+        assert run["states"][target] == SLOT_EVICTED
+        assert run["pool"].in_lockstep(target)
+        assert run["pool"].lockstep_slots() == {target: self.DEMOTE_AT}
+        # survivors: zero blast radius (bank-resident, bit-identical)
+        assert blast_radius_violations(run, control) == []
+        # the demoted match kept running past its resume point
+        assert run["frames"][target] > run["resume_frame"] > 0
+
+    def test_demoted_slot_never_saves_or_loads(self, tmp_path):
+        run = drive_varrec_pool(
+            60, 3, journals=True, tmp_path=tmp_path, leg="nl",
+            inject=self._inject, frame_keyed=True,
+        )
+        post = [
+            r
+            for tick in run["reqs"][run["target"]][self.DEMOTE_AT:]
+            for r in tick
+        ]
+        loads = [r for r in post if r[0] == "LoadGameState"]
+        assert len(loads) == 1, (
+            "expected exactly the one-time adoption load, got "
+            f"{len(loads)}"
+        )
+        assert not any(r[0] == "SaveGameState" for r in post)
+        advances = [r for r in post if r[0] == "adv"]
+        assert advances, "demoted slot never advanced"
+        for adv in advances:
+            for _value, status in adv[1]:
+                assert status is InputStatus.CONFIRMED, (
+                    "lockstep tier advanced on a predicted input"
+                )
+
+    def test_demoted_confirmed_stream_matches_control(self, tmp_path):
+        run = drive_varrec_pool(
+            60, 3, journals=True, tmp_path=tmp_path, leg="cs",
+            inject=self._inject, frame_keyed=True,
+        )
+        control = drive_varrec_pool(
+            60, 3, journals=True, tmp_path=tmp_path, leg="csc",
+            frame_keyed=True,
+        )
+        target = run["target"]
+        tail_run = list(run["journals"][target].tail)
+        tail_ctl = list(control["journals"][target].tail)
+        assert len(tail_run) > self.DEMOTE_AT, (
+            "journal stalled at demotion"
+        )
+        assert tail_run == tail_ctl[: len(tail_run)], (
+            "demoted slot's confirmed stream diverged from the rollback "
+            "control"
+        )
+
+    def test_demote_is_one_way_and_native_only(self, tmp_path):
+        run = drive_varrec_pool(
+            40, 2, inject=lambda i, ctx: (
+                ctx["pool"].demote_to_lockstep(0) if i == 10 else None
+            ),
+        )
+        with pytest.raises(InvalidRequest):
+            run["pool"].demote_to_lockstep(0)  # already on the tier
+
+    def test_shard_demote_match(self):
+        clock = [0]
+        shard = PoolShard("s0", capacity=4, metrics=Registry())
+        peers, nets, peer_reqs = [], [], []
+        for k in range(2):
+            net = InMemoryNetwork(latency_ticks=1, seed=50 + k)
+            nets.append(net)
+            shard.admit(
+                f"m{k}",
+                two_peer_builder(clock, 70 + 2 * k, 0, f"P{k}"),
+                net.socket(f"H-m{k}"),
+            )
+            peers.append(
+                two_peer_builder(
+                    clock, 71 + 2 * k, 1, f"H-m{k}", other_handle=0
+                ).start_p2p_session(net.socket(f"P{k}"))
+            )
+            peer_reqs.append([])
+
+        def tick(i):
+            clock[0] += 16
+            for k, peer in enumerate(peers):
+                peer.add_local_input(1, (i * 3 + k) % 16)
+                fulfill(peer.advance_frame())
+                shard.add_local_input(f"m{k}", 0, (i * 7 + k) % 16)
+            for reqs in shard.advance_all().values():
+                fulfill(reqs)
+            for net in nets:
+                net.tick()
+
+        for i in range(20):
+            tick(i)
+        assert shard.lockstep_matches() == []
+        resume = shard.demote_match("m1")
+        assert resume > 0
+        assert shard.lockstep_matches() == ["m1"]
+        before = shard.pool.current_frame(shard._matches["m1"])
+        for i in range(20, 40):
+            tick(i)
+        assert shard.pool.current_frame(shard._matches["m1"]) > before
+        assert shard.live_matches() == 2
+        with pytest.raises(InvalidRequest):
+            shard.demote_match("nope")
+
+
+# ---------------------------------------------------------------------------
+# varrec eviction adoption (fault path) — the OTHER road onto the
+# per-session tier must also carry variable-size inputs losslessly
+# ---------------------------------------------------------------------------
+
+
+@needs_native
+class TestVarrecEviction:
+    def test_fault_eviction_adopts_varrec_match(self, tmp_path):
+        def inject(i, ctx):
+            if i == 20:
+                ctx["pool"].inject_slot_error(ctx["target"])
+
+        run = drive_varrec_pool(
+            50, 3, journals=True, tmp_path=tmp_path, leg="ev",
+            inject=inject, frame_keyed=True,
+        )
+        control = drive_varrec_pool(
+            50, 3, journals=True, tmp_path=tmp_path, leg="evc",
+            frame_keyed=True,
+        )
+        target = run["target"]
+        assert run["states"][target] == SLOT_EVICTED
+        assert not run["pool"].in_lockstep(target), (
+            "fault eviction must not be tagged as a lockstep demotion"
+        )
+        assert blast_radius_violations(run, control) == []
+        tail_run = list(run["journals"][target].tail)
+        tail_ctl = list(control["journals"][target].tail)
+        assert len(tail_run) > 20
+        assert tail_run == tail_ctl[: len(tail_run)]
+
+
+# ---------------------------------------------------------------------------
+# sync-handshake decision pin (DESIGN.md §27): default sessions start
+# Running and the handshake vocabulary stays dormant
+# ---------------------------------------------------------------------------
+
+
+class TestSyncHandshakeDefault:
+    def test_default_run_emits_no_handshake_events(self):
+        cfg = RtsCmd(max_cmds=4).config()
+        net = InMemoryNetwork(latency_ticks=1)
+        clock = [0]
+        sessions = []
+        for me, other, handle in (("A", "B", 0), ("B", "A", 1)):
+            sessions.append(
+                SessionBuilder(cfg)
+                .with_clock(lambda: clock[0])
+                .with_rng(random.Random(5 + handle))
+                .add_player(Local(), handle)
+                .add_player(Remote(other), 1 - handle)
+                .start_p2p_session(net.socket(me))
+            )
+        # the vocabulary survives (callers may still match on it) ...
+        assert Synchronizing is not None and Synchronized is not None
+        events = []
+        for sess in sessions:
+            # ... but a default build starts Running: no handshake phase
+            assert sess.current_state() is SessionState.RUNNING
+        for i in range(30):
+            clock[0] += 16
+            for handle, sess in enumerate(sessions):
+                sess.poll_remote_clients()
+                sess.add_local_input(handle, cmd_sched(handle, i))
+                fulfill(sess.advance_frame())
+                events.extend(sess.events())
+            net.tick()
+        assert not any(
+            isinstance(e, (Synchronizing, Synchronized)) for e in events
+        ), "default session produced handshake events"

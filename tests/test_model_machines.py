@@ -41,7 +41,7 @@ class TestCatalog:
         findings, results = check_models(REPO)
         elapsed = time.monotonic() - t0
         assert findings == []
-        assert len(results) == len(MODEL_CATALOG) == 16
+        assert len(results) == len(MODEL_CATALOG) == 18
         assert elapsed < 60.0  # the build_sanitized.sh budget
         by_name = {r["model"]: r for r in results}
         heads = [n for n in by_name if n.endswith(":head")

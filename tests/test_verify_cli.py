@@ -49,12 +49,12 @@ class TestVerifyCli:
         out = tmp_path / "verify.json"
         proc = run_cli("--model", "--no-runtime", "--json", str(out))
         assert proc.returncode == 0, proc.stdout + proc.stderr
-        assert "model leg: 16 models," in proc.stdout
+        assert "model leg: 18 models," in proc.stdout
         assert "invariant(expected)" in proc.stdout
         verdict = json.loads(out.read_text())
         assert verdict["counts"]["model"] == 0
         models = {m["model"]: m for m in verdict["models"]}
-        assert len(models) == 16
+        assert len(models) == 18
         # the pinned §20.4 counterexample rides in the artifact,
         # replayable from the trace alone
         fix = models["checkpoint-order:pre-pr11"]

@@ -629,3 +629,93 @@ class TestIngressWireSkew:
         assert ingress.ROUTE_UPDATE.format == ING_ROUTE_FMT
         assert (ingress.ROUTE_UPDATE.size
                 == ingress.FWD_HEADER.size + ING_FENCE_BYTES)
+
+
+VARREC_GOOD = """\
+import struct
+VARREC_HEADER_FMT = "<H"
+VARREC_HEADER_BYTES = 2
+VARREC_MAX_CAPACITY = 0xFFFF
+def envelope_pack(payload, capacity):
+    return struct.pack("<H", len(payload)) + payload
+"""
+
+RTSCMD_GOOD = """\
+from ..core.varrec import VARREC_HEADER_BYTES
+CMD_BYTES = 4
+"""
+
+
+class TestVarrecSkew:
+    """§27 envelope contract: the [u16 len][payload][pad] framing is
+    what makes variable-size inputs native-eligible, so a drifted
+    header silently desyncs every varrec match — the fixtures prove the
+    checker fires before that can land."""
+
+    def _tree(self, tmp_path, varrec_text, rtscmd_text=RTSCMD_GOOD):
+        (tmp_path / "ggrs_tpu/core").mkdir(parents=True)
+        (tmp_path / "ggrs_tpu/games").mkdir(parents=True)
+        (tmp_path / "ggrs_tpu/core/varrec.py").write_text(varrec_text)
+        (tmp_path / "ggrs_tpu/games/rtscmd.py").write_text(rtscmd_text)
+        return tmp_path
+
+    def _check(self, root):
+        from ggrs_tpu.analysis.layout import _check_varrec
+        return _check_varrec(root)
+
+    def test_clean_fixture_passes(self, tmp_path):
+        assert self._check(self._tree(tmp_path, VARREC_GOOD)) == []
+
+    def test_header_fmt_drift_fires(self, tmp_path):
+        # widening the length prefix to u32 shifts every payload byte:
+        # old and new nodes would decode different records from the
+        # same envelope
+        bad = VARREC_GOOD.replace('"<H"', '"<I"')
+        findings = self._check(self._tree(tmp_path, bad))
+        assert any(
+            f.rule == "layout/varrec-header"
+            and "length prefix" in f.detail
+            for f in findings
+        )
+
+    def test_header_width_drift_fires(self, tmp_path):
+        # the byte-literal width is what the device-side decode and the
+        # native jump offsets consume; it must track the fmt
+        bad = VARREC_GOOD.replace("VARREC_HEADER_BYTES = 2",
+                                  "VARREC_HEADER_BYTES = 4")
+        findings = self._check(self._tree(tmp_path, bad))
+        assert any(
+            f.rule == "layout/varrec-header"
+            and "VARREC_HEADER_BYTES" in f.detail
+            for f in findings
+        )
+
+    def test_capacity_bound_drift_fires(self, tmp_path):
+        # a capacity past the u16 length prefix's reach could frame
+        # payloads whose length does not round-trip
+        bad = VARREC_GOOD.replace("0xFFFF", "0x1FFFF")
+        findings = self._check(self._tree(tmp_path, bad))
+        assert any(f.rule == "layout/varrec-capacity" for f in findings)
+
+    def test_consumer_literal_offset_fires(self, tmp_path):
+        # the in-kernel decode must read the header width through the
+        # shared constant — a hand-inlined 2 drifts silently when the
+        # envelope changes
+        findings = self._check(self._tree(
+            tmp_path, VARREC_GOOD,
+            rtscmd_text="CMD_BYTES = 4\nHEADER = 2\n",
+        ))
+        assert any(f.rule == "layout/varrec-consumer" for f in findings)
+
+    def test_contract_matches_live_module(self):
+        from ggrs_tpu.analysis.layout import (
+            VARREC_HEADER_BYTES,
+            VARREC_HEADER_FMT,
+            VARREC_MAX_CAPACITY,
+        )
+        from ggrs_tpu.core import varrec
+
+        assert varrec.VARREC_HEADER_FMT == VARREC_HEADER_FMT
+        assert varrec.VARREC_HEADER_BYTES == VARREC_HEADER_BYTES
+        assert varrec.VARREC_MAX_CAPACITY == VARREC_MAX_CAPACITY
+        assert varrec.envelope_size(60) == 60 + VARREC_HEADER_BYTES
