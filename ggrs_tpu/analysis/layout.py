@@ -83,10 +83,18 @@ TCP_NONCE_BYTES = 16
 # the ingress<->leg uplink, and the route-update frame — the SAME shape
 # plus the two u64 fence words (placement epoch, route version) between
 # the version byte-pair and the vport, so a route write can never be
-# confused with (or replayed as) a forwarded datagram.
+# confused with (or replayed as) a forwarded datagram.  v2 (§28) grew a
+# trailing 16-byte trace context on the route frame.
 ING_FWD_FMT = "<2sBBHH4s"
-ING_ROUTE_FMT = "<2sBBQQHH4s"
+ING_ROUTE_FMT = "<2sBBQQHH4s16s"
 ING_FENCE_BYTES = 16  # epoch u64 + route-version u64
+ING_ROUTE_WIRE_VERSION = 2  # bumped when the trace-context tail landed
+
+# §28 trace context (obs/timeline.py TRACE_CTX, mirrored as a literal
+# in fleet/transport.py): match-id hash u64, placement epoch u32, span
+# id u32 — 16 bytes riding the route-update tail and RPC payloads.
+TRACE_CTX_FMT = "<QII"
+TRACE_CTX_BYTES = 16
 
 # Harvest prefix (ggrs_bank_harvest): i64 current, i64 last_confirmed,
 # i64 disconnect_frame.
@@ -688,12 +696,14 @@ def _check_ingress_wire(root: Path) -> List[Finding]:
                 "drifted from the §26 contract?)",
             ))
     if (struct.calcsize(ING_ROUTE_FMT)
-            != struct.calcsize(ING_FWD_FMT) + ING_FENCE_BYTES):
+            != struct.calcsize(ING_FWD_FMT) + ING_FENCE_BYTES
+            + TRACE_CTX_BYTES):
         out.append(Finding(
             "layout/ingress-wire", "ggrs_tpu/fleet/ingress.py", 0,
             f"route frame {ING_ROUTE_FMT!r} is not the forwarded "
             f"header {ING_FWD_FMT!r} + {ING_FENCE_BYTES} fence bytes "
-            "(epoch u64 + route-version u64 drifted?)",
+            f"+ {TRACE_CTX_BYTES} trace-context bytes (epoch u64 + "
+            "route-version u64 + trace ctx drifted?)",
         ))
     consts = parse_py_constants(ing)
     for name in ("FWD_VERSION", "ROUTE_WIRE_VERSION"):
@@ -703,11 +713,59 @@ def _check_ingress_wire(root: Path) -> List[Finding]:
                 f"{name} constant not statically visible (version "
                 "refusal needs a comparable constant)",
             ))
+    if (consts.get("ROUTE_WIRE_VERSION") is not None
+            and consts.get("ROUTE_WIRE_VERSION")
+            != ING_ROUTE_WIRE_VERSION):
+        out.append(Finding(
+            "layout/ingress-wire", "ggrs_tpu/fleet/ingress.py", 0,
+            f"ROUTE_WIRE_VERSION {consts.get('ROUTE_WIRE_VERSION')!r} "
+            f"!= contract {ING_ROUTE_WIRE_VERSION} (the v2 trace-"
+            "context tail requires the version bump)",
+        ))
     if (consts.get("ROUTE_OP_PUT"), consts.get("ROUTE_OP_DEL")) != (1, 2):
         out.append(Finding(
             "layout/ingress-wire", "ggrs_tpu/fleet/ingress.py", 0,
             f"route ops PUT={consts.get('ROUTE_OP_PUT')!r} "
             f"DEL={consts.get('ROUTE_OP_DEL')!r} != contract (1, 2)",
+        ))
+    return out
+
+
+def _check_trace_context(root: Path) -> List[Finding]:
+    """The §28 trace context: timeline.py owns the definition,
+    transport.py mirrors it as a literal (RPC payload carriage), and
+    the ingress route frame's trailing ``16s`` makes room for exactly
+    ``TRACE_CTX_BYTES`` — all three pinned to the same 16 bytes."""
+    out: List[Finding] = []
+    for rel in ("ggrs_tpu/obs/timeline.py", "ggrs_tpu/fleet/transport.py"):
+        path = root / rel
+        fmts = {f.fmt for f in parse_py_struct_formats(path)}
+        if TRACE_CTX_FMT not in fmts:
+            out.append(Finding(
+                "layout/trace-context", rel, 0,
+                f"trace context {TRACE_CTX_FMT!r} not found (the §28 "
+                "16-byte context drifted from the contract?)",
+            ))
+        consts = parse_py_constants(path)
+        if consts.get("TRACE_CTX_BYTES") != TRACE_CTX_BYTES:
+            out.append(Finding(
+                "layout/trace-context", rel, 0,
+                f"TRACE_CTX_BYTES {consts.get('TRACE_CTX_BYTES')!r} != "
+                f"contract {TRACE_CTX_BYTES}",
+            ))
+    if struct.calcsize(TRACE_CTX_FMT) != TRACE_CTX_BYTES:
+        out.append(Finding(
+            "layout/trace-context", "ggrs_tpu/analysis/layout.py", 0,
+            f"trace context {TRACE_CTX_FMT!r} packs to "
+            f"{struct.calcsize(TRACE_CTX_FMT)} bytes, contract says "
+            f"{TRACE_CTX_BYTES}",
+        ))
+    # the route frame's tail must hold exactly one packed context
+    if not ING_ROUTE_FMT.endswith(f"{TRACE_CTX_BYTES}s"):
+        out.append(Finding(
+            "layout/trace-context", "ggrs_tpu/fleet/ingress.py", 0,
+            f"route frame {ING_ROUTE_FMT!r} does not end in a "
+            f"{TRACE_CTX_BYTES}-byte tail for the trace context",
         ))
     return out
 
@@ -847,6 +905,7 @@ def check_layout(
     findings += _check_rpc_framing(root)
     findings += _check_tcp_handshake(root)
     findings += _check_ingress_wire(root)
+    findings += _check_trace_context(root)
     findings += _check_stat_tables(root)
     findings += _check_varrec(root)
     return findings

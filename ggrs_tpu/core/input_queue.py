@@ -49,6 +49,15 @@ class InputQueue(Generic[I]):
         self._plane = None
         self._plane_slot = 0
         self._plane_player = 0
+        self._prediction_via_plane = False
+        # prediction-accuracy accounting (DESIGN.md §28): one mispredict
+        # per rollback episode (the first_incorrect transition), split by
+        # the source that produced the wrong value, plus the re-simulated
+        # frames each episode cost — the pool scrape aggregates these
+        # into the ggrs_predict_* family at zero extra crossings
+        self.mispredicts = 0
+        self.plane_mispredicts = 0
+        self.mispredict_depth_frames = 0
 
     # ------------------------------------------------------------------
     # bookkeeping
@@ -144,7 +153,9 @@ class InputQueue(Generic[I]):
                 self._plane_slot, self._plane_player, previous
             )
             if hit:
+                self._prediction_via_plane = True
                 return value
+        self._prediction_via_plane = False
         return self._config.predictor.predict(previous)
 
     # ------------------------------------------------------------------
@@ -195,6 +206,15 @@ class InputQueue(Generic[I]):
             # Record the first incorrect prediction so the session can roll back.
             if self.first_incorrect_frame == NULL_FRAME and not prediction_matches:
                 self.first_incorrect_frame = frame_number
+                self.mispredicts += 1
+                if self._prediction_via_plane:
+                    self.plane_mispredicts += 1
+                if self.last_requested_frame != NULL_FRAME:
+                    # frames simulated past the wrong input = the
+                    # rollback depth this mispredict just caused
+                    self.mispredict_depth_frames += max(
+                        0, self.last_requested_frame - frame_number + 1
+                    )
 
             # Exit prediction mode once reality has caught up with the last
             # frame the session asked for — but only if nothing was wrong.

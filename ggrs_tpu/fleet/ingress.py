@@ -73,6 +73,12 @@ from ..net.sockets import (
 from ..net.wire import WireError
 from ..obs.fleet_obs import RegistryCollector
 from ..obs.registry import DEFAULT, Registry
+from ..obs.timeline import (
+    EV_ROUTE_FLIP,
+    ZERO_TRACE_CTX,
+    timeline_event,
+    unpack_trace_ctx,
+)
 from ..utils.ownership import ThreadOwned
 from ..utils.tracing import get_logger
 from .proc import ShardRunner, _GracefulExit
@@ -93,41 +99,50 @@ _RUNNER_SCRIPT = _REPO_ROOT / "scripts" / "shard_runner.py"
 
 INGRESS_MAGIC = b"GI"
 FWD_VERSION = 1
-ROUTE_WIRE_VERSION = 1
+# v2 (DESIGN.md §28): the route-update frame grew a trailing 16-byte
+# trace context (obs/timeline.py TRACE_CTX) — the placement plane's
+# causal stamp rides the same fenced bytes as the route itself
+ROUTE_WIRE_VERSION = 2
 
 # forwarded-datagram header (ingress<->host leg): magic, version, flags,
 # vport, peer_port, peer_ipv4 — the payload follows verbatim
 FWD_HEADER = struct.Struct("<2sBBHH4s")
 
 # route-update frame: magic, version, op, epoch, route version, vport,
-# dst_port, dst_ipv4 — refused unless (epoch, version) beats the floor
-ROUTE_UPDATE = struct.Struct("<2sBBQQHH4s")
+# dst_port, dst_ipv4, trace_ctx — refused unless (epoch, version) beats
+# the floor
+ROUTE_UPDATE = struct.Struct("<2sBBQQHH4s16s")
 
 ROUTE_OP_PUT = 1
 ROUTE_OP_DEL = 2
 
 
 def encode_route_update(op: int, epoch: int, version: int, vport: int,
-                        dst: Tuple[str, int]) -> bytes:
+                        dst: Tuple[str, int],
+                        ctx: bytes = ZERO_TRACE_CTX) -> bytes:
     """Pack one route update.  ``dst`` is the serving leg's (ipv4, port);
     for a DEL the address still rides along (it names the leg being
-    retired, useful in logs) but is not required to resolve."""
+    retired, useful in logs) but is not required to resolve.  ``ctx`` is
+    the packed 16-byte trace context (``pack_trace_ctx``; all-zero =
+    no causal stamp)."""
     host, port = dst
     return ROUTE_UPDATE.pack(
         INGRESS_MAGIC, ROUTE_WIRE_VERSION, op, epoch, version, vport,
-        port, _socket.inet_aton(host),
+        port, _socket.inet_aton(host), ctx,
     )
 
 
-def decode_route_update(data: bytes
-                        ) -> Tuple[int, int, int, int, Tuple[str, int]]:
+def decode_route_update(
+    data: bytes,
+) -> Tuple[int, int, int, int, Tuple[str, int], bytes]:
     """Unpack + validate one route update; raises :class:`WireError` on
     anything malformed (the single judgment both the RPC op and the
-    in-process path share)."""
+    in-process path share).  The last element is the packed 16-byte
+    trace context."""
     if len(data) != ROUTE_UPDATE.size:
         raise WireError(
             f"route update: {len(data)} bytes, want {ROUTE_UPDATE.size}")
-    magic, ver, op, epoch, version, vport, port, ip4 = \
+    magic, ver, op, epoch, version, vport, port, ip4, ctx = \
         ROUTE_UPDATE.unpack(data)
     if magic != INGRESS_MAGIC:
         raise WireError(f"route update: bad magic {magic!r}")
@@ -135,7 +150,7 @@ def decode_route_update(data: bytes
         raise WireError(f"route update: unsupported version {ver}")
     if op not in (ROUTE_OP_PUT, ROUTE_OP_DEL):
         raise WireError(f"route update: unknown op {op}")
-    return op, epoch, version, vport, (_socket.inet_ntoa(ip4), port)
+    return op, epoch, version, vport, (_socket.inet_ntoa(ip4), port), ctx
 
 
 def pack_fwd(vport: int, peer: Tuple[str, int], payload: bytes,
@@ -215,6 +230,10 @@ class IngressNode(ThreadOwned):
         self._next_vport = 1
         self._recv_buf = bytearray(RECV_BUFFER_SIZE)
         self._recv_view = memoryview(self._recv_buf)
+        # route-flip timeline events (§28): buffered here, ferried by
+        # the runner's existing heartbeat obs payload (keyed by the wire
+        # trace context's hex — the ingress never learns a match id)
+        self._timeline_items: List[Dict[str, Any]] = []
         # plain mirrors for info()/healthz (cheap, no registry walk)
         self.flips = 0
         self.forwarded = {"in": 0, "out": 0}
@@ -294,7 +313,7 @@ class IngressNode(ThreadOwned):
         in-process caller go through — there is no unfenced side door."""
         self._check_owner()
         try:
-            op, epoch, version, vport, dst = decode_route_update(data)
+            op, epoch, version, vport, dst, ctx = decode_route_update(data)
         except WireError:
             return self._judge_update("bad-frame")
         if vport not in self._views:
@@ -315,6 +334,22 @@ class IngressNode(ThreadOwned):
             if prev is not None and prev.dst != dst:
                 self.flips += 1
                 self._c_flips.inc()
+                # §28: the flip, as witnessed at the dataplane, stamped
+                # with the trace context the fenced bytes carried — the
+                # cross-host join key is the trace hash, not a match id
+                trace, ctx_epoch, span = (
+                    unpack_trace_ctx(ctx) if ctx != ZERO_TRACE_CTX
+                    else (0, 0, 0))
+                ev = timeline_event(
+                    EV_ROUTE_FLIP, f"trace:{trace:016x}",
+                    origin=self.name, epoch=ctx_epoch, span=span,
+                    detail={"vport": vport,
+                            "from": f"{prev.dst[0]}:{prev.dst[1]}",
+                            "to": f"{dst[0]}:{dst[1]}"},
+                )
+                ev["trace"] = trace
+                self._timeline_items.append(ev)
+                del self._timeline_items[:-64]
         self._g_routes.set(len(self._routes))
         return self._judge_update("ok")
 
@@ -388,6 +423,13 @@ class IngressNode(ThreadOwned):
     def _drop(self, reason: str) -> None:
         self.dropped[reason] = self.dropped.get(reason, 0) + 1
         self._c_drop.labels(reason=reason).inc()
+
+    def drain_timeline(self) -> List[Dict[str, Any]]:
+        """Buffered route-flip timeline events, cleared — the runner's
+        heartbeat payload ships these (§28 piggyback contract)."""
+        out = self._timeline_items
+        self._timeline_items = []
+        return out
 
     # -- introspection / teardown --------------------------------------
 
@@ -531,6 +573,11 @@ class IngressRunner(ShardRunner):
                 hb_next = now + self.tuning.heartbeat_interval_s
                 if self.node is not None:
                     payload = self._obs_payload(include_spans=False)
+                    timeline = self.node.drain_timeline()
+                    if timeline:
+                        if payload is None:
+                            payload = {"now_ns": time.perf_counter_ns()}
+                        payload["timeline"] = timeline
                     try:
                         self.conn.send(KIND_HEARTBEAT, dict(
                             info=self.node.info(),
@@ -538,6 +585,10 @@ class IngressRunner(ShardRunner):
                         ), timeout=5.0)
                     except RpcTimeout:
                         self._requeue_obs(payload)
+                        if payload and payload.get("timeline"):
+                            self.node._timeline_items[:0] = (
+                                payload["timeline"])
+                            del self.node._timeline_items[:-64]
             wait = max(0.0, hb_next - now)
             fds = [self.conn.fileno()]
             if self.node is not None:

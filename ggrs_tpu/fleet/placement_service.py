@@ -40,6 +40,16 @@ from typing import Any, Callable, Dict, List, Optional, Set, Tuple
 
 from ..core.errors import InvalidRequest
 from ..obs.registry import Registry
+from ..obs.timeline import (
+    EV_FAILOVER,
+    EV_MIGRATE_ABORT,
+    EV_MIGRATE_BEGIN,
+    EV_MIGRATE_COMMIT,
+    EV_PLACE,
+    EV_ROUTE_FLIP,
+    TimelineStore,
+    pack_trace_ctx,
+)
 from ..utils.tracing import get_logger
 from .ingress import (
     ROUTE_OP_DEL,
@@ -127,6 +137,12 @@ class PlacementService:
         self.route_epoch = 1
         self._route_version = 0
         self._tick = 0
+        # match-lifecycle timelines (§28): the placement plane is the
+        # cross-host narrator — it sees every PLACE / MIGRATE_* /
+        # ROUTE_FLIP / FAILOVER edge, and mints the span ids the trace
+        # context carries onto the wire
+        self.timelines = TimelineStore()
+        self._span_seq = 0
         m = self.metrics
         self._m_admissions = m.counter(
             "ggrs_placement_admissions_total",
@@ -162,6 +178,21 @@ class PlacementService:
 
     def _host_addr(self, hid: str) -> str:
         return self.host_addrs.get(hid, "127.0.0.1")
+
+    def _next_span(self) -> int:
+        self._span_seq += 1
+        return self._span_seq
+
+    def _record_timeline(self, etype: str, match_id: str,
+                         span: Optional[int] = None,
+                         detail: Optional[Dict[str, Any]] = None
+                         ) -> Dict[str, Any]:
+        return self.timelines.record(
+            etype, match_id, origin="placement", tick=self._tick,
+            epoch=self.route_epoch,
+            span=span if span is not None else self._next_span(),
+            detail=detail,
+        )
 
     def host_refusal(self, hid: str) -> Optional[str]:
         """Why this host cannot take a match right now (None = it can):
@@ -245,6 +276,9 @@ class PlacementService:
         rec = PlacedMatch(match_id, hid, vport, peers)
         self._records[match_id] = rec
         self._m_admissions.labels(host=hid).inc()
+        self._record_timeline(
+            EV_PLACE, match_id,
+            detail={"host": hid, "vport": vport, "shard": placed})
         if placed is not None:
             self._push_route(rec)
         return hid
@@ -277,15 +311,26 @@ class PlacementService:
         if port is None:
             return False  # parked/pending: routed once actually placed
         self._route_version += 1
+        was_routed = rec.routed
+        # the §28 causal stamp rides the fenced route bytes themselves:
+        # the ingress re-emits the flip keyed by this exact context
+        span = self._next_span()
         update = encode_route_update(
             ROUTE_OP_PUT, self.route_epoch, self._route_version,
             rec.vport, (self._host_addr(rec.host), port),
+            ctx=pack_trace_ctx(rec.match_id, self.route_epoch, span),
         )
         verdict = self.ingress.apply_route_update(update)
         self._m_route_updates.labels(verdict=verdict).inc()
         if verdict != "ok":
             raise FleetError(
                 f"route update for {rec.match_id!r} refused: {verdict}")
+        if was_routed:
+            # a re-point of an already-live route IS the flip peers feel
+            self._record_timeline(
+                EV_ROUTE_FLIP, rec.match_id, span=span,
+                detail={"host": rec.host, "port": port,
+                        "vport": rec.vport})
         rec.routed = True
         return True
 
@@ -294,6 +339,8 @@ class PlacementService:
         update = encode_route_update(
             ROUTE_OP_DEL, self.route_epoch, self._route_version,
             rec.vport, (self._host_addr(rec.host), 0),
+            ctx=pack_trace_ctx(rec.match_id, self.route_epoch,
+                               self._next_span()),
         )
         verdict = self.ingress.apply_route_update(update)
         self._m_route_updates.labels(verdict=verdict).inc()
@@ -322,6 +369,9 @@ class PlacementService:
                 f"match {match_id!r} already serves on {src!r}")
         t0 = time.perf_counter()
         mig = _Migration(match_id, src, dst_host)
+        self._record_timeline(
+            EV_MIGRATE_BEGIN, match_id,
+            detail={"from": src, "to": dst_host, "reason": reason})
         blob = self.hosts[src].export_transfer(match_id)
         # ggrs-model: transitions(idle->exported)
         mig.phase = MIG_EXPORTED
@@ -344,6 +394,9 @@ class PlacementService:
         mig.phase = MIG_FLIPPED
         self._m_migrations.labels(reason=reason).inc()
         self._h_migration.observe(time.perf_counter() - t0)
+        self._record_timeline(
+            EV_MIGRATE_COMMIT, match_id,
+            detail={"from": src, "to": dst_host})
         # ggrs-model: transitions(flipped->idle)
         mig.phase = MIG_IDLE
         return dst_host
@@ -353,6 +406,9 @@ class PlacementService:
         """The abort edge: target refused/failed adoption, so the same
         exported bytes restore the match where it was (a fresh unpickle
         — the failed target may have half-consumed its copy)."""
+        self._record_timeline(
+            EV_MIGRATE_ABORT, rec.match_id,
+            detail={"to": mig.dst, "cause": str(cause)})
         try:
             self.hosts[rec.host].adopt_transfer(
                 rec.match_id, pickle.loads(wire))
@@ -397,6 +453,7 @@ class PlacementService:
 
     def _failover_match(self, rec: PlacedMatch) -> None:
         mig = _Migration(rec.match_id, None, "?")
+        dead_host = rec.host
         meta = rec.meta
         if meta is None:
             rec.lost = "no replicated meta survived the host"
@@ -431,6 +488,9 @@ class PlacementService:
             # ggrs-model: transitions(adopted->flipped)
             mig.phase = MIG_FLIPPED
             self._m_host_failovers.inc()
+            self._record_timeline(
+                EV_FAILOVER, rec.match_id,
+                detail={"from": dead_host, "to": dst})
             # ggrs-model: transitions(flipped->idle)
             mig.phase = MIG_IDLE
             return
@@ -553,7 +613,8 @@ class PlacementService:
             ok = ok and bool(h["ok"])
             pending += h.get("pending_admissions", 0)
             hosts[hid] = dict(ok=h["ok"], state="live",
-                              matches=h["matches"], tick=h["tick"])
+                              matches=h["matches"], tick=h["tick"],
+                              slo=h.get("slo"))
             for sid, sh in h["shards"].items():
                 sh = dict(sh)
                 sh["ingress_routes"] = routes_by_loc.get((hid, sid), 0)
@@ -563,6 +624,20 @@ class PlacementService:
             ing = self.ingress.info()
         except Exception as e:
             ing = dict(error=str(e))
+        # §28 rollup: the fleet-of-fleets SLO verdict is the worst
+        # host's — one level to page on, per-host detail kept under hosts
+        rank = {"ok": 0, "warn": 1, "critical": 2}
+        host_levels = {
+            hid: (hinfo.get("slo") or {}).get("level")
+            for hid, hinfo in hosts.items()
+            if (hinfo.get("slo") or {}).get("level")
+        }
+        slo = None
+        if host_levels:
+            worst = max(host_levels.values(),
+                        key=lambda lv: rank.get(lv, 0))
+            slo = dict(level=worst, ok=worst != "critical",
+                       hosts=host_levels)
         return dict(
             ok=ok and not lost and bool(hosts),
             tick=self._tick,
@@ -572,6 +647,7 @@ class PlacementService:
             pending_admissions=pending,
             lost_matches=len(lost),
             route_epoch=self.route_epoch,
+            slo=slo,
             ingress=ing,
         )
 

@@ -433,6 +433,9 @@ class ShardRunner:
             forensics = self.shard.drain_forensics()
             if forensics:
                 payload["forensics"] = forensics
+            timeline = self.shard.drain_timeline()
+            if timeline:
+                payload["timeline"] = timeline
         if include_spans and self.tracer.enabled:
             spans = self._new_spans()
             if spans:
@@ -455,6 +458,10 @@ class ShardRunner:
         if forensics and self.shard is not None:
             self.shard._forensic_items[:0] = forensics
             del self.shard._forensic_items[:-32]
+        timeline = payload.get("timeline")
+        if timeline and self.shard is not None:
+            self.shard._timeline_items[:0] = timeline
+            del self.shard._timeline_items[:-64]
         snaps = payload.get("metrics")
         if snaps:
             if not isinstance(snaps, list):

@@ -36,6 +36,14 @@ from ..core.errors import (
 )
 from ..core.types import GgrsRequest, SessionState
 from ..obs.registry import Registry, default_registry
+from ..obs.slo import ShardSloMeter
+from ..obs.timeline import (
+    EV_DESYNC,
+    EV_EVICT,
+    EV_QUARANTINE,
+    EV_RETIRE,
+    timeline_event,
+)
 from ..parallel.host_bank import (
     HostSessionPool,
     SLOT_DEAD,
@@ -170,6 +178,21 @@ class PoolShard:
         # reply, so the artifact outlives the child that produced it
         self._forensic_items: List[Dict[str, Any]] = []
         self._slot_last_state: Dict[str, str] = {}
+        # the timeline ferry (DESIGN.md §28): match-lifecycle events
+        # buffered exactly like forensics until drain_timeline() ships
+        # them on the next tick/heartbeat reply — zero extra round trips
+        self._timeline_items: List[Dict[str, Any]] = []
+        # a short per-match event history kept AFTER draining, so a
+        # DesyncReport captured late still embeds the match's lifecycle
+        # context (§28's "every DesyncReport carries its timeline")
+        self._timeline_history: Dict[str, List[Dict[str, Any]]] = {}
+        # per-tier SLO budget-compliance counters (§28), fed from the
+        # tick timer this loop already runs — they ride the registry
+        # harvest, adding zero crossings and zero RPCs
+        self.slo = ShardSloMeter(self.metrics)
+        # pool-level lifecycle emissions (host_bank §28 seam): the pool
+        # reports by slot, the shard translates to match ids
+        self.pool.timeline_sink = self._pool_timeline_event
         m = self.metrics
         self._g_matches = m.gauge(
             "ggrs_shard_matches", "matches served per shard, by tier",
@@ -409,8 +432,26 @@ class PoolShard:
             if am is not None:
                 self._journal_adopted(match_id, am)
         self.ticks += 1
-        self._tick_ms.append((time.perf_counter() - t0) * 1000.0)
+        tick_ms = (time.perf_counter() - t0) * 1000.0
+        self._tick_ms.append(tick_ms)
         self._g_p99.labels(shard=self.shard_id).set(self.tick_p99_ms())
+        # SLO compliance (§28): the rollback tier against the frame
+        # budget from the tick timer above; the lockstep tier against
+        # its confirmed-lag budget, read straight off the Python-tier
+        # sessions the lockstep slots already run on (no crossing)
+        self.slo.observe_rollback(tick_ms)
+        lockstep = self.pool.lockstep_slots()
+        if lockstep:
+            worst = 0
+            for slot in lockstep:
+                try:
+                    lag = (self.pool.current_frame(slot)
+                           - self.pool.last_confirmed_frame(slot))
+                except Exception:
+                    continue
+                if lag > worst:
+                    worst = lag
+            self.slo.observe_lockstep(worst)
         return out
 
     def _sweep_slot_forensics(self) -> None:
@@ -476,6 +517,16 @@ class PoolShard:
                 item["desync_report"] = report.to_dict()
         except Exception:
             pass
+        if state == "quarantined":
+            self._record_timeline(EV_QUARANTINE, match_id,
+                                  {"slot": slot})
+        if "desync_report" in item:
+            self._record_timeline(EV_DESYNC, match_id, {"slot": slot})
+            # every DesyncReport carries its match's lifecycle context
+            # (§28) — the events that led here, late-captured included
+            item["desync_report"]["timeline"] = list(
+                self._timeline_history.get(match_id, ())
+            )
         self._record_forensic(item)
 
     def _record_forensic(self, item: Dict[str, Any]) -> None:
@@ -486,6 +537,38 @@ class PoolShard:
         """Ship-and-clear the ferry buffer (plain JSON-safe dicts)."""
         out = self._forensic_items
         self._forensic_items = []
+        return out
+
+    # ------------------------------------------------------------------
+    # the timeline ferry (DESIGN.md §28)
+    # ------------------------------------------------------------------
+
+    def _record_timeline(self, etype: str, match_id: str,
+                         detail: Optional[Dict[str, Any]] = None) -> None:
+        ev = timeline_event(
+            etype, match_id, origin=self.shard_id, tick=self.ticks,
+            detail=detail,
+        )
+        self._timeline_items.append(ev)
+        del self._timeline_items[:-64]  # bounded while undrained
+        hist = self._timeline_history.setdefault(match_id, [])
+        hist.append(ev)
+        del hist[:-16]
+
+    def _pool_timeline_event(self, etype: str, slot: int,
+                             detail: Optional[Dict[str, Any]]) -> None:
+        """The pool's §28 emission seam: translate its slot-keyed event
+        to the match id this shard placed there."""
+        for match_id, s in self._matches.items():
+            if s == slot:
+                self._record_timeline(etype, match_id, detail)
+                return
+
+    def drain_timeline(self) -> List[Dict[str, Any]]:
+        """Ship-and-clear the timeline buffer — rides the same tick
+        reply / heartbeat payloads as :meth:`drain_forensics`."""
+        out = self._timeline_items
+        self._timeline_items = []
         return out
 
     def scrape(self):
@@ -518,6 +601,8 @@ class PoolShard:
                 kind="adopted", match=match_id, reason=reason,
                 tick=self.ticks,
             ))
+            self._record_timeline(EV_QUARANTINE, match_id,
+                                  {"reason": reason})
             _logger.error("shard %s match %s marked dead: %s",
                           self.shard_id, match_id, reason)
             return []
@@ -610,6 +695,7 @@ class PoolShard:
         self.pool.release_slot(
             slot, detail=f"migrated off shard {self.shard_id}"
         )
+        self._record_timeline(EV_EVICT, match_id, {"slot": slot})
         del self._matches[match_id]
         self._slot_last_state.pop(match_id, None)
         self._close_journal(match_id)
@@ -854,6 +940,8 @@ class PoolShard:
     def retire(self) -> None:
         # ggrs-model: transitions(active->retired, draining->retired)
         self.state = SHARD_RETIRED
+        for match_id in self.match_ids():
+            self._record_timeline(EV_RETIRE, match_id)
         for match_id in list(self._journals):
             self._close_journal(match_id)
 

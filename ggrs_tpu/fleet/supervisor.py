@@ -56,6 +56,14 @@ from ..core.types import (
 )
 from ..obs.fleet_obs import FleetObs
 from ..obs.registry import MultiRegistry, Registry, default_registry
+from ..obs.slo import BurnRateEngine
+from ..obs.timeline import (
+    EV_ADMIT,
+    EV_FAILOVER,
+    EV_MIGRATE_ABORT,
+    EV_MIGRATE_BEGIN,
+    EV_MIGRATE_COMMIT,
+)
 from ..obs.trace import NULL_TRACER
 from ..utils.tracing import get_logger
 from .placement import HashRing
@@ -180,6 +188,10 @@ class ShardSupervisor:
         # every runner's harvested metrics/spans/forensics; proc shards
         # share it so one scrape serves the whole fleet
         self.fleet_obs = FleetObs(metrics=self.metrics, tracer=self.tracer)
+        # the SLO plane (DESIGN.md §28): windowed burn rates over the
+        # merged ggrs_slo_* counters every shard's harvest already
+        # carries; a critical multi-window burn flips healthz to 503
+        self.slo = BurnRateEngine(metrics=self.metrics)
         self.journal_dir = (
             os.fspath(journal_dir) if journal_dir is not None else None
         )
@@ -323,6 +335,10 @@ class ShardSupervisor:
         placed = self._try_place(record, builder=probe, pinned=shard)
         if placed is None:
             self._park(record, attempts=0)
+        self.fleet_obs.record_timeline(
+            EV_ADMIT, match_id, origin="fleet", tick=self._tick,
+            detail={"shard": placed} if placed else {"parked": True},
+        )
         self._update_match_gauge()
         return placed
 
@@ -517,23 +533,32 @@ class ShardSupervisor:
                 self._tick % self.identity_refresh_every == 0
             ):
                 self._refresh_identities()
+            # burn-rate update over the merged fleet counters (§28):
+            # reads what the harvest already ferried — no new traffic
+            self.slo.update(self._tick, self.merged_registry())
         self.last_tick_at = time.monotonic()
         return out
 
     def _ferry_inproc_forensics(self) -> None:
-        """In-process shards feed the same forensics ring the runners
-        ferry into — one place to look, whatever the backend."""
+        """In-process shards feed the same forensics ring (and timeline
+        store) the runners ferry into — one place to look, whatever the
+        backend."""
         for sid in sorted(self.shards):
             shard = self.shards[sid]
             if shard.backend != "inproc":
                 continue
             try:
                 items = shard.drain_forensics()
+                timeline = shard.drain_timeline()
             except Exception:
                 continue
+            payload: Dict[str, Any] = {}
             if items:
-                self.fleet_obs.ingest(sid, {"forensics": items},
-                                      backend="inproc")
+                payload["forensics"] = items
+            if timeline:
+                payload["timeline"] = timeline
+            if payload:
+                self.fleet_obs.ingest(sid, payload, backend="inproc")
 
     def events(self, match_id: str) -> List:
         record = self._records[match_id]
@@ -674,6 +699,10 @@ class ShardSupervisor:
                     f"shard {dst_shard} refuses the migration: {refusal}"
                 )
         dst = self.shards[dst_shard]
+        self.fleet_obs.record_timeline(
+            EV_MIGRATE_BEGIN, match_id, origin="fleet", tick=self._tick,
+            detail={"from": src_id, "to": dst_shard, "reason": reason},
+        )
         # refresh the identity first: failover of the NEW incarnation needs
         # the same magics the bundle carries
         record.identity = src.wire_identity(match_id)
@@ -724,6 +753,19 @@ class ShardSupervisor:
                 self._recover_or_lose(record, dst_shard, e,
                                       try_journal=False)
         self._m_migrations.labels(reason=reason).inc()
+        if record.location == dst_shard and record.lost is None:
+            self.fleet_obs.record_timeline(
+                EV_MIGRATE_COMMIT, match_id, origin="fleet",
+                tick=self._tick,
+                detail={"from": src_id, "to": dst_shard},
+            )
+        else:
+            self.fleet_obs.record_timeline(
+                EV_MIGRATE_ABORT, match_id, origin="fleet",
+                tick=self._tick,
+                detail={"from": src_id, "to": dst_shard,
+                        "landed": record.location, "lost": record.lost},
+            )
         self._update_match_gauge()
         return dst_shard
 
@@ -1081,6 +1123,11 @@ class ShardSupervisor:
                 _logger.error("match %s lost: %s", match_id, record.lost)
             else:
                 self._m_migrations.labels(reason="failover").inc()
+                self.fleet_obs.record_timeline(
+                    EV_FAILOVER, match_id, origin="fleet", tick=self._tick,
+                    detail={"from": shard_id, "to": record.location,
+                            "reason": reason},
+                )
         self._update_match_gauge()
 
     def _retry_failovers(self) -> None:
@@ -1105,6 +1152,11 @@ class ShardSupervisor:
             else:
                 del self._failover_retry[match_id]
                 self._m_migrations.labels(reason="failover").inc()
+                self.fleet_obs.record_timeline(
+                    EV_FAILOVER, match_id, origin="fleet", tick=self._tick,
+                    detail={"from": exclude, "to": record.location,
+                            "reason": "retry-recovered"},
+                )
                 _logger.info("parked failover of %s recovered", match_id)
             self._update_match_gauge()
 
@@ -1269,7 +1321,10 @@ class ShardSupervisor:
             h for h in shards.values()
             if h["state"] not in (SHARD_RETIRED, SHARD_DEAD)
         ]
-        ok = bool(serving) and all(h["ok"] for h in serving)
+        slo = self.slo.verdict()
+        # the §28 escalation door: a critical multi-window burn answers
+        # 503 through the health endpoint the fleet already watches
+        ok = bool(serving) and all(h["ok"] for h in serving) and slo["ok"]
         age = (
             None if self.last_tick_at is None
             else max(0.0, time.monotonic() - self.last_tick_at)
@@ -1282,6 +1337,8 @@ class ShardSupervisor:
             matches=sum(h["matches"] for h in shards.values()),
             pending_admissions=len(self._pending),
             lost_matches=len(self.lost_matches()),
+            slo=slo,
+            timeline_matches=len(self.fleet_obs.timelines),
         )
         proc: Dict[str, Any] = {}
         for sid, shard in self.shards.items():

@@ -101,6 +101,13 @@ AUTH = struct.Struct("<2sBBQQ16s32s")
 # server's rx frame sequence (the client replays retained tx past it)
 VERDICT = struct.Struct("<2sBBQQ")
 
+# §28 trace context, as carried inside fleet-link RPC payloads and the
+# ingress ROUTE_UPDATE tail: match-id hash u64, placement epoch u32,
+# span id u32.  This is a LITERAL mirror of obs/timeline.py TRACE_CTX —
+# the §20 layout check parses both definitions and pins them equal.
+TRACE_CTX = struct.Struct("<QII")
+TRACE_CTX_BYTES = 16
+
 AUTH_FLAG_RESUME = 0x01
 
 # verdict codes

@@ -15,6 +15,12 @@ Three dependency-free pieces:
   ``Tracer(enabled=False)`` compiles the layer out.
 - :mod:`forensics` — desync post-mortems: first-divergent-frame bisection
   over shared checksum histories and the :class:`DesyncReport` artifact.
+- :mod:`timeline` — match-lifecycle timelines (DESIGN.md §28): the
+  stable cross-host event schema, the 16-byte trace context, and the
+  bounded per-match stores the fleet ferries over the harvest plane.
+- :mod:`slo` — frame-budget SLOs (DESIGN.md §28): per-tier compliance
+  counters on the shard, multi-window burn rates + the 503-on-burn
+  verdict on the supervisor.
 
 The bank-side numbers behind these come from the native stat harvest:
 ``HostSessionPool.scrape()`` dumps every slot's protocol/sync counters
@@ -67,8 +73,28 @@ from .fleet_obs import (
     fleet_metrics_digest,
     histogram_quantile,
 )
+from .timeline import (
+    MatchTimeline,
+    TIMELINE_EVENTS,
+    TRACE_CTX,
+    TRACE_CTX_BYTES,
+    TimelineStore,
+    first_occurrence_order,
+    format_timeline,
+    match_trace_id,
+    merge_timelines,
+    pack_trace_ctx,
+    timeline_event,
+    unpack_trace_ctx,
+)
+from .slo import (
+    BurnRateEngine,
+    ShardSloMeter,
+    SloPolicy,
+)
 
 __all__ = [
+    "BurnRateEngine",
     "ChecksumHistory",
     "Counter",
     "DEFAULT",
@@ -77,21 +103,35 @@ __all__ = [
     "FlightRecorder",
     "Gauge",
     "Histogram",
+    "MatchTimeline",
     "MetricsHTTPServer",
     "MetricsServer",
     "MultiRegistry",
     "NULL_TRACER",
     "Registry",
     "RegistryCollector",
+    "ShardSloMeter",
+    "SloPolicy",
+    "TIMELINE_EVENTS",
+    "TRACE_CTX",
+    "TRACE_CTX_BYTES",
+    "TimelineStore",
     "Tracer",
     "build_desync_report",
     "default_registry",
     "first_divergent_frame",
+    "first_occurrence_order",
     "fleet_metrics_digest",
+    "format_timeline",
     "histogram_quantile",
     "json_snapshot",
+    "match_trace_id",
+    "merge_timelines",
+    "pack_trace_ctx",
     "prometheus_text",
     "start_http_server",
+    "timeline_event",
+    "unpack_trace_ctx",
     "validate_chrome_trace",
     "validate_exposition",
 ]

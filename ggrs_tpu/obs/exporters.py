@@ -366,11 +366,15 @@ class MetricsServer:
     healthy (timestamp age under ``stale_after`` seconds / ``ok`` true),
     503 otherwise — the pageable "pool wedged" signal.  ``tracer``:
     optional :class:`~ggrs_tpu.obs.trace.Tracer` served on ``/trace``.
+    ``timelines``: optional callable returning the merged §28 match
+    timelines (``{mid: [events]}``), served on ``/timeline`` for
+    ``scripts/match_timeline.py`` and the fleet_top footer.
     """
 
     def __init__(self, registry: Registry, port: int = 0,
                  addr: str = "127.0.0.1", tracer: Any = None,
-                 health: Any = None, stale_after: float = 5.0) -> None:
+                 health: Any = None, stale_after: float = 5.0,
+                 timelines: Any = None) -> None:
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
         def healthz_body() -> tuple:
@@ -418,6 +422,9 @@ class MetricsServer:
                 elif h.path.startswith("/trace") and tracer is not None:
                     body = json.dumps(tracer.chrome_trace()).encode()
                     ctype = "application/json"
+                elif h.path.startswith("/timeline") and timelines is not None:
+                    body = json.dumps(timelines(), default=str).encode()
+                    ctype = "application/json"
                 else:
                     h.send_response(404)
                     h.end_headers()
@@ -454,9 +461,12 @@ MetricsHTTPServer = MetricsServer
 def start_http_server(registry: Registry, port: int = 0,
                       addr: str = "127.0.0.1", tracer: Any = None,
                       health: Any = None,
-                      stale_after: float = 5.0) -> MetricsServer:
+                      stale_after: float = 5.0,
+                      timelines: Any = None) -> MetricsServer:
     """Serve ``registry`` on ``http://addr:port/metrics`` (port 0 picks a
     free one; read it back from the returned server's ``.port``).  Pass
-    ``tracer=`` / ``health=`` to light up ``/trace`` and ``/healthz``."""
+    ``tracer=`` / ``health=`` to light up ``/trace`` and ``/healthz``,
+    ``timelines=`` for ``/timeline``."""
     return MetricsServer(registry, port=port, addr=addr, tracer=tracer,
-                         health=health, stale_after=stale_after)
+                         health=health, stale_after=stale_after,
+                         timelines=timelines)

@@ -50,6 +50,7 @@ from collections import deque
 from typing import Any, Deque, Dict, List, Optional, Tuple
 
 from .registry import Registry
+from .timeline import TimelineStore, timeline_ring_events
 from .trace import NULL_TRACER
 
 __all__ = [
@@ -166,6 +167,10 @@ class FleetObs:
         self.harvest = harvest if harvest is not None else Registry()
         self.tracer = tracer if tracer is not None else NULL_TRACER
         self.forensics: Deque[Dict[str, Any]] = deque(maxlen=max_forensics)
+        # per-match lifecycle timelines (§28): local events recorded by
+        # the supervisor + remote events ferried over the same payloads
+        # that carry metrics/spans/forensics, clock-offset corrected
+        self.timelines = TimelineStore()
         self._applied: Dict[str, Tuple[int, int]] = {}  # shard -> (gen, seq)
         self._span_names: Dict[str, set] = {}           # shard -> names seen
         m = metrics if metrics is not None else Registry(enabled=False)
@@ -193,6 +198,10 @@ class FleetObs:
             "ggrs_fleet_obs_forensics_total",
             "forensic records (flight dumps, desync reports) ferried "
             "from shards", labels=("shard", "kind"))
+        self._m_timeline = m.counter(
+            "ggrs_fleet_obs_timeline_events_total",
+            "match-lifecycle timeline events merged into the fleet view",
+            labels=("shard",))
         self._h_span = self.harvest.histogram(
             "ggrs_fleet_span_seconds",
             "fleet-wide span durations harvested from shard trace rings",
@@ -223,6 +232,8 @@ class FleetObs:
             ("spans", lambda v: self.ingest_spans(
                 shard, v, offset_ns=offset_ns)),
             ("forensics", lambda v: self.ingest_forensics(shard, v)),
+            ("timeline", lambda v: self.ingest_timeline(
+                shard, v, offset_ns=offset_ns)),
         ):
             value = payload.get(section) if isinstance(payload, dict) \
                 else None
@@ -382,6 +393,34 @@ class FleetObs:
         self.forensics.clear()
         return out
 
+    # ------------------------------------------------------------------
+    # match-lifecycle timelines (§28)
+    # ------------------------------------------------------------------
+
+    def ingest_timeline(self, shard: str, events: List[Dict[str, Any]],
+                        *, offset_ns: int = 0) -> int:
+        """Fold ferried timeline events into the per-match store (clock
+        offset applied, like spans) and re-emit each as a Perfetto
+        instant on the supervisor tracer — the cross-host causal view
+        drops out of the existing ``chrome_trace`` export."""
+        shard = str(shard)
+        n = self.timelines.ingest(events, offset_ns=offset_ns)
+        if n:
+            self._m_timeline.labels(shard=shard).inc(n)
+            self.tracer.import_spans(
+                timeline_ring_events(events), offset_ns=offset_ns,
+                extra_args={"shard": shard},
+            )
+        return n
+
+    def record_timeline(self, etype: str, match_id: str,
+                        **kw: Any) -> Dict[str, Any]:
+        """A LOCAL (supervisor-side) timeline emission: stored, and
+        re-emitted as a tracer instant in the local clock domain."""
+        ev = self.timelines.record(etype, match_id, **kw)
+        self.tracer.import_spans(timeline_ring_events([ev]))
+        return ev
+
 
 # ----------------------------------------------------------------------
 # read-side helpers (fleet_top, chaos artifacts)
@@ -447,4 +486,7 @@ def fleet_metrics_digest(supervisor) -> Dict[str, Any]:
         spans_reemitted=_sum("ggrs_fleet_obs_spans_total"),
         forensics_ferried=_sum("ggrs_fleet_obs_forensics_total"),
         forensics_pending=len(obs.forensics),
+        timeline_events_merged=_sum(
+            "ggrs_fleet_obs_timeline_events_total"),
+        timeline_matches=len(obs.timelines),
     )

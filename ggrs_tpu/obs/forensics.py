@@ -90,7 +90,7 @@ class DesyncReport:
         "kind", "detected_frame", "first_divergent_frame", "addr",
         "local_checksum", "remote_checksum", "checksum_window",
         "recorder_dump", "remote_recorder_dump", "journal_tail",
-        "trace_events", "detail",
+        "trace_events", "timeline", "detail",
     )
 
     def __init__(
@@ -105,6 +105,7 @@ class DesyncReport:
         recorder_dump: str = "",
         journal_tail: Optional[List[Tuple[int, int]]] = None,
         trace_events: Optional[List[Dict[str, Any]]] = None,
+        timeline: Optional[List[Dict[str, Any]]] = None,
         detail: str = "",
     ) -> None:
         self.kind = kind
@@ -119,6 +120,9 @@ class DesyncReport:
         self.remote_recorder_dump = ""
         self.journal_tail = journal_tail or []
         self.trace_events = trace_events or []
+        # the match's §28 lifecycle timeline up to the desync — filled
+        # by the shard (its per-match history) or a chaos driver
+        self.timeline = timeline or []
         self.detail = detail
 
     def to_dict(self) -> Dict[str, Any]:
@@ -140,6 +144,7 @@ class DesyncReport:
                 {"frame": f, "crc32": c} for f, c in self.journal_tail
             ],
             "trace_events": self.trace_events,
+            "timeline": self.timeline,
             "detail": self.detail,
         }
 
@@ -225,6 +230,7 @@ def build_desync_report(
     recorder: Optional[FlightRecorder] = None,
     journal: Any = None,
     tracer: Any = None,
+    timeline: Optional[List[Dict[str, Any]]] = None,
     detail: str = "",
 ) -> DesyncReport:
     """Assemble a :class:`DesyncReport` from whatever forensic sources the
@@ -260,5 +266,6 @@ def build_desync_report(
         recorder_dump=recorder.dump(32) if recorder is not None else "",
         journal_tail=_journal_tail_around(journal, center),
         trace_events=trace_events,
+        timeline=timeline,
         detail=detail,
     )

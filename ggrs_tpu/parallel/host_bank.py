@@ -149,6 +149,9 @@ from ..obs.recorder import (
 from ..obs.registry import Registry, default_registry
 from ..obs.trace import NULL_TRACER, Tracer
 from ..obs.forensics import DesyncReport, build_desync_report
+# timeline event name (DESIGN.md §28) — aliased: the flight recorder
+# above already owns the bare EV_* namespace in this module
+from ..obs.timeline import EV_DEMOTE_LOCKSTEP as TL_DEMOTE_LOCKSTEP
 from ..utils.tracing import get_logger, trace_span
 from ..sessions.p2p import (
     MAX_EVENT_QUEUE_SIZE,
@@ -709,6 +712,11 @@ class HostSessionPool:
         # slots demoted to the lockstep tier (load-shedding): index ->
         # tick demoted, for stats; the session itself lives in _evicted
         self._lockstep_slots: Dict[int, int] = {}
+        # match-lifecycle timeline seam (DESIGN.md §28): the owning shard
+        # installs a callable(etype, slot, detail) to translate pool-level
+        # lifecycle moments (lockstep demotion) into match-keyed timeline
+        # events; None when the pool runs unsupervised
+        self.timeline_sink = None
         self._clock = None
         self._out_buf: Optional[ctypes.Array] = None
         self._out_len = ctypes.c_size_t(0)
@@ -812,6 +820,31 @@ class HostSessionPool:
         self._m_demotions = m.counter(
             "ggrs_pool_lockstep_demotions_total",
             "healthy slots demoted to the lockstep tier (load-shedding)")
+        # ---- prediction accuracy (DESIGN.md §28): the Python tier's
+        # input queues count mispredict episodes / rollback depth, the
+        # device plane counts adopt-vs-decline; both fold into these at
+        # scrape cadence (zero extra crossings) ----
+        _mis = m.counter(
+            "ggrs_predict_mispredicts_total",
+            "rollback episodes caused by a wrong input prediction, by "
+            "the source that produced it (plane = device-batched table, "
+            "scalar = the config predictor)", labels=("source",))
+        self._m_mis_plane = _mis.labels(source="plane")
+        self._m_mis_scalar = _mis.labels(source="scalar")
+        _served = m.counter(
+            "ggrs_predict_served_total",
+            "device prediction-plane row outcomes: adopted from the "
+            "batched table vs declined to the scalar fallback",
+            labels=("outcome",))
+        self._m_pred_adopt = _served.labels(outcome="adopted")
+        self._m_pred_fallback = _served.labels(outcome="fallback")
+        self._m_mis_depth = m.counter(
+            "ggrs_predict_rollback_frames_total",
+            "rollback depth (frames re-simulated) attributed to "
+            "mispredicted inputs")
+        # last folded cumulative totals: (mispredicts, plane_mispredicts,
+        # depth_frames, plane_hits, plane_fallbacks)
+        self._predict_seen = [0, 0, 0, 0, 0]
         _req = m.counter(
             "ggrs_pool_requests_total",
             "GgrsRequests returned to the game, by kind",
@@ -4112,6 +4145,12 @@ class HostSessionPool:
         self._set_slot_state(index, SLOT_EVICTED)
         self._lockstep_slots[index] = self._tick_no
         self._m_demotions.inc()
+        if self.timeline_sink is not None:
+            try:
+                self.timeline_sink(TL_DEMOTE_LOCKSTEP, index,
+                                   {"frame": load_req.frame})
+            except Exception:
+                pass  # a broken sink must never block load-shedding
         self._fault_log[index].append(SlotFault(
             self._tick_no, 0,
             f"demoted to lockstep tier, resuming from frame "
@@ -4894,9 +4933,52 @@ class HostSessionPool:
         self._setter_cache[index] = cached
         return cached
 
+    def _refresh_predict_metrics(self) -> None:
+        """Fold the Python-tier prediction-accuracy counters (input-queue
+        mispredict accounting, DESIGN.md §28) and the device plane's
+        adopt/decline tallies into the ``ggrs_predict_*`` family, as
+        deltas against the previous scrape.  Rides the existing scrape
+        cadence: zero extra ctypes crossings, zero extra RPC traffic."""
+        mis = plane_mis = depth = 0
+        seen_ids = set()
+        for session in list(self._sessions) + list(self._evicted.values()):
+            if id(session) in seen_ids:
+                continue
+            seen_ids.add(id(session))
+            sl = getattr(session, "_sync_layer", None)
+            if sl is None:
+                continue
+            for q in sl.input_queues:
+                mis += q.mispredicts
+                plane_mis += q.plane_mispredicts
+                depth += q.mispredict_depth_frames
+        hits = fallbacks = 0
+        if self._prediction_plane is not None:
+            st = self._prediction_plane.stats()
+            hits = st.get("hits", 0)
+            fallbacks = st.get("fallbacks", 0)
+        prev = self._predict_seen
+        d_plane = max(0, plane_mis - prev[1])
+        d_scalar = max(0, (mis - plane_mis) - (prev[0] - prev[1]))
+        d_depth = max(0, depth - prev[2])
+        d_hits = max(0, hits - prev[3])
+        d_fallbacks = max(0, fallbacks - prev[4])
+        if d_plane:
+            self._m_mis_plane.inc(d_plane)
+        if d_scalar:
+            self._m_mis_scalar.inc(d_scalar)
+        if d_depth:
+            self._m_mis_depth.inc(d_depth)
+        if d_hits:
+            self._m_pred_adopt.inc(d_hits)
+        if d_fallbacks:
+            self._m_pred_fallback.inc(d_fallbacks)
+        self._predict_seen = [mis, plane_mis, depth, hits, fallbacks]
+
     def _update_scrape_gauges(self, stats: List[Dict[str, Any]]) -> None:
         if not self._obs_on:
             return
+        self._refresh_predict_metrics()
         now = self._now_ms()
         for s in stats:
             slot_set, ep_set = self._gauge_setters(

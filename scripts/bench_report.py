@@ -1,0 +1,220 @@
+#!/usr/bin/env python
+"""bench_report: the bench-trajectory table and regression gate
+(DESIGN.md §28).
+
+Every bench round drops a ``BENCH_rNN.json`` next to the repo docs:
+``{"n": round, "cmd": [...], "rc": exit, "tail": "...", "note": "..."}``
+where ``tail`` holds the run's stdout tail and each metric is one
+JSON line inside it::
+
+    {"metric": "host_bank_io_b64_tick_ms_p99", "value": 6.1,
+     "unit": "ms/tick ...", "vs_baseline": 1.12}
+
+This script normalizes those lines across all rounds into flat records
+— the **normalized record schema**::
+
+    {"round": 6,            # the file's round number (its "n")
+     "metric": "...",       # the stable metric name (the join key)
+     "value": 6.1,          # the reported scalar
+     "unit": "...",         # free-text unit/context string
+     "vs_baseline": 1.12,   # the round's own baseline ratio
+     "p99": true,           # name ends in _p99 -> latency, lower-better
+     "rc": 0}               # the round's exit code
+
+— prints the per-metric trajectory (every round the metric appeared
+in, oldest first, with the step-over-step delta), and **gates**: for
+each ``_p99`` metric in its LATEST round, compare against the BEST
+(minimum — p99s are lower-better) value from any PRIOR round reporting
+the same metric name (same name = same workload = comparable).  A
+latest value more than ``--threshold`` (default 15%) above that best
+prior exits 1 — the CI tripwire against quietly regressing a bench a
+previous PR fought for.
+
+Rounds with ``rc != 0`` (e.g. r05's rc=124 timeout) carry no metric
+lines; they are listed as data-less, never treated as regressions.
+
+Usage:
+  python scripts/bench_report.py                 # repo-root BENCH_r*.json
+  python scripts/bench_report.py --dir . --threshold 0.10
+  python scripts/bench_report.py --json          # machine-readable dump
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+from typing import Any, Dict, List, Optional
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def parse_round(path: str) -> Dict[str, Any]:
+    """One file -> {"round", "rc", "records": [normalized records]}."""
+    with open(path) as f:
+        doc = json.load(f)
+    m = _ROUND_RE.search(os.path.basename(path))
+    rnd = int(doc.get("n", int(m.group(1)) if m else 0))
+    rc = int(doc.get("rc", 0))
+    records: List[Dict[str, Any]] = []
+    for line in (doc.get("tail") or "").splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            rec = json.loads(line)
+        except ValueError:
+            continue
+        if not isinstance(rec, dict) or "metric" not in rec:
+            continue
+        records.append({
+            "round": rnd,
+            "metric": str(rec["metric"]),
+            "value": float(rec.get("value", 0.0)),
+            "unit": str(rec.get("unit", "")),
+            "vs_baseline": rec.get("vs_baseline"),
+            "p99": str(rec["metric"]).endswith("_p99"),
+            "rc": rc,
+        })
+    # some rounds also carry one pre-parsed record; fold it in when the
+    # tail didn't already (dedup by name keeps the tail's fresher value)
+    parsed = doc.get("parsed")
+    if isinstance(parsed, dict) and "metric" in parsed:
+        if not any(r["metric"] == parsed["metric"] for r in records):
+            records.append({
+                "round": rnd,
+                "metric": str(parsed["metric"]),
+                "value": float(parsed.get("value", 0.0)),
+                "unit": str(parsed.get("unit", "")),
+                "vs_baseline": parsed.get("vs_baseline"),
+                "p99": str(parsed["metric"]).endswith("_p99"),
+                "rc": rc,
+            })
+    return {"round": rnd, "rc": rc, "path": path, "records": records}
+
+
+def load_rounds(directory: str) -> List[Dict[str, Any]]:
+    paths = sorted(glob.glob(os.path.join(directory, "BENCH_r*.json")))
+    rounds = [parse_round(p) for p in paths]
+    rounds.sort(key=lambda r: r["round"])
+    return rounds
+
+
+def trajectory(rounds: List[Dict[str, Any]]
+               ) -> Dict[str, List[Dict[str, Any]]]:
+    """Per metric: its records oldest-round-first (one per round — the
+    LAST occurrence in a round wins, it is the leg the round shipped)."""
+    by_metric: Dict[str, Dict[int, Dict[str, Any]]] = {}
+    for rnd in rounds:
+        for rec in rnd["records"]:
+            by_metric.setdefault(rec["metric"], {})[rec["round"]] = rec
+    return {
+        m: [recs[r] for r in sorted(recs)]
+        for m, recs in sorted(by_metric.items())
+    }
+
+
+def gate(traj: Dict[str, List[Dict[str, Any]]],
+         threshold: float = 0.15) -> List[Dict[str, Any]]:
+    """The regressions: p99 metrics whose latest value exceeds the best
+    prior round's by more than ``threshold`` (fractional)."""
+    regressions = []
+    for metric, recs in traj.items():
+        if not recs or not recs[-1]["p99"] or len(recs) < 2:
+            continue
+        latest = recs[-1]
+        best_prior = min(recs[:-1], key=lambda r: r["value"])
+        if best_prior["value"] <= 0:
+            continue
+        ratio = latest["value"] / best_prior["value"]
+        if ratio > 1.0 + threshold:
+            regressions.append({
+                "metric": metric,
+                "latest_round": latest["round"],
+                "latest_value": latest["value"],
+                "best_prior_round": best_prior["round"],
+                "best_prior_value": best_prior["value"],
+                "ratio": ratio,
+            })
+    return regressions
+
+
+def render(rounds: List[Dict[str, Any]],
+           traj: Dict[str, List[Dict[str, Any]]],
+           regressions: List[Dict[str, Any]],
+           threshold: float) -> str:
+    lines: List[str] = []
+    lines.append(f"bench trajectory — {len(rounds)} rounds, "
+                 f"{len(traj)} metrics")
+    dataless = [r for r in rounds if not r["records"]]
+    for r in dataless:
+        lines.append(f"  r{r['round']:02d}: no metrics "
+                     f"(rc={r['rc']}{', timeout' if r['rc'] == 124 else ''})")
+    lines.append("")
+    for metric, recs in traj.items():
+        tag = " [p99]" if recs[-1]["p99"] else ""
+        lines.append(f"{metric}{tag}")
+        prev: Optional[float] = None
+        for rec in recs:
+            delta = ""
+            if prev is not None and prev > 0:
+                pct = 100.0 * (rec["value"] - prev) / prev
+                delta = f"  ({pct:+.1f}%)"
+            vs = (f"  vs_baseline={rec['vs_baseline']}"
+                  if rec.get("vs_baseline") is not None else "")
+            lines.append(f"  r{rec['round']:02d}  "
+                         f"{rec['value']:>14.3f}{delta}{vs}")
+            prev = rec["value"]
+        lines.append("")
+    if regressions:
+        lines.append(f"GATE: {len(regressions)} p99 regression(s) "
+                     f"beyond {threshold:.0%} vs best prior round:")
+        for reg in regressions:
+            lines.append(
+                f"  {reg['metric']}: r{reg['latest_round']:02d}="
+                f"{reg['latest_value']:.3f} vs best "
+                f"r{reg['best_prior_round']:02d}="
+                f"{reg['best_prior_value']:.3f} "
+                f"({(reg['ratio'] - 1) * 100:+.1f}%)"
+            )
+    else:
+        lines.append(f"GATE: ok — no p99 metric regressed beyond "
+                     f"{threshold:.0%} of its best prior round")
+    return "\n".join(lines)
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    default_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    ap.add_argument("--dir", default=default_dir,
+                    help="directory holding BENCH_r*.json (default: repo root)")
+    ap.add_argument("--threshold", type=float, default=0.15,
+                    help="fractional p99 regression tolerance (default 0.15)")
+    ap.add_argument("--json", action="store_true",
+                    help="dump normalized records + verdict as JSON")
+    args = ap.parse_args()
+    rounds = load_rounds(args.dir)
+    if not rounds:
+        print(f"bench_report: no BENCH_r*.json under {args.dir}",
+              file=sys.stderr)
+        return 2
+    traj = trajectory(rounds)
+    regressions = gate(traj, args.threshold)
+    if args.json:
+        print(json.dumps({
+            "rounds": [{"round": r["round"], "rc": r["rc"],
+                        "records": r["records"]} for r in rounds],
+            "regressions": regressions,
+            "threshold": args.threshold,
+            "ok": not regressions,
+        }, indent=1))
+    else:
+        print(render(rounds, traj, regressions, args.threshold))
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

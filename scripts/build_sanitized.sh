@@ -92,6 +92,7 @@ python -m pytest tests/test_session_bank.py tests/test_policy_plane.py \
     tests/test_fleet_link.py tests/test_fleet_obs.py \
     tests/test_ingress.py tests/test_placement.py \
     tests/test_input_plane.py \
+    tests/test_timeline_slo.py \
     -q -p no:cacheprovider -m "not slow" \
     -k "not batched_executor and not size_mismatch and not fused_scrub and not scrub_matches and not device_state_bit_identical and not reaches_the_device and not plane_on_off and not plane_parity and not b64_plane and not jax_advance" "$@"
 
@@ -132,6 +133,7 @@ python -m pytest tests/test_native_io.py tests/test_socket_datapath.py \
     tests/test_fleet_link.py tests/test_descriptor_plane.py \
     tests/test_ingress.py tests/test_placement.py \
     tests/test_input_plane.py \
+    tests/test_timeline_slo.py \
     -q -p no:cacheprovider -m "not slow" \
     -k "not batched_executor and not size_mismatch and not device_state_bit_identical and not reaches_the_device and not plane_on_off and not plane_parity and not b64_plane and not jax_advance" "$@"
 

@@ -109,6 +109,24 @@ from ggrs_tpu.obs import (  # noqa: E402
     json_snapshot,
     validate_chrome_trace,
 )
+from ggrs_tpu.obs.slo import (  # noqa: E402
+    BurnRateEngine,
+    ShardSloMeter,
+    SloPolicy,
+)
+from ggrs_tpu.obs.timeline import (  # noqa: E402
+    EV_ADMIT,
+    EV_DEMOTE_LOCKSTEP,
+    EV_FAILOVER,
+    EV_MIGRATE_BEGIN,
+    EV_MIGRATE_COMMIT,
+    EV_ROUTE_FLIP,
+    TimelineStore,
+    first_occurrence_order,
+    fold_trace_aliases,
+    merge_timelines,
+    timeline_ring_events,
+)
 
 
 def _fleet_trace_artifact(artifact_dir, name: str, tracer):
@@ -134,6 +152,56 @@ def _fleet_trace_artifact(artifact_dir, name: str, tracer):
         "trace_path": str(path),
         "trace_spans": len(trace["traceEvents"]),
         "trace_problems": problems[:8],
+    }
+
+
+def _placement_timelines(ctx) -> dict:
+    """The cross-host merged match timelines of a placement-fleet run
+    (DESIGN.md §28): the placement plane's own store, each host
+    supervisor's harvested store (origin prefixed with the host id so
+    the merged view shows WHICH machine saw each event), and the
+    ingress node's trace-keyed ROUTE_FLIP events folded onto their
+    matches via the wire trace context."""
+    sources = [ctx["placement"].timelines.to_dict()]
+    for hid, sup in ctx["hosts"].items():
+        exported = sup.fleet_obs.timelines.to_dict()
+        sources.append({
+            mid: [dict(e, origin=f"{hid}/{e.get('origin') or '?'}")
+                  for e in evs]
+            for mid, evs in exported.items()
+        })
+    ing: dict = {}
+    for ev in ctx["ingress"].drain_timeline():
+        ing.setdefault(ev["mid"], []).append(ev)
+    sources.append(ing)
+    return fold_trace_aliases(merge_timelines(*sources))
+
+
+def _timeline_trace_artifact(artifact_dir, name: str, timelines: dict):
+    """ONE Perfetto export for a merged timeline view — every match's
+    lifecycle events re-emitted as instants through the §18 Tracer path
+    — schema-validated in CI like the span exports.  Returns the
+    embedding dict (empty without --artifact-dir)."""
+    if artifact_dir is None or not timelines:
+        return {}
+    events = [ev for evs in timelines.values() for ev in evs]
+    tracer = Tracer(capacity=max(256, len(events) + 16))
+    tracer.import_spans(timeline_ring_events(events))
+    out = Path(artifact_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    path = tracer.write(out / f"{name}.timeline.trace.json")
+    trace = tracer.chrome_trace()
+    problems = validate_chrome_trace(trace, eps_us=50.0)
+    if problems:
+        print(f"  timeline trace validation ({name}): "
+              f"{len(problems)} problems, e.g. {problems[0]}")
+    else:
+        print(f"  timeline trace: {path} "
+              f"({len(trace['traceEvents'])} events, schema-valid)")
+    return {
+        "timeline_trace_path": str(path),
+        "timeline_trace_events": len(trace["traceEvents"]),
+        "timeline_trace_problems": problems[:8],
     }
 
 
@@ -372,11 +440,40 @@ def verify_lockstep_leg(matches: int, ticks: int, seed: int,
 
     demote_at = max(20, min(60, ticks // 3))
 
+    # §28 riders on the chaos leg: the pool's timeline sink (slot-keyed
+    # lifecycle events) and a self-contained SLO pipeline — meter fed
+    # from real inter-tick wall time + the demoted slot's confirmed
+    # lag, burn engine over windows sized to the run
+    import time
+
+    from ggrs_tpu.obs.registry import Registry
+
+    timelines = TimelineStore()
+    slo_reg = Registry()
+    policy = SloPolicy(windows=(("16t", 16), ("64t", max(64, ticks // 2))))
+    meter = ShardSloMeter(slo_reg, policy=policy)
+    burn = BurnRateEngine(policy=policy)
+    last_ns = [0]
+    tick_box = [0]
+
     def inject(i, ctx):
+        pool = ctx["pool"]
+        tick_box[0] = i
+        if i == 0:
+            pool.timeline_sink = lambda etype, slot, detail: (
+                timelines.record(etype, f"slot{slot}", origin="pool",
+                                 tick=tick_box[0], detail=detail))
+        now = time.perf_counter_ns()
+        if last_ns[0]:
+            meter.observe_rollback((now - last_ns[0]) / 1e6)
+        last_ns[0] = now
+        if pool.lockstep_slots():
+            lag = max(0, ctx["ext"].current_frame
+                      - pool.current_frame(ctx["target"]))
+            meter.observe_lockstep(lag)
+        burn.update(i, slo_reg)
         if i == demote_at:
-            ctx["resume_frame"] = ctx["pool"].demote_to_lockstep(
-                ctx["target"]
-            )
+            ctx["resume_frame"] = pool.demote_to_lockstep(ctx["target"])
 
     control = drive_chaos(ticks, n_matches=matches, seed=seed)
     chaos = drive_chaos(ticks, n_matches=matches, seed=seed, inject=inject)
@@ -442,6 +539,20 @@ def verify_lockstep_leg(matches: int, ticks: int, seed: int,
           f"fastpath_slot_ticks={pool.fast_slot_ticks}")
     print(_metrics_summary(chaos))
 
+    # §28: the pool's timeline seam must have emitted the demotion
+    demote_events = [
+        e for e in timelines.timeline(f"slot{target}")
+        if e["ev"] == EV_DEMOTE_LOCKSTEP
+    ]
+    if not demote_events:
+        violations.append(
+            "timeline sink recorded no DEMOTE_LOCKSTEP for the target"
+        )
+    slo_verdict = burn.verdict()
+    tier_levels = ", ".join(
+        f"{t}={v['level']}" for t, v in slo_verdict["tiers"].items())
+    print(f"  slo: level={slo_verdict['level']} tiers=[{tier_levels}]")
+
     verdict = not violations
     _write_artifact(artifact_dir, "lockstep", {
         "scenario": "lockstep",
@@ -457,6 +568,9 @@ def verify_lockstep_leg(matches: int, ticks: int, seed: int,
                           "non_confirmed_inputs": predicted},
         "crossings": {"tick": pool.crossings, "harvest": pool.harvests,
                       "stats": pool.stat_crossings},
+        # §28 riders: the pool-seam timeline and the run's SLO verdict
+        "timeline": timelines.to_dict(),
+        "slo": slo_verdict,
         "metrics": json_snapshot(chaos["registry"]),
     })
     if violations:
@@ -1554,6 +1668,17 @@ def verify_net_leg(matches_per_shard: int, ticks: int, seed: int,
                 f"viewer {v} stalled at {frames[-1] if frames else None} "
                 "after the host kill"
             )
+    # §28: every failed-over match's merged timeline must carry the
+    # FAILOVER event after its ADMIT — the causal record of the kill
+    kill_timelines = _placement_timelines(chaos)
+    for mid in h1_matches:
+        if not first_occurrence_order(
+            kill_timelines.get(mid, []), EV_ADMIT, EV_FAILOVER
+        ):
+            violations.append(
+                f"{mid}: merged timeline missing ADMIT -> FAILOVER "
+                f"({[e['ev'] for e in kill_timelines.get(mid, [])]})"
+            )
     print(f"  [net_placement_host_kill] h1 killed @tick {kill_tick}: "
           f"{sum(1 for m in h1_matches if chaos['locations'][m] and chaos['locations'][m][0] != 'h1')}"
           f"/{len(h1_matches)} matches failed over cross-host, "
@@ -1573,10 +1698,92 @@ def verify_net_leg(matches_per_shard: int, ticks: int, seed: int,
         "flips": flips,
         "failovers": failovers,
         "healthz": {k: v for k, v in hz.items() if k != "shards"},
+        "timeline": kill_timelines,
+        "slo": hz.get("slo"),
+        **_timeline_trace_artifact(artifact_dir, "net_placement_host_kill",
+                                   kill_timelines),
         "metrics": json_snapshot(chaos["registry"]),
     })
     if violations:
         print("  NET_PLACEMENT_HOST_KILL VIOLATED:")
+        for v in violations:
+            print(f"    {v}")
+        ok = False
+
+    # 7. cross-host live migration (§26 + §28): migrate one live match
+    # h1 -> h0 mid-traffic; beyond the §26 contract (peer/viewers never
+    # re-aim, survivors bit-identical), the §28 acceptance is causal:
+    # ONE merged timeline — stitched from both hosts, the placement
+    # plane, and the ingress's trace-keyed flip — must read
+    # ADMIT -> MIGRATE_BEGIN -> ROUTE_FLIP -> MIGRATE_COMMIT in order,
+    # and its Perfetto re-emission must schema-validate
+    mig_mid = f"m{pp}"  # pinned to h1
+    mig_tick = pticks // 3
+
+    def migrate_m(i, ctx):
+        if i == mig_tick:
+            ctx["placement"].migrate(mig_mid, reason="chaos")
+
+    chaos = drive_placement_fleet(
+        pticks, matches_per_host=pp, seed=seed, n_spectators=2,
+        spectate_match=spectate, inject=migrate_m,
+    )
+    chaos["close"]()
+    untouched = [m for m in chaos["match_ids"] if m != mig_mid]
+    violations = fleet_survivor_violations(chaos, p_control, untouched)
+    violations += fleet_recovery_violations(chaos, [mig_mid])
+    mig_loc = chaos["locations"][mig_mid]
+    if mig_loc is None or mig_loc[0] != "h0":
+        violations.append(
+            f"{mig_mid}: not serving on h0 after migration ({mig_loc})"
+        )
+    if chaos["vports"] != p_control["vports"]:
+        violations.append("virtual endpoints changed across the migration")
+    mig_timelines = _placement_timelines(chaos)
+    mig_events = mig_timelines.get(mig_mid, [])
+    if not first_occurrence_order(
+        mig_events, EV_ADMIT, EV_MIGRATE_BEGIN, EV_ROUTE_FLIP,
+        EV_MIGRATE_COMMIT,
+    ):
+        violations.append(
+            f"{mig_mid}: merged timeline out of causal order: "
+            f"{[e['ev'] for e in mig_events]}"
+        )
+    origins = {e.get("origin", "").split("/")[0] for e in mig_events}
+    if not {"h1", "placement"} <= origins:
+        violations.append(
+            f"{mig_mid}: timeline not cross-source (origins {origins})"
+        )
+    trace_info = _timeline_trace_artifact(
+        artifact_dir, "net_placement_migrate", mig_timelines)
+    if trace_info.get("timeline_trace_problems"):
+        violations.append(
+            "timeline Perfetto export failed schema validation: "
+            f"{trace_info['timeline_trace_problems'][:2]}"
+        )
+    print(f"  [net_placement_migrate] {mig_mid} h1 -> "
+          f"{mig_loc[0] if mig_loc else '?'} @tick {mig_tick}: "
+          f"{len(mig_events)} timeline events "
+          f"({' -> '.join(dict.fromkeys(e['ev'] for e in mig_events))})")
+    _write_artifact(artifact_dir, "net_placement_migrate", {
+        "scenario": "net_placement_migrate",
+        "verdict": "PASS" if not violations else "FAIL",
+        "violations": violations,
+        "matches_per_host": pp,
+        "ticks": pticks,
+        "migrated": mig_mid,
+        "migrated_to": list(mig_loc) if mig_loc else None,
+        "locations": {m: list(v) if v else None
+                      for m, v in chaos["locations"].items()},
+        "vports": chaos["vports"],
+        "lost": chaos["lost"],
+        "timeline": mig_timelines,
+        "slo": chaos["healthz"].get("slo"),
+        **trace_info,
+        "metrics": json_snapshot(chaos["registry"]),
+    })
+    if violations:
+        print("  NET_PLACEMENT_MIGRATE VIOLATED:")
         for v in violations:
             print(f"    {v}")
         ok = False

@@ -17,7 +17,12 @@ place:
   eats the budget" view ROADMAP item 3 wants;
 - the fleet counters (admissions, migrations, failovers, lost) and the
   harvest plane's own health (snapshots merged, dups, gaps, ferried
-  forensics).
+  forensics);
+- the §28 SLO plane: per-shard budget compliance from the harvested
+  ``ggrs_slo_*`` counters (the SLO column), the supervisor's
+  multi-window burn-rate verdict from ``healthz["slo"]``, and a match
+  timeline footer (the last lifecycle events per match) when the
+  endpoint also serves ``/timeline``.
 
 Usage:
   python scripts/fleet_top.py --url http://127.0.0.1:9464
@@ -98,6 +103,61 @@ def _span_p99s(metrics: Dict[str, Any]
     return out
 
 
+def _slo_by_shard(metrics: Dict[str, Any]) -> Dict[str, Tuple[int, int]]:
+    """Per shard: (ticks, breaches) summed across tiers from the
+    harvested ``ggrs_slo_*`` counter families."""
+    out: Dict[str, List[int]] = {}
+    for name, idx in (("ggrs_slo_ticks_total", 0),
+                      ("ggrs_slo_breaches_total", 1)):
+        fam = metrics.get(name)
+        if not fam:
+            continue
+        for sample in fam.get("samples", ()):
+            shard = sample.get("labels", {}).get("shard", "?")
+            out.setdefault(shard, [0, 0])[idx] += int(sample.get("value", 0))
+    return {s: (t[0], t[1]) for s, (t) in out.items()}
+
+
+def _fmt_slo(stats: Optional[Tuple[int, int]]) -> str:
+    if not stats or stats[0] <= 0:
+        return "-"
+    ticks, breaches = stats
+    return f"{100.0 * (1.0 - breaches / ticks):.2f}%"
+
+
+def _slo_header(slo: Dict[str, Any]) -> str:
+    """One line: verdict level plus each tier's worst-window burn."""
+    parts = [f"slo: {slo.get('level', '?')}"]
+    for tier, t in sorted((slo.get("tiers") or {}).items()):
+        burns = t.get("burn") or {}
+        worst = max(burns.values()) if burns else 0.0
+        parts.append(
+            f"{tier}={t.get('level', '?')} burn_max={worst:.2f} "
+            f"({int(t.get('breaches', 0))}/{int(t.get('ticks', 0))} breached)"
+        )
+    return "  ".join(parts)
+
+
+def _timeline_footer(timelines: Dict[str, List[Dict[str, Any]]],
+                     max_matches: int = 8,
+                     max_events: int = 10) -> List[str]:
+    """Compact per-match lifecycle rows from merged §28 timelines:
+    newest matches first, each as ``mid: EV@origin -> EV@origin ...``."""
+    lines = ["match timelines (latest events):"]
+    def newest(evs: List[Dict[str, Any]]) -> int:
+        return max((e.get("ts_ns", 0) for e in evs), default=0)
+    mids = sorted(timelines, key=lambda m: -newest(timelines[m]))
+    for mid in mids[:max_matches]:
+        evs = timelines[mid][-max_events:]
+        chain = " -> ".join(
+            f"{e.get('ev', '?')}@{e.get('origin') or '?'}" for e in evs
+        )
+        lines.append(f"  {mid:<14} {chain}")
+    if len(mids) > max_matches:
+        lines.append(f"  ... and {len(mids) - max_matches} more matches")
+    return lines
+
+
 def _counter_total(metrics: Dict[str, Any], name: str) -> int:
     fam = metrics.get(name)
     if not fam:
@@ -106,7 +166,9 @@ def _counter_total(metrics: Dict[str, Any], name: str) -> int:
 
 
 def render(healthz: Dict[str, Any], metrics: Dict[str, Any],
-           phases_per_shard: int = 4) -> str:
+           phases_per_shard: int = 4,
+           timelines: Optional[Dict[str, List[Dict[str, Any]]]] = None
+           ) -> str:
     """One dashboard frame as text (pure; no I/O)."""
     lines: List[str] = []
     ok = healthz.get("ok")
@@ -118,16 +180,20 @@ def render(healthz: Dict[str, Any], metrics: Dict[str, Any],
         f"lost={healthz.get('lost_matches', 0)}  "
         f"last_tick={_fmt_age(healthz.get('last_tick_age_s'))}"
     )
+    slo = healthz.get("slo")
+    if slo:
+        lines.append(_slo_header(slo))
     lines.append("")
     header = (
         f"{'SHARD':<10} {'BACKEND':<8} {'STATE':<9} {'OK':<3} "
         f"{'MATCHES':<9} {'HB AGE':<8} {'WATCHDOG':<11} {'RST':<4} "
-        f"{'LINK':<14} {'INGRESS':<8} {'P99 MS':<8}"
+        f"{'LINK':<14} {'INGRESS':<8} {'P99 MS':<8} {'SLO':<8}"
     )
     lines.append(header)
     lines.append("-" * len(header))
     shards = healthz.get("shards", {})
     proc = healthz.get("proc", {})
+    slo_shards = _slo_by_shard(metrics)
     for sid in sorted(shards):
         h = shards[sid]
         p = proc.get(sid, {})
@@ -151,7 +217,8 @@ def render(healthz: Dict[str, Any], metrics: Dict[str, Any],
             f"{str(p.get('restarts', h.get('restarts', 0))):<4} "
             f"{link_col:<14} "
             f"{str(h.get('ingress_routes', '-')):<8} "
-            f"{_fmt_ms(h.get('tick_p99_ms')):<8}"
+            f"{_fmt_ms(h.get('tick_p99_ms')):<8} "
+            f"{_fmt_slo(slo_shards.get(sid)):<8}"
         )
     p99s = _span_p99s(metrics)
     if p99s:
@@ -194,6 +261,15 @@ def render(healthz: Dict[str, Any], metrics: Dict[str, Any],
             _counter_total(metrics, "ggrs_fleet_obs_forensics_total"),
         )
     )
+    if timelines:
+        lines.append("")
+        lines.extend(_timeline_footer(timelines))
+    elif healthz.get("timeline_matches"):
+        lines.append("")
+        lines.append(
+            f"timelines: {healthz['timeline_matches']} matches tracked "
+            f"(serve /timeline or use scripts/match_timeline.py to view)"
+        )
     return "\n".join(lines)
 
 
@@ -215,7 +291,12 @@ def main() -> int:
         except Exception as e:
             frame = f"fleet_top: cannot reach {base}: {e}"
         else:
-            frame = render(healthz, metrics, phases_per_shard=args.phases)
+            try:
+                timelines = fetch(base + "/timeline")
+            except Exception:
+                timelines = None  # endpoint optional (older servers: 404)
+            frame = render(healthz, metrics, phases_per_shard=args.phases,
+                           timelines=timelines)
         if args.once:
             print(frame)
             return 0

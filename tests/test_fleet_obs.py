@@ -279,6 +279,23 @@ class TestExpositionConformance:
         finally:
             ctx["sup"].close()
 
+    def test_placement_fleet_families_are_conformant(self):
+        """§28 satellite: the §26 planes' families ride ONE merged
+        scrape of the cross-host world and conform — ingress, placement,
+        lockstep demotions, and the new slo family all present."""
+        from ggrs_tpu.chaos import drive_placement_fleet
+
+        ctx = drive_placement_fleet(16, matches_per_host=1, seed=11)
+        try:
+            text = prometheus_text(ctx["registry"])
+        finally:
+            ctx["close"]()
+        assert validate_exposition(text) == []
+        lines = text.splitlines()
+        for prefix in ("ggrs_ingress_", "ggrs_placement_",
+                       "ggrs_pool_lockstep_", "ggrs_slo_"):
+            assert any(ln.startswith(prefix) for ln in lines), prefix
+
 
 # ----------------------------------------------------------------------
 # Perfetto export schema validation (satellite: CI-checked traces)
@@ -496,6 +513,21 @@ class TestFleetHarvestE2E:
         line = next(l for l in text.splitlines()
                     if l.startswith("ggrs_pool_ticks_total{"))
         assert 'shard="s1"' in line and 'backend="proc"' in line
+
+    def test_fleet_link_families_are_conformant(self):
+        """§28 satellite: the TCP fleet-link transport's families are
+        present and conformant in the merged scrape (the link only
+        instruments when a shard actually serves over TCP)."""
+        ctx = drive_proc_fleet(16, matches_per_shard=1, seed=9,
+                               backend="tcp", tuning=TUNING,
+                               desync_interval=0)
+        try:
+            text = prometheus_text(ctx["sup"].merged_registry())
+        finally:
+            ctx["sup"].close()
+        assert validate_exposition(text) == []
+        assert any(ln.startswith("ggrs_fleet_link_")
+                   for ln in text.splitlines())
 
     def test_perfetto_export_nests_runner_crossing_in_fleet_tick(
             self, traced_proc_fleet):

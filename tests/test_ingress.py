@@ -45,6 +45,12 @@ from ggrs_tpu.fleet.ingress import (
 )
 from ggrs_tpu.net.wire import WireError
 from ggrs_tpu.obs import Registry
+from ggrs_tpu.obs.timeline import (
+    ZERO_TRACE_CTX,
+    match_trace_id,
+    pack_trace_ctx,
+    unpack_trace_ctx,
+)
 
 
 # ----------------------------------------------------------------------
@@ -56,16 +62,29 @@ class TestRouteUpdateCodec:
     def test_round_trip_put(self):
         data = encode_route_update(
             ROUTE_OP_PUT, 3, 17, 9, ("127.0.0.1", 40001))
-        assert len(data) == ROUTE_UPDATE.size == 28
-        op, epoch, version, vport, dst = decode_route_update(data)
+        assert len(data) == ROUTE_UPDATE.size == 44
+        op, epoch, version, vport, dst, ctx = decode_route_update(data)
         assert (op, epoch, version, vport) == (ROUTE_OP_PUT, 3, 17, 9)
         assert dst == ("127.0.0.1", 40001)
+        assert ctx == ZERO_TRACE_CTX  # no causal stamp by default
 
     def test_round_trip_del(self):
         data = encode_route_update(
             ROUTE_OP_DEL, 1, 2, 5, ("10.0.0.7", 0))
-        op, epoch, version, vport, dst = decode_route_update(data)
+        op, epoch, version, vport, dst, _ = decode_route_update(data)
         assert op == ROUTE_OP_DEL and dst == ("10.0.0.7", 0)
+
+    def test_trace_ctx_rides_the_frame(self):
+        # §28: the 16-byte trace context survives the wire round trip
+        # and carries the match's stable trace hash + epoch + span
+        ctx = pack_trace_ctx("m7", 3, 12)
+        data = encode_route_update(
+            ROUTE_OP_PUT, 3, 18, 9, ("127.0.0.1", 40001), ctx)
+        *_, got = decode_route_update(data)
+        assert got == ctx
+        trace, epoch, span = unpack_trace_ctx(got)
+        assert trace == match_trace_id("m7")
+        assert (epoch, span) == (3, 12)
 
     def test_short_frame_refused(self):
         with pytest.raises(WireError, match="bytes"):

@@ -563,11 +563,11 @@ class TestTcpHandshakeSkew:
 ING_GOOD = """\
 import struct
 FWD_VERSION = 1
-ROUTE_WIRE_VERSION = 1
+ROUTE_WIRE_VERSION = 2
 ROUTE_OP_PUT = 1
 ROUTE_OP_DEL = 2
 FWD_HEADER = struct.Struct("<2sBBHH4s")
-ROUTE_UPDATE = struct.Struct("<2sBBQQHH4s")
+ROUTE_UPDATE = struct.Struct("<2sBBQQHH4s16s")
 """
 
 
@@ -588,7 +588,7 @@ class TestIngressWireSkew:
         # shrinking the route epoch from u64 to u32 must fire: a
         # truncated epoch is exactly the fence-defeating skew that
         # would let a stale supervisor's route write wrap around
-        bad = ING_GOOD.replace('"<2sBBQQHH4s"', '"<2sBBIQHH4s"')
+        bad = ING_GOOD.replace('"<2sBBQQHH4s16s"', '"<2sBBIQHH4s16s"')
         findings = self._check(self._tree(tmp_path, bad))
         assert any(
             f.rule == "layout/ingress-wire" and "route-update" in f.detail
@@ -606,7 +606,7 @@ class TestIngressWireSkew:
         )
 
     def test_unversioned_route_frame_fires(self, tmp_path):
-        bad = ING_GOOD.replace("ROUTE_WIRE_VERSION = 1\n", "")
+        bad = ING_GOOD.replace("ROUTE_WIRE_VERSION = 2\n", "")
         findings = self._check(self._tree(tmp_path, bad))
         assert any("ROUTE_WIRE_VERSION" in f.detail for f in findings)
 
@@ -622,13 +622,91 @@ class TestIngressWireSkew:
             ING_FENCE_BYTES,
             ING_FWD_FMT,
             ING_ROUTE_FMT,
+            TRACE_CTX_BYTES,
         )
         from ggrs_tpu.fleet import ingress
 
         assert ingress.FWD_HEADER.format == ING_FWD_FMT
         assert ingress.ROUTE_UPDATE.format == ING_ROUTE_FMT
         assert (ingress.ROUTE_UPDATE.size
-                == ingress.FWD_HEADER.size + ING_FENCE_BYTES)
+                == ingress.FWD_HEADER.size + ING_FENCE_BYTES
+                + TRACE_CTX_BYTES)
+
+
+# ----------------------------------------------------------------------
+# §28 trace-context contract: timeline.py owns the 16-byte context,
+# transport.py mirrors it as a literal, the route frame tails it —
+# deliberate-skew fixtures prove the checker catches each drifting alone
+# ----------------------------------------------------------------------
+
+TC_TL_GOOD = """\
+import struct
+TRACE_CTX_FMT = "<QII"
+TRACE_CTX = struct.Struct("<QII")
+TRACE_CTX_BYTES = 16
+"""
+
+TC_TP_GOOD = """\
+import struct
+TRACE_CTX_BYTES = 16
+_TRACE = struct.Struct("<QII")
+"""
+
+
+class TestTraceContextSkew:
+    def _tree(self, tmp_path, tl_text=TC_TL_GOOD, tp_text=TC_TP_GOOD):
+        (tmp_path / "ggrs_tpu/obs").mkdir(parents=True)
+        (tmp_path / "ggrs_tpu/fleet").mkdir(parents=True)
+        (tmp_path / "ggrs_tpu/obs/timeline.py").write_text(tl_text)
+        (tmp_path / "ggrs_tpu/fleet/transport.py").write_text(tp_text)
+        return tmp_path
+
+    def _check(self, root):
+        from ggrs_tpu.analysis.layout import _check_trace_context
+        return _check_trace_context(root)
+
+    def test_clean_fixture_passes(self, tmp_path):
+        assert self._check(self._tree(tmp_path)) == []
+
+    def test_timeline_fmt_drift_fires(self, tmp_path):
+        # shrinking the span word breaks every already-written 16-byte
+        # tail on the wire — the owner drifting is the worst skew
+        bad = TC_TL_GOOD.replace('"<QII"', '"<QIH"')
+        findings = self._check(self._tree(tmp_path, tl_text=bad))
+        assert any(
+            f.rule == "layout/trace-context"
+            and f.path == "ggrs_tpu/obs/timeline.py"
+            for f in findings
+        )
+
+    def test_transport_mirror_drift_fires(self, tmp_path):
+        # transport.py mirrors the struct as a literal (it cannot import
+        # the obs plane into the runner hot path); a drifted mirror
+        # corrupts every RPC-carried context
+        bad = TP_GOOD.replace('"<QII"', '"<QQ"')
+        findings = self._check(self._tree(tmp_path, tp_text=bad))
+        assert any(
+            f.rule == "layout/trace-context"
+            and f.path == "ggrs_tpu/fleet/transport.py"
+            for f in findings
+        )
+
+    def test_byte_count_drift_fires(self, tmp_path):
+        bad = TC_TL_GOOD.replace("TRACE_CTX_BYTES = 16", "TRACE_CTX_BYTES = 12")
+        findings = self._check(self._tree(tmp_path, tl_text=bad))
+        assert any("TRACE_CTX_BYTES" in f.detail for f in findings)
+
+    def test_contract_matches_live_structs(self):
+        from ggrs_tpu.analysis import layout
+        from ggrs_tpu.fleet import transport
+        from ggrs_tpu.obs import timeline
+
+        assert timeline.TRACE_CTX.format == layout.TRACE_CTX_FMT
+        assert timeline.TRACE_CTX_BYTES == layout.TRACE_CTX_BYTES == 16
+        assert timeline.TRACE_CTX.size == timeline.TRACE_CTX_BYTES
+        assert transport.TRACE_CTX_BYTES == layout.TRACE_CTX_BYTES
+        assert layout.ING_ROUTE_FMT.endswith(
+            f"{layout.TRACE_CTX_BYTES}s")
 
 
 VARREC_GOOD = """\
